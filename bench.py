@@ -1,0 +1,50 @@
+"""Driver benchmark: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.md north star): EC encode throughput at k=8, m=3 on
+4 MiB objects — the ``ceph_erasure_code_benchmark plugin=isa k=8 m=3``
+configuration. ``vs_baseline`` compares against 7.5 GiB/s, the midpoint of
+the ISA-L single-core estimate recorded in BASELINE.md (the reference
+publishes no numbers in-repo).
+
+Runs on whatever platform is live (the driver provides one real TPU chip).
+"""
+
+import json
+import os
+import sys
+import time
+
+BASELINE_GIBS = 7.5  # ISA-L RS k=8,m=3 single-core (BASELINE.md external row)
+
+
+def main() -> None:
+    from ceph_tpu.bench.ec_benchmark import ErasureCodeBench, parse_args
+
+    backend = os.environ.get("CEPH_TPU_BENCH_BACKEND", "bitmatmul")
+    iters = int(os.environ.get("CEPH_TPU_BENCH_ITERS", "1024"))
+    args = parse_args([
+        "--plugin", "jax", "--workload", "encode",
+        "--size", str(4 << 20), "--iterations", str(iters),
+        "--parameter", "k=8", "--parameter", "m=3",
+        "--parameter", f"backend={backend}",
+        "--parameter", "technique=reed_sol_van",
+    ])
+    bench = ErasureCodeBench(args)
+    res = bench.run()
+    print(json.dumps({
+        "metric": "ec_encode_k8m3_4MiB",
+        "value": round(res["GiB/s"], 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(res["GiB/s"] / BASELINE_GIBS, 3),
+        "detail": {
+            "seconds": round(res["seconds"], 4),
+            "iterations": res["iterations"],
+            "batch": res["batch"],
+            "backend": res["backend"],
+            "platform": res["platform"],
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""ceph_tpu — a TPU-native framework with the storage-math capabilities of Ceph.
+
+A from-scratch JAX/XLA design (NOT a port) of Ceph's placement and durability
+core:
+
+- ``ceph_tpu.gf``      GF(2^8) arithmetic as bit-plane linear algebra (MXU path)
+                       and nibble-table lookups (VPU path).
+- ``ceph_tpu.ec``      Reed-Solomon erasure coding behind the reference's
+                       ``ErasureCodeInterface`` contract
+                       (ref: src/erasure-code/ErasureCodeInterface.h), plugin
+                       registry, profiles.
+- ``ceph_tpu.crush``   Vectorized CRUSH: rjenkins hash, straw2 draws via the
+                       fixed-point crush_ln LUTs, rule VM
+                       (ref: src/crush/mapper.c:crush_do_rule).
+- ``ceph_tpu.osdmap``  OSDMap-lite: pg_t -> pps -> up/acting OSD sets with
+                       upmap / primary-affinity / pg_temp post-processing
+                       (ref: src/osd/OSDMap.cc:pg_to_up_acting_osds).
+- ``ceph_tpu.parallel`` Mesh / shard_map scale-out over ICI+DCN.
+- ``ceph_tpu.bench``   CLIs mirroring ceph_erasure_code_benchmark and
+                       crushtool --test.
+- ``ceph_tpu.sim``     Map-churn rebalance simulator.
+- ``ceph_tpu.models``  Flagship end-to-end pipelines (placement, durability).
+- ``ceph_tpu.ops``     Low-level JAX/Pallas kernels shared by the above.
+- ``ceph_tpu.utils``   Layered config, subsystem-gated logging, perf counters.
+
+All citations of the form ``src/...`` refer to the reference tree layout
+documented in SURVEY.md (the mount at /root/reference was empty; anchors are
+path:Symbol, unverified — see SURVEY.md provenance warning).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Benchmark harnesses mirroring the reference's tools.
+
+- ``ec_benchmark``: flag-compatible with ceph_erasure_code_benchmark
+  (ref: src/test/erasure-code/ceph_erasure_code_benchmark.cc).
+- ``crush_tester`` / crushtool CLI: the ``crushtool --test`` engine
+  (ref: src/crush/CrushTester.cc, src/tools/crushtool.cc).
+"""

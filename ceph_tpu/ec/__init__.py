@@ -1,0 +1,14 @@
+"""Erasure coding behind the reference's ErasureCodeInterface contract.
+
+(ref: src/erasure-code/ErasureCodeInterface.h; src/erasure-code/ErasureCode.cc
+base class; src/erasure-code/ErasureCodePlugin.cc registry.)
+
+The compute path is JAX on TPU (``plugin=jax``); profiles use the reference's
+``plugin=... technique=... k=... m=...`` key=value syntax so benchmark
+invocations carry over verbatim.
+"""
+
+from ceph_tpu.ec.interface import ErasureCodeInterface, ErasureCodeProfile
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry, factory
+from ceph_tpu.ec.jax_plugin import ErasureCodeJax
+from ceph_tpu.ec import matrix

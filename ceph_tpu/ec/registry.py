@@ -1,0 +1,78 @@
+"""Erasure-code plugin registry.
+
+Mirrors the reference's dlopen-based registry semantics
+(ref: src/erasure-code/ErasureCodePlugin.cc ErasureCodePluginRegistry:
+singleton, ``factory(plugin_name, profile) -> ErasureCodeInterfaceRef``,
+load-once caching) with in-process registration instead of dlopen.
+
+``jerasure`` and ``isa`` are registered as compatibility aliases resolving to
+the JAX backend with the matching default technique, so reference benchmark
+invocations (``--plugin jerasure``) run unmodified.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping
+
+from ceph_tpu.ec.interface import ErasureCodeInterface, ErasureCodeProfile
+from ceph_tpu.ec.jax_plugin import ErasureCodeJax
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("ec")
+
+
+class ErasureCodePluginRegistry:
+    _instance: "ErasureCodePluginRegistry | None" = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._plugins: dict[str, Callable[[], ErasureCodeInterface]] = {}
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+                cls._instance._register_builtins()
+            return cls._instance
+
+    def _register_builtins(self) -> None:
+        self.add("jax", ErasureCodeJax)
+        # Compatibility aliases: same techniques, same parity bytes.
+        self.add("jerasure", ErasureCodeJax)
+        self.add("isa", ErasureCodeJax)
+
+    def add(self, name: str,
+            ctor: Callable[[], ErasureCodeInterface]) -> None:
+        """ref: ErasureCodePluginRegistry::add."""
+        with self._lock:
+            self._plugins[name] = ctor
+
+    def load(self, name: str) -> Callable[[], ErasureCodeInterface]:
+        """ref: ErasureCodePluginRegistry::load (dlopen analog)."""
+        with self._lock:
+            if name not in self._plugins:
+                raise KeyError(
+                    f"erasure-code plugin {name!r} not found; "
+                    f"registered: {sorted(self._plugins)}")
+            return self._plugins[name]
+
+    def factory(self, name: str,
+                profile: Mapping[str, str] | str) -> ErasureCodeInterface:
+        """ref: ErasureCodePluginRegistry::factory."""
+        ctor = self.load(name)
+        prof = ErasureCodeProfile.parse(profile)
+        prof.setdefault("plugin", name)
+        ec = ctor()
+        ec.init(prof)
+        log.dout(5, "factory", plugin=name, profile=str(prof))
+        return ec
+
+
+def factory(profile: Mapping[str, str] | str) -> ErasureCodeInterface:
+    """Build an EC backend from a profile carrying ``plugin=...``."""
+    prof = ErasureCodeProfile.parse(profile)
+    name = prof.get("plugin", "jax")
+    return ErasureCodePluginRegistry.instance().factory(name, prof)

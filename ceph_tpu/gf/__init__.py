@@ -1,0 +1,40 @@
+"""GF(2^8) arithmetic as TPU-friendly linear algebra.
+
+The whole erasure-code stack reduces to GF(2^8) matrix-vector products over
+byte streams (ref: src/erasure-code/jerasure vendored gf-complete; the ISA-L
+plugin's ec_encode_data hot loop). Two TPU formulations:
+
+- **bitmatmul (MXU)**: multiplication by a constant c in GF(2^8) is linear
+  over GF(2), so c is an 8x8 bit-matrix and an (m x k) GF coding matrix
+  expands to an (8m x 8k) 0/1 matrix B.  RS encode of k chunks becomes
+  ``pack_bits((B @ unpack_bits(data)) mod 2)`` — an int8 matmul landing on
+  the systolic array, XOR-accumulate realized as int32 accumulate + mod 2.
+
+- **lut (VPU)**: the ISA-L PSHUFB trick — split each byte into nibbles and
+  look each up in per-coefficient 16-entry product tables, XOR the halves
+  (ref: src/isa-l ec_encode_data vpshufb kernels). On TPU this is gathers +
+  elementwise XOR on the vector unit; no matmul involved.
+
+Both are bit-exact against the pure-numpy oracle in ``tables.py``.
+"""
+
+from ceph_tpu.gf.tables import (
+    GF_POLY,
+    gf_mul,
+    gf_div,
+    gf_inv,
+    gf_pow,
+    gf_mul_np,
+    gf_matmul_np,
+    gf_matinv_np,
+    coeff_bitmatrix,
+    expand_bitmatrix,
+    nibble_tables,
+)
+from ceph_tpu.gf.ops import (
+    unpack_bits,
+    pack_bits,
+    gf_matmul_bitplanes,
+    gf_matmul_lut,
+    gf_matmul_bytes,
+)

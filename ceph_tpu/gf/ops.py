@@ -1,0 +1,114 @@
+"""JAX kernels for GF(2^8) linear algebra over byte streams.
+
+These are the hot loops of the erasure-code path — the TPU-native replacement
+for ISA-L's ``ec_encode_data`` AVX kernels and jerasure's region ops
+(ref: src/erasure-code/isa/ErasureCodeIsa.cc isa_encode;
+src/erasure-code/jerasure/ErasureCodeJerasure.cc jerasure_encode).
+
+Layouts: byte payloads are (k, L) uint8 — k chunks of L bytes, L = lane
+dimension (chunk bytes, possibly batch*chunk flattened). All kernels are pure
+and jit/vmap/shard_map-safe; matrix/table operands are small per-profile
+constants built host-side in ``tables.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.gf import tables
+
+
+def xor_reduce(x: jax.Array, axis: int) -> jax.Array:
+    """XOR-accumulate along an axis (GF(2^8) addition)."""
+    return jax.lax.reduce(x, np.array(0, dtype=x.dtype),
+                          jax.lax.bitwise_xor, (axis,))
+
+
+def unpack_bits(data: jax.Array) -> jax.Array:
+    """(k, L) uint8 -> (8k, L) int8 bit-planes, LSB-first within each byte.
+
+    Row ordering matches tables.expand_bitmatrix: chunk i's bits occupy rows
+    [8i, 8i+8), bit j (value 2^j) at row 8i+j.
+    """
+    k, L = data.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (data[:, None, :] >> shifts[None, :, None]) & jnp.uint8(1)
+    return bits.reshape(8 * k, L).astype(jnp.int8)
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """(8m, L) 0/1 -> (m, L) uint8, inverse of unpack_bits."""
+    m8, L = bits.shape
+    m = m8 // 8
+    b = bits.reshape(m, 8, L).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(b * weights[None, :, None], axis=1, dtype=jnp.uint8)
+
+
+def gf_matmul_bitplanes(bitmatrix: jax.Array, data: jax.Array) -> jax.Array:
+    """GF(2^8) coding-matrix product via the MXU.
+
+    bitmatrix: (8m, 8k) 0/1 int8 (tables.expand_bitmatrix of the GF matrix).
+    data:      (k, L) uint8.
+    returns    (m, L) uint8 — XOR-accumulated GF products.
+
+    GF(2^8) multiply-accumulate is GF(2)-linear, so the whole coding matrix is
+    one binary matmul: int8 x int8 -> int32 accumulate on the systolic array,
+    XOR realized as the low bit of the integer sum.
+    """
+    bits = unpack_bits(data)                                # (8k, L) int8
+    acc = jax.lax.dot_general(
+        bitmatrix.astype(jnp.int8), bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)                   # (8m, L)
+    return pack_bits(acc & 1)
+
+
+def gf_matmul_lut(lo: jax.Array, hi: jax.Array, data: jax.Array) -> jax.Array:
+    """GF(2^8) coding-matrix product via nibble product tables (VPU path).
+
+    lo, hi: (m, k, 16) uint8 from tables.nibble_tables.
+    data:   (k, L) uint8.
+    returns (m, L) uint8.
+    """
+    low = (data & 15).astype(jnp.int32)                     # (k, L)
+    high = (data >> 4).astype(jnp.int32)
+    prod = (jnp.take_along_axis(lo, low[None], axis=2) ^
+            jnp.take_along_axis(hi, high[None], axis=2))    # (m, k, L)
+    return xor_reduce(prod, axis=1)
+
+
+def gf_matmul_bytes(matrix: jax.Array, data: jax.Array) -> jax.Array:
+    """Reference JAX path: full 256x256 product table gathers.
+
+    matrix: (m, k) uint8 GF coefficients; data: (k, L) uint8.
+    Slow (64 KiB gather per element) — used for testing/validation only.
+    """
+    table = jnp.asarray(tables.mul_table().reshape(-1))
+    idx = matrix[:, :, None].astype(jnp.int32) * 256 + data[None].astype(jnp.int32)
+    return xor_reduce(jnp.take(table, idx), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("backend",))
+def encode_stripes(bitmatrix: jax.Array, lo: jax.Array, hi: jax.Array,
+                   data: jax.Array, backend: str = "bitmatmul") -> jax.Array:
+    """Batched stripe encode: data (batch, k, C) uint8 -> (batch, m, C).
+
+    The stripe batch is the data-parallel axis (SURVEY.md §2.5): every stripe
+    is independent, so batching — not tensor-splitting the tiny coding matrix
+    — is how this fills the MXU.
+    """
+    b, k, C = data.shape
+    flat = jnp.transpose(data, (1, 0, 2)).reshape(k, b * C)
+    if backend == "bitmatmul":
+        out = gf_matmul_bitplanes(bitmatrix, flat)
+    elif backend == "lut":
+        out = gf_matmul_lut(lo, hi, flat)
+    else:
+        raise ValueError(f"unknown gf backend {backend!r}")
+    m = out.shape[0]
+    return jnp.transpose(out.reshape(m, b, C), (1, 0, 2))

@@ -1,0 +1,14 @@
+"""Scale-out: device meshes and sharded pipelines.
+
+The TPU-native replacement for the reference's cluster-parallel structure
+(SURVEY.md §2.5): PG-sharding and EC fan-out become data-parallel axes of a
+``jax.sharding.Mesh``; the messenger's primary->shard fan-out sub-ops become
+XLA collectives over ICI; multi-host (DCN) rides the same shardings via
+``jax.distributed``.
+"""
+
+from ceph_tpu.parallel.mesh import make_mesh, local_mesh
+from ceph_tpu.parallel.sharded import (
+    sharded_encode,
+    sharded_decode,
+)

@@ -1,0 +1,45 @@
+"""Mesh construction helpers.
+
+One logical axis family:
+
+- ``shard``: the data-parallel axis — stripes for EC, PG-id blocks for CRUSH.
+  This is where Ceph's "every PG / every stripe is independent" parallelism
+  (SURVEY.md §2.5) lands on the hardware: batches split over ICI.
+
+A second axis (``lane``) can split the byte/lane dimension of very large
+chunks across devices (the sequence-parallel slot, SURVEY.md §5.7) — EC
+chunks are embarrassingly parallel along bytes, so this is a pure reshape,
+no collectives on the forward path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(devices=None, axes: tuple[str, ...] = ("shard",),
+              shape: tuple[int, ...] | None = None) -> Mesh:
+    """Build a Mesh over `devices` (default: all) with named `axes`.
+
+    shape defaults to putting every device on the first axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axes) - 1)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def local_mesh(n: int | None = None) -> Mesh:
+    """A 1-D ('shard',) mesh over the first n local devices."""
+    devices = jax.devices()
+    if n is not None:
+        devices = devices[:n]
+    return make_mesh(devices)

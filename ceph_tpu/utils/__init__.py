@@ -1,0 +1,12 @@
+"""Common runtime: config, logging, perf counters.
+
+TPU-native analog of Ceph's common runtime layer (ref: src/common/config.h
+ConfigProxy, src/common/perf_counters.h, src/log/Log.cc) — one typed, layered
+config schema instead of ~2000 YAML options, subsystem-gated structured
+logging instead of dout(), and in-process counters dumped as JSON instead of
+an admin socket.
+"""
+
+from ceph_tpu.utils.config import Config, ConfigProxy, Option, OPTIONS
+from ceph_tpu.utils.logging import get_logger, set_subsys_level
+from ceph_tpu.utils.perf_counters import PerfCounters, PerfCountersBuilder

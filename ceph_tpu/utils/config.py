@@ -1,0 +1,183 @@
+"""Layered typed configuration.
+
+TPU-native analog of Ceph's option system (ref: src/common/options/*.yaml.in
+-> Option structs; src/common/config.h md_config_t/ConfigProxy). Ceph resolves
+each option through layered precedence:
+
+    compiled default < conf file < mon config db < env < cli < runtime override
+
+We keep the same precedence semantics with explicit named layers, a typed
+``Option`` declaration table, and change-notification observers
+(ref: src/common/config_obs.h md_config_obs_t). Option names keep their Ceph
+spellings where an analog exists (``erasure_code_dir``,
+``osd_pool_default_*``) for operator familiarity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+# Layer precedence, low to high (ref: src/common/config.h CONF_DEFAULT..CONF_OVERRIDE).
+LAYERS = ("default", "file", "mon", "env", "cmdline", "override")
+
+
+@dataclass(frozen=True)
+class Option:
+    """One declared option (ref: src/common/options.h Option)."""
+
+    name: str
+    type: type  # int, float, str, bool
+    default: Any
+    doc: str = ""
+    min: Any = None
+    max: Any = None
+    enum_allowed: tuple = ()
+    runtime: bool = True  # may be changed after startup (flags: [runtime])
+
+    def validate(self, value: Any) -> Any:
+        if self.type is bool and isinstance(value, str):
+            value = value.lower() in ("1", "true", "yes", "on")
+        try:
+            value = self.type(value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"option {self.name}: cannot coerce {value!r} to "
+                             f"{self.type.__name__}") from e
+        if self.enum_allowed and value not in self.enum_allowed:
+            raise ValueError(f"option {self.name}: {value!r} not in "
+                             f"{self.enum_allowed}")
+        if self.min is not None and value < self.min:
+            raise ValueError(f"option {self.name}: {value!r} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ValueError(f"option {self.name}: {value!r} > max {self.max}")
+        return value
+
+
+# The option schema. Names mirror Ceph's where analogous
+# (ref: src/common/options/global.yaml.in, osd.yaml.in).
+OPTIONS: dict[str, Option] = {o.name: o for o in [
+    Option("erasure_code_dir", str, "",
+           "directory for out-of-tree EC plugin shims (dlopen analog)"),
+    Option("osd_pool_default_size", int, 3, "replica count", min=1),
+    Option("osd_pool_default_min_size", int, 0, "min replicas to serve IO"),
+    Option("osd_pool_default_pg_num", int, 32, "default pg_num", min=1),
+    Option("osd_pool_default_crush_rule", int, -1, "default crush rule id"),
+    Option("osd_pool_default_erasure_code_profile", str,
+           "plugin=jax technique=reed_sol_van k=2 m=2",
+           "default EC profile"),
+    Option("mon_max_pg_per_osd", int, 250, "pg-per-osd health limit"),
+    # CRUSH tunables defaults (jewel profile; ref: src/crush/CrushWrapper.h
+    # set_tunables_jewel).
+    Option("crush_choose_total_tries", int, 50, "descent retry budget"),
+    Option("crush_choose_local_tries", int, 0, "local retries (legacy)"),
+    Option("crush_choose_local_fallback_tries", int, 0,
+           "local fallback retries (legacy)"),
+    Option("crush_chooseleaf_descend_once", int, 1, "retry descent not leaf"),
+    Option("crush_chooseleaf_vary_r", int, 1, "vary r on leaf recursion"),
+    Option("crush_chooseleaf_stable", int, 1, "stable leaf mapping"),
+    # TPU execution knobs (no Ceph analog).
+    Option("tpu_ec_backend", str, "auto",
+           "GF kernel: bitmatmul (MXU) | lut (VPU) | auto",
+           enum_allowed=("bitmatmul", "lut", "auto")),
+    Option("tpu_block_bytes", int, 1 << 20,
+           "per-step chunk-bytes tile for streaming encodes", min=4096),
+    Option("tpu_mesh_axes", str, "batch", "mesh axis names, comma-separated"),
+    Option("debug_default_level", int, 0, "default log gate level"),
+]}
+
+
+class Config:
+    """Layered option store with observer notification."""
+
+    def __init__(self, options: dict[str, Option] | None = None):
+        self._options = dict(options or OPTIONS)
+        self._layers: dict[str, dict[str, Any]] = {name: {} for name in LAYERS}
+        self._observers: list[Callable[[str, Any], None]] = []
+
+    # -- declaration ------------------------------------------------------
+    def declare(self, option: Option) -> None:
+        self._options[option.name] = option
+
+    # -- resolution -------------------------------------------------------
+    def get(self, name: str) -> Any:
+        opt = self._options[name]
+        for layer in reversed(LAYERS):
+            if name in self._layers[layer]:
+                return self._layers[layer][name]
+        return opt.default
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any, layer: str = "override") -> None:
+        if layer not in self._layers:
+            raise KeyError(f"unknown config layer {layer!r}")
+        opt = self._options.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        value = opt.validate(value)
+        old = self.get(name)
+        self._layers[layer][name] = value
+        if self.get(name) != old:
+            for obs in self._observers:
+                obs(name, self.get(name))
+
+    def rm(self, name: str, layer: str) -> None:
+        old = self.get(name)
+        self._layers[layer].pop(name, None)
+        new = self.get(name)
+        if new != old:
+            for obs in self._observers:
+                obs(name, new)
+
+    # -- bulk ingestion ---------------------------------------------------
+    def load_file(self, path: str) -> None:
+        """Load a JSON conf file into the 'file' layer."""
+        with open(path) as f:
+            for k, v in json.load(f).items():
+                self.set(k, v, layer="file")
+
+    def load_env(self, prefix: str = "CEPH_TPU_") -> None:
+        for k, v in os.environ.items():
+            if k.startswith(prefix):
+                name = k[len(prefix):].lower()
+                if name in self._options:
+                    self.set(name, v, layer="env")
+
+    def apply_cmdline(self, pairs: Iterable[str]) -> None:
+        """Apply ``name=value`` strings (the benchmark CLI --parameter style)."""
+        for pair in pairs:
+            name, _, value = pair.partition("=")
+            self.set(name.strip(), value.strip(), layer="cmdline")
+
+    # -- observation ------------------------------------------------------
+    def add_observer(self, fn: Callable[[str, Any], None]) -> None:
+        self._observers.append(fn)
+
+    def show(self) -> dict[str, Any]:
+        return {name: self.get(name) for name in sorted(self._options)}
+
+
+@dataclass
+class ConfigProxy:
+    """Process-wide config handle (ref: src/common/config_proxy.h)."""
+
+    config: Config = field(default_factory=Config)
+
+    def __getattr__(self, name):
+        return getattr(self.config, name)
+
+
+_global: Config | None = None
+
+
+def global_config() -> Config:
+    """The per-process config (ref: src/common/ceph_context.h CephContext)."""
+    global _global
+    if _global is None:
+        cfg = Config()
+        cfg.load_env()  # raises on malformed CEPH_TPU_* before caching
+        _global = cfg
+    return _global

@@ -1,0 +1,133 @@
+"""In-process typed perf counters.
+
+TPU-native analog of Ceph's PerfCounters (ref: src/common/perf_counters.h
+PerfCountersBuilder / PerfCounters). Same counter taxonomy — u64 counters,
+time sums, and (count, sum) averages — registered through a builder and dumped
+as JSON, standing in for ``ceph daemon <id> perf dump`` over the admin socket
+(ref: src/common/admin_socket.cc). Histograms use fixed log2 buckets.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict
+
+TYPE_U64 = "u64"          # PERFCOUNTER_U64
+TYPE_TIME = "time"        # PERFCOUNTER_TIME
+TYPE_LONGRUNAVG = "avg"   # PERFCOUNTER_LONGRUNAVG
+TYPE_HISTOGRAM = "hist"   # PERFCOUNTER_HISTOGRAM
+
+
+@dataclass
+class _Counter:
+    type: str
+    doc: str = ""
+    value: float = 0
+    count: int = 0
+    sum: float = 0.0
+    buckets: list = field(default_factory=lambda: [0] * 64)
+
+
+class PerfCounters:
+    def __init__(self, name: str):
+        self.name = name
+        self._counters: Dict[str, _Counter] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, key: str, expected: str) -> _Counter:
+        c = self._counters[key]
+        if c.type != expected:
+            raise TypeError(f"counter {key} is {c.type}, not {expected}")
+        return c
+
+    def inc(self, key: str, amount: int = 1) -> None:
+        with self._lock:
+            self._get(key, TYPE_U64).value += amount
+
+    def set(self, key: str, value: float) -> None:
+        with self._lock:
+            self._get(key, TYPE_U64).value = value
+
+    def tinc(self, key: str, seconds: float) -> None:
+        with self._lock:
+            self._get(key, TYPE_TIME).value += seconds
+
+    def avg_add(self, key: str, value: float) -> None:
+        with self._lock:
+            c = self._get(key, TYPE_LONGRUNAVG)
+            c.count += 1
+            c.sum += value
+
+    def hist_add(self, key: str, value: float) -> None:
+        with self._lock:
+            c = self._get(key, TYPE_HISTOGRAM)
+            bucket = min(63, max(0, int(value).bit_length()))
+            c.buckets[bucket] += 1
+            c.count += 1
+            c.sum += value
+
+    class _Timer:
+        def __init__(self, pc: "PerfCounters", key: str):
+            self.pc, self.key = pc, key
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.pc.tinc(self.key, time.perf_counter() - self.t0)
+
+    def timer(self, key: str) -> "_Timer":
+        return self._Timer(self, key)
+
+    def dump(self) -> dict:
+        """``perf dump`` analog."""
+        with self._lock:
+            out = {}
+            for key, c in self._counters.items():
+                if c.type == TYPE_U64:
+                    out[key] = int(c.value)
+                elif c.type == TYPE_TIME:
+                    out[key] = c.value
+                elif c.type == TYPE_LONGRUNAVG:
+                    out[key] = {"avgcount": c.count, "sum": c.sum}
+                else:
+                    out[key] = {"count": c.count, "sum": c.sum,
+                                "log2_buckets": [b for b in c.buckets]}
+            return out
+
+    def dump_json(self) -> str:
+        return json.dumps({self.name: self.dump()}, indent=2)
+
+
+class PerfCountersBuilder:
+    """ref: src/common/perf_counters.h PerfCountersBuilder."""
+
+    def __init__(self, name: str):
+        self._pc = PerfCounters(name)
+
+    def add_u64_counter(self, key: str, doc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[key] = _Counter(TYPE_U64, doc)
+        return self
+
+    def add_u64(self, key: str, doc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[key] = _Counter(TYPE_U64, doc)
+        return self
+
+    def add_time(self, key: str, doc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[key] = _Counter(TYPE_TIME, doc)
+        return self
+
+    def add_time_avg(self, key: str, doc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[key] = _Counter(TYPE_LONGRUNAVG, doc)
+        return self
+
+    def add_histogram(self, key: str, doc: str = "") -> "PerfCountersBuilder":
+        self._pc._counters[key] = _Counter(TYPE_HISTOGRAM, doc)
+        return self
+
+    def create_perf_counters(self) -> PerfCounters:
+        return self._pc

@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is unavailable in CI; sharding tests run on XLA's
+host-platform device virtualization (the same mechanism the driver's
+dryrun_multichip uses).
+
+This environment registers a remote-TPU ('axon') PJRT backend from
+sitecustomize and forces ``jax_platforms=axon,cpu`` via jax.config — env vars
+alone cannot override it, and initializing the axon backend dials a remote
+claim that can block for minutes. Tests are CPU-only, so we reset the config
+knob before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,48 @@
+"""Benchmark CLI tests (small sizes; validates flags + accounting)."""
+
+import json
+
+from ceph_tpu.bench.ec_benchmark import ErasureCodeBench, parse_args
+
+
+def _run(argv):
+    return ErasureCodeBench(parse_args(argv)).run()
+
+
+def test_encode_flags_and_accounting():
+    res = _run(["--plugin", "jax", "--workload", "encode",
+                "--size", "16384", "--iterations", "4",
+                "--parameter", "k=4", "--parameter", "m=2"])
+    assert res["k"] == 4 and res["m"] == 2
+    assert res["chunk_size"] == 4096
+    assert res["total_bytes"] == res["iterations"] * 4 * 4096
+    assert res["GiB/s"] > 0
+
+
+def test_decode_workload_with_erasures():
+    res = _run(["--plugin", "jerasure", "--workload", "decode",
+                "--size", "16384", "--iterations", "2",
+                "--parameter", "k=4", "--parameter", "m=2",
+                "--erasures", "2"])
+    assert res["workload"] == "decode"
+    assert res["erased"] == [0, 1]
+
+
+def test_explicit_erased_chunks():
+    res = _run(["--workload", "decode", "--size", "8192",
+                "--iterations", "1", "--parameter", "k=2",
+                "--parameter", "m=2", "--erased", "1", "--erased", "2"])
+    assert res["erased"] == [1, 2]
+
+
+def test_json_output_parses(capsys):
+    from ceph_tpu.bench import ec_benchmark
+    ec_benchmark.main(["--size", "8192", "--iterations", "1",
+                       "--parameter", "k=2", "--parameter", "m=1",
+                       "--json"])
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    secs, mbs = lines[0].split("\t")
+    float(secs), float(mbs)
+    detail = json.loads(lines[1])
+    assert detail["plugin"] == "jax"

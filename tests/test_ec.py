@@ -1,0 +1,150 @@
+"""Erasure-code tests.
+
+Mirrors the reference's plugin test pattern: build profile -> factory() ->
+encode known buffer -> erase chunks -> minimum_to_decode -> decode ->
+byte-compare (ref: src/test/erasure-code/TestErasureCodeJerasure.cc,
+TestErasureCodePlugin.cc).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import ErasureCodeProfile, factory, matrix as rs
+from ceph_tpu.gf import gf_matmul_np, gf_matinv_np
+
+
+class TestMatrices:
+    @pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy_orig",
+                                           "cauchy_good"])
+    @pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (8, 3), (10, 4)])
+    def test_mds_property(self, technique, k, m):
+        """Every k-subset of generator rows must be invertible (MDS)."""
+        g = rs.generator_matrix(technique, k, m)
+        rows = list(range(k + m))
+        subsets = list(itertools.combinations(rows, k))
+        if len(subsets) > 300:
+            rng = np.random.default_rng(7)
+            subsets = [tuple(sorted(rng.choice(rows, size=k, replace=False)))
+                       for _ in range(300)]
+        for sub in subsets:
+            gf_matinv_np(g[list(sub)])  # raises if singular
+
+    def test_vandermonde_systematic_and_ones(self):
+        m = rs.reed_sol_van(8, 3)
+        # Construction invariants of the published jerasure algorithm:
+        # parity row 0 is all ones, and column 0 of every parity row is one.
+        assert np.all(m[0] == 1)
+        assert np.all(m[:, 0] == 1)
+
+    def test_cauchy_good_first_row_ones(self):
+        assert np.all(rs.cauchy_good(6, 3)[0] == 1)
+
+    def test_decode_matrix_identity_when_available(self):
+        d = rs.decode_matrix("reed_sol_van", 4, 2, (0, 1, 2, 3), (1, 3))
+        expect = np.zeros((2, 4), dtype=np.uint8)
+        expect[0, 1] = 1
+        expect[1, 3] = 1
+        assert np.array_equal(d, expect)
+
+
+@pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy_good"])
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (8, 3)])
+class TestRoundtrip:
+    def _plugin(self, technique, k, m):
+        return factory(f"plugin=jax technique={technique} k={k} m={m}")
+
+    def test_encode_decode_all_erasure_patterns(self, rng, technique, k, m):
+        ec = self._plugin(technique, k, m)
+        data = rng.integers(0, 256, size=(k, 256)).astype(np.uint8)
+        parity = ec.encode_chunks(data)
+        assert parity.shape == (m, 256)
+        full = {i: data[i] for i in range(k)}
+        full.update({k + i: parity[i] for i in range(m)})
+        # Erase every possible <= m subset; decode must reconstruct exactly.
+        ids = list(range(k + m))
+        patterns = [p for r in range(1, m + 1)
+                    for p in itertools.combinations(ids, r)]
+        if len(patterns) > 60:
+            rng2 = np.random.default_rng(3)
+            patterns = [tuple(sorted(rng2.choice(ids, size=m, replace=False)))
+                        for _ in range(60)]
+        for erased in patterns:
+            avail = {i: c for i, c in full.items() if i not in erased}
+            got = ec.decode_chunks(list(erased), avail)
+            for i in erased:
+                assert np.array_equal(got[i], full[i]), (erased, i)
+
+    def test_byte_api(self, rng, technique, k, m):
+        ec = self._plugin(technique, k, m)
+        payload = rng.integers(0, 256, size=1000).astype(np.uint8).tobytes()
+        encoded = ec.encode(range(k + m), payload)
+        assert len(encoded) == k + m
+        # Drop m chunks, decode_concat must return the payload (plus padding).
+        kept = {i: encoded[i] for i in list(encoded)[m:]}
+        out = ec.decode_concat(kept)
+        assert out[:len(payload)] == payload
+
+    def test_backends_agree(self, rng, technique, k, m):
+        lut = factory(f"plugin=jax technique={technique} k={k} m={m} "
+                      f"backend=lut")
+        mxu = factory(f"plugin=jax technique={technique} k={k} m={m} "
+                      f"backend=bitmatmul")
+        data = rng.integers(0, 256, size=(k, 128)).astype(np.uint8)
+        assert np.array_equal(lut.encode_chunks(data),
+                              mxu.encode_chunks(data))
+
+    def test_matches_numpy_oracle(self, rng, technique, k, m):
+        ec = self._plugin(technique, k, m)
+        data = rng.integers(0, 256, size=(k, 64)).astype(np.uint8)
+        expect = gf_matmul_np(rs.coding_matrix(technique, k, m), data)
+        assert np.array_equal(ec.encode_chunks(data), expect)
+
+
+class TestInterface:
+    def test_profile_parse(self):
+        p = ErasureCodeProfile.parse("plugin=jax technique=reed_sol_van k=8 m=3")
+        assert p["plugin"] == "jax"
+        assert p.get_int("k", 0) == 8
+
+    def test_chunk_size_alignment(self):
+        ec = factory("plugin=jax k=4 m=2")
+        cs = ec.get_chunk_size(4 * 1024 * 1024)
+        assert cs == 1024 * 1024
+        assert ec.get_chunk_size(1) % ec.get_alignment() == 0
+
+    def test_minimum_to_decode(self):
+        ec = factory("plugin=jax k=4 m=2")
+        # All wanted available -> want itself.
+        assert ec.minimum_to_decode([0, 1], [0, 1, 2, 3, 4]) == {0, 1}
+        # Missing wanted -> any k available.
+        got = ec.minimum_to_decode([0], [1, 2, 3, 4, 5])
+        assert len(got) == 4 and got <= {1, 2, 3, 4, 5}
+        with pytest.raises(ValueError):
+            ec.minimum_to_decode([0], [1, 2])
+
+    def test_minimum_to_decode_with_cost(self):
+        ec = factory("plugin=jax k=2 m=2")
+        got = ec.minimum_to_decode_with_cost([0], {1: 10, 2: 1, 3: 5})
+        assert got == {2, 3}
+
+    def test_registry_aliases(self):
+        for name in ("jax", "jerasure", "isa"):
+            ec = factory(f"plugin={name} k=4 m=2")
+            assert ec.get_chunk_count() == 6
+
+    def test_unknown_plugin(self):
+        with pytest.raises(KeyError):
+            factory("plugin=nope k=2 m=1")
+
+    def test_unknown_technique(self):
+        with pytest.raises(ValueError):
+            factory("plugin=jax technique=liberation8 k=2 m=2")
+
+    def test_batched_encode(self, rng):
+        ec = factory("plugin=jax k=4 m=2")
+        data = rng.integers(0, 256, size=(8, 4, 128)).astype(np.uint8)
+        out = np.asarray(ec.encode_batch(data))
+        for b in range(8):
+            assert np.array_equal(out[b], ec.encode_chunks(data[b]))
