@@ -78,6 +78,8 @@ class ErasureCodeBench:
             " ".join(args.parameter) or "k=2 m=2")
         profile.setdefault("plugin", args.plugin)
         self.profile = profile
+        if args.iterations < 1:
+            raise SystemExit("--iterations must be >= 1")
         self.ec = ErasureCodePluginRegistry.instance().factory(
             args.plugin, profile)
         self.k = self.ec.k
@@ -146,7 +148,8 @@ class ErasureCodeBench:
             "k": self.k, "m": self.m,
             "object_size": self.args.size,
             "chunk_size": self.chunk,
-            "iterations": ops,
+            "iterations": ops,  # actual ops run (requested rounded up to
+            "requested_iterations": self.args.iterations,  # whole batches)
             "batch": self.batch,
             "seconds": elapsed,
             "total_bytes": total_bytes,

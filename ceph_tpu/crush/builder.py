@@ -1,0 +1,133 @@
+"""Programmatic CRUSH map construction.
+
+ref: src/crush/builder.c (crush_make_bucket/crush_add_bucket) and
+src/crush/CrushWrapper.cc (add_simple_rule, insert_item). Builds the common
+hierarchies (root -> rack -> host -> osd) and replicated/erasure rules.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.crush.types import (
+    ALG_STRAW2, OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP, OP_CHOOSE_FIRSTN,
+    OP_CHOOSE_INDEP, OP_EMIT, OP_TAKE, WEIGHT_ONE,
+    Bucket, CrushMap, Rule, RuleStep, Tunables,
+)
+
+# Conventional type ids (ref: default crushmap types in
+# src/crush/CrushCompiler.cc / vstart-generated maps).
+TYPE_OSD = 0
+TYPE_HOST = 1
+TYPE_RACK = 3
+TYPE_ROOT = 10
+
+DEFAULT_TYPE_NAMES = {TYPE_OSD: "osd", TYPE_HOST: "host", TYPE_RACK: "rack",
+                      TYPE_ROOT: "root"}
+
+
+def add_bucket(map_: CrushMap, bucket: Bucket, name: str | None = None) -> int:
+    """ref: builder.c crush_add_bucket (id assignment when 0)."""
+    if bucket.id == 0:
+        bucket.id = -(len(map_.buckets) + 1)
+    if bucket.id in map_.buckets:
+        raise ValueError(f"bucket id {bucket.id} exists")
+    map_.buckets[bucket.id] = bucket
+    if name:
+        map_.bucket_names[bucket.id] = name
+    return bucket.id
+
+
+def make_bucket(map_: CrushMap, type_: int, items: list[int],
+                weights: list[int] | None = None, alg: int = ALG_STRAW2,
+                name: str | None = None, bucket_id: int = 0) -> int:
+    """Create + insert a bucket; child weights default to their subtree sum."""
+    if weights is None:
+        weights = [item_weight(map_, i) for i in items]
+    b = Bucket(id=bucket_id, type=type_, alg=alg, items=list(items),
+               weights=list(weights))
+    return add_bucket(map_, b, name)
+
+
+def item_weight(map_: CrushMap, item: int) -> int:
+    """Subtree weight: devices default to 1.0; buckets sum their items."""
+    if item >= 0:
+        return WEIGHT_ONE
+    return map_.buckets[item].weight
+
+
+def build_flat(n_osds: int, alg: int = ALG_STRAW2,
+               weights: list[int] | None = None,
+               tunables: Tunables | None = None) -> tuple[CrushMap, int]:
+    """One root bucket holding n devices. Returns (map, root_id)."""
+    m = CrushMap(tunables=tunables or Tunables(),
+                 type_names=dict(DEFAULT_TYPE_NAMES))
+    m.max_devices = n_osds
+    root = make_bucket(m, TYPE_ROOT, list(range(n_osds)),
+                       weights or [WEIGHT_ONE] * n_osds, alg=alg, name="root")
+    return m, root
+
+
+def build_hierarchy(n_hosts: int, osds_per_host: int,
+                    alg: int = ALG_STRAW2,
+                    n_racks: int = 0,
+                    osd_weights: list[int] | None = None,
+                    tunables: Tunables | None = None) -> tuple[CrushMap, int]:
+    """root -> [rack ->] host -> osd tree, evenly filled.
+
+    Mirrors the shape vstart/osdmaptool generate for testing
+    (ref: src/tools/osdmaptool.cc --createsimple).
+    """
+    m = CrushMap(tunables=tunables or Tunables(),
+                 type_names=dict(DEFAULT_TYPE_NAMES))
+    n = n_hosts * osds_per_host
+    m.max_devices = n
+    if osd_weights is None:
+        osd_weights = [WEIGHT_ONE] * n
+    hosts = []
+    for hi in range(n_hosts):
+        osds = list(range(hi * osds_per_host, (hi + 1) * osds_per_host))
+        hosts.append(make_bucket(
+            m, TYPE_HOST, osds, [osd_weights[o] for o in osds], alg=alg,
+            name=f"host{hi}"))
+    if n_racks:
+        racks = []
+        per = max(1, n_hosts // n_racks)
+        for ri in range(n_racks):
+            hs = hosts[ri * per: (ri + 1) * per] if ri < n_racks - 1 \
+                else hosts[(n_racks - 1) * per:]
+            racks.append(make_bucket(m, TYPE_RACK, hs, alg=alg,
+                                     name=f"rack{ri}"))
+        root = make_bucket(m, TYPE_ROOT, racks, alg=alg, name="root")
+    else:
+        root = make_bucket(m, TYPE_ROOT, hosts, alg=alg, name="root")
+    return m, root
+
+
+def add_simple_rule(map_: CrushMap, root: int, failure_domain_type: int,
+                    name: str = "", rule_id: int | None = None,
+                    indep: bool = False) -> int:
+    """take root; chooseleaf firstn|indep 0 type <fd>; emit
+    (ref: src/crush/CrushWrapper.cc add_simple_rule_at)."""
+    rid = rule_id if rule_id is not None else len(map_.rules)
+    op = OP_CHOOSELEAF_INDEP if indep else OP_CHOOSELEAF_FIRSTN
+    if failure_domain_type == TYPE_OSD:
+        op = OP_CHOOSE_INDEP if indep else OP_CHOOSE_FIRSTN
+    rule = Rule(id=rid, name=name or f"rule{rid}",
+                type=3 if indep else 1,
+                steps=[RuleStep(OP_TAKE, root),
+                       RuleStep(op, 0, failure_domain_type),
+                       RuleStep(OP_EMIT)])
+    map_.rules[rid] = rule
+    return rid
+
+
+def add_multistep_rule(map_: CrushMap, root: int, steps: list[RuleStep],
+                       name: str = "", rule_id: int | None = None,
+                       indep: bool = False) -> int:
+    """take root; <caller steps>; emit — for rack-aware layouts like
+    ``choose firstn 0 type rack; chooseleaf firstn 1 type host``."""
+    rid = rule_id if rule_id is not None else len(map_.rules)
+    rule = Rule(id=rid, name=name or f"rule{rid}",
+                type=3 if indep else 1,
+                steps=[RuleStep(OP_TAKE, root), *steps, RuleStep(OP_EMIT)])
+    map_.rules[rid] = rule
+    return rid

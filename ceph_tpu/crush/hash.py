@@ -1,0 +1,121 @@
+"""rjenkins1 integer hash — the randomness source of CRUSH.
+
+ref: src/crush/hash.c (crush_hash32_rjenkins1*, crush_hashmix). Robert
+Jenkins' 96-bit mix, seeded with 1315423911, applied to 1-4 uint32 inputs.
+Everything downstream (straw2 draws, perm shuffles, out-checks) consumes
+these 32-bit values, so this must wrap exactly like C uint32 arithmetic.
+
+Written once over an array namespace so the same code runs under numpy
+(scalar oracle) and jax.numpy (vectorized mapper); both use uint32 dtype
+whose add/sub/shift wrap identically to C.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CRUSH_HASH_SEED = 1315423911
+
+# The hash-algorithm id stored in buckets/rules; only rjenkins1 exists
+# (ref: src/crush/hash.h CRUSH_HASH_RJENKINS1).
+CRUSH_HASH_RJENKINS1 = 0
+
+
+def _mix(a, b, c, xp):
+    """One crush_hashmix round. Returns updated (a, b, c).
+
+    uint32 add/sub/shift wrap identically to C in both numpy and jnp.
+    """
+    u32 = xp.uint32
+    a = a - b
+    a = a - c
+    a = a ^ (c >> u32(13))
+    b = b - c
+    b = b - a
+    b = b ^ (a << u32(8))
+    c = c - a
+    c = c - b
+    c = c ^ (b >> u32(13))
+    a = a - b
+    a = a - c
+    a = a ^ (c >> u32(12))
+    b = b - c
+    b = b - a
+    b = b ^ (a << u32(16))
+    c = c - a
+    c = c - b
+    c = c ^ (b >> u32(5))
+    a = a - b
+    a = a - c
+    a = a ^ (c >> u32(3))
+    b = b - c
+    b = b - a
+    b = b ^ (a << u32(10))
+    c = c - a
+    c = c - b
+    c = c ^ (b >> u32(15))
+    return a, b, c
+
+
+class _quiet:
+    """Silence numpy's unsigned-overflow RuntimeWarnings (wrap is intended);
+    no-op under jax.numpy."""
+
+    def __init__(self, xp):
+        self._ctx = np.errstate(over="ignore") if xp is np else None
+
+    def __enter__(self):
+        if self._ctx:
+            self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        if self._ctx:
+            self._ctx.__exit__(*exc)
+
+
+def _u32(v, xp):
+    return xp.asarray(v).astype(xp.uint32)
+
+
+def hash32_2(a, b, xp=np):
+    """crush_hash32_rjenkins1_2."""
+    with _quiet(xp):
+        a, b = _u32(a, xp), _u32(b, xp)
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        a, b, h = _mix(a, b, h, xp)
+        x, a, h = _mix(x, a, h, xp)
+        b, y, h = _mix(b, y, h, xp)
+        return h
+
+
+def hash32_3(a, b, c, xp=np):
+    """crush_hash32_rjenkins1_3."""
+    with _quiet(xp):
+        a, b, c = _u32(a, xp), _u32(b, xp), _u32(c, xp)
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        a, b, h = _mix(a, b, h, xp)
+        c, x, h = _mix(c, x, h, xp)
+        y, a, h = _mix(y, a, h, xp)
+        b, x, h = _mix(b, x, h, xp)
+        y, c, h = _mix(y, c, h, xp)
+        return h
+
+
+def hash32_4(a, b, c, d, xp=np):
+    """crush_hash32_rjenkins1_4."""
+    with _quiet(xp):
+        a, b, c, d = _u32(a, xp), _u32(b, xp), _u32(c, xp), _u32(d, xp)
+        h = xp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
+        x = xp.uint32(231232)
+        y = xp.uint32(1232)
+        a, b, h = _mix(a, b, h, xp)
+        c, d, h = _mix(c, d, h, xp)
+        a, x, h = _mix(a, x, h, xp)
+        y, b, h = _mix(y, b, h, xp)
+        c, x, h = _mix(c, x, h, xp)
+        y, d, h = _mix(y, d, h, xp)
+        return h

@@ -1,0 +1,85 @@
+"""Fixed-point log2 lookup tables and crush_ln.
+
+ref: src/crush/mapper.c crush_ln and src/crush/crush_ln_table.h. straw2
+computes draw = ln(hash16)/weight in 64-bit fixed point, where ln is a
+table-driven log2 on the scale 2^44 per octave:
+
+    x in [1, 2^16] normalized to x_norm = idx1*256 + xlow, idx1 in [128,256]
+    LH[i] = 2^48 * log2((128+i)/128)        log of the high byte
+    RH[i] = 2^22 / (128+i)                  reciprocal, to index the residual
+    LL[k] = 2^48 * log2(1 + k/2^15)         log of the residual fraction
+    crush_ln(x) = (iexpon << 44) + (LH + LL) >> 4
+
+The table *scales* here are chosen so every intermediate fits int64
+(residual index k = xlow*RH >> 15); upstream's header ships pre-generated
+constants on its own scales which could not be byte-compared (reference
+mount empty — SURVEY.md warning). The quantity computed is the same
+2^44*log2(x); the scalar oracle, C++ oracle and JAX mapper all consume
+THESE tables so cross-validation is exact, and straw2's statistical
+contract (weight-proportional selection) is tested independently.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def rh_lh_tables() -> tuple[np.ndarray, np.ndarray]:
+    """(RH, LH), 129 entries each, for the high byte idx1-128 in [0, 128]."""
+    idx1 = np.arange(128, 257, dtype=np.float64)
+    rh = np.rint(2.0 ** 22 / idx1).astype(np.int64)
+    lh = np.rint(2.0 ** 48 * np.log2(idx1 / 128.0)).astype(np.int64)
+    rh.flags.writeable = False
+    lh.flags.writeable = False
+    return rh, lh
+
+
+@functools.lru_cache(maxsize=None)
+def ll_table() -> np.ndarray:
+    """LL: 256 entries for the residual fraction k in [0, 255]."""
+    k = np.arange(256, dtype=np.float64)
+    t = np.rint(2.0 ** 48 * np.log2(1.0 + k / 2.0 ** 15)).astype(np.int64)
+    t.flags.writeable = False
+    return t
+
+
+def crush_ln(xin, xp=np):
+    """2^44 * log2(xin + 1) for xin in [0, 0xffff], array-vectorized.
+
+    Mirrors mapper.c crush_ln's structure: normalize into [2^15, 2^16],
+    split into high byte + residual fraction, sum the two log terms.
+    """
+    rh_np, lh_np = rh_lh_tables()
+    ll_np = ll_table()
+    if xp is np:
+        rh, lh, ll = rh_np, lh_np, ll_np
+    else:
+        rh, lh, ll = xp.asarray(rh_np), xp.asarray(lh_np), xp.asarray(ll_np)
+
+    x = xp.asarray(xin).astype(xp.int64) + 1          # [1, 2^16]
+    nbits = _bit_length(x, xp)
+    shift = xp.maximum(xp.zeros_like(x), xp.int64(16) - nbits)
+    x_norm = x << shift                               # [2^15, 2^16]
+    iexpon = xp.int64(15) - shift
+
+    idx1 = x_norm >> 8                                # [128, 256]
+    xlow = x_norm & 0xFF
+    RH = rh[idx1 - 128]
+    LH = lh[idx1 - 128]
+    k = (xlow * RH) >> 15                             # residual in [0, 255]
+    LL = ll[k]
+    return (iexpon << 44) + ((LH + LL) >> 4)
+
+
+def _bit_length(x, xp):
+    """Position of the highest set bit (1-indexed) for x in [1, 2^17)."""
+    n = xp.zeros_like(x)
+    v = x
+    for b in (16, 8, 4, 2, 1):
+        big = v >= (1 << b)
+        n = xp.where(big, n + b, n)
+        v = xp.where(big, v >> b, v)
+    return n + 1

@@ -1,0 +1,589 @@
+"""Vectorized CRUSH rule VM — millions of PG mappings per device step.
+
+The TPU-native replacement for the reference's per-PG scalar walk
+(ref: src/crush/mapper.c crush_do_rule and its choose loops). Design
+(SURVEY.md §7): the PG id x is the vectorized lane axis; rule steps unroll
+at trace time; the divergent retry loops become masked ``lax.while_loop``s
+(all lanes iterate until the slowest finishes — collisions are rare, so
+nearly all lanes finish in one pass); bucket descent is a fixed unroll to
+the map's max depth; per-bucket variable arity is padding + masks.
+
+Semantics deltas vs the scalar spec (``mapper_ref``), all documented:
+- requires chooseleaf_stable=1 (the modern default; legacy stable=0 renames
+  replica slots on failure in a way that needs data-dependent loop bounds);
+- firstn blocks are fixed-width with failure holes compacted at EMIT, which
+  reproduces the scalar output except when a multi-root step underfills
+  mid-rule (astronomically rare, needs a near-full cluster of failures);
+- straw(v1)/tree buckets: not yet (straw2/uniform/list cover modern maps).
+
+Everything is int64 inside (straw2 draws are 48-bit fixed point); x64 mode
+is enabled at import.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from jax import lax
+
+from ceph_tpu.crush import hash as h
+from ceph_tpu.crush.ln_table import crush_ln
+from ceph_tpu.crush.tensors import PackedMap, pack_map
+from ceph_tpu.crush.types import (
+    ALG_LIST, ALG_STRAW2, ALG_UNIFORM,
+    ITEM_NONE,
+    OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP, OP_CHOOSE_FIRSTN,
+    OP_CHOOSE_INDEP, OP_EMIT, OP_NOOP, OP_SET_CHOOSELEAF_STABLE,
+    OP_SET_CHOOSELEAF_TRIES, OP_SET_CHOOSELEAF_VARY_R,
+    OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES, OP_SET_CHOOSE_LOCAL_TRIES,
+    OP_SET_CHOOSE_TRIES, OP_TAKE,
+    CrushMap, WEIGHT_ONE,
+)
+
+S64_MIN = np.int64(np.iinfo(np.int64).min)
+LN_ONE = np.int64(1) << 48
+
+
+def _u32(v):
+    return v.astype(jnp.uint32)
+
+
+def _div_trunc_neg(ln, w):
+    """C-style trunc division for ln <= 0, w > 0."""
+    return -((-ln) // w)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized bucket choose
+# ---------------------------------------------------------------------------
+
+def _straw2_choose(arrs, rows, x, r):
+    """(N,) lanes: straw2 argmax draw (ref: mapper.c bucket_straw2_choose)."""
+    items = arrs["items"][rows]            # (N, S) int32
+    w = arrs["weights"][rows]              # (N, S) int64
+    size = arrs["size"][rows]              # (N,)
+    S = items.shape[1]
+    u = h.hash32_3(_u32(x)[:, None], _u32(items), _u32(r)[:, None],
+                   xp=jnp).astype(jnp.int64) & 0xFFFF
+    ln = crush_ln(u, xp=jnp) - LN_ONE      # (N, S) <= 0
+    draw = jnp.where(w > 0, _div_trunc_neg(ln, jnp.maximum(w, 1)), S64_MIN)
+    posmask = jnp.arange(S)[None, :] < size[:, None]
+    draw = jnp.where(posmask, draw, S64_MIN)
+    idx = jnp.argmax(draw, axis=1)         # first max, like the scalar loop
+    return jnp.take_along_axis(items, idx[:, None], axis=1)[:, 0]
+
+
+def _uniform_choose(arrs, rows, x, r):
+    """(N,) lanes: pseudo-random permutation pick
+    (ref: mapper.c bucket_perm_choose), as a full Fisher-Yates unroll."""
+    items = arrs["items"][rows]
+    size = arrs["size"][rows].astype(jnp.int32)
+    bid = arrs["bid"][rows]
+    S = items.shape[1]
+    safe_size = jnp.maximum(size, 1)
+    pr = (r.astype(jnp.int32) % safe_size).astype(jnp.int32)
+    perm = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32),
+                            items.shape)
+    ar = jnp.arange(S, dtype=jnp.int32)[None, :]
+    for p in range(S - 1):
+        active = (p < size - 1)
+        mod = jnp.maximum(size - p, 1).astype(jnp.uint32)
+        i = (h.hash32_3(_u32(x), _u32(bid), jnp.uint32(p), xp=jnp)
+             % mod).astype(jnp.int32)
+        idx = p + i                                     # (N,)
+        val_p = perm[:, p]
+        val_i = jnp.take_along_axis(perm, idx[:, None], axis=1)[:, 0]
+        swap_to_p = (ar == p) & active[:, None]
+        swap_to_i = (ar == idx[:, None]) & active[:, None]
+        perm = jnp.where(swap_to_i, val_p[:, None],
+                         jnp.where(swap_to_p, val_i[:, None], perm))
+    s = jnp.take_along_axis(perm, pr[:, None], axis=1)[:, 0]
+    return jnp.take_along_axis(items, s[:, None], axis=1)[:, 0]
+
+
+def _list_choose(arrs, rows, x, r):
+    """(N,) lanes: list bucket walk tail->head
+    (ref: mapper.c bucket_list_choose)."""
+    items = arrs["items"][rows]
+    w = arrs["weights"][rows]
+    cumw = arrs["cumw"][rows]
+    size = arrs["size"][rows]
+    S = items.shape[1]
+    draw = h.hash32_4(_u32(x)[:, None], _u32(items), _u32(r)[:, None],
+                      _u32(arrs["bid"][rows])[:, None],
+                      xp=jnp).astype(jnp.int64) & 0xFFFF
+    scaled = (draw * cumw) >> 16
+    posmask = jnp.arange(S)[None, :] < size[:, None]
+    accept = (scaled < w) & posmask
+    # First acceptance scanning from the tail == highest accepting index.
+    rev = accept[:, ::-1]
+    idx = (S - 1) - jnp.argmax(rev, axis=1)
+    found = jnp.any(accept, axis=1)
+    idx = jnp.where(found, idx, 0)
+    return jnp.take_along_axis(items, idx[:, None], axis=1)[:, 0]
+
+
+def _bucket_choose(arrs, present, rows, x, r):
+    """Dispatch on bucket alg (ref: mapper.c crush_bucket_choose)."""
+    item = _straw2_choose(arrs, rows, x, r)
+    alg = arrs["alg"][rows]
+    if ALG_UNIFORM in present:
+        item = jnp.where(alg == ALG_UNIFORM,
+                         _uniform_choose(arrs, rows, x, r), item)
+    if ALG_LIST in present:
+        item = jnp.where(alg == ALG_LIST,
+                         _list_choose(arrs, rows, x, r), item)
+    return item
+
+
+def _is_out(arrs, item, x):
+    """ref: mapper.c is_out — probabilistic reweight rejection."""
+    devw = arrs["device_weights"]
+    safe = jnp.clip(item, 0, devw.shape[0] - 1)
+    w = devw[safe]
+    hh = h.hash32_2(_u32(x), _u32(item), xp=jnp).astype(jnp.int64) & 0xFFFF
+    out = jnp.where(w >= WEIGHT_ONE, False,
+                    jnp.where(w == 0, True, hh >= w))
+    return jnp.where(item >= devw.shape[0], True, out)
+
+
+# ---------------------------------------------------------------------------
+# Descent through the hierarchy
+# ---------------------------------------------------------------------------
+
+def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
+             target_type, indep_numrep):
+    """Walk from start buckets down to an item of target_type.
+
+    base_r: (N,) int32 = rep + parent_r. ftotal: (N,) or scalar retry count.
+    indep_numrep: None for firstn (r = base_r + ftotal) else the numrep used
+    for the indep r-stride (ref: crush_choose_indep r computation; the
+    stride consults the alg/size of the bucket at EACH level).
+    Returns (item, success, r_final) — r_final is the r used at the level
+    where the item was drawn (the scalar code's `r` at recursion time).
+    Lanes that hit a device/bucket of the wrong kind, an empty bucket, or
+    exceed max depth fail.
+    """
+    B = arrs["size"].shape[0]
+    n = start_rows.shape[0]
+    cur = jnp.clip(start_rows, 0, B - 1)
+    done = ~start_valid
+    success = jnp.zeros(n, dtype=bool)
+    out_item = jnp.full(n, ITEM_NONE, dtype=jnp.int32)
+    r_final = jnp.zeros(n, dtype=jnp.int32)
+    for _ in range(cfg["max_depth"]):
+        active = ~done
+        size_c = arrs["size"][cur]
+        if indep_numrep is None:
+            r = base_r + ftotal
+        else:
+            alg_c = arrs["alg"][cur]
+            stride = jnp.where(
+                (alg_c == ALG_UNIFORM) & (size_c % indep_numrep == 0),
+                indep_numrep + 1, indep_numrep)
+            r = base_r + stride * ftotal
+        item = _bucket_choose(arrs, cfg["present"], cur, x, r)
+        empty = size_c == 0
+        row = -1 - item
+        is_bucket = item < 0
+        it_type = jnp.where(
+            is_bucket,
+            arrs["btype"][jnp.clip(row, 0, B - 1)],
+            0)
+        reached = (~empty) & (it_type == target_type)
+        descend_more = (~empty) & (~reached) & is_bucket & (row < B)
+        fail_now = active & ~reached & ~descend_more
+        out_item = jnp.where(active & reached, item, out_item)
+        r_final = jnp.where(active & reached, r.astype(jnp.int32), r_final)
+        success = success | (active & reached)
+        done = done | (active & (reached | fail_now))
+        cur = jnp.where(active & descend_more, jnp.clip(row, 0, B - 1), cur)
+    return out_item, success, r_final
+
+
+# ---------------------------------------------------------------------------
+# choose_firstn / choose_indep, one replica slot at a time
+# ---------------------------------------------------------------------------
+
+def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries):
+    """The chooseleaf recursion: pick one device under `item`
+    (ref: crush_choose_firstn recursive call with numrep=1, stable=1).
+
+    Returns (leaf, ok). Device items pass through unchecked (the scalar
+    code only is_out-checks items at the level whose type is 0).
+    """
+    n = item.shape[0]
+    B = arrs["size"].shape[0]
+    is_bucket = item < 0
+    rows = jnp.clip(-1 - item, 0, B - 1)
+
+    def cond(c):
+        return jnp.any(~c["done"])
+
+    def body(c):
+        active = ~c["done"]
+        item_l, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
+                                 sub_r, c["ftotal"], 0, None)
+        collide = jnp.zeros(n, dtype=bool)
+        if prior_leaves is not None and prior_leaves.shape[1]:
+            collide = jnp.any(item_l[:, None] == prior_leaves, axis=1)
+        reject = ~ok | collide | _is_out(arrs, item_l, x)
+        succeed = active & ~reject
+        ftotal_next = c["ftotal"] + 1
+        give_up = active & reject & (ftotal_next >= tries)
+        return {
+            "leaf": jnp.where(succeed, item_l, c["leaf"]),
+            "ok": c["ok"] | succeed,
+            "done": c["done"] | succeed | give_up,
+            "ftotal": jnp.where(active & reject, ftotal_next, c["ftotal"]),
+        }
+
+    init = {
+        "leaf": jnp.full(n, ITEM_NONE, dtype=jnp.int32),
+        "ok": jnp.zeros(n, dtype=bool),
+        "done": ~(is_bucket & item_ok),
+        "ftotal": jnp.zeros(n, dtype=jnp.int32),
+    }
+    out = lax.while_loop(cond, body, init)
+    # Device item (or failed outer) passes through.
+    leaf = jnp.where(is_bucket, out["leaf"], item)
+    ok = jnp.where(is_bucket, out["ok"], item_ok)
+    return leaf, ok
+
+
+def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
+                       prior_out, prior_leaves, target_type,
+                       recurse_to_leaf, tries, recurse_tries, vary_r):
+    """One replica slot of crush_choose_firstn, all lanes at once."""
+    n = x.shape[0]
+    base_r = jnp.full(n, rep, dtype=jnp.int32)
+
+    def cond(c):
+        return jnp.any(~c["done"])
+
+    def body(c):
+        active = ~c["done"]
+        item, ok, r_fin = _descend(arrs, cfg, root_rows, root_valid, x,
+                                   base_r, c["ftotal"], target_type, None)
+        collide = jnp.zeros(n, dtype=bool)
+        if prior_out.shape[1]:
+            collide = jnp.any(item[:, None] == prior_out, axis=1)
+        ok = ok & ~collide
+        if recurse_to_leaf:
+            r_cur = base_r + c["ftotal"]
+            if vary_r:
+                sub_r = r_cur >> (vary_r - 1)
+            else:
+                sub_r = jnp.zeros_like(r_cur)
+            leaf, ok = _leaf_choose(arrs, cfg, item, ok, x, sub_r,
+                                    prior_leaves, recurse_tries)
+        else:
+            leaf = item
+            if target_type == 0:
+                ok = ok & ~_is_out(arrs, item, x)
+        succeed = active & ok
+        ftotal_next = c["ftotal"] + 1
+        give_up = active & ~ok & (ftotal_next >= tries)
+        return {
+            "item": jnp.where(succeed, item, c["item"]),
+            "leaf": jnp.where(succeed, leaf, c["leaf"]),
+            "ok": c["ok"] | succeed,
+            "done": c["done"] | succeed | give_up,
+            "ftotal": jnp.where(active & ~ok, ftotal_next, c["ftotal"]),
+        }
+
+    init = {
+        "item": jnp.full(n, ITEM_NONE, dtype=jnp.int32),
+        "leaf": jnp.full(n, ITEM_NONE, dtype=jnp.int32),
+        "ok": jnp.zeros(n, dtype=bool),
+        "done": ~root_valid,
+        "ftotal": jnp.zeros(n, dtype=jnp.int32),
+    }
+    out = lax.while_loop(cond, body, init)
+    return out["item"], out["leaf"], out["ok"]
+
+
+def _choose_firstn_block(arrs, cfg, root_rows, root_valid, x, numrep,
+                         target_type, recurse_to_leaf, tries, recurse_tries,
+                         vary_r):
+    """numrep replica slots from one root column -> (N, numrep) x2."""
+    n = x.shape[0]
+    out = jnp.full((n, numrep), ITEM_NONE, dtype=jnp.int32)
+    leaves = jnp.full((n, numrep), ITEM_NONE, dtype=jnp.int32)
+    for rep in range(numrep):
+        item, leaf, ok = _choose_one_firstn(
+            arrs, cfg, root_rows, root_valid, x, rep,
+            out[:, :rep], leaves[:, :rep], target_type,
+            recurse_to_leaf, tries, recurse_tries, vary_r)
+        out = out.at[:, rep].set(jnp.where(ok, item, ITEM_NONE))
+        leaves = leaves.at[:, rep].set(jnp.where(ok, leaf, ITEM_NONE))
+    return out, leaves
+
+
+def _leaf_choose_indep(arrs, cfg, item, item_ok, x, parent_r, rep, numrep,
+                       tries):
+    """Indep leaf recursion (ref: crush_choose_indep recursive call with
+    left=1, outpos=rep, parent_r=r)."""
+    n = item.shape[0]
+    B = arrs["size"].shape[0]
+    is_bucket = item < 0
+    rows = jnp.clip(-1 - item, 0, B - 1)
+    base_r = rep + parent_r
+
+    def cond(c):
+        return jnp.any(~c["done"])
+
+    def body(c):
+        active = ~c["done"]
+        item_l, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
+                                 base_r, c["ftotal"], 0, numrep)
+        reject = ~ok | _is_out(arrs, item_l, x)
+        succeed = active & ~reject
+        ftotal_next = c["ftotal"] + 1
+        give_up = active & reject & (ftotal_next >= tries)
+        return {
+            "leaf": jnp.where(succeed, item_l, c["leaf"]),
+            "ok": c["ok"] | succeed,
+            "done": c["done"] | succeed | give_up,
+            "ftotal": jnp.where(active & reject, ftotal_next, c["ftotal"]),
+        }
+
+    init = {
+        "leaf": jnp.full(n, ITEM_NONE, dtype=jnp.int32),
+        "ok": jnp.zeros(n, dtype=bool),
+        "done": ~(is_bucket & item_ok),
+        "ftotal": jnp.zeros(n, dtype=jnp.int32),
+    }
+    out = lax.while_loop(cond, body, init)
+    leaf = jnp.where(is_bucket, out["leaf"], item)
+    ok = jnp.where(is_bucket, out["ok"], item_ok)
+    return leaf, ok
+
+
+def _choose_indep_block(arrs, cfg, root_rows, root_valid, x, out_size,
+                        numrep, target_type, recurse_to_leaf, tries,
+                        recurse_tries):
+    """ref: mapper.c crush_choose_indep — position-stable EC placement."""
+    n = x.shape[0]
+    out0 = jnp.full((n, out_size), ITEM_NONE - 1, dtype=jnp.int32)  # UNDEF
+    leaves0 = jnp.full((n, out_size), ITEM_NONE - 1, dtype=jnp.int32)
+    UNDEF = ITEM_NONE - 1
+
+    def cond(c):
+        return (c["ftotal"] < tries) & jnp.any(c["out"] == UNDEF)
+
+    def body(c):
+        out, leaves = c["out"], c["leaves"]
+        ftotal = c["ftotal"]
+        for rep in range(out_size):
+            need = out[:, rep] == UNDEF
+            base_r = jnp.full(n, rep, dtype=jnp.int32)
+            item, ok, r_parent = _descend(arrs, cfg, root_rows,
+                                          root_valid & need, x,
+                                          base_r, ftotal, target_type,
+                                          numrep)
+            real = jnp.where(out == UNDEF, ITEM_NONE, out)
+            collide = jnp.any(item[:, None] == real, axis=1)
+            ok = ok & ~collide
+            if recurse_to_leaf:
+                # parent_r = the r at which `item` was drawn (scalar passes
+                # its loop-local r into the recursion).
+                leaf, ok = _leaf_choose_indep(arrs, cfg, item, ok, x,
+                                              r_parent, rep, numrep,
+                                              recurse_tries)
+            else:
+                leaf = item
+                if target_type == 0:
+                    ok = ok & ~_is_out(arrs, item, x)
+            place = need & ok
+            out = out.at[:, rep].set(jnp.where(place, item, out[:, rep]))
+            leaves = leaves.at[:, rep].set(
+                jnp.where(place, leaf, leaves[:, rep]))
+        return {"out": out, "leaves": leaves, "ftotal": ftotal + 1}
+
+    res = lax.while_loop(cond, body,
+                         {"out": out0, "leaves": leaves0,
+                          "ftotal": jnp.int32(0)})
+    out = jnp.where(res["out"] == UNDEF, ITEM_NONE, res["out"])
+    leaves = jnp.where(res["leaves"] == UNDEF, ITEM_NONE, res["leaves"])
+    return out, leaves
+
+
+def _compact(w):
+    """Stable left-compaction of non-NONE entries (firstn EMIT)."""
+    W = w.shape[1]
+    keys = jnp.where(w == ITEM_NONE, W, 0) + jnp.arange(W)[None, :]
+    order = jnp.argsort(keys, axis=1)
+    return jnp.take_along_axis(w, order, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Rule execution
+# ---------------------------------------------------------------------------
+
+class Mapper:
+    """Compiled batched CRUSH mapper for one CrushMap.
+
+    Usage:
+        mapper = Mapper(crush_map)
+        osds = mapper.map_pgs(ruleno, xs, numrep)   # (N, numrep) int32
+
+    Each (ruleno, numrep, N-shape) triple compiles once; map mutations mean
+    building a new Mapper (maps are cheap to pack — the arrays are the map).
+    """
+
+    def __init__(self, crush_map: CrushMap,
+                 device_weights: np.ndarray | None = None):
+        self.map = crush_map
+        self.packed: PackedMap = pack_map(crush_map)
+        if crush_map.tunables.chooseleaf_stable != 1:
+            raise NotImplementedError(
+                "vectorized mapper requires chooseleaf_stable=1 "
+                "(the modern default); use mapper_ref for legacy maps")
+        if crush_map.tunables.choose_local_tries or \
+                crush_map.tunables.choose_local_fallback_tries:
+            raise NotImplementedError(
+                "legacy local retries unsupported in the vectorized mapper")
+        p = self.packed
+        if device_weights is None:
+            device_weights = np.full(p.max_devices, WEIGHT_ONE,
+                                     dtype=np.int64)
+        self.arrays = {
+            "items": jnp.asarray(p.items),
+            "weights": jnp.asarray(p.weights),
+            "cumw": jnp.asarray(p.cumw),
+            "size": jnp.asarray(p.size),
+            "alg": jnp.asarray(p.alg),
+            "btype": jnp.asarray(p.btype),
+            "bid": jnp.asarray(p.bid),
+            "device_weights": jnp.asarray(device_weights, dtype=jnp.int64),
+        }
+        self.cfg = {"max_depth": p.max_depth,
+                    "present": p.algs_present}
+
+    def set_device_weights(self, device_weights: np.ndarray) -> None:
+        """Update reweights (is_out vector) without recompiling."""
+        self.arrays["device_weights"] = jnp.asarray(device_weights,
+                                                    dtype=jnp.int64)
+
+    def map_pgs(self, ruleno: int, xs, result_max: int) -> jax.Array:
+        """Vectorized crush_do_rule over xs -> (N, result_max) device ids
+        (ITEM_NONE fills failures/indep holes)."""
+        rule = self.map.rules[ruleno]
+        steps = tuple((s.op, s.arg1, s.arg2) for s in rule.steps)
+        xs = jnp.asarray(xs, dtype=jnp.uint32)
+        fn = _compiled_rule(steps, result_max,
+                            _tunables_key(self.map.tunables),
+                            self.cfg["max_depth"], self.cfg["present"])
+        return fn(self.arrays, xs)
+
+
+def _tunables_key(t):
+    return (t.choose_total_tries, t.chooseleaf_descend_once,
+            t.chooseleaf_vary_r, t.chooseleaf_stable)
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_rule(steps, result_max, tkey, max_depth, present):
+    total_tries, descend_once, vary_r, stable = tkey
+    cfg = {"max_depth": max_depth, "present": present}
+
+    def run(arrs, xs):
+        n = xs.shape[0]
+        B = arrs["size"].shape[0]
+        choose_tries = total_tries
+        choose_leaf_tries = 0
+        vr = vary_r
+        # Working set: list of (values (N,), is_leaf_col) columns.
+        w_cols: list = []
+        emitted: list = []
+        any_firstn = False
+        for op, arg1, arg2 in steps:
+            if op == OP_NOOP:
+                continue
+            if op == OP_TAKE:
+                w_cols = [jnp.full(n, arg1, dtype=jnp.int32)]
+            elif op == OP_SET_CHOOSE_TRIES:
+                if arg1 > 0:
+                    choose_tries = arg1
+            elif op == OP_SET_CHOOSELEAF_TRIES:
+                if arg1 > 0:
+                    choose_leaf_tries = arg1
+            elif op == OP_SET_CHOOSELEAF_VARY_R:
+                if arg1 >= 0:
+                    vr = arg1
+            elif op == OP_SET_CHOOSELEAF_STABLE:
+                if arg1 >= 0 and arg1 != 1:
+                    raise NotImplementedError("stable=0 unsupported")
+            elif op in (OP_SET_CHOOSE_LOCAL_TRIES,
+                        OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+                if arg1 > 0:
+                    raise NotImplementedError("local retries unsupported")
+            elif op in (OP_CHOOSE_FIRSTN, OP_CHOOSELEAF_FIRSTN,
+                        OP_CHOOSE_INDEP, OP_CHOOSELEAF_INDEP):
+                firstn = op in (OP_CHOOSE_FIRSTN, OP_CHOOSELEAF_FIRSTN)
+                recurse = op in (OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP)
+                any_firstn = any_firstn or firstn
+                numrep = arg1 if arg1 > 0 else arg1 + result_max
+                if firstn:
+                    recurse_tries = (choose_leaf_tries or
+                                     (1 if descend_once else choose_tries))
+                else:
+                    recurse_tries = choose_leaf_tries or 1
+                new_cols = []
+                osize = 0
+                for col in w_cols:
+                    if osize >= result_max:
+                        break
+                    root_valid = (col < 0) & (-1 - col < B)
+                    root_rows = jnp.clip(-1 - col, 0, B - 1)
+                    if firstn:
+                        blk = min(numrep, result_max - osize)
+                        out, leaves = _choose_firstn_block(
+                            arrs, cfg, root_rows, root_valid, xs, blk,
+                            arg2, recurse, choose_tries, recurse_tries, vr)
+                    else:
+                        blk = min(numrep, result_max - osize)
+                        out, leaves = _choose_indep_block(
+                            arrs, cfg, root_rows, root_valid, xs, blk,
+                            numrep, arg2, recurse, choose_tries,
+                            recurse_tries)
+                    chosen = leaves if recurse else out
+                    # Device roots with matching type pass through.
+                    if arg2 == 0:
+                        passthrough = (col >= 0)
+                        chosen = jnp.where(passthrough[:, None],
+                                           jnp.where(
+                                               jnp.arange(blk)[None, :] == 0,
+                                               col[:, None],
+                                               ITEM_NONE),
+                                           chosen)
+                    for j in range(blk):
+                        new_cols.append(chosen[:, j])
+                    osize += blk
+                w_cols = new_cols
+            elif op == OP_EMIT:
+                emitted.extend(w_cols)
+                w_cols = []
+            else:
+                raise NotImplementedError(f"rule op {op}")
+        if not emitted:
+            emitted = w_cols
+        w = (jnp.stack(emitted, axis=1) if emitted
+             else jnp.full((n, result_max), ITEM_NONE, dtype=jnp.int32))
+        if any_firstn:
+            w = _compact(w)
+        if w.shape[1] < result_max:
+            pad = jnp.full((n, result_max - w.shape[1]), ITEM_NONE,
+                           dtype=jnp.int32)
+            w = jnp.concatenate([w, pad], axis=1)
+        return w[:, :result_max]
+
+    return jax.jit(run)

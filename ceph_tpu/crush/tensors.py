@@ -1,0 +1,92 @@
+"""Pack a CrushMap into padded device arrays for the vectorized mapper.
+
+The no-dynamic-shapes rule (SURVEY.md §7 hard parts): per-bucket item lists
+are padded to the map-wide max size; bucket rows are indexed by
+``bno = -1 - bucket_id`` exactly like the reference's bucket table
+(ref: src/crush/crush.h crush_map.buckets[-1-id]); gaps become size-0 rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ceph_tpu.crush.types import (
+    ALG_LIST, ALG_STRAW2, ALG_UNIFORM, CrushMap,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedMap:
+    """Device-ready map tensors + static metadata.
+
+    Array fields are numpy here; the mapper moves them to device once.
+    Hashable/static fields (shapes, tunables, flags) drive jit
+    specialization.
+    """
+
+    # (B, S) padded per-bucket arrays; row = bno = -1 - bucket_id.
+    items: np.ndarray          # int32 child ids (pad 0)
+    weights: np.ndarray        # int64 16.16 weights (pad 0)
+    cumw: np.ndarray           # int64 inclusive cumsum of weights (list alg)
+    # (B,) per-bucket scalars.
+    size: np.ndarray           # int32
+    alg: np.ndarray            # int32
+    btype: np.ndarray          # int32
+    bid: np.ndarray            # int32 (the negative id)
+    # Static metadata.
+    n_buckets: int
+    max_size: int
+    max_devices: int
+    max_depth: int
+    algs_present: tuple[int, ...]
+
+    def row(self, item: int) -> int:
+        return -1 - item
+
+
+def pack_map(m: CrushMap) -> PackedMap:
+    m.validate()
+    if not m.buckets:
+        raise ValueError("empty crush map")
+    n_buckets = max(-bid for bid in m.buckets)
+    S = max(1, m.max_bucket_size())
+    items = np.zeros((n_buckets, S), dtype=np.int32)
+    weights = np.zeros((n_buckets, S), dtype=np.int64)
+    size = np.zeros(n_buckets, dtype=np.int32)
+    alg = np.full(n_buckets, ALG_STRAW2, dtype=np.int32)
+    btype = np.zeros(n_buckets, dtype=np.int32)
+    bid = np.array([-(i + 1) for i in range(n_buckets)], dtype=np.int32)
+    for b in m.buckets.values():
+        r = -1 - b.id
+        size[r] = b.size
+        alg[r] = b.alg
+        btype[r] = b.type
+        items[r, :b.size] = b.items
+        weights[r, :b.size] = b.weights
+    cumw = np.cumsum(weights, axis=1)
+    return PackedMap(
+        items=items, weights=weights, cumw=cumw, size=size, alg=alg,
+        btype=btype, bid=bid,
+        n_buckets=n_buckets, max_size=S, max_devices=m.max_devices,
+        max_depth=_max_depth(m),
+        algs_present=tuple(sorted({b.alg for b in m.buckets.values()})))
+
+
+def _max_depth(m: CrushMap) -> int:
+    """Longest bucket chain from any bucket down to a device."""
+    memo: dict[int, int] = {}
+
+    def depth(item: int) -> int:
+        if item >= 0:
+            return 0
+        if item in memo:
+            return memo[item]
+        memo[item] = 0  # cycle guard
+        b = m.buckets[item]
+        d = 1 + max((depth(c) for c in b.items), default=0)
+        memo[item] = d
+        return d
+
+    return max((depth(bid) for bid in m.buckets), default=1)

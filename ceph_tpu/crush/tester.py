@@ -1,0 +1,88 @@
+"""The crushtool --test engine, batched.
+
+ref: src/crush/CrushTester.{h,cc} (CrushTester::test) — loops x over
+[min_x, max_x], runs the rule, and aggregates per-device utilization,
+bad-mapping counts and timing. Here the whole x range is one (or a few)
+batched mapper calls on the accelerator instead of a scalar loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from ceph_tpu.crush.mapper import Mapper
+from ceph_tpu.crush.types import CrushMap, ITEM_NONE
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("crush")
+
+
+@dataclasses.dataclass
+class TestResult:
+    rule: int
+    num_rep: int
+    total_x: int
+    device_counts: np.ndarray          # (max_devices,) placements per device
+    bad_mappings: int                  # x's with < num_rep distinct devices
+    seconds: float
+    mappings: np.ndarray | None = None  # (N, num_rep) if requested
+
+    @property
+    def mappings_per_second(self) -> float:
+        return self.total_x / self.seconds if self.seconds else float("inf")
+
+    def utilization_summary(self) -> dict:
+        c = self.device_counts
+        active = c[c > 0]
+        expected = c.sum() / max(len(c), 1)
+        return {
+            "devices": int(len(c)),
+            "active_devices": int(len(active)),
+            "placements": int(c.sum()),
+            "expected_per_device": float(expected),
+            "min": int(c.min()) if len(c) else 0,
+            "max": int(c.max()) if len(c) else 0,
+            "stddev": float(c.std()),
+        }
+
+
+class CrushTester:
+    """ref: src/crush/CrushTester.h CrushTester."""
+
+    def __init__(self, crush_map: CrushMap,
+                 device_weights: np.ndarray | None = None,
+                 batch: int = 1 << 20):
+        self.map = crush_map
+        self.mapper = Mapper(crush_map, device_weights)
+        self.batch = batch
+
+    def test(self, rule: int, num_rep: int, min_x: int = 0,
+             max_x: int = 1023, keep_mappings: bool = False) -> TestResult:
+        n = max_x - min_x + 1
+        counts = np.zeros(self.map.max_devices, dtype=np.int64)
+        bad = 0
+        kept = [] if keep_mappings else None
+        t0 = time.perf_counter()
+        for start in range(min_x, max_x + 1, self.batch):
+            stop = min(start + self.batch - 1, max_x)
+            xs = np.arange(start, stop + 1, dtype=np.uint32)
+            out = np.asarray(self.mapper.map_pgs(rule, xs, num_rep))
+            valid = out != ITEM_NONE
+            flat = out[valid]
+            counts += np.bincount(flat, minlength=self.map.max_devices)
+            # bad mapping: fewer than num_rep distinct live devices
+            per_x = valid.sum(axis=1)
+            bad += int((per_x < num_rep).sum())
+            if keep_mappings:
+                kept.append(out)
+        seconds = time.perf_counter() - t0
+        res = TestResult(
+            rule=rule, num_rep=num_rep, total_x=n,
+            device_counts=counts, bad_mappings=bad, seconds=seconds,
+            mappings=np.concatenate(kept) if kept else None)
+        log.dout(5, "test done", rule=rule, num_rep=num_rep, n=n,
+                 secs=round(seconds, 3))
+        return res

@@ -157,6 +157,11 @@ class ErasureCodeInterface(ABC):
                chunk_size: int | None = None) -> dict[int, bytes]:
         """ref: src/erasure-code/ErasureCode.cc decode -> decode_chunks."""
         arrs = {i: np.frombuffer(c, dtype=np.uint8) for i, c in chunks.items()}
+        sizes = {a.shape[0] for a in arrs.values()}
+        if chunk_size is not None:
+            sizes.add(chunk_size)
+        if len(sizes) > 1:
+            raise ValueError(f"chunk size mismatch: {sorted(sizes)}")
         want = list(want_to_read)
         have = {i: arrs[i] for i in want if i in arrs}
         missing = [i for i in want if i not in arrs]

@@ -33,6 +33,8 @@ def _log_exp_tables() -> tuple[np.ndarray, np.ndarray]:
             x ^= GF_POLY
     exp[255:510] = exp[0:255]  # wraparound so exp[log a + log b] needs no mod
     log[0] = -1  # sentinel; 0 has no log
+    log.flags.writeable = False
+    exp.flags.writeable = False
     return log, exp
 
 
@@ -75,7 +77,9 @@ def mul_table() -> np.ndarray:
     t = exp[(la[:, None] + la[None, :])]
     t[0, :] = 0
     t[:, 0] = 0
-    return t.astype(np.uint8)
+    t = t.astype(np.uint8)
+    t.flags.writeable = False  # cached: mutation would corrupt all GF math
+    return t
 
 
 def gf_mul_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -146,6 +150,7 @@ def _coeff_bitmatrices() -> np.ndarray:
             col = gf_mul(c, 1 << j)
             for i in range(8):
                 out[c, i, j] = (col >> i) & 1
+    out.flags.writeable = False  # cached: see mul_table
     return out
 
 

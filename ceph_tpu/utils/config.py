@@ -117,6 +117,9 @@ class Config:
         opt = self._options.get(name)
         if opt is None:
             raise KeyError(f"unknown option {name!r}")
+        if layer == "override" and not opt.runtime:
+            raise ValueError(f"option {name} is not runtime-changeable "
+                             f"(flags: [runtime] absent)")
         value = opt.validate(value)
         old = self.get(name)
         self._layers[layer][name] = value

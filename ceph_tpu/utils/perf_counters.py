@@ -28,6 +28,7 @@ class _Counter:
     value: float = 0
     count: int = 0
     sum: float = 0.0
+    monotonic: bool = False  # add_u64_counter (inc-only) vs add_u64 (gauge)
     buckets: list = field(default_factory=lambda: [0] * 64)
 
 
@@ -49,7 +50,11 @@ class PerfCounters:
 
     def set(self, key: str, value: float) -> None:
         with self._lock:
-            self._get(key, TYPE_U64).value = value
+            c = self._get(key, TYPE_U64)
+            if c.monotonic:
+                raise TypeError(f"counter {key} is monotonic (add_u64_"
+                                f"counter); use inc(), not set()")
+            c.value = value
 
     def tinc(self, key: str, seconds: float) -> None:
         with self._lock:
@@ -110,10 +115,12 @@ class PerfCountersBuilder:
         self._pc = PerfCounters(name)
 
     def add_u64_counter(self, key: str, doc: str = "") -> "PerfCountersBuilder":
-        self._pc._counters[key] = _Counter(TYPE_U64, doc)
+        """Monotonic counter (inc-only), PERFCOUNTER_COUNTER analog."""
+        self._pc._counters[key] = _Counter(TYPE_U64, doc, monotonic=True)
         return self
 
     def add_u64(self, key: str, doc: str = "") -> "PerfCountersBuilder":
+        """Gauge (set allowed), plain PERFCOUNTER_U64 analog."""
         self._pc._counters[key] = _Counter(TYPE_U64, doc)
         return self
 

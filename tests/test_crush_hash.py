@@ -1,0 +1,92 @@
+"""rjenkins hash + crush_ln tests (self-consistency, vector==scalar,
+statistical quality of straw2 draws)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ceph_tpu.crush import hash as h
+from ceph_tpu.crush.ln_table import crush_ln, ll_table, rh_lh_tables
+
+
+class TestHash:
+    def test_deterministic_and_spread(self):
+        vals = {int(h.hash32_3(x, 7, 0)) for x in range(1000)}
+        assert len(vals) == 1000  # no collisions in a small sample
+        assert int(h.hash32_3(3, 7, 0)) == int(h.hash32_3(3, 7, 0))
+
+    def test_arity_variants_differ(self):
+        assert int(h.hash32_2(1, 2)) != int(h.hash32_3(1, 2, 0))
+        assert int(h.hash32_3(1, 2, 3)) != int(h.hash32_4(1, 2, 3, 0))
+
+    def test_vectorized_matches_scalar(self, rng):
+        a = rng.integers(0, 2 ** 32, size=256, dtype=np.uint32)
+        b = rng.integers(0, 2 ** 32, size=256, dtype=np.uint32)
+        c = rng.integers(0, 2 ** 32, size=256, dtype=np.uint32)
+        np_res = h.hash32_3(a, b, c)
+        jnp_res = np.asarray(h.hash32_3(jnp.asarray(a), jnp.asarray(b),
+                                        jnp.asarray(c), xp=jnp))
+        assert np.array_equal(np_res, jnp_res)
+        np2 = h.hash32_2(a, b)
+        jnp2 = np.asarray(h.hash32_2(jnp.asarray(a), jnp.asarray(b), xp=jnp))
+        assert np.array_equal(np2, jnp2)
+
+    def test_uniformity(self):
+        """Low bit bias check over a large sample (chi^2-ish)."""
+        x = np.arange(20000, dtype=np.uint32)
+        vals = h.hash32_3(x, np.uint32(42), np.uint32(0))
+        frac_msb = np.mean((vals >> 31) & 1)
+        assert 0.48 < frac_msb < 0.52
+        frac_lsb = np.mean(vals & 1)
+        assert 0.48 < frac_lsb < 0.52
+
+
+class TestCrushLn:
+    def test_tables_shapes(self):
+        rh, lh = rh_lh_tables()
+        assert rh.shape == (129,) and lh.shape == (129,)
+        assert ll_table().shape == (256,)
+
+    def test_endpoints(self):
+        # crush_ln(0) = 2^44*log2(1) = 0; crush_ln(0xffff) = 2^44*16 = 2^48.
+        assert int(crush_ln(np.array(0))) == 0
+        assert int(crush_ln(np.array(0xFFFF))) == 1 << 48
+
+    def test_monotone(self):
+        xs = np.arange(0x10000)
+        v = crush_ln(xs)
+        assert np.all(np.diff(v) >= 0)
+
+    def test_accuracy(self):
+        xs = np.arange(1, 0x10000)
+        got = crush_ln(xs).astype(np.float64)
+        want = 2.0 ** 44 * np.log2(xs + 1.0)
+        rel = np.abs(got - want) / np.maximum(want, 1)
+        assert rel.max() < 2e-4
+
+    def test_jnp_matches_np(self):
+        xs = np.arange(0, 0x10000, 17)
+        a = crush_ln(xs)
+        b = np.asarray(crush_ln(jnp.asarray(xs), xp=jnp))
+        assert np.array_equal(a, b)
+
+
+class TestStraw2Statistics:
+    def test_weight_proportional_selection(self):
+        """straw2's contract: selection probability proportional to weight
+        (the straw2 design goal; ref: mapper.c bucket_straw2_choose)."""
+        from ceph_tpu.crush import builder, mapper_ref
+        from ceph_tpu.crush.types import WEIGHT_ONE
+
+        weights = [WEIGHT_ONE, 2 * WEIGHT_ONE, 3 * WEIGHT_ONE,
+                   2 * WEIGHT_ONE]
+        m, root = builder.build_flat(4, weights=weights)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        n = 8000
+        counts = np.zeros(4)
+        for x in range(n):
+            counts[mapper_ref.do_rule(m, rid, x, 1)[0]] += 1
+        expect = np.array([1, 2, 3, 2], dtype=float) / 8 * n
+        # within 5 sigma of binomial noise
+        sigma = np.sqrt(expect * (1 - expect / n))
+        assert np.all(np.abs(counts - expect) < 5 * sigma), (counts, expect)

@@ -1,0 +1,199 @@
+"""CRUSH mapper tests.
+
+Two tiers, mirroring the reference's crush test strategy
+(ref: src/test/crush/TestCrushWrapper.cc + crushtool --test fixtures):
+1. semantic assertions on the scalar spec (distinct failure domains,
+   weight proportionality, reweight-out behavior);
+2. exact cross-validation of the vectorized JAX mapper against the scalar
+   spec over a matrix of map shapes, algorithms and rules, including
+   randomized maps.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import builder, mapper_ref
+from ceph_tpu.crush.mapper import Mapper
+from ceph_tpu.crush.types import (
+    ALG_LIST, ALG_STRAW2, ALG_UNIFORM, ITEM_NONE, WEIGHT_ONE,
+    OP_CHOOSE_FIRSTN, OP_CHOOSE_INDEP, OP_CHOOSELEAF_FIRSTN, RuleStep,
+    Tunables,
+)
+
+N_X = 256  # xs per config; full sweeps ran during bring-up
+
+
+def assert_match(m, rid, numrep, xs=None, weights=None):
+    xs = xs if xs is not None else np.arange(N_X, dtype=np.uint32)
+    mapper = Mapper(m, np.asarray(weights, dtype=np.int64)
+                    if weights is not None else None)
+    got = np.asarray(mapper.map_pgs(rid, xs, numrep))
+    wl = list(weights) if weights is not None else None
+    for i, x in enumerate(xs):
+        ref = mapper_ref.do_rule(m, rid, int(x), numrep, weight=wl)
+        ref = ref + [ITEM_NONE] * (numrep - len(ref))
+        assert list(got[i]) == ref, (int(x), list(got[i]), ref)
+
+
+class TestScalarSemantics:
+    def test_firstn_distinct_and_complete(self):
+        m, root = builder.build_flat(10)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        for x in range(300):
+            out = mapper_ref.do_rule(m, rid, x, 3)
+            assert len(out) == 3 and len(set(out)) == 3
+
+    def test_chooseleaf_distinct_hosts(self):
+        m, root = builder.build_hierarchy(6, 4)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        for x in range(300):
+            out = mapper_ref.do_rule(m, rid, x, 3)
+            hosts = {o // 4 for o in out}
+            assert len(hosts) == 3
+
+    def test_indep_positions_and_domains(self):
+        m, root = builder.build_hierarchy(8, 2)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST, indep=True)
+        for x in range(200):
+            out = mapper_ref.do_rule(m, rid, x, 6)
+            assert len(out) == 6
+            real = [o for o in out if o != ITEM_NONE]
+            assert len({o // 2 for o in real}) == len(real)
+
+    def test_reweight_zero_excludes(self):
+        m, root = builder.build_flat(5)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        w = [0x10000, 0, 0x10000, 0x10000, 0x10000]
+        for x in range(200):
+            assert 1 not in mapper_ref.do_rule(m, rid, x, 3, weight=w)
+
+    def test_mapping_stability_under_weight_change(self):
+        """CRUSH's core promise: adjusting one item's weight only moves
+        data to/from that item (statistically)."""
+        m1, root1 = builder.build_flat(8)
+        r1 = builder.add_simple_rule(m1, root1, builder.TYPE_OSD)
+        w2 = [WEIGHT_ONE] * 8
+        w2[3] = WEIGHT_ONE // 2
+        m2, root2 = builder.build_flat(8, weights=w2)
+        r2 = builder.add_simple_rule(m2, root2, builder.TYPE_OSD)
+        moved_not_involving_3 = 0
+        total_moved = 0
+        for x in range(500):
+            a = mapper_ref.do_rule(m1, r1, x, 1)[0]
+            b = mapper_ref.do_rule(m2, r2, x, 1)[0]
+            if a != b:
+                total_moved += 1
+                if a != 3 and b != 3:
+                    moved_not_involving_3 += 1
+        assert total_moved > 0
+        assert moved_not_involving_3 == 0
+
+    def test_legacy_tunables_run(self):
+        """The scalar spec also executes legacy tunables (retries>0)."""
+        m, root = builder.build_hierarchy(4, 3, tunables=Tunables.legacy())
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        out = mapper_ref.do_rule(m, rid, 42, 3)
+        assert len(out) == 3
+
+
+class TestJaxMatchesScalar:
+    def test_flat_straw2(self):
+        m, root = builder.build_flat(10)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        assert_match(m, rid, 3)
+
+    def test_flat_list(self):
+        m, root = builder.build_flat(7, alg=ALG_LIST)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        assert_match(m, rid, 3)
+
+    def test_hierarchy_chooseleaf_firstn(self):
+        m, root = builder.build_hierarchy(6, 4)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        assert_match(m, rid, 3)
+
+    def test_hierarchy_chooseleaf_indep(self):
+        m, root = builder.build_hierarchy(6, 4)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST, indep=True)
+        assert_match(m, rid, 5)
+
+    def test_uniform_buckets(self):
+        m, root = builder.build_hierarchy(5, 4, alg=ALG_UNIFORM)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        assert_match(m, rid, 3)
+        rid2 = builder.add_simple_rule(m, root, builder.TYPE_HOST, indep=True)
+        assert_match(m, rid2, 4)
+
+    def test_three_level_multistep(self):
+        m, root = builder.build_hierarchy(8, 2, n_racks=4)
+        rid = builder.add_multistep_rule(m, root, [
+            RuleStep(OP_CHOOSE_FIRSTN, 2, builder.TYPE_RACK),
+            RuleStep(OP_CHOOSELEAF_FIRSTN, 2, builder.TYPE_HOST)])
+        assert_match(m, rid, 4)
+
+    def test_choose_indep_direct_osd(self):
+        m, root = builder.build_hierarchy(6, 3)
+        rid = builder.add_multistep_rule(
+            m, root, [RuleStep(OP_CHOOSE_INDEP, 0, 0)], indep=True)
+        assert_match(m, rid, 4)
+
+    def test_failure_holes(self):
+        """More shards than failure domains: indep emits NONE holes,
+        firstn underfills — both must match the spec exactly."""
+        m, root = builder.build_hierarchy(4, 2)
+        ri = builder.add_simple_rule(m, root, builder.TYPE_HOST, indep=True)
+        assert_match(m, ri, 5)
+        rf = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        assert_match(m, rf, 5)
+
+    def test_weights_and_reweights(self):
+        m, root = builder.build_flat(
+            6, weights=[2 * WEIGHT_ONE, WEIGHT_ONE, WEIGHT_ONE, 0,
+                        WEIGHT_ONE, WEIGHT_ONE // 2])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        assert_match(m, rid, 3,
+                     weights=[0x10000, 0x8000, 0x10000, 0x10000, 0, 0x4000])
+
+    def test_zero_weight_subtree(self):
+        m, root = builder.build_hierarchy(
+            4, 3, osd_weights=[0, 0, 0] + [WEIGHT_ONE] * 9)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        assert_match(m, rid, 3)
+
+    def test_randomized_maps(self, rng):
+        """Fuzz: random hierarchy shapes, algs, weights, rule kinds."""
+        for trial in range(4):
+            n_hosts = int(rng.integers(3, 9))
+            per = int(rng.integers(1, 5))
+            alg = [ALG_STRAW2, ALG_UNIFORM, ALG_LIST][trial % 3]
+            weights = [int(w) for w in rng.integers(
+                0, 4 * WEIGHT_ONE, size=n_hosts * per)]
+            if alg == ALG_UNIFORM:
+                weights = [WEIGHT_ONE] * (n_hosts * per)
+            m, root = builder.build_hierarchy(n_hosts, per, alg=alg,
+                                              osd_weights=weights)
+            indep = bool(trial % 2)
+            rid = builder.add_simple_rule(m, root, builder.TYPE_HOST,
+                                          indep=indep)
+            numrep = int(rng.integers(2, min(n_hosts, 6) + 1))
+            xs = rng.integers(0, 2 ** 32, size=128, dtype=np.uint32)
+            assert_match(m, rid, numrep, xs=xs)
+
+    def test_device_weight_update_no_recompile(self):
+        m, root = builder.build_flat(6)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        mapper = Mapper(m)
+        xs = np.arange(64, dtype=np.uint32)
+        a = np.asarray(mapper.map_pgs(rid, xs, 2))
+        w = np.full(6, WEIGHT_ONE, dtype=np.int64)
+        w[0] = 0
+        mapper.set_device_weights(w)
+        b = np.asarray(mapper.map_pgs(rid, xs, 2))
+        assert not np.array_equal(a, b)
+        assert 0 not in b
+
+    def test_legacy_tunables_rejected(self):
+        m, root = builder.build_flat(4, tunables=Tunables.legacy())
+        builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        with pytest.raises(NotImplementedError):
+            Mapper(m)

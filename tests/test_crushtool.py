@@ -1,0 +1,68 @@
+"""CrushTester + crushtool CLI tests."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.bench.crushtool import main, parse_args
+from ceph_tpu.crush import builder
+from ceph_tpu.crush.tester import CrushTester
+from ceph_tpu.crush.types import WEIGHT_ONE
+
+
+class TestCrushTester:
+    def test_counts_and_badmaps(self):
+        m, root = builder.build_hierarchy(5, 2)
+        builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        t = CrushTester(m)
+        res = t.test(0, 3, 0, 511)
+        assert res.total_x == 512
+        assert res.device_counts.sum() == 512 * 3
+        assert res.bad_mappings == 0
+        s = res.utilization_summary()
+        assert s["active_devices"] == 10
+        assert s["placements"] == 512 * 3
+
+    def test_bad_mappings_counted(self):
+        # 3 hosts, ask 5 replicas by host -> every x underfills.
+        m, root = builder.build_hierarchy(3, 2)
+        builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        res = CrushTester(m).test(0, 5, 0, 63)
+        assert res.bad_mappings == 64
+
+    def test_batching_equivalence(self):
+        m, root = builder.build_flat(8)
+        builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        a = CrushTester(m, batch=64).test(0, 2, 0, 255)
+        b = CrushTester(m, batch=1 << 20).test(0, 2, 0, 255)
+        assert np.array_equal(a.device_counts, b.device_counts)
+
+    def test_weight_override(self):
+        m, root = builder.build_flat(4)
+        builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        w = np.full(4, WEIGHT_ONE, dtype=np.int64)
+        w[2] = 0
+        res = CrushTester(m, w).test(0, 2, 0, 255)
+        assert res.device_counts[2] == 0
+
+
+class TestCrushtoolCLI:
+    def test_build_test_json(self, capsys):
+        out = main(["--build", "--num-osds", "8", "--hosts", "4", "--test",
+                    "--num-rep", "2", "--max-x", "127", "--json"])
+        assert out["total_x"] == 128
+        assert out["bad_mappings"] == 0
+        assert out["utilization"]["placements"] == 256
+
+    def test_weight_flag(self):
+        out = main(["--build", "--num-osds", "4", "--test", "--num-rep",
+                    "2", "--max-x", "127", "--weight", "1", "0.0"])
+        # device 1 reweighted to 0 -> no placements
+        assert out["utilization"]["active_devices"] == 3
+
+    def test_requires_build(self):
+        with pytest.raises(SystemExit):
+            main(["--test"])
+
+    def test_uneven_hosts_rejected(self):
+        with pytest.raises(SystemExit):
+            main(["--build", "--num-osds", "10", "--hosts", "4"])
