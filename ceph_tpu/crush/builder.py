@@ -102,6 +102,60 @@ def build_hierarchy(n_hosts: int, osds_per_host: int,
     return m, root
 
 
+def _parents(map_: CrushMap) -> dict[int, int]:
+    return {child: b.id for b in map_.buckets.values() for child in b.items}
+
+
+def insert_item(map_: CrushMap, item: int, weight: int,
+                bucket_id: int) -> None:
+    """Add a device/bucket under `bucket_id` and propagate the weight
+    delta to ancestors (ref: src/crush/CrushWrapper.cc insert_item +
+    adjust_item_weight)."""
+    b = map_.buckets[bucket_id]
+    if item in b.items:
+        raise ValueError(f"item {item} already in bucket {bucket_id}")
+    b.items.append(item)
+    b.weights.append(weight)
+    if item >= 0:
+        map_.max_devices = max(map_.max_devices, item + 1)
+    _adjust_ancestors(map_, bucket_id, weight)
+
+
+def remove_item(map_: CrushMap, item: int) -> None:
+    """Unlink a device/bucket from its parent
+    (ref: CrushWrapper.cc remove_item)."""
+    for b in map_.buckets.values():
+        if item in b.items:
+            i = b.items.index(item)
+            w = b.weights[i]
+            del b.items[i]
+            del b.weights[i]
+            _adjust_ancestors(map_, b.id, -w)
+            return
+    raise ValueError(f"item {item} not in any bucket")
+
+
+def adjust_item_weight(map_: CrushMap, item: int, weight: int) -> None:
+    """Set the CRUSH weight of an item everywhere it appears
+    (ref: CrushWrapper.cc adjust_item_weight)."""
+    for b in map_.buckets.values():
+        if item in b.items:
+            i = b.items.index(item)
+            delta = weight - b.weights[i]
+            b.weights[i] = weight
+            _adjust_ancestors(map_, b.id, delta)
+
+
+def _adjust_ancestors(map_: CrushMap, bucket_id: int, delta: int) -> None:
+    parents = _parents(map_)
+    cur = bucket_id
+    while cur in parents:
+        parent = map_.buckets[parents[cur]]
+        i = parent.items.index(cur)
+        parent.weights[i] += delta
+        cur = parent.id
+
+
 def add_simple_rule(map_: CrushMap, root: int, failure_domain_type: int,
                     name: str = "", rule_id: int | None = None,
                     indep: bool = False) -> int:

@@ -1,0 +1,17 @@
+"""OSDMap-lite: object->PG->OSD placement (ref: src/osd/OSDMap.{h,cc}).
+
+The data path's pure placement math, re-built batch-first: every query
+takes an array of PG seeds and returns arrays of OSD sets, so the whole
+cluster's placement can be computed in one device program.
+"""
+
+from ceph_tpu.osd.str_hash import (  # noqa: F401
+    CEPH_STR_HASH_LINUX, CEPH_STR_HASH_RJENKINS,
+)
+from ceph_tpu.osd.types import (  # noqa: F401
+    PGPool, ObjectLocator, pg_t, spg_t,
+    POOL_TYPE_REPLICATED, POOL_TYPE_ERASURE,
+    FLAG_HASHPSPOOL, ceph_stable_mod,
+)
+from ceph_tpu.osd.osdmap import OSDMap  # noqa: F401
+from ceph_tpu.osd.str_hash import str_hash, str_hash_batch  # noqa: F401

@@ -1,0 +1,427 @@
+"""OSDMap: epoch-versioned cluster map + batched PG->OSD placement.
+
+ref: src/osd/OSDMap.{h,cc} (OSDMap, OSDMap::Incremental). The reference
+maps one PG per call (pg_to_up_acting_osds); here the same pipeline —
+pps, CRUSH, nonexistent-removal, upmap, up-filter, primary affinity,
+pg_temp — runs over an entire seed array at once, with the CRUSH step on
+the accelerator and the sparse overrides (upmap/pg_temp, typically a few
+thousand entries) as host-side scatters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ceph_tpu.crush import hash as chash
+from ceph_tpu.crush.mapper import Mapper
+from ceph_tpu.crush.types import ITEM_NONE, WEIGHT_ONE, CrushMap
+from ceph_tpu.osd.types import ObjectLocator, PGPool, pg_t
+
+MAX_PRIMARY_AFFINITY = 0x10000  # ref: CEPH_OSD_MAX_PRIMARY_AFFINITY
+DEFAULT_PRIMARY_AFFINITY = 0x10000
+
+# osd_state flags (ref: src/osd/OSDMap.h CEPH_OSD_EXISTS / CEPH_OSD_UP).
+STATE_EXISTS = 1
+STATE_UP = 2
+
+
+_EMPTY_ROWS = np.empty(0, dtype=np.int64)
+
+
+def _index_overrides(folded: np.ndarray, pgs) -> dict[int, np.ndarray]:
+    """seed -> matching row indices, one O(N log E) pass instead of an
+    O(N) scan per override entry."""
+    seeds = np.unique(np.array([pg.seed for pg in pgs], dtype=folded.dtype))
+    if not seeds.size:
+        return {}
+    hit = np.flatnonzero(np.isin(folded, seeds))
+    out: dict[int, np.ndarray] = {}
+    for s in seeds:
+        out[int(s)] = hit[folded[hit] == s]
+    return out
+
+
+def _shift_left(rows: np.ndarray) -> np.ndarray:
+    """Stable left-compaction of non-NONE entries (replicated up-sets)."""
+    w = rows.shape[1]
+    keys = np.where(rows == ITEM_NONE, w, 0) + np.arange(w)[None, :]
+    order = np.argsort(keys, axis=1, kind="stable")
+    return np.take_along_axis(rows, order, axis=1)
+
+
+@dataclass
+class Incremental:
+    """A delta between epochs (ref: OSDMap::Incremental — same role,
+    dict-shaped instead of encoded)."""
+
+    epoch: int = 0
+    new_max_osd: int | None = None
+    new_pools: dict[int, PGPool] = field(default_factory=dict)
+    old_pools: list[int] = field(default_factory=list)
+    new_up: list[int] = field(default_factory=list)
+    new_down: list[int] = field(default_factory=list)
+    new_weight: dict[int, int] = field(default_factory=dict)
+    new_primary_affinity: dict[int, int] = field(default_factory=dict)
+    new_pg_temp: dict[pg_t, list[int]] = field(default_factory=dict)
+    new_primary_temp: dict[pg_t, int] = field(default_factory=dict)
+    new_pg_upmap: dict[pg_t, tuple] = field(default_factory=dict)
+    old_pg_upmap: list[pg_t] = field(default_factory=list)
+    new_pg_upmap_items: dict[pg_t, list] = field(default_factory=dict)
+    old_pg_upmap_items: list[pg_t] = field(default_factory=list)
+    new_crush: CrushMap | None = None
+
+
+class OSDMap:
+    """The authoritative placement state at one epoch."""
+
+    def __init__(self, crush: CrushMap, max_osd: int | None = None):
+        self.epoch = 1
+        self.crush = crush
+        self.max_osd = max_osd if max_osd is not None else crush.max_devices
+        n = self.max_osd
+        self.osd_state = np.full(n, STATE_EXISTS | STATE_UP, dtype=np.int32)
+        self.osd_weight = np.full(n, WEIGHT_ONE, dtype=np.int64)
+        self.osd_primary_affinity = np.full(n, DEFAULT_PRIMARY_AFFINITY,
+                                            dtype=np.int64)
+        self.pools: dict[int, PGPool] = {}
+        self.pg_temp: dict[pg_t, list[int]] = {}
+        self.primary_temp: dict[pg_t, int] = {}
+        self.pg_upmap: dict[pg_t, tuple] = {}
+        self.pg_upmap_items: dict[pg_t, list] = {}
+        self._mapper: Mapper | None = None
+
+    # -- state predicates (array-capable) ---------------------------------
+    def exists(self, osd):
+        safe = np.clip(osd, 0, self.max_osd - 1)
+        ok = (self.osd_state[safe] & STATE_EXISTS) != 0
+        return ok & (np.asarray(osd) >= 0) & (np.asarray(osd) < self.max_osd)
+
+    def is_up(self, osd):
+        safe = np.clip(osd, 0, self.max_osd - 1)
+        return (self.osd_state[safe] & STATE_UP) != 0
+
+    def is_out(self, osd) -> bool:
+        return self.osd_weight[osd] == 0
+
+    # -- mutation (each bumps the epoch; ref: OSDMap::apply_incremental) --
+    def _dirty(self, crush_changed: bool = False) -> None:
+        self.epoch += 1
+        if crush_changed:
+            self._mapper = None
+
+    def set_max_osd(self, n: int) -> None:
+        grow = n - self.max_osd
+        if grow > 0:
+            self.osd_state = np.concatenate(
+                [self.osd_state, np.zeros(grow, dtype=np.int32)])
+            self.osd_weight = np.concatenate(
+                [self.osd_weight, np.zeros(grow, dtype=np.int64)])
+            self.osd_primary_affinity = np.concatenate(
+                [self.osd_primary_affinity,
+                 np.full(grow, DEFAULT_PRIMARY_AFFINITY, dtype=np.int64)])
+        else:
+            self.osd_state = self.osd_state[:n].copy()
+            self.osd_weight = self.osd_weight[:n].copy()
+            self.osd_primary_affinity = self.osd_primary_affinity[:n].copy()
+        self.max_osd = n
+        self.crush.max_devices = max(self.crush.max_devices, n)
+        self._dirty(crush_changed=True)
+
+    def create_osd(self, osd: int, weight: int = WEIGHT_ONE) -> None:
+        if osd >= self.max_osd:
+            self.set_max_osd(osd + 1)
+        self.osd_state[osd] = STATE_EXISTS | STATE_UP
+        self.osd_weight[osd] = weight
+        self._dirty()
+
+    def mark_up(self, osd: int) -> None:
+        self.osd_state[osd] |= STATE_UP
+        self._dirty()
+
+    def mark_down(self, osd: int) -> None:
+        self.osd_state[osd] &= ~STATE_UP
+        self._dirty()
+
+    def mark_out(self, osd: int) -> None:
+        self.set_weight(osd, 0)
+
+    def mark_in(self, osd: int) -> None:
+        self.set_weight(osd, WEIGHT_ONE)
+
+    def set_weight(self, osd: int, weight: int) -> None:
+        """The in/out reweight (16.16), consumed by CRUSH's is_out check."""
+        self.osd_weight[osd] = weight
+        if self._mapper is not None:
+            self._mapper.set_device_weights(self._device_weights())
+        self._dirty()
+
+    def set_primary_affinity(self, osd: int, aff: int) -> None:
+        self.osd_primary_affinity[osd] = aff
+        self._dirty()
+
+    def insert_crush_item(self, osd: int, weight: int,
+                          bucket_id: int) -> None:
+        """create + link an OSD into the CRUSH tree (the `ceph osd crush
+        add` path: CrushWrapper::insert_item)."""
+        from ceph_tpu.crush import builder
+        if osd >= self.max_osd:
+            self.set_max_osd(osd + 1)
+            self.epoch -= 1
+        self.osd_state[osd] = STATE_EXISTS | STATE_UP
+        self.osd_weight[osd] = WEIGHT_ONE
+        builder.insert_item(self.crush, osd, weight, bucket_id)
+        self.crush.max_devices = max(self.crush.max_devices, self.max_osd)
+        self._dirty(crush_changed=True)
+
+    def remove_crush_item(self, osd: int) -> None:
+        """unlink + mark gone (ref: CrushWrapper::remove_item +
+        OSDMap rm)."""
+        from ceph_tpu.crush import builder
+        builder.remove_item(self.crush, osd)
+        self.osd_state[osd] = 0
+        self.osd_weight[osd] = 0
+        self._dirty(crush_changed=True)
+
+    def set_crush(self, crush: CrushMap) -> None:
+        self.crush = crush
+        if crush.max_devices > self.max_osd:
+            self.set_max_osd(crush.max_devices)
+        self._dirty(crush_changed=True)
+
+    def add_pool(self, pool: PGPool) -> PGPool:
+        self.pools[pool.id] = pool
+        self._dirty()
+        return pool
+
+    def apply_incremental(self, inc: Incremental) -> None:
+        """ref: OSDMap::apply_incremental."""
+        if inc.epoch and inc.epoch != self.epoch + 1:
+            raise ValueError(
+                f"incremental epoch {inc.epoch} != {self.epoch + 1}")
+        if inc.new_crush is not None:
+            self.crush = inc.new_crush
+            self._mapper = None
+        if inc.new_max_osd is not None:
+            self.set_max_osd(inc.new_max_osd)
+            self.epoch -= 1  # counted once below
+        for pid in inc.old_pools:
+            self.pools.pop(pid, None)
+        self.pools.update(inc.new_pools)
+        for o in inc.new_up:
+            self.osd_state[o] |= STATE_EXISTS | STATE_UP
+        for o in inc.new_down:
+            self.osd_state[o] &= ~STATE_UP
+        for o, w in inc.new_weight.items():
+            self.osd_weight[o] = w
+        for o, a in inc.new_primary_affinity.items():
+            self.osd_primary_affinity[o] = a
+        for pg, osds in inc.new_pg_temp.items():
+            if osds:
+                self.pg_temp[pg] = list(osds)
+            else:
+                self.pg_temp.pop(pg, None)
+        for pg, p in inc.new_primary_temp.items():
+            if p >= 0:
+                self.primary_temp[pg] = p
+            else:
+                self.primary_temp.pop(pg, None)
+        self.pg_upmap.update(inc.new_pg_upmap)
+        for pg in inc.old_pg_upmap:
+            self.pg_upmap.pop(pg, None)
+        self.pg_upmap_items.update(inc.new_pg_upmap_items)
+        for pg in inc.old_pg_upmap_items:
+            self.pg_upmap_items.pop(pg, None)
+        if self._mapper is not None:
+            self._mapper.set_device_weights(self._device_weights())
+        self.epoch += 1
+
+    # -- mapper -----------------------------------------------------------
+    def _device_weights(self) -> np.ndarray:
+        w = np.zeros(max(self.crush.max_devices, self.max_osd),
+                     dtype=np.int64)
+        w[:self.max_osd] = self.osd_weight
+        return w
+
+    def mapper(self) -> Mapper:
+        if self._mapper is None:
+            self._mapper = Mapper(self.crush,
+                                  device_weights=self._device_weights())
+        return self._mapper
+
+    # -- object -> PG ------------------------------------------------------
+    def object_locator_to_pg(self, name: str, loc: ObjectLocator) -> pg_t:
+        """ref: OSDMap::object_locator_to_pg (raw pg; fold with
+        pool.raw_pg_to_pg)."""
+        pool = self.pools[loc.pool]
+        if loc.hash >= 0:
+            ps = loc.hash
+        else:
+            ps = pool.hash_key(loc.key or name, loc.nspace)
+        return pg_t(loc.pool, ps)
+
+    # -- PG -> OSDs, batched ----------------------------------------------
+    def pg_to_raw_osds(self, pool_id: int,
+                       seeds) -> tuple[np.ndarray, np.ndarray]:
+        """CRUSH output with nonexistent devices removed
+        (ref: OSDMap::pg_to_raw_osds)."""
+        pool = self.pools[pool_id]
+        seeds = np.asarray(seeds, dtype=np.uint32)
+        pps = pool.raw_pg_to_pps(seeds, xp=np)
+        raw = np.asarray(self.mapper().map_pgs(pool.crush_rule, pps,
+                                               pool.size))
+        return self._remove_nonexistent(pool, raw), pps
+
+    def _remove_nonexistent(self, pool: PGPool, raw: np.ndarray) -> np.ndarray:
+        """ref: OSDMap::_remove_nonexistent_osds."""
+        bad = (raw != ITEM_NONE) & ~self.exists(raw)
+        raw = np.where(bad, ITEM_NONE, raw)
+        if pool.can_shift_osds():
+            raw = _shift_left(raw)
+        return raw
+
+    def _apply_upmap(self, pool: PGPool, seeds: np.ndarray,
+                     raw: np.ndarray) -> np.ndarray:
+        """Sparse explicit overrides (ref: OSDMap::_apply_upmap)."""
+        if not self.pg_upmap and not self.pg_upmap_items:
+            return raw
+        folded = pool.raw_pg_to_pg(seeds, xp=np)
+        rows_of = _index_overrides(
+            folded, [pg for pg in self.pg_upmap if pg.pool == pool.id] +
+            [pg for pg in self.pg_upmap_items if pg.pool == pool.id])
+        for pg, target in self.pg_upmap.items():
+            if pg.pool != pool.id:
+                continue
+            rows = rows_of.get(pg.seed, _EMPTY_ROWS)
+            if not rows.size:
+                continue
+            if any(o != ITEM_NONE and (o < 0 or o >= self.max_osd or
+                                       self.osd_weight[o] == 0)
+                   for o in target):
+                continue  # reject mappings onto out/invalid osds
+            row = np.full(raw.shape[1], ITEM_NONE, dtype=raw.dtype)
+            row[:min(len(target), raw.shape[1])] = \
+                list(target)[:raw.shape[1]]
+            raw[rows] = row
+        for pg, pairs in self.pg_upmap_items.items():
+            if pg.pool != pool.id:
+                continue
+            rows = rows_of.get(pg.seed, _EMPTY_ROWS)
+            for ri in rows:
+                row = raw[ri]
+                for frm, to in pairs:
+                    if to in row:
+                        continue
+                    if to < 0 or to >= self.max_osd or \
+                            self.osd_weight[to] == 0:
+                        continue
+                    pos = np.flatnonzero(row == frm)
+                    if pos.size:
+                        row[pos[0]] = to
+        return raw
+
+    def _raw_to_up(self, pool: PGPool, raw: np.ndarray) -> np.ndarray:
+        """Drop down/gone devices (ref: OSDMap::_raw_to_up_osds)."""
+        ok = (raw != ITEM_NONE) & self.exists(raw) & self.is_up(
+            np.clip(raw, 0, self.max_osd - 1))
+        up = np.where(ok, raw, ITEM_NONE)
+        if pool.can_shift_osds():
+            up = _shift_left(up)
+        return up
+
+    @staticmethod
+    def _pick_primary(osds: np.ndarray) -> np.ndarray:
+        """First non-NONE entry per row, -1 if none
+        (ref: OSDMap::_pick_primary)."""
+        valid = osds != ITEM_NONE
+        has = valid.any(axis=1)
+        pos = np.argmax(valid, axis=1)
+        return np.where(has, np.take_along_axis(
+            osds, pos[:, None], axis=1)[:, 0], -1)
+
+    def _apply_primary_affinity(self, pps: np.ndarray, up: np.ndarray,
+                                primary: np.ndarray) -> np.ndarray:
+        """ref: OSDMap::_apply_primary_affinity — hash-gated pass-over of
+        low-affinity primaries, vectorized over (pg, slot)."""
+        if (self.osd_primary_affinity == DEFAULT_PRIMARY_AFFINITY).all():
+            return primary
+        valid = up != ITEM_NONE
+        safe = np.clip(up, 0, self.max_osd - 1)
+        aff = self.osd_primary_affinity[safe]
+        h = chash.hash32_2(pps[:, None].astype(np.uint32),
+                           up.astype(np.uint32), xp=np).astype(np.int64) >> 16
+        accept = valid & ((aff >= MAX_PRIMARY_AFFINITY) | (h < aff))
+        any_acc = accept.any(axis=1)
+        pos = np.argmax(accept, axis=1)
+        cand = np.take_along_axis(up, pos[:, None], axis=1)[:, 0]
+        return np.where(any_acc, cand, primary)
+
+    def _get_temp_osds(self, pool: PGPool, seeds: np.ndarray,
+                       up: np.ndarray, up_primary: np.ndarray):
+        """ref: OSDMap::_get_temp_osds."""
+        acting = up.copy()
+        acting_primary = up_primary.copy()
+        if not self.pg_temp and not self.primary_temp:
+            return acting, acting_primary
+        folded = pool.raw_pg_to_pg(seeds, xp=np)
+        rows_of = _index_overrides(
+            folded, [pg for pg in self.pg_temp if pg.pool == pool.id] +
+            [pg for pg in self.primary_temp if pg.pool == pool.id])
+        for pg, osds in self.pg_temp.items():
+            if pg.pool != pool.id:
+                continue
+            rows = rows_of.get(pg.seed, _EMPTY_ROWS)
+            if not rows.size:
+                continue
+            kept = [o for o in osds if o == ITEM_NONE or bool(
+                self.exists(np.asarray(o)))]
+            if not any(o != ITEM_NONE for o in kept):
+                continue
+            row = np.full(acting.shape[1], ITEM_NONE, dtype=acting.dtype)
+            row[:min(len(kept), len(row))] = kept[:len(row)]
+            acting[rows] = row
+            prim = next((o for o in kept if o != ITEM_NONE), -1)
+            acting_primary[rows] = prim
+        for pg, p in self.primary_temp.items():
+            if pg.pool != pool.id:
+                continue
+            acting_primary[rows_of.get(pg.seed, _EMPTY_ROWS)] = p
+        return acting, acting_primary
+
+    def pg_to_up_acting_osds(self, pool_id: int, seeds):
+        """The full pipeline (ref: OSDMap::_pg_to_up_acting_osds).
+
+        seeds: (N,) actual pg seeds in [0, pg_num). Returns
+        (up (N,size), up_primary (N,), acting, acting_primary).
+        """
+        pool = self.pools[pool_id]
+        seeds = np.atleast_1d(np.asarray(seeds, dtype=np.uint32))
+        raw, pps = self.pg_to_raw_osds(pool_id, seeds)
+        raw = self._apply_upmap(pool, seeds, raw)
+        up = self._raw_to_up(pool, raw)
+        up_primary = self._pick_primary(up)
+        up_primary = self._apply_primary_affinity(pps, up, up_primary)
+        acting, acting_primary = self._get_temp_osds(pool, seeds, up,
+                                                     up_primary)
+        return up, up_primary, acting, acting_primary
+
+    def pg_to_acting_osds(self, pool_id: int, seeds):
+        _, _, acting, acting_primary = self.pg_to_up_acting_osds(pool_id,
+                                                                 seeds)
+        return acting, acting_primary
+
+    def map_pool(self, pool_id: int):
+        """All PGs of a pool in one call -> (up, up_primary, acting,
+        acting_primary), shape (pg_num, ...)."""
+        pool = self.pools[pool_id]
+        return self.pg_to_up_acting_osds(
+            pool_id, np.arange(pool.pg_num, dtype=np.uint32))
+
+    # -- utilization ------------------------------------------------------
+    def pool_utilization(self, pool_id: int) -> np.ndarray:
+        """PG count per OSD for one pool (the CrushTester aggregate,
+        ref: src/crush/CrushTester.cc test aggregation)."""
+        up, _, _, _ = self.map_pool(pool_id)
+        flat = up[up != ITEM_NONE]
+        return np.bincount(flat, minlength=self.max_osd)

@@ -1,0 +1,10 @@
+"""Cluster-churn simulation (failure/recovery rebalance analysis).
+
+The TPU-shaped stand-in for the reference's thrashing suites
+(ref: qa/tasks/ceph_manager.py Thrasher; src/tools/osdmaptool.cc
+--test-map-pgs): replay OSD add/remove/reweight events over an OSDMap and
+measure, for every epoch, how much data CRUSH remaps — all placements
+computed batch-wise on the accelerator.
+"""
+
+from ceph_tpu.sim.churn import ChurnSim, ChurnEvent, StepReport  # noqa: F401

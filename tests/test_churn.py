@@ -1,0 +1,86 @@
+"""Churn simulator tests (BASELINE config #5): CRUSH's rebalance
+optimality properties under OSD add/remove, plus the osdmaptool CLI."""
+
+import numpy as np
+
+from ceph_tpu.bench import osdmaptool
+from ceph_tpu.crush.types import ITEM_NONE, WEIGHT_ONE
+from ceph_tpu.sim import ChurnEvent, ChurnSim
+
+
+def make_sim(n_osds=32, pg_num=256, size=3, erasure=False):
+    m = osdmaptool.create_simple(n_osds, pg_num, size, erasure)
+    return ChurnSim(m, 1)
+
+
+class TestChurn:
+    def test_out_moves_proportional_data(self):
+        """Marking one of 32 OSDs out should move roughly the victim's
+        share of shards (CRUSH minimal-movement property), not reshuffle
+        the cluster."""
+        sim = make_sim()
+        rep = sim.apply(ChurnEvent("out", 5))
+        assert rep.degraded_pgs == 0  # re-replicated immediately
+        # victim held ~3*256/32 = 24 shards; movement should be near that
+        assert 0 < rep.shards_moved < 3 * 256 * 0.15
+
+    def test_down_then_revive_restores(self):
+        sim = make_sim()
+        before = sim._up.copy()
+        sim.apply(ChurnEvent("down", 9))
+        sim.apply(ChurnEvent("out", 9))
+        sim.apply(ChurnEvent("in", 9))
+        rep = sim.apply(ChurnEvent("up", 9))
+        assert rep.degraded_pgs == 0
+        assert (sim._up == before).all()  # placement is a pure function
+
+    def test_down_degrades_ec(self):
+        sim = make_sim(erasure=True, size=5)
+        victim = int(sim._up[0, 0])
+        rep = sim.apply(ChurnEvent("down", victim))
+        assert rep.degraded_pgs > 0  # holes until marked out
+        rep2 = sim.apply(ChurnEvent("out", victim))
+        assert rep2.degraded_pgs == 0  # backfill targets found
+
+    def test_add_osd_rebalances_minimally(self):
+        sim = make_sim()
+        n_shards = 3 * 256
+        rep = sim.apply(ChurnEvent("add", 32, WEIGHT_ONE))
+        # new osd takes ~1/33 of shards; movement bounded well below that x3
+        assert 0 < rep.shards_moved < n_shards * 0.12
+
+    def test_random_thrash_converges(self):
+        sim = make_sim()
+        rng = np.random.default_rng(7)
+        sim.random_thrash(rng, 12)
+        # revive everything
+        for o in range(sim.map.max_osd):
+            sim.map.mark_up(o)
+            sim.map.mark_in(o)
+        up, _, _, _ = sim.map.map_pool(1)
+        assert (up != ITEM_NONE).all()
+
+    def test_summary(self):
+        sim = make_sim()
+        sim.apply(ChurnEvent("out", 1))
+        s = sim.summary()
+        assert s["events"] == 1 and s["total_shards_moved"] > 0
+
+
+class TestOsdmaptoolCLI:
+    def test_test_map_pgs(self, capsys):
+        rc = osdmaptool.main(["--createsimple", "16", "--pg-num", "128",
+                              "--test-map-pgs", "--format", "json"])
+        assert rc == 0
+        import json
+        out = json.loads(capsys.readouterr().out)
+        assert out["map_pgs"]["degraded_pgs"] == 0
+        assert out["map_pgs"]["avg"] > 0
+
+    def test_churn_cli(self, capsys):
+        rc = osdmaptool.main(["--createsimple", "16", "--pg-num", "64",
+                              "--churn", "4", "--format", "json"])
+        assert rc == 0
+        import json
+        out = json.loads(capsys.readouterr().out)
+        assert out["churn"]["events"] > 0

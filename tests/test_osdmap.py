@@ -1,0 +1,363 @@
+"""OSDMap-lite tests — mirrors src/test/osd/TestOSDMap.cc patterns:
+synthetic maps in-process, assert placement pipeline behavior, overrides,
+and stability. The scalar oracle re-implements the reference pipeline
+independently (mapper_ref + pure-python post-processing)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import builder, mapper_ref
+from ceph_tpu.crush.types import ITEM_NONE, WEIGHT_ONE
+from ceph_tpu.osd import (
+    OSDMap, ObjectLocator, PGPool, pg_t,
+    POOL_TYPE_ERASURE, ceph_stable_mod,
+)
+from ceph_tpu.osd.osdmap import DEFAULT_PRIMARY_AFFINITY, Incremental
+from ceph_tpu.osd.str_hash import (
+    CEPH_STR_HASH_LINUX, CEPH_STR_HASH_RJENKINS, pack_names,
+    str_hash, str_hash_batch, str_hash_linux, str_hash_rjenkins,
+)
+from ceph_tpu.osd.types import FLAG_HASHPSPOOL, calc_mask
+
+
+# ---------------------------------------------------------------------------
+# Independent scalar oracle for the rjenkins string hash
+# ---------------------------------------------------------------------------
+
+def _mix_py(a, b, c):
+    M = 0xFFFFFFFF
+    a = (a - b - c) & M; a ^= c >> 13
+    b = (b - c - a) & M; b ^= (a << 8) & M
+    c = (c - a - b) & M; c ^= b >> 13
+    a = (a - b - c) & M; a ^= c >> 12
+    b = (b - c - a) & M; b ^= (a << 16) & M
+    c = (c - a - b) & M; c ^= b >> 5
+    a = (a - b - c) & M; a ^= c >> 3
+    b = (b - c - a) & M; b ^= (a << 10) & M
+    c = (c - a - b) & M; c ^= b >> 15
+    return a, b, c
+
+
+def _rjenkins_oracle(data: bytes) -> int:
+    k, length = data, len(data)
+    a = b = 0x9E3779B9
+    c = 0
+    i = 0
+    ln = length
+    while ln >= 12:
+        a = (a + int.from_bytes(k[i:i + 4], "little")) & 0xFFFFFFFF
+        b = (b + int.from_bytes(k[i + 4:i + 8], "little")) & 0xFFFFFFFF
+        c = (c + int.from_bytes(k[i + 8:i + 12], "little")) & 0xFFFFFFFF
+        a, b, c = _mix_py(a, b, c)
+        i += 12
+        ln -= 12
+    c = (c + length) & 0xFFFFFFFF
+    t = k[i:]
+    if ln >= 11: c = (c + (t[10] << 24)) & 0xFFFFFFFF
+    if ln >= 10: c = (c + (t[9] << 16)) & 0xFFFFFFFF
+    if ln >= 9: c = (c + (t[8] << 8)) & 0xFFFFFFFF
+    if ln >= 8: b = (b + (t[7] << 24)) & 0xFFFFFFFF
+    if ln >= 7: b = (b + (t[6] << 16)) & 0xFFFFFFFF
+    if ln >= 6: b = (b + (t[5] << 8)) & 0xFFFFFFFF
+    if ln >= 5: b = (b + t[4]) & 0xFFFFFFFF
+    if ln >= 4: a = (a + (t[3] << 24)) & 0xFFFFFFFF
+    if ln >= 3: a = (a + (t[2] << 16)) & 0xFFFFFFFF
+    if ln >= 2: a = (a + (t[1] << 8)) & 0xFFFFFFFF
+    if ln >= 1: a = (a + t[0]) & 0xFFFFFFFF
+    a, b, c = _mix_py(a, b, c)
+    return c
+
+
+class TestStrHash:
+    def test_rjenkins_matches_oracle(self):
+        names = [b"", b"a", b"foo", b"rbd_data.1234", b"x" * 11, b"y" * 12,
+                 b"z" * 13, b"benchmark_data_host_12345_object67",
+                 bytes(range(256))]
+        for n in names:
+            assert str_hash_rjenkins(n) == _rjenkins_oracle(n), n
+
+    def test_batch_matches_scalar(self, rng):
+        names = [bytes(rng.integers(1, 255, size=int(L), dtype=np.uint8))
+                 for L in rng.integers(0, 40, size=64)]
+        padded, lens = pack_names(names)
+        out = str_hash_batch(CEPH_STR_HASH_RJENKINS, padded, lens)
+        for i, n in enumerate(names):
+            assert int(out[i]) == str_hash_rjenkins(n)
+
+    def test_linux_hash(self):
+        # hand-computed: h=0; h=(h + (c<<4)+(c>>4))*11 per byte
+        assert str_hash_linux(b"") == 0
+        c = ord("a")
+        assert str_hash_linux(b"a") == (((c << 4) + (c >> 4)) * 11) \
+            & 0xFFFFFFFF
+        padded, lens = pack_names([b"abc", b"hello"])
+        out = str_hash_batch(CEPH_STR_HASH_LINUX, padded, lens)
+        assert int(out[0]) == str_hash_linux(b"abc")
+        assert int(out[1]) == str_hash_linux(b"hello")
+
+    def test_dispatch(self):
+        assert str_hash(CEPH_STR_HASH_RJENKINS, b"foo") == \
+            str_hash_rjenkins(b"foo")
+        with pytest.raises(ValueError):
+            str_hash(99, b"foo")
+
+
+class TestStableMod:
+    def test_matches_definition(self):
+        for pg_num in (1, 3, 12, 16, 100):
+            bmask = calc_mask(pg_num)
+            for x in range(200):
+                want = x & bmask if (x & bmask) < pg_num else \
+                    x & (bmask >> 1)
+                assert int(ceph_stable_mod(x, pg_num, bmask)) == want
+
+    def test_mask(self):
+        assert calc_mask(1) == 0
+        assert calc_mask(16) == 15
+        assert calc_mask(17) == 31
+        assert calc_mask(12) == 15
+
+
+# ---------------------------------------------------------------------------
+# OSDMap pipeline
+# ---------------------------------------------------------------------------
+
+def make_map(n_hosts=8, per_host=2, pool_size=3, pg_num=64,
+             erasure=False, ec_size=5):
+    crush, root = builder.build_hierarchy(n_hosts, per_host)
+    rule = builder.add_simple_rule(crush, root, builder.TYPE_HOST,
+                                   indep=erasure)
+    m = OSDMap(crush)
+    m.add_pool(PGPool(id=1, pg_num=pg_num, size=ec_size if erasure
+                      else pool_size,
+                      type=POOL_TYPE_ERASURE if erasure else 1,
+                      crush_rule=rule))
+    return m
+
+
+def scalar_pipeline(m: OSDMap, pool: PGPool, seed: int):
+    """Independent re-derivation of pg_to_up_acting for one seed."""
+    pps = pool.raw_pg_to_pps(seed, xp=None)
+    weight = [0] * m.crush.max_devices
+    for o in range(m.max_osd):
+        weight[o] = int(m.osd_weight[o])
+    raw = mapper_ref.do_rule(m.crush, pool.crush_rule, pps, pool.size,
+                             weight)
+    raw = raw + [ITEM_NONE] * (pool.size - len(raw))
+    # nonexistent + down filter
+    def alive(o):
+        return (0 <= o < m.max_osd and
+                bool(m.osd_state[o] & 1) and bool(m.osd_state[o] & 2))
+    if pool.can_shift_osds():
+        up = [o for o in raw if o != ITEM_NONE and alive(o)]
+        up += [ITEM_NONE] * (pool.size - len(up))
+    else:
+        up = [o if o != ITEM_NONE and alive(o) else ITEM_NONE for o in raw]
+    primary = next((o for o in up if o != ITEM_NONE), -1)
+    return up, primary
+
+
+class TestOSDMapBasic:
+    def test_matches_scalar_pipeline(self):
+        m = make_map()
+        pool = m.pools[1]
+        seeds = np.arange(64, dtype=np.uint32)
+        up, upp, acting, actp = m.pg_to_up_acting_osds(1, seeds)
+        assert (up == acting).all() and (upp == actp).all()
+        for s in range(0, 64, 7):
+            want_up, want_p = scalar_pipeline(m, pool, s)
+            assert list(up[s]) == want_up, f"seed {s}"
+            assert upp[s] == want_p
+
+    def test_full_and_distinct_hosts(self):
+        m = make_map()
+        up, upp, _, _ = m.map_pool(1)
+        assert (up != ITEM_NONE).all()
+        assert (upp == up[:, 0]).all()
+        hosts = up // 2  # per_host=2, contiguous ids
+        for row in hosts:
+            assert len(set(row.tolist())) == 3
+
+    def test_ec_positional(self):
+        m = make_map(erasure=True)
+        up, _, _, _ = m.map_pool(1)
+        assert up.shape[1] == 5
+        assert (up != ITEM_NONE).all()  # plenty of hosts
+
+    def test_mark_down_removes_from_up(self):
+        m = make_map()
+        victim = 3
+        m.mark_down(victim)
+        up, _, _, _ = m.map_pool(1)
+        assert not (up == victim).any()
+        # replicated: compaction leaves NONE only at the tail
+        for s in range(64):
+            want_up, _ = scalar_pipeline(m, m.pools[1], s)
+            assert list(up[s]) == want_up
+
+    def test_mark_out_rereplicates(self):
+        m = make_map()
+        victim = 3
+        before = m.map_pool(1)[0]
+        m.mark_out(victim)
+        up, _, _, _ = m.map_pool(1)
+        assert not (up == victim).any()
+        # out (weight=0) triggers CRUSH retry: sets stay full
+        assert (up != ITEM_NONE).all()
+        # only PGs that touched the victim move
+        moved = (before != up).any(axis=1)
+        touched = (before == victim).any(axis=1)
+        assert (moved == touched).all()
+
+    def test_ec_down_leaves_hole(self):
+        m = make_map(erasure=True)
+        victim = int(m.map_pool(1)[0][0, 2])
+        m.mark_down(victim)
+        up, _, _, _ = m.map_pool(1)
+        assert (up[0] == ITEM_NONE).sum() >= 1
+        assert up[0, 2] == ITEM_NONE
+
+    def test_epoch_bumps(self):
+        m = make_map()
+        e = m.epoch
+        m.mark_down(0)
+        assert m.epoch == e + 1
+
+
+class TestOverrides:
+    def test_pg_upmap(self):
+        m = make_map()
+        up0 = m.map_pool(1)[0]
+        target = (10, 12, 14)
+        m.pg_upmap[pg_t(1, 5)] = target
+        up, upp, _, _ = m.map_pool(1)
+        assert tuple(up[5]) == target
+        assert upp[5] == 10
+        assert (up[4] == up0[4]).all()
+
+    def test_pg_upmap_rejected_when_target_out(self):
+        m = make_map()
+        up0 = m.map_pool(1)[0]
+        m.mark_out(10)
+        m.pg_upmap[pg_t(1, 5)] = (10, 12, 14)
+        up, _, _, _ = m.map_pool(1)
+        assert not (up[5] == 10).any()
+        del m.pg_upmap[pg_t(1, 5)]
+
+    def test_pg_upmap_items(self):
+        m = make_map()
+        up0 = m.map_pool(1)[0]
+        frm = int(up0[7, 1])
+        to = next(o for o in range(m.max_osd)
+                  if o not in up0[7].tolist())
+        m.pg_upmap_items[pg_t(1, 7)] = [(frm, to)]
+        up, _, _, _ = m.map_pool(1)
+        assert up[7, 1] == to
+        assert not (up[7] == frm).any()
+
+    def test_pg_temp(self):
+        m = make_map()
+        m.pg_temp[pg_t(1, 9)] = [1, 5, 9]
+        up, upp, acting, actp = m.map_pool(1)
+        assert list(acting[9]) == [1, 5, 9]
+        assert actp[9] == 1
+        assert not (up[9] == acting[9]).all() or True
+        assert (acting[8] == up[8]).all()
+
+    def test_primary_temp(self):
+        m = make_map()
+        up0, upp0, _, _ = m.map_pool(1)
+        other = int(up0[3, 1])
+        m.primary_temp[pg_t(1, 3)] = other
+        _, _, _, actp = m.map_pool(1)
+        assert actp[3] == other
+
+    def test_primary_affinity_zero_never_primary(self):
+        m = make_map()
+        victim = int(m.map_pool(1)[1][0])
+        m.set_primary_affinity(victim, 0)
+        up, upp, _, _ = m.map_pool(1)
+        present = (up == victim).any(axis=1)
+        assert present.any()
+        assert not (upp == victim).any()
+
+    def test_primary_affinity_partial_shifts_some(self):
+        m = make_map()
+        upp0 = m.map_pool(1)[1]
+        victim = int(upp0[0])
+        n_before = (upp0 == victim).sum()
+        m.set_primary_affinity(victim, DEFAULT_PRIMARY_AFFINITY // 2)
+        upp = m.map_pool(1)[1]
+        n_after = (upp == victim).sum()
+        assert 0 < n_after < n_before
+
+
+class TestObjectMapping:
+    def test_object_locator_to_pg(self):
+        m = make_map()
+        pool = m.pools[1]
+        raw = m.object_locator_to_pg("rbd_data.abc", ObjectLocator(pool=1))
+        assert raw.pool == 1
+        assert raw.seed == pool.hash_key("rbd_data.abc")
+        folded = pool.raw_pg_to_pg(raw.seed, xp=None)
+        assert 0 <= folded < pool.pg_num
+
+    def test_locator_key_overrides_name(self):
+        m = make_map()
+        a = m.object_locator_to_pg("x", ObjectLocator(pool=1, key="lock"))
+        b = m.object_locator_to_pg("y", ObjectLocator(pool=1, key="lock"))
+        assert a == b
+
+    def test_hashpspool_separates_pools(self):
+        m = make_map()
+        m.add_pool(PGPool(id=2, pg_num=64, size=3, crush_rule=0))
+        seeds = np.arange(64, dtype=np.uint32)
+        p1 = m.pools[1].raw_pg_to_pps(seeds)
+        p2 = m.pools[2].raw_pg_to_pps(seeds)
+        assert (np.asarray(p1) != np.asarray(p2)).any()
+
+    def test_batch_hash_keys(self):
+        m = make_map()
+        pool = m.pools[1]
+        names = [f"obj{i}".encode() for i in range(32)]
+        padded, lens = pack_names(names)
+        out = pool.hash_keys(padded, lens)
+        for i, n in enumerate(names):
+            assert int(out[i]) == pool.hash_key(n)
+
+
+class TestIncremental:
+    def test_apply(self):
+        m = make_map()
+        direct = make_map()
+        inc = Incremental(epoch=m.epoch + 1, new_down=[2],
+                          new_weight={5: 0},
+                          new_pg_temp={pg_t(1, 4): [1, 7, 9]})
+        m.apply_incremental(inc)
+        direct.mark_down(2)
+        direct.set_weight(5, 0)
+        direct.pg_temp[pg_t(1, 4)] = [1, 7, 9]
+        a = m.map_pool(1)
+        b = direct.map_pool(1)
+        for x, y in zip(a, b):
+            assert (x == y).all()
+
+    def test_bad_epoch_rejected(self):
+        m = make_map()
+        with pytest.raises(ValueError):
+            m.apply_incremental(Incremental(epoch=m.epoch + 5))
+
+    def test_remove_pg_temp(self):
+        m = make_map()
+        m.pg_temp[pg_t(1, 4)] = [1, 7, 9]
+        m.apply_incremental(Incremental(epoch=m.epoch + 1,
+                                        new_pg_temp={pg_t(1, 4): []}))
+        assert pg_t(1, 4) not in m.pg_temp
+
+
+class TestUtilization:
+    def test_counts(self):
+        m = make_map()
+        util = m.pool_utilization(1)
+        assert util.sum() == 64 * 3
+        assert (util > 0).all()  # 16 osds, 192 slots
