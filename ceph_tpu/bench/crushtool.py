@@ -26,6 +26,7 @@ from ceph_tpu.crush.tester import CrushTester
 from ceph_tpu.crush.types import (
     ALG_LIST, ALG_STRAW2, ALG_UNIFORM, ITEM_NONE, WEIGHT_ONE,
 )
+from ceph_tpu.utils.platform import cli_main
 
 ALGS = {"straw2": ALG_STRAW2, "uniform": ALG_UNIFORM, "list": ALG_LIST}
 
@@ -34,6 +35,12 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser(prog="crushtool",
                                  description="CRUSH map tool (TPU-batched)")
     ap.add_argument("--build", action="store_true")
+    ap.add_argument("-c", "--compile", metavar="FILE", default=None,
+                    help="load a crushmap text file")
+    ap.add_argument("-d", "--decompile", action="store_true",
+                    help="print the map back as crushmap text")
+    ap.add_argument("-o", "--outfn", metavar="FILE", default=None,
+                    help="write decompiled text here instead of stdout")
     ap.add_argument("--num-osds", type=int, default=16)
     ap.add_argument("--hosts", type=int, default=0,
                     help="host count (0 = flat map)")
@@ -74,12 +81,27 @@ def build_map(args):
     return m
 
 
+@cli_main
 def main(argv=None) -> dict:
     args = parse_args(argv)
-    if not args.build:
-        raise SystemExit("only --build maps supported until the compiler "
-                         "lands; pass --build")
-    m = build_map(args)
+    if args.compile:
+        from ceph_tpu.crush.compiler import compile_crushmap
+        with open(args.compile) as f:
+            m = compile_crushmap(f.read())
+    elif args.build:
+        m = build_map(args)
+    else:
+        raise SystemExit("pass --build or --compile FILE")
+    if args.decompile or args.outfn:
+        # -o without -d writes the canonical text form too (our "compiled"
+        # representation IS the text format; there is no binary blob)
+        from ceph_tpu.crush.compiler import decompile_crushmap
+        text = decompile_crushmap(m)
+        if args.outfn:
+            with open(args.outfn, "w") as f:
+                f.write(text)
+        else:
+            print(text, end="")
     out: dict = {"max_devices": m.max_devices,
                  "rules": {r.id: r.name for r in m.rules.values()}}
     if args.test:

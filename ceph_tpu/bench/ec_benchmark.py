@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from ceph_tpu.ec.interface import ErasureCodeProfile
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
 from ceph_tpu.utils.logging import get_logger
+from ceph_tpu.utils.platform import cli_main
 
 log = get_logger("bench")
 
@@ -61,6 +62,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--json", action="store_true", help="emit JSON detail")
     ap.add_argument("-v", "--verbose", action="store_true")
     return ap.parse_args(argv)
+
+
+def _sync(x):
+    """block_until_ready for device arrays; no-op for host (numpy) paths
+    (lrc/shec/clay base-class batch kernels return numpy)."""
+    sync = getattr(x, "block_until_ready", None)
+    if sync is not None:
+        sync()
+    return x
 
 
 def _auto_batch(object_size: int, iterations: int) -> int:
@@ -99,7 +109,7 @@ class ErasureCodeBench:
         # Warmup / compile (excluded from timing, as the reference's first
         # iteration is not — its loop is uncompiled C++; we report steady
         # state, which is the honest number for a jitted pipeline).
-        self.ec.encode_batch(data).block_until_ready()
+        _sync(self.ec.encode_batch(data))
         steps = -(-self.args.iterations // self.batch)
         t0 = time.perf_counter()
         out = None
@@ -107,7 +117,7 @@ class ErasureCodeBench:
             if self.args.stream:
                 data = jnp.asarray(host)
             out = self.ec.encode_batch(data)
-        out.block_until_ready()
+        _sync(out)
         elapsed = time.perf_counter() - t0
         ops = steps * self.batch
         return self._result("encode", elapsed, ops)
@@ -117,24 +127,33 @@ class ErasureCodeBench:
         host = self._make_data(rng)
         data = jnp.asarray(host)
         parity = self.ec.encode_batch(data)
-        full = jnp.concatenate([data, parity], axis=1)
-        n = self.k + self.m
+        full = jnp.concatenate([data, jnp.asarray(parity)], axis=1)
+        n = self.ec.get_chunk_count()
         if self.args.erased:
             erased = sorted(set(self.args.erased))
         else:
             erased = list(range(self.args.erasures))
-        avail = [i for i in range(n) if i not in erased][:self.k]
+        avail = [i for i in range(n) if i not in erased]
+        if self.ec.is_mds():
+            avail = avail[:self.k]  # MDS: any k; layered codes keep all
         chunks = full[:, jnp.asarray(avail), :]
         host_chunks = np.asarray(chunks)
-        self.ec.decode_batch(erased, avail, chunks).block_until_ready()
+        from ceph_tpu.ec.interface import ErasureCodeInterface
+        device_path = (type(self.ec).decode_batch
+                       is not ErasureCodeInterface.decode_batch)
+        # host-loop plugins get the host array so the timed loop doesn't
+        # hide a D2H copy per step (that cost belongs to --stream only)
+        chunks = chunks if device_path else host_chunks
+        _sync(self.ec.decode_batch(erased, avail, chunks))
         steps = -(-self.args.iterations // self.batch)
         t0 = time.perf_counter()
         out = None
         for _ in range(steps):
             if self.args.stream:
-                chunks = jnp.asarray(host_chunks)
+                chunks = (jnp.asarray(host_chunks) if device_path
+                          else host_chunks.copy())
             out = self.ec.decode_batch(erased, avail, chunks)
-        out.block_until_ready()
+        _sync(out)
         elapsed = time.perf_counter() - t0
         ops = steps * self.batch
         return self._result("decode", elapsed, ops, erased=erased)
@@ -167,6 +186,7 @@ class ErasureCodeBench:
         return self.decode()
 
 
+@cli_main
 def main(argv=None) -> dict:
     args = parse_args(argv)
     bench = ErasureCodeBench(args)

@@ -19,6 +19,7 @@ from ceph_tpu.crush import builder
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.osd import OSDMap, PGPool, POOL_TYPE_ERASURE
 from ceph_tpu.sim import ChurnEvent, ChurnSim
+from ceph_tpu.utils.platform import cli_main
 
 
 def create_simple(n_osds: int, pg_num: int, size: int, erasure: bool,
@@ -68,6 +69,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
+@cli_main
 def main(argv=None) -> int:
     args = parse_args(argv)
     m = create_simple(args.createsimple, args.pg_num, args.size,

@@ -35,9 +35,23 @@ class ErasureCodeProfile(dict):
         if isinstance(text, Mapping):
             return cls(text)
         prof = cls()
-        for tok in text.replace(",", " ").split():
-            key, _, val = tok.partition("=")
-            prof[key.strip()] = val.strip()
+        # commas separate pairs only at bracket depth 0 (lrc layers carry
+        # JSON values with their own commas)
+        depth = 0
+        parts: list[str] = [""]
+        for ch in text:
+            if ch in "[{":
+                depth += 1
+            elif ch in "]}":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append("")
+            else:
+                parts[-1] += ch
+        for part in parts:
+            for tok in part.split():
+                key, _, val = tok.partition("=")
+                prof[key.strip()] = val.strip()
         return prof
 
     def get_int(self, key: str, default: int) -> int:
@@ -129,6 +143,29 @@ class ErasureCodeInterface(ABC):
     def decode_chunks(self, want: Sequence[int],
                       chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
         """Reconstruct chunk ids `want` from available `chunks`."""
+
+    def is_mds(self) -> bool:
+        """True when any k chunks decode everything (RS); layered codes
+        (lrc/shec/clay) override to False and may want more chunks."""
+        return False
+
+    # -- batched kernels (subclasses override with fused device paths) ----
+    def encode_batch(self, data):
+        """(B, k, C) uint8 -> (B, m, C) parity. Base: per-stripe loop."""
+        data = np.asarray(data)
+        return np.stack([np.asarray(self.encode_chunks(data[b]))
+                         for b in range(data.shape[0])])
+
+    def decode_batch(self, want: Sequence[int], avail: Sequence[int],
+                     chunks):
+        """(B, len(avail), C) -> (B, len(want), C). Base: per-stripe."""
+        chunks = np.asarray(chunks)
+        out = []
+        for b in range(chunks.shape[0]):
+            got = self.decode_chunks(
+                list(want), {a: chunks[b, i] for i, a in enumerate(avail)})
+            out.append(np.stack([np.asarray(got[w]) for w in want]))
+        return np.stack(out)
 
     # -- byte-level API (base implements; harness-compatible) -------------
     def encode_prepare(self, data: bytes) -> np.ndarray:

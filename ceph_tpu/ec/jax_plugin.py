@@ -89,6 +89,9 @@ class ErasureCodeJax(ErasureCodeInterface):
         log.dout(5, "init", k=self.k, m=self.m, technique=self.technique,
                  backend=self.backend)
 
+    def is_mds(self) -> bool:
+        return True
+
     # -- encode -----------------------------------------------------------
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
         data = jnp.asarray(data, dtype=jnp.uint8)

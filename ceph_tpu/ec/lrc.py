@@ -151,17 +151,31 @@ class ErasureCodeLrc(ErasureCodeInterface):
 
     # -- encode -----------------------------------------------------------
     def encode_chunks(self, data: np.ndarray) -> np.ndarray:
-        """(k, C) data -> (n-k, C) parity, in non-D position order."""
+        """(k, C) data -> (n-k, C) parity, in non-D position order.
+
+        Single-stripe view of encode_batch (one algorithm, one code path).
+        """
+        return np.asarray(self.encode_batch(np.asarray(data)[None])[0])
+
+    def encode_batch(self, data):
+        """(B, k, C) -> (B, m, C): each layer is one batched device
+        matmul over the stripe batch (stays on device between layers)."""
+        import jax.numpy as jnp
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        B, _, C = data.shape
         n = len(self.mapping)
-        C = data.shape[1]
-        chunks = np.zeros((n, C), dtype=np.uint8)
-        dpos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
-        chunks[dpos] = data
+        chunks = jnp.zeros((B, n, C), dtype=jnp.uint8)
+        dpos = jnp.asarray(
+            [i for i, ch in enumerate(self.mapping) if ch == "D"])
+        chunks = chunks.at[:, dpos, :].set(data)
         for layer in self.layers:
-            parity = layer.code.encode_chunks(chunks[layer.data_pos])
-            chunks[layer.coding_pos] = parity
-        ppos = [i for i, ch in enumerate(self.mapping) if ch != "D"]
-        return chunks[ppos]
+            parity = layer.code.encode_batch(
+                chunks[:, jnp.asarray(layer.data_pos), :])
+            chunks = chunks.at[:, jnp.asarray(layer.coding_pos), :].set(
+                parity)
+        ppos = jnp.asarray(
+            [i for i, ch in enumerate(self.mapping) if ch != "D"])
+        return chunks[:, ppos, :]
 
     def _position_chunks(self, chunks: Mapping[int, np.ndarray],
                          C: int) -> tuple[np.ndarray, set[int]]:

@@ -39,10 +39,17 @@ class ErasureCodePluginRegistry:
             return cls._instance
 
     def _register_builtins(self) -> None:
+        from ceph_tpu.ec.clay import ErasureCodeClay
+        from ceph_tpu.ec.lrc import ErasureCodeLrc
+        from ceph_tpu.ec.shec import ErasureCodeShec
+
         self.add("jax", ErasureCodeJax)
         # Compatibility aliases: same techniques, same parity bytes.
         self.add("jerasure", ErasureCodeJax)
         self.add("isa", ErasureCodeJax)
+        self.add("lrc", ErasureCodeLrc)
+        self.add("shec", ErasureCodeShec)
+        self.add("clay", ErasureCodeClay)
 
     def add(self, name: str,
             ctor: Callable[[], ErasureCodeInterface]) -> None:

@@ -1,0 +1,35 @@
+"""Platform selection shim.
+
+This sandbox's sitecustomize force-selects the remote-TPU backend through
+``jax.config`` — plain ``JAX_PLATFORMS=cpu`` in the environment is
+silently outranked, and initializing the remote backend dials a device
+claim that can block for minutes. CLI entry points call
+``honor_platform_env()`` so the conventional env var works as users
+expect; when the var is unset the configured default (the real TPU under
+the driver) stands.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        import jax
+
+        jax.config.update("jax_platforms", plats)
+
+
+def cli_main(fn):
+    """Decorator for CLI main(argv) functions: apply the platform shim
+    before any device work. Every bench/tool entry point uses this."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(argv=None):
+        honor_platform_env()
+        return fn(argv)
+
+    return wrapper
