@@ -50,6 +50,12 @@ class ErasureCodePluginRegistry:
         self.add("lrc", ErasureCodeLrc)
         self.add("shec", ErasureCodeShec)
         self.add("clay", ErasureCodeClay)
+        try:  # the build itself is lazy; only a missing module skips this
+            from ceph_tpu.interop.native import ErasureCodeRef
+        except ImportError:  # pragma: no cover
+            pass
+        else:
+            self.add("ref", ErasureCodeRef)
 
     def add(self, name: str,
             ctor: Callable[[], ErasureCodeInterface]) -> None:

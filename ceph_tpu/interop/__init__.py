@@ -1,0 +1,11 @@
+"""Native interop: the C++ runtime pieces behind ctypes.
+
+``native.py`` loads libec_ref.so (C ABI RS backend) as plugin ``ref`` —
+the measured CPU baseline and an independent correctness oracle for the
+JAX backend — and exposes the dlopen plugin-registry flow
+(ref: src/erasure-code/ErasureCodePlugin.cc) for tests.
+"""
+
+from ceph_tpu.interop.native import (  # noqa: F401
+    ErasureCodeRef, build_native, native_build_dir,
+)

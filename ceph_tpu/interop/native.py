@@ -1,0 +1,143 @@
+"""ctypes bridge to the native EC runtime (native/).
+
+ErasureCodeRef implements the Python ErasureCodeInterface on top of
+libec_ref.so — the C++ RS backend whose matrix construction is
+coefficient-exact with the JAX plugin. Registered as plugin ``ref``:
+
+    factory("plugin=ref technique=reed_sol_van k=8 m=3")
+
+The shared objects build on demand via ``make -C native`` (g++ is part of
+the toolchain; see native/Makefile).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import pathlib
+import subprocess
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from ceph_tpu.ec.interface import ErasureCodeInterface, ErasureCodeProfile
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+_NATIVE = _REPO / "native"
+
+
+def native_build_dir() -> pathlib.Path:
+    return _NATIVE / "build"
+
+
+def build_native() -> pathlib.Path:
+    """Ensure the native libs exist; returns the build dir.
+
+    Raises RuntimeError when the toolchain or build fails.
+    """
+    lib = native_build_dir() / "libec_ref.so"
+    if not lib.exists():
+        try:
+            subprocess.run(["make", "-C", str(_NATIVE)], check=True,
+                           capture_output=True, text=True, timeout=300)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired) as e:
+            out = getattr(e, "stderr", "") or str(e)
+            raise RuntimeError(f"native build failed: {out}") from e
+    return native_build_dir()
+
+
+@functools.lru_cache(maxsize=1)
+def _lib() -> ctypes.CDLL:
+    path = build_native() / "libec_ref.so"
+    lib = ctypes.CDLL(str(path))
+    lib.ec_ref_init.restype = ctypes.c_void_p
+    lib.ec_ref_init.argtypes = [ctypes.c_int, ctypes.c_int,
+                                ctypes.c_char_p]
+    lib.ec_ref_free.argtypes = [ctypes.c_void_p]
+    lib.ec_ref_encode.restype = ctypes.c_int
+    lib.ec_ref_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_size_t]
+    lib.ec_ref_decode.restype = ctypes.c_int
+    lib.ec_ref_decode.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_size_t]
+    lib.ec_ref_coding_matrix.restype = ctypes.c_int
+    lib.ec_ref_coding_matrix.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    return lib
+
+
+class ErasureCodeRef(ErasureCodeInterface):
+    """plugin=ref — native C++ RS backend (CPU baseline + oracle)."""
+
+    def __init__(self, profile: ErasureCodeProfile | str | None = None):
+        super().__init__()
+        self.technique = "reed_sol_van"
+        self._h = None
+        if profile is not None:
+            self.init(ErasureCodeProfile.parse(profile))
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        self.profile = profile
+        self.k = profile.get_int("k", 2)
+        self.m = profile.get_int("m", 2)
+        self.technique = profile.get("technique", "reed_sol_van")
+        lib = _lib()
+        self._h = lib.ec_ref_init(self.k, self.m,
+                                  self.technique.encode())
+        if not self._h:
+            raise ValueError(
+                f"ec_ref_init failed: k={self.k} m={self.m} "
+                f"technique={self.technique}")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                _lib().ec_ref_free(h)
+            except Exception:
+                pass
+
+    def is_mds(self) -> bool:
+        return True
+
+    def coding_matrix(self) -> np.ndarray:
+        out = np.zeros((self.m, self.k), dtype=np.uint8)
+        rc = _lib().ec_ref_coding_matrix(
+            self._h, out.ctypes.data_as(ctypes.c_char_p))
+        assert rc == 0
+        return out
+
+    def encode_chunks(self, data: np.ndarray) -> np.ndarray:
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        k, chunk = data.shape
+        assert k == self.k
+        parity = np.zeros((self.m, chunk), dtype=np.uint8)
+        rc = _lib().ec_ref_encode(
+            self._h, data.ctypes.data_as(ctypes.c_char_p),
+            parity.ctypes.data_as(ctypes.c_char_p), chunk)
+        if rc != 0:
+            raise RuntimeError(f"ec_ref_encode rc={rc}")
+        return parity
+
+    def decode_chunks(self, want: Sequence[int],
+                      chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
+        avail = sorted(chunks)[:self.k]
+        if len(avail) < self.k:
+            raise ValueError(f"need {self.k} chunks, have {len(chunks)}")
+        chunk = np.asarray(chunks[avail[0]]).shape[0]
+        stacked = np.ascontiguousarray(
+            np.stack([np.asarray(chunks[i], dtype=np.uint8)
+                      for i in avail]))
+        want_l = list(want)
+        out = np.zeros((len(want_l), chunk), dtype=np.uint8)
+        av = (ctypes.c_int * len(avail))(*avail)
+        wa = (ctypes.c_int * len(want_l))(*want_l)
+        rc = _lib().ec_ref_decode(
+            self._h, av, len(avail), wa, len(want_l),
+            stacked.ctypes.data_as(ctypes.c_char_p),
+            out.ctypes.data_as(ctypes.c_char_p), chunk)
+        if rc != 0:
+            raise RuntimeError(f"ec_ref_decode rc={rc}")
+        return {w: out[i] for i, w in enumerate(want_l)}

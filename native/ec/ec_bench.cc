@@ -1,0 +1,102 @@
+// Native benchmark binary — the C++ twin of ceph_erasure_code_benchmark
+// (ref: src/test/erasure-code/ceph_erasure_code_benchmark.cc). Produces
+// the measured CPU baseline the TPU numbers are compared against.
+//
+//   ec_bench --plugin rsvan --dir build --workload encode \
+//            --size 4194304 --iterations 64 --parameter k=8 --parameter m=3
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "plugin.h"
+
+extern "C" void* ec_registry_factory(const char*, const char*, const char*,
+                                     const void**);
+
+int main(int argc, char** argv) {
+  std::string plugin = "rsvan", dir = ".", workload = "encode";
+  std::string profile;
+  size_t size = 1 << 20;
+  int iterations = 1, erasures = 1;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      return (i + 1 < argc) ? argv[++i] : "";
+    };
+    if (a == "--plugin" || a == "-p") plugin = next();
+    else if (a == "--dir") dir = next();
+    else if (a == "--workload" || a == "-w") workload = next();
+    else if (a == "--size" || a == "-s") size = std::stoul(next());
+    else if (a == "--iterations" || a == "-i") iterations = std::stoi(next());
+    else if (a == "--erasures" || a == "-e") erasures = std::stoi(next());
+    else if (a == "--parameter" || a == "-P") {
+      if (!profile.empty()) profile += " ";
+      profile += next();
+    } else {
+      std::fprintf(stderr, "unknown arg %s\n", a.c_str());
+      return 2;
+    }
+  }
+  const ec_plugin_vtable_t* vt = nullptr;
+  const void* vtp = nullptr;
+  auto* be = static_cast<ec_backend_t*>(
+      ec_registry_factory(plugin.c_str(), dir.c_str(), profile.c_str(),
+                          &vtp));
+  vt = static_cast<const ec_plugin_vtable_t*>(vtp);
+  if (!be || !vt) {
+    std::fprintf(stderr, "plugin %s load failed\n", plugin.c_str());
+    return 1;
+  }
+  int k = vt->k_of(be), m = vt->m_of(be);
+  if (erasures < 1 || erasures > m) {
+    std::fprintf(stderr, "--erasures must be in [1, m=%d]\n", m);
+    return 2;
+  }
+  size_t chunk = (size + k - 1) / k;
+  chunk = (chunk + 127) / 128 * 128;  // same alignment as the JAX side
+  std::vector<uint8_t> data(static_cast<size_t>(k) * chunk);
+  std::vector<uint8_t> parity(static_cast<size_t>(m) * chunk);
+  std::mt19937 rng(0);
+  for (auto& b : data) b = static_cast<uint8_t>(rng());
+
+  double elapsed = 0;
+  if (workload == "encode") {
+    auto t0 = std::chrono::steady_clock::now();
+    for (int it = 0; it < iterations; ++it)
+      vt->encode(be, data.data(), parity.data(), chunk);
+    elapsed = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+  } else {
+    vt->encode(be, data.data(), parity.data(), chunk);
+    std::vector<uint8_t> all(static_cast<size_t>(k + m) * chunk);
+    std::memcpy(all.data(), data.data(), data.size());
+    std::memcpy(all.data() + data.size(), parity.data(), parity.size());
+    std::vector<int> want, avail;
+    for (int i = 0; i < erasures; ++i) want.push_back(i);
+    for (int i = erasures; i < k + m && (int)avail.size() < k; ++i)
+      avail.push_back(i);
+    std::vector<uint8_t> in(static_cast<size_t>(k) * chunk);
+    for (int i = 0; i < k; ++i)
+      std::memcpy(in.data() + static_cast<size_t>(i) * chunk,
+                  all.data() + static_cast<size_t>(avail[i]) * chunk, chunk);
+    std::vector<uint8_t> out(static_cast<size_t>(want.size()) * chunk);
+    auto t0 = std::chrono::steady_clock::now();
+    for (int it = 0; it < iterations; ++it)
+      vt->decode(be, avail.data(), k, want.data(),
+                 static_cast<int>(want.size()), in.data(), out.data(),
+                 chunk);
+    elapsed = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+  }
+  double total = static_cast<double>(iterations) * k * chunk;
+  // reference output format: seconds <tab> MB/s
+  std::printf("%.6f\t%.2f\n", elapsed, total / elapsed / 1e6);
+  vt->destroy(be);
+  return 0;
+}

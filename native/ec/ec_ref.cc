@@ -1,0 +1,117 @@
+// libec_ref: the native CPU Reed-Solomon backend behind a C ABI.
+//
+// Role (two hats):
+//  1. independent correctness oracle for the JAX plugin — same matrix
+//     constructions, different implementation, byte-compared in tests
+//     (the jerasure<->isa cross-check pattern);
+//  2. the measured CPU baseline the benchmark compares the TPU against
+//     (ref: src/erasure-code/isa/ErasureCodeIsa.cc role).
+//
+// ABI: plain C, consumed via ctypes from ceph_tpu/interop/native.py.
+
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "gf256.h"
+#include "rs_matrix.h"
+
+using ceph_tpu::coding_matrix;
+using ceph_tpu::decode_matrix;
+using ceph_tpu::gf_matmul;
+
+namespace {
+
+struct Handle {
+  int k = 0;
+  int m = 0;
+  std::string technique;
+  std::vector<uint8_t> coding;  // (m x k)
+  // decode-matrix cache keyed by (avail, want) — the table-cache role
+  // (ref: src/erasure-code/isa/ErasureCodeIsaTableCache.cc).
+  std::map<std::pair<std::vector<int>, std::vector<int>>,
+           std::vector<uint8_t>>
+      dcache;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle or null on error.
+void* ec_ref_init(int k, int m, const char* technique) {
+  try {
+    auto* h = new Handle;
+    h->k = k;
+    h->m = m;
+    h->technique = technique ? technique : "reed_sol_van";
+    h->coding = coding_matrix(h->technique, k, m);
+    return h;
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void ec_ref_free(void* handle) { delete static_cast<Handle*>(handle); }
+
+// data: k contiguous chunks of chunk_size bytes (data[i] = base+i*size);
+// parity out: m contiguous chunks. Returns 0 on success.
+int ec_ref_encode(void* handle, const uint8_t* data, uint8_t* parity,
+                  size_t chunk_size) {
+  auto* h = static_cast<Handle*>(handle);
+  if (!h) return -1;
+  std::vector<const uint8_t*> in(h->k);
+  std::vector<uint8_t*> out(h->m);
+  for (int i = 0; i < h->k; ++i) in[i] = data + i * chunk_size;
+  for (int i = 0; i < h->m; ++i) out[i] = parity + i * chunk_size;
+  gf_matmul(h->coding.data(), h->m, h->k, in.data(), out.data(),
+            chunk_size);
+  return 0;
+}
+
+// avail/want: chunk-id arrays; chunks: n_avail contiguous input chunks in
+// avail order; out: n_want contiguous chunks. Returns 0 on success.
+int ec_ref_decode(void* handle, const int* avail, int n_avail,
+                  const int* want, int n_want, const uint8_t* chunks,
+                  uint8_t* out, size_t chunk_size) {
+  auto* h = static_cast<Handle*>(handle);
+  if (!h || n_avail < h->k) return -1;
+  std::vector<int> av(avail, avail + n_avail);
+  std::vector<int> wa(want, want + n_want);
+  try {
+    std::vector<uint8_t>* d;
+    {
+      std::lock_guard<std::mutex> lock(h->mu);
+      auto key = std::make_pair(av, wa);
+      auto it = h->dcache.find(key);
+      if (it == h->dcache.end())
+        it = h->dcache
+                 .emplace(key, decode_matrix(h->technique, h->k, h->m, av,
+                                             wa))
+                 .first;
+      d = &it->second;
+    }
+    std::vector<const uint8_t*> in(n_avail);
+    std::vector<uint8_t*> ou(n_want);
+    for (int i = 0; i < n_avail; ++i) in[i] = chunks + i * chunk_size;
+    for (int i = 0; i < n_want; ++i) ou[i] = out + i * chunk_size;
+    gf_matmul(d->data(), n_want, n_avail, in.data(), ou.data(),
+              chunk_size);
+    return 0;
+  } catch (...) {
+    return -2;
+  }
+}
+
+// Expose the coding matrix for cross-language construction checks.
+int ec_ref_coding_matrix(void* handle, uint8_t* out) {
+  auto* h = static_cast<Handle*>(handle);
+  if (!h) return -1;
+  std::memcpy(out, h->coding.data(), h->coding.size());
+  return 0;
+}
+
+}  // extern "C"

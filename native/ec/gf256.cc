@@ -1,0 +1,92 @@
+#include "gf256.h"
+
+#include <cstring>
+
+namespace ceph_tpu {
+
+static constexpr int kPoly = 0x11d;  // x^8+x^4+x^3+x^2+1, generator 2
+
+const GF256& GF256::instance() {
+  static GF256 gf;
+  return gf;
+}
+
+GF256::GF256() {
+  int x = 1;
+  for (int i = 0; i < 255; ++i) {
+    exp_[i] = static_cast<uint8_t>(x);
+    log_[x] = static_cast<uint8_t>(i);
+    x <<= 1;
+    if (x & 0x100) x ^= kPoly;
+  }
+  for (int i = 255; i < 512; ++i) exp_[i] = exp_[i - 255];
+  log_[0] = 0;  // never read for zero operands
+}
+
+uint8_t GF256::inv(uint8_t a) const {
+  return exp_[255 - log_[a]];
+}
+
+void GF256::mul_region_xor(uint8_t c, const uint8_t* src, uint8_t* dst,
+                           size_t len) const {
+  if (c == 0) return;
+  if (c == 1) {
+    for (size_t i = 0; i < len; ++i) dst[i] ^= src[i];
+    return;
+  }
+  // Per-coefficient 256-entry product table, then one pass: the scalar
+  // version of the PSHUFB nibble trick (two gathers beats recomputing
+  // log/exp per byte ~3x).
+  uint8_t table[256];
+  table[0] = 0;
+  const int lc = log_[c];
+  for (int v = 1; v < 256; ++v)
+    table[v] = exp_[lc + log_[v]];
+  for (size_t i = 0; i < len; ++i) dst[i] ^= table[src[i]];
+}
+
+void gf_matmul(const uint8_t* mat, int rows, int cols,
+               const uint8_t* const* data, uint8_t* const* out, size_t len) {
+  const GF256& gf = GF256::instance();
+  for (int r = 0; r < rows; ++r) {
+    std::memset(out[r], 0, len);
+    for (int c = 0; c < cols; ++c)
+      gf.mul_region_xor(mat[r * cols + c], data[c], out[r], len);
+  }
+}
+
+bool gf_matinv(std::vector<uint8_t>& m, int n) {
+  const GF256& gf = GF256::instance();
+  std::vector<uint8_t> inv(n * n, 0);
+  for (int i = 0; i < n; ++i) inv[i * n + i] = 1;
+  for (int col = 0; col < n; ++col) {
+    int piv = -1;
+    for (int r = col; r < n; ++r)
+      if (m[r * n + col]) { piv = r; break; }
+    if (piv < 0) return false;
+    if (piv != col) {
+      for (int j = 0; j < n; ++j) {
+        std::swap(m[piv * n + j], m[col * n + j]);
+        std::swap(inv[piv * n + j], inv[col * n + j]);
+      }
+    }
+    uint8_t d = gf.inv(m[col * n + col]);
+    for (int j = 0; j < n; ++j) {
+      m[col * n + j] = gf.mul(m[col * n + j], d);
+      inv[col * n + j] = gf.mul(inv[col * n + j], d);
+    }
+    for (int r = 0; r < n; ++r) {
+      if (r == col) continue;
+      uint8_t f = m[r * n + col];
+      if (!f) continue;
+      for (int j = 0; j < n; ++j) {
+        m[r * n + j] ^= gf.mul(f, m[col * n + j]);
+        inv[r * n + j] ^= gf.mul(f, inv[col * n + j]);
+      }
+    }
+  }
+  m = inv;
+  return true;
+}
+
+}  // namespace ceph_tpu

@@ -1,0 +1,41 @@
+// GF(2^8) arithmetic over the 0x11d polynomial — the native runtime's
+// counterpart of ceph_tpu/gf/tables.py (ref: jerasure/gf-complete's w=8
+// tables; reimplemented from the algebra, not the code).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace ceph_tpu {
+
+class GF256 {
+ public:
+  static const GF256& instance();
+
+  uint8_t mul(uint8_t a, uint8_t b) const {
+    if (a == 0 || b == 0) return 0;
+    return exp_[log_[a] + log_[b]];
+  }
+  uint8_t inv(uint8_t a) const;  // a != 0
+  uint8_t div(uint8_t a, uint8_t b) const { return mul(a, inv(b)); }
+
+  // dst[0..len) ^= c * src[0..len)  — the region kernel
+  // (ref: isa-l ec_encode_data inner loop; plain table walk here).
+  void mul_region_xor(uint8_t c, const uint8_t* src, uint8_t* dst,
+                      size_t len) const;
+
+ private:
+  GF256();
+  uint8_t exp_[512];
+  uint8_t log_[256];
+};
+
+// (rows x cols) @ (cols x len) over GF(2^8): out = mat * data.
+void gf_matmul(const uint8_t* mat, int rows, int cols,
+               const uint8_t* const* data, uint8_t* const* out, size_t len);
+
+// In-place inversion of an n x n GF matrix; returns false if singular.
+bool gf_matinv(std::vector<uint8_t>& m, int n);
+
+}  // namespace ceph_tpu

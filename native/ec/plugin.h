@@ -1,0 +1,61 @@
+// The native erasure-code plugin contract.
+//
+// ref: src/erasure-code/ErasureCodePlugin.h — same mechanics with a C
+// vtable instead of a C++ interface: a plugin shared object exports
+// __erasure_code_init(), which registers a named vtable; the registry
+// dlopens libec_<name>.so on demand and instantiates backends from
+// profiles.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+typedef struct ec_backend ec_backend_t;  // opaque per-profile instance
+
+typedef struct {
+  // profile: "k=8 m=3 technique=reed_sol_van"; null on failure.
+  ec_backend_t* (*create)(const char* profile);
+  void (*destroy)(ec_backend_t*);
+  int (*k_of)(ec_backend_t*);
+  int (*m_of)(ec_backend_t*);
+  // k contiguous data chunks -> m contiguous parity chunks; 0 = ok.
+  int (*encode)(ec_backend_t*, const uint8_t* data, uint8_t* parity,
+                size_t chunk_size);
+  int (*decode)(ec_backend_t*, const int* avail, int n_avail,
+                const int* want, int n_want, const uint8_t* chunks,
+                uint8_t* out, size_t chunk_size);
+} ec_plugin_vtable_t;
+
+// Called by plugins from __erasure_code_init; 0 = ok, -1 = duplicate.
+int ec_plugin_register(const char* name, const ec_plugin_vtable_t* vt);
+
+// Entry point every plugin .so must export
+// (ref: ErasureCodePlugin.cc __erasure_code_init contract).
+typedef int (*ec_plugin_init_fn)(const char* plugin_name);
+
+}  // extern "C"
+
+#ifdef __cplusplus
+namespace ceph_tpu {
+
+// ref: ErasureCodePluginRegistry (singleton, load-once, factory).
+class PluginRegistry {
+ public:
+  static PluginRegistry& instance();
+
+  // dlopen "<dir>/libec_<name>.so" if not yet registered; then create a
+  // backend from the profile. Returns nullptr + sets err on failure.
+  ec_backend_t* factory(const char* name, const char* directory,
+                        const char* profile, const ec_plugin_vtable_t** vt,
+                        const char** err);
+
+  int add(const char* name, const ec_plugin_vtable_t* vt);
+
+ private:
+  PluginRegistry() = default;
+};
+
+}  // namespace ceph_tpu
+#endif

@@ -1,0 +1,106 @@
+#include "plugin.h"
+
+#include <dlfcn.h>
+
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct State {
+  std::mutex mu;
+  std::map<std::string, const ec_plugin_vtable_t*> plugins;
+  std::map<std::string, void*> handles;  // dlopen handles, kept for life
+  std::string last_err;
+};
+
+State& state() {
+  static State s;
+  return s;
+}
+
+}  // namespace
+
+extern "C" int ec_plugin_register(const char* name,
+                                  const ec_plugin_vtable_t* vt) {
+  auto& s = state();
+  // mu already held during load(); direct registration (tests, builtins)
+  // races are the caller's problem, as in the reference singleton.
+  if (s.plugins.count(name)) return -1;
+  s.plugins[name] = vt;
+  return 0;
+}
+
+namespace ceph_tpu {
+
+PluginRegistry& PluginRegistry::instance() {
+  static PluginRegistry r;
+  return r;
+}
+
+int PluginRegistry::add(const char* name, const ec_plugin_vtable_t* vt) {
+  return ec_plugin_register(name, vt);
+}
+
+ec_backend_t* PluginRegistry::factory(const char* name,
+                                      const char* directory,
+                                      const char* profile,
+                                      const ec_plugin_vtable_t** vt_out,
+                                      const char** err) {
+  auto& s = state();
+  std::unique_lock<std::mutex> lock(s.mu);
+  auto it = s.plugins.find(name);
+  if (it == s.plugins.end()) {
+    // ref: ErasureCodePluginRegistry::load — dlopen + __erasure_code_init
+    std::string path = std::string(directory ? directory : ".") +
+                       "/libec_" + name + ".so";
+    void* h = dlopen(path.c_str(), RTLD_NOW | RTLD_GLOBAL);
+    if (!h) {
+      s.last_err = dlerror();
+      if (err) *err = s.last_err.c_str();
+      return nullptr;
+    }
+    auto init = reinterpret_cast<ec_plugin_init_fn>(
+        dlsym(h, "__erasure_code_init"));
+    if (!init) {
+      s.last_err = path + ": no __erasure_code_init";
+      if (err) *err = s.last_err.c_str();
+      dlclose(h);
+      return nullptr;
+    }
+    s.handles[name] = h;
+    int rc = init(name);
+    if (rc != 0 || !s.plugins.count(name)) {
+      s.last_err = path + ": __erasure_code_init failed";
+      if (err) *err = s.last_err.c_str();
+      return nullptr;
+    }
+    it = s.plugins.find(name);
+  }
+  const ec_plugin_vtable_t* vt = it->second;
+  ec_backend_t* b = vt->create(profile);
+  if (!b) {
+    s.last_err = std::string(name) + ": bad profile: " + profile;
+    if (err) *err = s.last_err.c_str();
+    return nullptr;
+  }
+  lock.unlock();
+  if (vt_out) *vt_out = vt;
+  return b;
+}
+
+}  // namespace ceph_tpu
+
+// C shims for ctypes / external callers.
+extern "C" {
+
+void* ec_registry_factory(const char* name, const char* directory,
+                          const char* profile, const void** vt_out) {
+  const char* err = nullptr;
+  return ceph_tpu::PluginRegistry::instance().factory(
+      name, directory, profile,
+      reinterpret_cast<const ec_plugin_vtable_t**>(vt_out), &err);
+}
+
+}  // extern "C"

@@ -1,0 +1,99 @@
+// libec_rsvan: sample dlopen-able plugin wrapping the native RS backend.
+// Demonstrates the full registry contract (ref: the jerasure plugin's
+// ErasureCodePluginJerasure.cc __erasure_code_init).
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "plugin.h"
+
+// from ec_ref.cc (linked into this .so as well)
+extern "C" {
+void* ec_ref_init(int k, int m, const char* technique);
+void ec_ref_free(void* handle);
+int ec_ref_encode(void* handle, const uint8_t* data, uint8_t* parity,
+                  size_t chunk_size);
+int ec_ref_decode(void* handle, const int* avail, int n_avail,
+                  const int* want, int n_want, const uint8_t* chunks,
+                  uint8_t* out, size_t chunk_size);
+}
+
+namespace {
+
+struct Backend {
+  void* h;
+  int k, m;
+};
+
+// Find "key=" at a token boundary (start or after whitespace/comma) so
+// "pack=9" never matches key "k". Returns npos or the value offset.
+size_t find_value(const std::string& p, const char* key) {
+  std::string needle = std::string(key) + "=";
+  size_t pos = 0;
+  while ((pos = p.find(needle, pos)) != std::string::npos) {
+    if (pos == 0 || p[pos - 1] == ' ' || p[pos - 1] == '\t' ||
+        p[pos - 1] == ',')
+      return pos + needle.size();
+    pos += needle.size();
+  }
+  return std::string::npos;
+}
+
+int parse_int(const char* profile, const char* key, int dflt) {
+  std::string p(profile ? profile : "");
+  auto pos = find_value(p, key);
+  if (pos == std::string::npos) return dflt;
+  return std::atoi(p.c_str() + pos);
+}
+
+std::string parse_str(const char* profile, const char* key,
+                      const char* dflt) {
+  std::string p(profile ? profile : "");
+  auto pos = find_value(p, key);
+  if (pos == std::string::npos) return dflt;
+  auto end = p.find_first_of(" \t,", pos);
+  return p.substr(pos, end == std::string::npos ? std::string::npos
+                                                : end - pos);
+}
+
+ec_backend_t* create(const char* profile) {
+  int k = parse_int(profile, "k", 4);
+  int m = parse_int(profile, "m", 2);
+  std::string tech = parse_str(profile, "technique", "reed_sol_van");
+  void* h = ec_ref_init(k, m, tech.c_str());
+  if (!h) return nullptr;
+  auto* b = new Backend{h, k, m};
+  return reinterpret_cast<ec_backend_t*>(b);
+}
+
+void destroy(ec_backend_t* be) {
+  auto* b = reinterpret_cast<Backend*>(be);
+  ec_ref_free(b->h);
+  delete b;
+}
+
+int k_of(ec_backend_t* be) { return reinterpret_cast<Backend*>(be)->k; }
+int m_of(ec_backend_t* be) { return reinterpret_cast<Backend*>(be)->m; }
+
+int encode(ec_backend_t* be, const uint8_t* data, uint8_t* parity,
+           size_t chunk) {
+  auto* b = reinterpret_cast<Backend*>(be);
+  return ec_ref_encode(b->h, data, parity, chunk);
+}
+
+int decode(ec_backend_t* be, const int* avail, int n_avail, const int* want,
+           int n_want, const uint8_t* chunks, uint8_t* out, size_t chunk) {
+  auto* b = reinterpret_cast<Backend*>(be);
+  return ec_ref_decode(b->h, avail, n_avail, want, n_want, chunks, out,
+                       chunk);
+}
+
+const ec_plugin_vtable_t kVtable = {create, destroy, k_of, m_of, encode,
+                                    decode};
+
+}  // namespace
+
+extern "C" int __erasure_code_init(const char* plugin_name) {
+  return ec_plugin_register(plugin_name, &kVtable);
+}

@@ -1,0 +1,142 @@
+#include "rs_matrix.h"
+
+#include <stdexcept>
+
+#include "gf256.h"
+
+namespace ceph_tpu {
+
+namespace {
+
+// Extended Vandermonde (rows x cols), ref construction mirrored from
+// ceph_tpu/ec/matrix.py extended_vandermonde.
+std::vector<uint8_t> extended_vandermonde(int rows, int cols) {
+  const GF256& gf = GF256::instance();
+  if (rows > 257) throw std::runtime_error("k+m must be <= 257 at w=8");
+  std::vector<uint8_t> v(rows * cols, 0);
+  v[0] = 1;
+  v[(rows - 1) * cols + (cols - 1)] = 1;
+  for (int i = 1; i < rows - 1; ++i) {
+    uint8_t acc = 1;
+    for (int j = 0; j < cols; ++j) {
+      v[i * cols + j] = acc;
+      acc = gf.mul(acc, static_cast<uint8_t>(i));
+    }
+  }
+  return v;
+}
+
+// Column elimination to identity top block; mirrors matrix.py
+// _systematize step-for-step (same pivot/scaling order => same bytes).
+std::vector<uint8_t> systematize(std::vector<uint8_t> dist, int rows,
+                                 int cols) {
+  const GF256& gf = GF256::instance();
+  auto at = [&](int r, int c) -> uint8_t& { return dist[r * cols + c]; };
+  for (int i = 1; i < cols; ++i) {
+    if (at(i, i) == 0) {
+      int found = -1;
+      for (int j = i + 1; j < rows; ++j)
+        if (at(j, i)) { found = j; break; }
+      if (found < 0) throw std::runtime_error("singular construction");
+      for (int c = 0; c < cols; ++c)
+        std::swap(at(i, c), at(found, c));
+    }
+    if (at(i, i) != 1) {
+      uint8_t inv = gf.inv(at(i, i));
+      for (int r = 0; r < rows; ++r) at(r, i) = gf.mul(at(r, i), inv);
+    }
+    for (int j = 0; j < cols; ++j) {
+      uint8_t e = at(i, j);
+      if (j != i && e) {
+        for (int r = 0; r < rows; ++r)
+          at(r, j) ^= gf.mul(e, at(r, i));
+      }
+    }
+  }
+  if (rows > cols) {
+    for (int j = 0; j < cols; ++j) {
+      uint8_t e = at(cols, j);
+      if (e == 0) throw std::runtime_error("singular construction");
+      if (e != 1) {
+        uint8_t inv = gf.inv(e);
+        for (int r = cols; r < rows; ++r) at(r, j) = gf.mul(at(r, j), inv);
+      }
+    }
+    for (int i = cols + 1; i < rows; ++i) {
+      uint8_t e = at(i, 0);
+      if (e == 0) throw std::runtime_error("singular construction");
+      if (e != 1) {
+        uint8_t inv = gf.inv(e);
+        for (int j = 0; j < cols; ++j) at(i, j) = gf.mul(at(i, j), inv);
+      }
+    }
+  }
+  return dist;
+}
+
+}  // namespace
+
+std::vector<uint8_t> coding_matrix(const std::string& technique, int k,
+                                   int m) {
+  const GF256& gf = GF256::instance();
+  if (k < 1 || m < 1) throw std::runtime_error("invalid k/m");
+  if (technique == "reed_sol_van") {
+    auto dist = systematize(extended_vandermonde(k + m, k), k + m, k);
+    return std::vector<uint8_t>(dist.begin() + k * k, dist.end());
+  }
+  if (technique == "cauchy_orig" || technique == "cauchy_good" ||
+      technique == "cauchy") {
+    if (k + m > 256) throw std::runtime_error("k+m must be <= 256");
+    std::vector<uint8_t> c(m * k);
+    for (int i = 0; i < m; ++i)
+      for (int j = 0; j < k; ++j)
+        c[i * k + j] = gf.inv(static_cast<uint8_t>(i ^ (m + j)));
+    if (technique != "cauchy_orig") {
+      for (int j = 0; j < k; ++j) {
+        uint8_t e = c[j];
+        if (e != 1) {
+          uint8_t inv = gf.inv(e);
+          for (int i = 0; i < m; ++i) c[i * k + j] = gf.mul(c[i * k + j], inv);
+        }
+      }
+    }
+    return c;
+  }
+  throw std::runtime_error("unknown technique " + technique);
+}
+
+std::vector<uint8_t> decode_matrix(const std::string& technique, int k,
+                                   int m, const std::vector<int>& avail,
+                                   const std::vector<int>& want) {
+  const GF256& gf = GF256::instance();
+  if (static_cast<int>(avail.size()) < k)
+    throw std::runtime_error("need k chunks to decode");
+  for (int id : avail)
+    if (id < 0 || id >= k + m)
+      throw std::runtime_error("available chunk id out of range");
+  for (int id : want)
+    if (id < 0 || id >= k + m)
+      throw std::runtime_error("wanted chunk id out of range");
+  auto coding = coding_matrix(technique, k, m);
+  auto grow = [&](int r, int j) -> uint8_t {  // generator row r, col j
+    if (r < k) return r == j ? 1 : 0;
+    return coding[(r - k) * k + j];
+  };
+  std::vector<uint8_t> sub(k * k);
+  for (int i = 0; i < k; ++i)
+    for (int j = 0; j < k; ++j) sub[i * k + j] = grow(avail[i], j);
+  if (!gf_matinv(sub, k)) throw std::runtime_error("singular submatrix");
+  const int w = static_cast<int>(want.size());
+  const int a = static_cast<int>(avail.size());
+  std::vector<uint8_t> d(w * a, 0);
+  for (int i = 0; i < w; ++i)
+    for (int j = 0; j < k; ++j) {
+      uint8_t acc = 0;
+      for (int x = 0; x < k; ++x)
+        acc ^= gf.mul(grow(want[i], x), sub[x * k + j]);
+      d[i * a + j] = acc;
+    }
+  return d;
+}
+
+}  // namespace ceph_tpu

@@ -1,0 +1,24 @@
+// Reed-Solomon coding-matrix constructions — must be coefficient-exact
+// with ceph_tpu/ec/matrix.py (the JAX plugin) so the two backends produce
+// identical parity bytes (the jerasure<->isa cross-check pattern,
+// ref: src/test/erasure-code TestErasureCodeIsa vs Jerasure).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace ceph_tpu {
+
+// (m x k) coding matrix; technique in {reed_sol_van, cauchy_orig,
+// cauchy_good, cauchy}. Throws std::runtime_error on bad input.
+std::vector<uint8_t> coding_matrix(const std::string& technique, int k,
+                                   int m);
+
+// Rows reconstructing `want` chunk ids from `avail` ids (>= k of them);
+// (want.size() x avail.size()), columns past k zero.
+std::vector<uint8_t> decode_matrix(const std::string& technique, int k,
+                                   int m, const std::vector<int>& avail,
+                                   const std::vector<int>& want);
+
+}  // namespace ceph_tpu
