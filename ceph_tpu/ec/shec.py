@@ -33,7 +33,7 @@ log = get_logger("ec")
 
 def shec_matrix(k: int, m: int, c: int) -> np.ndarray:
     """(m, k) windowed Cauchy coding matrix; zeros outside each shingle."""
-    if not (0 < c <= m <= k + m):
+    if not (0 < c <= m <= k):
         raise ValueError(f"invalid shec geometry k={k} m={m} c={c}")
     w = -(-k * c // m)
     mat = np.zeros((m, k), dtype=np.uint8)

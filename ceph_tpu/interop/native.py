@@ -31,19 +31,21 @@ def native_build_dir() -> pathlib.Path:
 
 
 def build_native() -> pathlib.Path:
-    """Ensure the native libs exist; returns the build dir.
+    """Ensure the native libs are up to date; returns the build dir.
 
-    Raises RuntimeError when the toolchain or build fails.
+    Always invokes make (it is incremental) so the loaded .so tracks the
+    C++ sources. Raises RuntimeError when the toolchain or build fails.
     """
-    lib = native_build_dir() / "libec_ref.so"
-    if not lib.exists():
-        try:
-            subprocess.run(["make", "-C", str(_NATIVE)], check=True,
-                           capture_output=True, text=True, timeout=300)
-        except (subprocess.CalledProcessError, FileNotFoundError,
-                subprocess.TimeoutExpired) as e:
-            out = getattr(e, "stderr", "") or str(e)
-            raise RuntimeError(f"native build failed: {out}") from e
+    try:
+        subprocess.run(["make", "-C", str(_NATIVE)], check=True,
+                       capture_output=True, text=True, timeout=300)
+    except FileNotFoundError as e:
+        # No toolchain: fall back to a previously built lib if one exists.
+        if not (native_build_dir() / "libec_ref.so").exists():
+            raise RuntimeError(f"native build failed: {e}") from e
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        out = getattr(e, "stderr", "") or str(e)
+        raise RuntimeError(f"native build failed: {out}") from e
     return native_build_dir()
 
 

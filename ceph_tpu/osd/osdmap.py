@@ -290,22 +290,26 @@ class OSDMap:
         rows_of = _index_overrides(
             folded, [pg for pg in self.pg_upmap if pg.pool == pool.id] +
             [pg for pg in self.pg_upmap_items if pg.pool == pool.id])
+        # A REJECTED pg_upmap entry settles the PG (the scalar walk
+        # returns early); a valid one is applied and then falls through
+        # to pg_upmap_items. Only in-range zero-weight targets reject.
+        settled: set[int] = set()
         for pg, target in self.pg_upmap.items():
             if pg.pool != pool.id:
                 continue
             rows = rows_of.get(pg.seed, _EMPTY_ROWS)
             if not rows.size:
                 continue
-            if any(o != ITEM_NONE and (o < 0 or o >= self.max_osd or
-                                       self.osd_weight[o] == 0)
-                   for o in target):
-                continue  # reject mappings onto out/invalid osds
+            if any(o != ITEM_NONE and 0 <= o < self.max_osd and
+                   self.osd_weight[o] == 0 for o in target):
+                settled.add(pg.seed)
+                continue  # reject mappings onto marked-out osds
             row = np.full(raw.shape[1], ITEM_NONE, dtype=raw.dtype)
             row[:min(len(target), raw.shape[1])] = \
                 list(target)[:raw.shape[1]]
             raw[rows] = row
         for pg, pairs in self.pg_upmap_items.items():
-            if pg.pool != pool.id:
+            if pg.pool != pool.id or pg.seed in settled:
                 continue
             rows = rows_of.get(pg.seed, _EMPTY_ROWS)
             for ri in rows:

@@ -4,6 +4,7 @@
 
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 
 namespace {
@@ -69,13 +70,22 @@ ec_backend_t* PluginRegistry::factory(const char* name,
       dlclose(h);
       return nullptr;
     }
-    s.handles[name] = h;
+    std::set<std::string> before;
+    for (const auto& kv : s.plugins) before.insert(kv.first);
     int rc = init(name);
     if (rc != 0 || !s.plugins.count(name)) {
       s.last_err = path + ": __erasure_code_init failed";
       if (err) *err = s.last_err.c_str();
+      // Drop anything the failed init registered before unloading, so no
+      // vtable pointer into the closed .so survives in the registry.
+      for (auto it2 = s.plugins.begin(); it2 != s.plugins.end();) {
+        if (!before.count(it2->first)) it2 = s.plugins.erase(it2);
+        else ++it2;
+      }
+      dlclose(h);
       return nullptr;
     }
+    s.handles[name] = h;
     it = s.plugins.find(name);
   }
   const ec_plugin_vtable_t* vt = it->second;
