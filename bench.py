@@ -6,43 +6,83 @@ configuration. ``vs_baseline`` compares against 7.5 GiB/s, the midpoint of
 the ISA-L single-core estimate recorded in BASELINE.md (the reference
 publishes no numbers in-repo).
 
-Runs on whatever platform is live (the driver provides one real TPU chip).
+Methodology note (round 2): round 1's number (9,317 GiB/s) was measured
+with a dispatch-timed loop and is RETRACTED — on this platform
+``block_until_ready`` returns before execution. All rates here come from
+the chained readback-anchored slope method (ceph_tpu/utils/timing.py) and
+pass the physical roofline guard (ceph_tpu/utils/roofline.py); the
+methodology fields are included in the output so the number can be audited.
+
+Secondary metrics in ``detail``: decode throughput, MFU, and the CRUSH
+north-star ``crush_mappings_per_s`` (batched pg->osd mapping rate).
 """
 
 import json
 import os
 import sys
 import time
+import traceback
 
 BASELINE_GIBS = 7.5  # ISA-L RS k=8,m=3 single-core (BASELINE.md external row)
 
 
-def main() -> None:
+def ec_metrics() -> tuple[dict, dict]:
     from ceph_tpu.bench.ec_benchmark import ErasureCodeBench, parse_args
 
     backend = os.environ.get("CEPH_TPU_BENCH_BACKEND", "bitmatmul")
-    iters = int(os.environ.get("CEPH_TPU_BENCH_ITERS", "1024"))
-    args = parse_args([
-        "--plugin", "jax", "--workload", "encode",
-        "--size", str(4 << 20), "--iterations", str(iters),
+    common = [
+        "--plugin", "jax", "--size", str(4 << 20),
+        "--iterations", "1024",
         "--parameter", "k=8", "--parameter", "m=3",
         "--parameter", f"backend={backend}",
         "--parameter", "technique=reed_sol_van",
-    ])
-    bench = ErasureCodeBench(args)
-    res = bench.run()
+    ]
+    enc = ErasureCodeBench(parse_args(
+        common + ["--workload", "encode"])).run()
+    dec = ErasureCodeBench(parse_args(
+        common + ["--workload", "decode", "--erasures", "2"])).run()
+    return enc, dec
+
+
+def crush_metric() -> dict:
+    """North-star #2: batched CRUSH mappings/s on a 10k-OSD straw2 map."""
+    from ceph_tpu.bench.crush_sweep import sweep_rate
+
+    n_pgs = int(os.environ.get("CEPH_TPU_BENCH_CRUSH_PGS", str(1 << 22)))
+    return sweep_rate(n_osds=10240, n_pgs=n_pgs, num_rep=3)
+
+
+def main() -> None:
+    enc, dec = ec_metrics()
+    detail = {
+        "seconds_per_step": round(enc["seconds"], 6),
+        "batch": enc["batch"],
+        "backend": enc["backend"],
+        "platform": enc["platform"],
+        "device": enc.get("device"),
+        "mfu_pct": enc.get("mfu_pct"),
+        "roofline_GiB/s": enc.get("roofline_GiB/s"),
+        "timing": enc.get("timing"),
+        "decode_GiB/s": round(dec["GiB/s"], 3),
+        "decode_timing_method": dec.get("timing", {}).get("method"),
+        "retraction": "round-1 value 9317 GiB/s was dispatch-timed and "
+                      "invalid; this value is readback-anchored",
+    }
+    try:
+        crush = crush_metric()
+        detail["crush_mappings_per_s"] = crush["mappings_per_s"]
+        detail["crush_detail"] = {
+            k: crush[k] for k in ("n_pgs", "n_osds", "num_rep",
+                                  "seconds_per_batch", "batch",
+                                  "method") if k in crush}
+    except Exception:
+        detail["crush_error"] = traceback.format_exc(limit=3)
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
-        "value": round(res["GiB/s"], 3),
+        "value": round(enc["GiB/s"], 3),
         "unit": "GiB/s",
-        "vs_baseline": round(res["GiB/s"] / BASELINE_GIBS, 3),
-        "detail": {
-            "seconds": round(res["seconds"], 4),
-            "iterations": res["iterations"],
-            "batch": res["batch"],
-            "backend": res["backend"],
-            "platform": res["platform"],
-        },
+        "vs_baseline": round(enc["GiB/s"] / BASELINE_GIBS, 3),
+        "detail": detail,
     }))
 
 

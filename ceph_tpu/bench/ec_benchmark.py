@@ -17,6 +17,16 @@ MXU sees deep batches — same total bytes, same per-op geometry. ``--stream``
 additionally measures host->device transfer in the loop (the honest
 PCIe-bound number; default keeps data resident like the reference's reuse of
 one in-RAM buffer).
+
+Timing methodology (round 2, replacing round 1's invalid dispatch-timed
+loop): device paths are measured with the chained readback-anchored slope
+method of ``ceph_tpu.utils.timing`` — each step's input depends on the
+previous step's full output, the timed program ends in a scalar readback,
+and the per-step time is the slope between two step counts so the RPC
+dispatch floor cancels. Every reported rate passes the physical-bound guard
+in ``ceph_tpu.utils.roofline`` (a number above the device's HBM/MXU roofline
+raises instead of printing). Host-loop plugins (lrc/shec/clay base paths)
+keep plain wall-clock, which is sound for synchronous numpy.
 """
 
 from __future__ import annotations
@@ -31,8 +41,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ceph_tpu.ec.interface import ErasureCodeProfile
+from ceph_tpu.ec.interface import ErasureCodeInterface, ErasureCodeProfile
 from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+from ceph_tpu.utils import roofline, timing
 from ceph_tpu.utils.logging import get_logger
 from ceph_tpu.utils.platform import cli_main
 
@@ -60,22 +71,38 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--stream", action="store_true",
                     help="include host->device transfer per step")
     ap.add_argument("--json", action="store_true", help="emit JSON detail")
+    ap.add_argument("--slope-steps", nargs=2, type=int, default=None,
+                    metavar=("LO", "HI"),
+                    help="step counts for the chained-slope measurement")
+    ap.add_argument("--perf-dump", action="store_true",
+                    help="dump perf counters after the run "
+                         "(`ceph daemon ... perf dump` analog)")
     ap.add_argument("-v", "--verbose", action="store_true")
     return ap.parse_args(argv)
 
 
-def _sync(x):
-    """block_until_ready for device arrays; no-op for host (numpy) paths
-    (lrc/shec/clay base-class batch kernels return numpy)."""
-    sync = getattr(x, "block_until_ready", None)
-    if sync is not None:
-        sync()
-    return x
+def _readback(x) -> None:
+    """Force execution by reading the result back to host. On this
+    platform block_until_ready() acks the dispatch without waiting for
+    execution (measured: ~30 us 'sync' vs ~1 s readback of the same
+    value), so a D2H copy is the only trustworthy barrier."""
+    np.asarray(x)
 
 
-def _auto_batch(object_size: int, iterations: int) -> int:
-    """Pick stripes/step to fill ~256 MiB of device input per step."""
+# Working-set multiple of the input bytes each backend materializes in HBM
+# (bit-planes at 8x + int32 accumulator rows for bitmatmul; the (m, k, L)
+# nibble-product intermediate for lut — measured from XLA OOM dumps).
+_HBM_MULTIPLE = {"bitmatmul": 16, "lut": 72}
+
+
+def _auto_batch(object_size: int, iterations: int, backend: str,
+                spec: roofline.DeviceSpec | None) -> int:
+    """Stripes/step: fill the device without overflowing HBM (round 1
+    ignored HBM and OOMed the lut path at 256 MiB input)."""
     target = 256 << 20
+    if spec is not None:
+        mult = _HBM_MULTIPLE.get(backend, 16)
+        target = min(target, int(spec.hbm_bytes * 0.5) // mult)
     return max(1, min(iterations, target // max(object_size, 1)))
 
 
@@ -95,34 +122,96 @@ class ErasureCodeBench:
         self.k = self.ec.k
         self.m = self.ec.m
         self.chunk = self.ec.get_chunk_size(args.size)
-        self.batch = args.batch or _auto_batch(args.size, args.iterations)
+        self.spec = roofline.device_spec()
+        backend = getattr(self.ec, "backend", "bitmatmul")
+        self.batch = args.batch or _auto_batch(
+            args.size, args.iterations, backend, self.spec)
+        # device path iff the plugin overrides the batched kernels
+        self.device_path = (type(self.ec).encode_batch
+                            is not ErasureCodeInterface.encode_batch)
+        from ceph_tpu.utils.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder("ec_bench")
+                     .add_u64_counter("encode_bytes", "input bytes encoded")
+                     .add_u64_counter("decode_bytes", "chunk bytes read for decode")
+                     .add_u64_counter("encode_ops", "stripe encodes")
+                     .add_u64_counter("decode_ops", "stripe decodes")
+                     .add_time("encode_seconds", "time in timed encode region")
+                     .add_time("decode_seconds", "time in timed decode region")
+                     .create_perf_counters())
 
     # -- workloads --------------------------------------------------------
     def _make_data(self, rng) -> np.ndarray:
         return rng.integers(0, 256, size=(self.batch, self.k, self.chunk),
                             dtype=np.uint8)
 
+    def _slope_steps(self) -> tuple[int, int]:
+        if self.args.slope_steps:
+            lo, hi = self.args.slope_steps
+            return int(lo), int(hi)
+        return (2, 10)
+
     def encode(self) -> dict:
         rng = np.random.default_rng(0)
         host = self._make_data(rng)
+        if not self.device_path:
+            return self._encode_hostloop(host)
+        if self.args.stream:
+            return self._encode_stream(host)
         data = jnp.asarray(host)
-        # Warmup / compile (excluded from timing, as the reference's first
-        # iteration is not — its loop is uncompiled C++; we report steady
-        # state, which is the honest number for a jitted pipeline).
-        _sync(self.ec.encode_batch(data))
-        steps = -(-self.args.iterations // self.batch)
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(steps):
-            if self.args.stream:
-                data = jnp.asarray(host)
-            out = self.ec.encode_batch(data)
-        _sync(out)
-        elapsed = time.perf_counter() - t0
-        ops = steps * self.batch
-        return self._result("encode", elapsed, ops)
 
-    def decode(self) -> dict:
+        def step(carry):
+            d, acc = carry
+            parity = self.ec.encode_batch(d)
+            acc = acc ^ timing.xor_anchor(parity)
+            # fold the digest of the FULL parity back into the next input:
+            # XLA cannot elide any lane, and steps cannot overlap
+            d = jax.lax.dynamic_update_slice(
+                d, acc[None, None, None], (0, 0, 0))
+            return (d, acc)
+
+        t = timing.measure_chained(step, (data, jnp.uint8(0)),
+                                   lambda c: c[1],
+                                   steps=self._slope_steps())
+        return self._result("encode", t.seconds_per_step, self.batch,
+                            timing_detail=t.as_dict(),
+                            steps_run=t.steps_executed,
+                            region_s=t.timed_region_s)
+
+    def _encode_stream(self, host: np.ndarray) -> dict:
+        """Streamed mode: H2D transfer inside the loop, pipelining allowed
+        (that is how a real ingest pipeline runs); anchored by a final
+        readback — in-order device execution means the last program
+        completing implies all did."""
+        steps = max(4, -(-self.args.iterations // self.batch))
+        out = self.ec.encode_batch(jnp.asarray(host))  # warm/compile
+        _readback(timing.xor_anchor(out))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = self.ec.encode_batch(jnp.asarray(host))
+        _readback(timing.xor_anchor(out))
+        elapsed = time.perf_counter() - t0
+        return self._result("encode", elapsed / steps, self.batch,
+                            timing_detail={"method":
+                                           "streamed_pipeline_readback",
+                                           "steps": steps},
+                            steps_run=steps, region_s=elapsed)
+
+    def _encode_hostloop(self, host: np.ndarray) -> dict:
+        """Host plugins (lrc/shec/clay base paths): synchronous numpy, so
+        plain wall-clock is sound."""
+        steps = -(-self.args.iterations // self.batch)
+        self.ec.encode_batch(host)  # warm any caches
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = self.ec.encode_batch(host)
+        np.asarray(out)
+        elapsed = time.perf_counter() - t0
+        return self._result("encode", elapsed / steps, self.batch,
+                            timing_detail={"method": "host_wallclock",
+                                           "steps": steps},
+                            steps_run=steps, region_s=elapsed)
+
+    def _decode_setup(self):
         rng = np.random.default_rng(0)
         host = self._make_data(rng)
         data = jnp.asarray(host)
@@ -137,48 +226,131 @@ class ErasureCodeBench:
         if self.ec.is_mds():
             avail = avail[:self.k]  # MDS: any k; layered codes keep all
         chunks = full[:, jnp.asarray(avail), :]
-        host_chunks = np.asarray(chunks)
-        from ceph_tpu.ec.interface import ErasureCodeInterface
-        device_path = (type(self.ec).decode_batch
-                       is not ErasureCodeInterface.decode_batch)
-        # host-loop plugins get the host array so the timed loop doesn't
-        # hide a D2H copy per step (that cost belongs to --stream only)
-        chunks = chunks if device_path else host_chunks
-        _sync(self.ec.decode_batch(erased, avail, chunks))
-        steps = -(-self.args.iterations // self.batch)
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(steps):
-            if self.args.stream:
-                chunks = (jnp.asarray(host_chunks) if device_path
-                          else host_chunks.copy())
-            out = self.ec.decode_batch(erased, avail, chunks)
-        _sync(out)
-        elapsed = time.perf_counter() - t0
-        ops = steps * self.batch
-        return self._result("decode", elapsed, ops, erased=erased)
+        return erased, avail, chunks
 
-    def _result(self, workload: str, elapsed: float, ops: int, **extra) -> dict:
-        total_bytes = ops * self.k * self.chunk  # input bytes, ref accounting
-        return {
+    def decode(self) -> dict:
+        erased, avail, chunks = self._decode_setup()
+        if not self.device_path:
+            host_chunks = np.asarray(chunks)
+            steps = -(-self.args.iterations // self.batch)
+            self.ec.decode_batch(erased, avail, host_chunks)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = self.ec.decode_batch(erased, avail, host_chunks)
+            np.asarray(out)
+            elapsed = time.perf_counter() - t0
+            return self._result(
+                "decode", elapsed / steps, self.batch, erased=erased,
+                avail=avail,
+                timing_detail={"method": "host_wallclock", "steps": steps},
+                steps_run=steps, region_s=elapsed)
+        if self.args.stream:
+            return self._decode_stream(erased, avail, chunks)
+
+        # Build the per-pattern decode kernel eagerly: inside the traced
+        # loop a cache miss would stage its constants as tracers.
+        self.ec.decode_batch(erased, avail, chunks)
+
+        def step(carry):
+            c, acc = carry
+            out = self.ec.decode_batch(erased, avail, c)
+            acc = acc ^ timing.xor_anchor(out)
+            c = jax.lax.dynamic_update_slice(
+                c, acc[None, None, None], (0, 0, 0))
+            return (c, acc)
+
+        t = timing.measure_chained(step, (chunks, jnp.uint8(0)),
+                                   lambda c: c[1],
+                                   steps=self._slope_steps())
+        return self._result("decode", t.seconds_per_step, self.batch,
+                            erased=erased, avail=avail,
+                            timing_detail=t.as_dict(),
+                            steps_run=t.steps_executed,
+                            region_s=t.timed_region_s)
+
+    def _decode_stream(self, erased, avail, chunks) -> dict:
+        """Streamed decode: H2D of the survivor chunks inside the loop
+        (see _encode_stream for the pipelining/anchoring rationale)."""
+        host_chunks = np.asarray(chunks)
+        steps = max(4, -(-self.args.iterations // self.batch))
+        out = self.ec.decode_batch(erased, avail, jnp.asarray(host_chunks))
+        _readback(timing.xor_anchor(out))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = self.ec.decode_batch(erased, avail,
+                                       jnp.asarray(host_chunks))
+        _readback(timing.xor_anchor(out))
+        elapsed = time.perf_counter() - t0
+        return self._result("decode", elapsed / steps, self.batch,
+                            erased=erased, avail=avail,
+                            timing_detail={"method":
+                                           "streamed_pipeline_readback",
+                                           "steps": steps},
+                            steps_run=steps, region_s=elapsed)
+
+    def _result(self, workload: str, seconds_per_step: float,
+                ops_per_step: int, erased=None, avail=None,
+                timing_detail=None, steps_run: int = 1,
+                region_s: float | None = None) -> dict:
+        """Throughput accounting (round 2, fixing round 1's Weak #6):
+
+        encode: bytes = input bytes (k * chunk per op) — the reference's
+        accounting for ``--workload encode``.
+        decode: headline bytes = chunk bytes actually READ
+        (len(avail) * chunk per op); ``reconstructed_bytes`` = erased
+        chunks produced; ``object_MBps`` = the reference-comparable rate in
+        object bytes (k * chunk per op, what ErasureCodeBench::decode
+        reports), stated separately so no single number overstates work.
+        """
+        n_read = len(avail) if avail is not None else self.k
+        if workload == "encode":
+            step_bytes = ops_per_step * self.k * self.chunk
+            bound = (roofline.encode_bound(self.k, self.m, self.spec)
+                     if self.spec else None)
+        else:
+            step_bytes = ops_per_step * n_read * self.chunk
+            bound = (roofline.decode_bound(len(erased or []), n_read,
+                                           self.spec)
+                     if self.spec else None)
+        rate = step_bytes / seconds_per_step
+        if not self.args.stream:  # streamed mode is PCIe-bound, not device
+            roofline.check(rate, bound, f"{workload} throughput")
+        # Counters account everything the device actually executed
+        # (warmup + all timed reps), not just one step.
+        self.perf.inc(f"{workload}_bytes", step_bytes * steps_run)
+        self.perf.inc(f"{workload}_ops", ops_per_step * steps_run)
+        self.perf.tinc(f"{workload}_seconds",
+                       region_s if region_s is not None
+                       else seconds_per_step * steps_run)
+        res = {
             "workload": workload,
             "plugin": self.args.plugin,
             "technique": self.ec.profile.get("technique", "reed_sol_van"),
             "k": self.k, "m": self.m,
             "object_size": self.args.size,
             "chunk_size": self.chunk,
-            "iterations": ops,  # actual ops run (requested rounded up to
-            "requested_iterations": self.args.iterations,  # whole batches)
-            "batch": self.batch,
-            "seconds": elapsed,
-            "total_bytes": total_bytes,
-            "MB/s": total_bytes / elapsed / 1e6,
-            "GiB/s": total_bytes / elapsed / (1 << 30),
+            "batch": ops_per_step,
+            "seconds": seconds_per_step,      # per step of `batch` ops
+            "total_bytes": step_bytes,        # accounted bytes per step
+            "MB/s": rate / 1e6,
+            "GiB/s": rate / (1 << 30),
             "backend": getattr(self.ec, "backend", "n/a"),
             "stream": self.args.stream,
             "platform": jax.devices()[0].platform,
-            **extra,
+            "device": jax.devices()[0].device_kind,
+            "roofline_GiB/s": (bound / (1 << 30)) if bound else None,
+            "timing": timing_detail or {},
         }
+        if workload == "encode" and self.spec:
+            res["mfu_pct"] = round(
+                100 * roofline.mfu(self.k, self.m, rate, self.spec), 2)
+        if erased is not None:
+            res["erased"] = erased
+            res["chunks_read"] = n_read
+            res["reconstructed_bytes"] = ops_per_step * len(erased) * self.chunk
+            res["object_MBps"] = ops_per_step * self.k * self.chunk \
+                / seconds_per_step / 1e6
+        return res
 
     def run(self) -> dict:
         if self.args.workload == "encode":
@@ -195,6 +367,9 @@ def main(argv=None) -> dict:
     print(f"{res['seconds']:.6f}\t{res['MB/s']:.2f}")
     if args.json or args.verbose:
         print(json.dumps(res))
+    if args.perf_dump:
+        from ceph_tpu.utils.perf_counters import PerfCountersCollection
+        print(PerfCountersCollection.instance().dump_json())
     return res
 
 

@@ -108,6 +108,44 @@ class PerfCounters:
         return json.dumps({self.name: self.dump()}, indent=2)
 
 
+class PerfCountersCollection:
+    """Process-wide registry of PerfCounters instances, the analog of
+    CephContext's collection behind ``perf dump``
+    (ref: src/common/perf_counters_collection.h PerfCountersCollection)."""
+
+    _instance: "PerfCountersCollection | None" = None
+
+    def __init__(self) -> None:
+        self._loggers: Dict[str, PerfCounters] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "PerfCountersCollection":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def add(self, pc: PerfCounters) -> None:
+        with self._lock:
+            self._loggers[pc.name] = pc
+
+    def remove(self, pc: PerfCounters) -> None:
+        with self._lock:
+            self._loggers.pop(pc.name, None)
+
+    def get(self, name: str) -> PerfCounters | None:
+        with self._lock:
+            return self._loggers.get(name)
+
+    def dump(self) -> dict:
+        """Cluster-of-one ``perf dump``: {logger_name: {counter: value}}."""
+        with self._lock:
+            return {name: pc.dump() for name, pc in self._loggers.items()}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.dump(), indent=2, sort_keys=True)
+
+
 class PerfCountersBuilder:
     """ref: src/common/perf_counters.h PerfCountersBuilder."""
 
@@ -136,5 +174,9 @@ class PerfCountersBuilder:
         self._pc._counters[key] = _Counter(TYPE_HISTOGRAM, doc)
         return self
 
-    def create_perf_counters(self) -> PerfCounters:
+    def create_perf_counters(self, register: bool = True) -> PerfCounters:
+        """Finalize; registers with the process collection by default, the
+        way daemons hand their counters to the CephContext collection."""
+        if register:
+            PerfCountersCollection.instance().add(self._pc)
         return self._pc

@@ -15,8 +15,9 @@ def test_encode_flags_and_accounting():
                 "--parameter", "k=4", "--parameter", "m=2"])
     assert res["k"] == 4 and res["m"] == 2
     assert res["chunk_size"] == 4096
-    assert res["total_bytes"] == res["iterations"] * 4 * 4096
+    assert res["total_bytes"] == res["batch"] * 4 * 4096
     assert res["GiB/s"] > 0
+    assert res["timing"]["method"].startswith("chained_fori_loop")
 
 
 def test_decode_workload_with_erasures():
