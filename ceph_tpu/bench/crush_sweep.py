@@ -1,0 +1,112 @@
+"""North-star #2 benchmark: batched CRUSH mapping rate on TPU.
+
+The `crushtool --test` timing harness scaled to 100M PGs
+(ref: src/crush/CrushTester.cc CrushTester::test with --show-statistics;
+src/tools/crushtool.cc). The sweep is ONE device program per measurement
+(Mapper.sweep: fori_loop over PG blocks + on-device scatter-add), so the
+only host<->device traffic is the final (max_devices,) count readback —
+which is also the execution anchor (this platform's block_until_ready
+does not wait for execution; see ceph_tpu/utils/timing.py).
+
+Methodology: two sweep sizes, rate taken from the SLOPE so the constant
+dispatch+readback floor cancels — same discipline as the EC benchmark.
+
+Canonical map: 10k OSDs in a root->rack->host->osd straw2 hierarchy with
+a 3-replica chooseleaf rule (BASELINE.md tracked config #3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from ceph_tpu.crush import builder
+from ceph_tpu.crush.builder import TYPE_HOST
+from ceph_tpu.crush.mapper import Mapper
+from ceph_tpu.utils.logging import get_logger
+from ceph_tpu.utils.platform import cli_main
+
+log = get_logger("bench")
+
+
+def canonical_map(n_osds: int = 10240):
+    """10k-OSD 3-level map + 3-replica chooseleaf rule (rule 0)."""
+    osds_per_host = 16
+    n_hosts = n_osds // osds_per_host
+    m, root = builder.build_hierarchy(n_hosts, osds_per_host,
+                                      n_racks=max(1, n_hosts // 32))
+    builder.add_simple_rule(m, root, TYPE_HOST)
+    return m
+
+
+def _timed_sweep(mapper: Mapper, rule: int, n: int, num_rep: int) -> float:
+    """Wall seconds for one aggregated sweep of n PGs, readback-anchored."""
+    t0 = time.perf_counter()
+    counts, bad = mapper.sweep(rule, 0, n, num_rep)
+    np.asarray(counts)  # D2H readback: cannot complete before execution
+    return time.perf_counter() - t0
+
+
+def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
+               mapper: Mapper | None = None, rule: int = 0,
+               block: int | None = None) -> dict:
+    """Measure mappings/s via the two-size slope method."""
+    if mapper is None:
+        mapper = Mapper(canonical_map(n_osds), block=block)
+    n_hi = max(n_pgs, mapper.block)
+    n_lo = min(n_hi // 2, max(mapper.block, n_pgs // 4))
+    # warm/compile (the per-block program is size-independent, but warm so
+    # the first-compile cost is excluded from timing)
+    _timed_sweep(mapper, rule, n_lo or n_hi, num_rep)
+    t_hi = min(_timed_sweep(mapper, rule, n_hi, num_rep) for _ in range(2))
+    if n_lo and n_lo < n_hi:
+        t_lo = min(_timed_sweep(mapper, rule, n_lo, num_rep)
+                   for _ in range(2))
+    else:
+        t_lo = None
+    if t_lo is not None and t_hi > t_lo:
+        per_pg = (t_hi - t_lo) / (n_hi - n_lo)
+        method = "sweep_two_size_slope_readback"
+        overhead = t_lo - n_lo * per_pg
+    else:  # single size or noise floor: conservative total
+        per_pg = t_hi / n_hi
+        method = "sweep_total_readback"
+        overhead = 0.0
+    rate = 1.0 / per_pg
+    import jax
+    return {
+        "metric": "crush_mappings_per_s",
+        "mappings_per_s": round(rate, 1),
+        "n_pgs": n_pgs,
+        "n_osds": n_osds,
+        "num_rep": num_rep,
+        "seconds_per_batch": t_hi,
+        "batch": mapper.block,
+        "seconds_100M_est": round(1e8 * per_pg + overhead, 3),
+        "overhead_s": round(overhead, 4),
+        "method": method,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+@cli_main
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(
+        prog="crush_sweep", description="batched CRUSH mapping benchmark")
+    ap.add_argument("--num-osds", type=int, default=10240)
+    ap.add_argument("--num-pgs", type=int, default=1 << 22)
+    ap.add_argument("--num-rep", type=int, default=3)
+    ap.add_argument("--block", type=int, default=None,
+                    help="PGs per device block (default: auto from HBM)")
+    args = ap.parse_args(argv)
+    res = sweep_rate(args.num_osds, args.num_pgs, args.num_rep,
+                     block=args.block)
+    print(json.dumps(res))
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -126,9 +126,15 @@ class ErasureCodeBench:
         backend = getattr(self.ec, "backend", "bitmatmul")
         self.batch = args.batch or _auto_batch(
             args.size, args.iterations, backend, self.spec)
-        # device path iff the plugin overrides the batched kernels
-        self.device_path = (type(self.ec).encode_batch
-                            is not ErasureCodeInterface.encode_batch)
+        # device path iff the plugin overrides the matching batched kernel
+        # (lrc overrides encode_batch but inherits the numpy decode_batch —
+        # the two workloads must be classified independently)
+        self.device_path = (
+            type(self.ec).encode_batch
+            is not ErasureCodeInterface.encode_batch
+            if args.workload == "encode"
+            else type(self.ec).decode_batch
+            is not ErasureCodeInterface.decode_batch)
         from ceph_tpu.utils.perf_counters import PerfCountersBuilder
         self.perf = (PerfCountersBuilder("ec_bench")
                      .add_u64_counter("encode_bytes", "input bytes encoded")

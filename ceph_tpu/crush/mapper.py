@@ -16,8 +16,24 @@ Semantics deltas vs the scalar spec (``mapper_ref``), all documented:
   mid-rule (astronomically rare, needs a near-full cluster of failures);
 - straw(v1)/tree buckets: not yet (straw2/uniform/list cover modern maps).
 
-Everything is int64 inside (straw2 draws are 48-bit fixed point); x64 mode
-is enabled at import.
+The straw2 draw is 48-bit fixed point, so the draw math needs 64-bit
+integers; x64 is enabled ONLY inside this module's entry points via the
+scoped ``jax.enable_x64(True)`` context (round 1 flipped the global
+``jax_enable_x64`` flag at import time, silently changing dtype semantics
+for every other JAX user in the process). Per-lane loop state stays int32.
+
+Large batches are tiled: ``map_pgs`` splits the x range into fixed-size
+blocks (bounding the (N, S) int64 straw2 temps that OOMed round 1 at 4M
+lanes), and ``sweep`` streams an arbitrary PG range through per-block
+device programs with on-device scatter-add utilization counts — dispatches
+pipeline (async), only the final count readback synchronizes, and nothing
+of O(N) ever crosses the host boundary.
+
+Performance techniques (each cross-checked bit-exact vs mapper_ref):
+precomputed 64K-entry negated-ln table (crush_ln becomes one gather),
+magic-multiply exact division (no 64-bit divider on TPU), speculative
+parallel tries replacing most while_loop retry iterations, and static
+descent-depth unrolling on uniform hierarchies.
 """
 
 from __future__ import annotations
@@ -27,9 +43,6 @@ import functools
 import numpy as np
 
 import jax
-
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 from jax import lax
 
@@ -48,16 +61,23 @@ from ceph_tpu.crush.types import (
 )
 
 S64_MIN = np.int64(np.iinfo(np.int64).min)
+S64_MAX = np.int64(np.iinfo(np.int64).max)
 LN_ONE = np.int64(1) << 48
+
+
+@functools.lru_cache(maxsize=None)
+def _negln_table() -> np.ndarray:
+    """negln[u] = 2^48 - crush_ln(u) for u in [0, 0xffff]: the negated
+    straw2 draw numerator, precomputed once (crush_ln is pure and its
+    domain is 16 bits — the whole function becomes one gather)."""
+    t = (np.int64(1) << 48) - np.asarray(
+        crush_ln(np.arange(0x10000, dtype=np.int64)), dtype=np.int64)
+    t.flags.writeable = False
+    return t
 
 
 def _u32(v):
     return v.astype(jnp.uint32)
-
-
-def _div_trunc_neg(ln, w):
-    """C-style trunc division for ln <= 0, w > 0."""
-    return -((-ln) // w)
 
 
 # ---------------------------------------------------------------------------
@@ -65,18 +85,37 @@ def _div_trunc_neg(ln, w):
 # ---------------------------------------------------------------------------
 
 def _straw2_choose(arrs, rows, x, r):
-    """(N,) lanes: straw2 argmax draw (ref: mapper.c bucket_straw2_choose)."""
+    """(N,) lanes: straw2 argmax draw (ref: mapper.c bucket_straw2_choose).
+
+    The 48-bit fixed-point ln is ONE gather from the precomputed 64K-entry
+    ``negln`` table (negln[u] = 2^48 - crush_ln(u), the negated draw
+    numerator) — measured ~5x cheaper on TPU than evaluating crush_ln's
+    normalize/multiply chain in emulated int64 per item.
+    """
     items = arrs["items"][rows]            # (N, S) int32
     w = arrs["weights"][rows]              # (N, S) int64
     size = arrs["size"][rows]              # (N,)
     S = items.shape[1]
-    u = h.hash32_3(_u32(x)[:, None], _u32(items), _u32(r)[:, None],
-                   xp=jnp).astype(jnp.int64) & 0xFFFF
-    ln = crush_ln(u, xp=jnp) - LN_ONE      # (N, S) <= 0
-    draw = jnp.where(w > 0, _div_trunc_neg(ln, jnp.maximum(w, 1)), S64_MIN)
-    posmask = jnp.arange(S)[None, :] < size[:, None]
-    draw = jnp.where(posmask, draw, S64_MIN)
-    idx = jnp.argmax(draw, axis=1)         # first max, like the scalar loop
+    u = (h.hash32_3(_u32(x)[:, None], _u32(items), _u32(r)[:, None],
+                    xp=jnp) & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    neg = arrs["negln"][u].astype(jnp.uint64)   # (N, S), <= 2^48
+    # draw = trunc((ln - 2^48)/w) = -(neg // w); maximize draw = minimize q.
+    # neg // w via the per-slot magic multiply (exact; see PackedMap.wm1)
+    # — TPUs have no 64-bit divider and XLA's emulation is ~6.5x slower.
+    m1 = arrs["wm1"][rows]
+    m0 = arrs["wm0"][rows]
+    sh = arrs["wsh"][rows]
+    n1 = neg >> jnp.uint64(32)
+    n0 = neg & jnp.uint64(0xFFFFFFFF)
+    mid = n1 * m0 + n0 * m1 + ((n0 * m0) >> jnp.uint64(32))
+    q = ((n1 * m1 + (mid >> jnp.uint64(32))) >> sh).astype(jnp.int64)
+    # w in {1, 2}: plain shift (magic table is zero there); w <= 0: masked
+    small = w < 3
+    q = jnp.where(small, (neg >> jnp.clip(w - 1, 0, 1).astype(jnp.uint64)
+                          ).astype(jnp.int64), q)
+    posmask = jnp.arange(S, dtype=jnp.int32)[None, :] < size[:, None]
+    q = jnp.where(posmask & (w > 0), q, S64_MAX)
+    idx = jnp.argmin(q, axis=1)            # first min == scalar's first max
     return jnp.take_along_axis(items, idx[:, None], axis=1)[:, 0]
 
 
@@ -159,17 +198,21 @@ def _is_out(arrs, item, x):
 # ---------------------------------------------------------------------------
 
 def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
-             target_type, indep_numrep):
+             target_type, indep_numrep, levels: int | None = None):
     """Walk from start buckets down to an item of target_type.
 
     base_r: (N,) int32 = rep + parent_r. ftotal: (N,) or scalar retry count.
     indep_numrep: None for firstn (r = base_r + ftotal) else the numrep used
     for the indep r-stride (ref: crush_choose_indep r computation; the
     stride consults the alg/size of the bucket at EACH level).
+    levels: exact unroll count when the caller knows the static descent
+    depth (uniform-depth hierarchies; see PackedMap.type_depth) — the
+    max_depth default costs a full bucket_choose per excess level for
+    every lane.
     Returns (item, success, r_final) — r_final is the r used at the level
     where the item was drawn (the scalar code's `r` at recursion time).
     Lanes that hit a device/bucket of the wrong kind, an empty bucket, or
-    exceed max depth fail.
+    exceed the unrolled depth fail.
     """
     B = arrs["size"].shape[0]
     n = start_rows.shape[0]
@@ -178,7 +221,9 @@ def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
     success = jnp.zeros(n, dtype=bool)
     out_item = jnp.full(n, ITEM_NONE, dtype=jnp.int32)
     r_final = jnp.zeros(n, dtype=jnp.int32)
-    for _ in range(cfg["max_depth"]):
+    if levels is None or not (0 < levels <= cfg["max_depth"]):
+        levels = cfg["max_depth"]
+    for _ in range(levels):
         active = ~done
         size_c = arrs["size"][cur]
         if indep_numrep is None:
@@ -230,7 +275,8 @@ def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries):
     def body(c):
         active = ~c["done"]
         item_l, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
-                                 sub_r, c["ftotal"], 0, None)
+                                 sub_r, c["ftotal"], 0, None,
+                                 levels=cfg.get("levels_leaf"))
         collide = jnp.zeros(n, dtype=bool)
         if prior_leaves is not None and prior_leaves.shape[1]:
             collide = jnp.any(item_l[:, None] == prior_leaves, axis=1)
@@ -260,8 +306,13 @@ def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries):
 
 def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
                        prior_out, prior_leaves, target_type,
-                       recurse_to_leaf, tries, recurse_tries, vary_r):
-    """One replica slot of crush_choose_firstn, all lanes at once."""
+                       recurse_to_leaf, tries, recurse_tries, vary_r,
+                       ftotal0: int = 0):
+    """One replica slot of crush_choose_firstn, all lanes at once.
+
+    ftotal0 > 0 resumes after the caller's speculative tries: the while
+    cond is False when no lane is active, so the fallback costs nothing
+    on collision-free blocks."""
     n = x.shape[0]
     base_r = jnp.full(n, rep, dtype=jnp.int32)
 
@@ -271,7 +322,8 @@ def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
     def body(c):
         active = ~c["done"]
         item, ok, r_fin = _descend(arrs, cfg, root_rows, root_valid, x,
-                                   base_r, c["ftotal"], target_type, None)
+                                   base_r, c["ftotal"], target_type, None,
+                                   levels=cfg.get("levels_main"))
         collide = jnp.zeros(n, dtype=bool)
         if prior_out.shape[1]:
             collide = jnp.any(item[:, None] == prior_out, axis=1)
@@ -303,25 +355,124 @@ def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
         "item": jnp.full(n, ITEM_NONE, dtype=jnp.int32),
         "leaf": jnp.full(n, ITEM_NONE, dtype=jnp.int32),
         "ok": jnp.zeros(n, dtype=bool),
-        "done": ~root_valid,
-        "ftotal": jnp.zeros(n, dtype=jnp.int32),
+        "done": ~root_valid if ftotal0 < tries
+        else jnp.ones(n, dtype=bool),
+        "ftotal": jnp.full(n, ftotal0, dtype=jnp.int32),
     }
     out = lax.while_loop(cond, body, init)
     return out["item"], out["leaf"], out["ok"]
 
 
+SPEC_TRIES = 2  # speculative parallel tries per replica slot (try 0
+                # succeeds for all but ~1e-3 of lanes on healthy maps; the
+                # while_loop fallback catches the tail exactly)
+
+
+def _leaf_once(arrs, cfg, item, item_ok, x, sub_r):
+    """Single-pass chooseleaf recursion (descend_once semantics): one
+    descent from `item` to a device; no retry loop. Device items pass
+    through unchecked (the scalar code only is_out-checks at type 0)."""
+    B = arrs["size"].shape[0]
+    is_bucket = item < 0
+    rows = jnp.clip(-1 - item, 0, B - 1)
+    leaf, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
+                           sub_r, jnp.zeros_like(sub_r), 0, None,
+                           levels=cfg.get("levels_leaf"))
+    leaf = jnp.where(is_bucket, leaf, item)
+    ok = jnp.where(is_bucket, ok, item_ok)
+    return leaf, ok
+
+
 def _choose_firstn_block(arrs, cfg, root_rows, root_valid, x, numrep,
                          target_type, recurse_to_leaf, tries, recurse_tries,
                          vary_r):
-    """numrep replica slots from one root column -> (N, numrep) x2."""
+    """numrep replica slots from one root column -> (N, numrep) x2.
+
+    Structure (round 2): the first SPEC_TRIES tries of EVERY slot descend
+    in parallel as extra lanes — the descent for (slot, try) is
+    deterministic (r = slot + try under chooseleaf_stable=1) and
+    independent of which earlier tries succeed, so speculation is exact.
+    Collision filtering against earlier slots is a cheap elementwise scan
+    afterwards. Only lanes whose slot fails all SPEC_TRIES enter the
+    masked while_loop fallback (round 1 ran that full-width loop for
+    every slot: ~5-7 full-width re-descents per block for a handful of
+    colliding lanes).
+
+    The speculative path requires the single-descent leaf recursion
+    (recurse_tries == 1, the chooseleaf_descend_once=1 modern default);
+    other configurations use the loop path.
+    """
     n = x.shape[0]
     out = jnp.full((n, numrep), ITEM_NONE, dtype=jnp.int32)
     leaves = jnp.full((n, numrep), ITEM_NONE, dtype=jnp.int32)
+    speculate = (tries >= 1) and (recurse_tries == 1 or not recurse_to_leaf)
+
+    items_s = ok_s = leaves_s = None
+    if speculate:
+        K = min(SPEC_TRIES, tries)
+        # lanes (n, numrep*K): slot-major, try-minor
+        reps = np.repeat(np.arange(numrep, dtype=np.int32), K)
+        ts = np.tile(np.arange(K, dtype=np.int32), numrep)
+        r_all = jnp.asarray(reps + ts, dtype=jnp.int32)      # r = slot+ftotal
+        M = numrep * K
+        x_f = jnp.broadcast_to(x[:, None], (n, M)).reshape(-1)
+        rows_f = jnp.broadcast_to(root_rows[:, None], (n, M)).reshape(-1)
+        valid_f = jnp.broadcast_to(root_valid[:, None], (n, M)).reshape(-1)
+        base_r = jnp.broadcast_to(r_all[None, :], (n, M)).reshape(-1)
+        ftot0 = jnp.zeros_like(base_r)
+        item_f, ok_f, _ = _descend(arrs, cfg, rows_f, valid_f, x_f,
+                                   base_r, ftot0, target_type, None,
+                                   levels=cfg.get("levels_main"))
+        if recurse_to_leaf:
+            if vary_r:
+                sub_r = base_r >> (vary_r - 1)
+            else:
+                sub_r = jnp.zeros_like(base_r)
+            leaf_f, ok_f = _leaf_once(arrs, cfg, item_f, ok_f, x_f, sub_r)
+            # is_out applies to recursed leaves only; a device item sitting
+            # directly at the target level passes through unchecked (same
+            # as the loop path / scalar spec).
+            ok_f = ok_f & ~(_is_out(arrs, leaf_f, x_f) & (item_f < 0))
+        else:
+            leaf_f = item_f
+            if target_type == 0:
+                ok_f = ok_f & ~_is_out(arrs, item_f, x_f)
+        items_s = item_f.reshape(n, numrep, K)
+        ok_s = ok_f.reshape(n, numrep, K)
+        leaves_s = leaf_f.reshape(n, numrep, K)
+
     for rep in range(numrep):
-        item, leaf, ok = _choose_one_firstn(
-            arrs, cfg, root_rows, root_valid, x, rep,
-            out[:, :rep], leaves[:, :rep], target_type,
-            recurse_to_leaf, tries, recurse_tries, vary_r)
+        if speculate:
+            K = items_s.shape[2]
+            it_k = items_s[:, rep, :]                        # (n, K)
+            lf_k = leaves_s[:, rep, :]
+            ok_k = ok_s[:, rep, :]
+            if rep:
+                collide = jnp.any(
+                    it_k[:, :, None] == out[:, None, :rep], axis=2)
+                ok_k = ok_k & ~collide
+                if recurse_to_leaf:
+                    lcollide = jnp.any(
+                        lf_k[:, :, None] == leaves[:, None, :rep], axis=2)
+                    ok_k = ok_k & ~lcollide
+            first = jnp.argmax(ok_k, axis=1)                 # first valid try
+            any_ok = jnp.any(ok_k, axis=1)
+            item = jnp.take_along_axis(it_k, first[:, None], axis=1)[:, 0]
+            leaf = jnp.take_along_axis(lf_k, first[:, None], axis=1)[:, 0]
+            # fallback continues from ftotal = K for unresolved lanes only
+            item2, leaf2, ok2 = _choose_one_firstn(
+                arrs, cfg, root_rows, root_valid & ~any_ok, x, rep,
+                out[:, :rep], leaves[:, :rep], target_type,
+                recurse_to_leaf, tries, recurse_tries, vary_r,
+                ftotal0=K)
+            ok = any_ok | ok2
+            item = jnp.where(any_ok, item, item2)
+            leaf = jnp.where(any_ok, leaf, leaf2)
+        else:
+            item, leaf, ok = _choose_one_firstn(
+                arrs, cfg, root_rows, root_valid, x, rep,
+                out[:, :rep], leaves[:, :rep], target_type,
+                recurse_to_leaf, tries, recurse_tries, vary_r)
         out = out.at[:, rep].set(jnp.where(ok, item, ITEM_NONE))
         leaves = leaves.at[:, rep].set(jnp.where(ok, leaf, ITEM_NONE))
     return out, leaves
@@ -343,7 +494,8 @@ def _leaf_choose_indep(arrs, cfg, item, item_ok, x, parent_r, rep, numrep,
     def body(c):
         active = ~c["done"]
         item_l, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
-                                 base_r, c["ftotal"], 0, numrep)
+                                 base_r, c["ftotal"], 0, numrep,
+                                 levels=cfg.get("levels_leaf"))
         reject = ~ok | _is_out(arrs, item_l, x)
         succeed = active & ~reject
         ftotal_next = c["ftotal"] + 1
@@ -388,7 +540,8 @@ def _choose_indep_block(arrs, cfg, root_rows, root_valid, x, out_size,
             item, ok, r_parent = _descend(arrs, cfg, root_rows,
                                           root_valid & need, x,
                                           base_r, ftotal, target_type,
-                                          numrep)
+                                          numrep,
+                                          levels=cfg.get("levels_main"))
             real = jnp.where(out == UNDEF, ITEM_NONE, out)
             collide = jnp.any(item[:, None] == real, axis=1)
             ok = ok & ~collide
@@ -440,7 +593,8 @@ class Mapper:
     """
 
     def __init__(self, crush_map: CrushMap,
-                 device_weights: np.ndarray | None = None):
+                 device_weights: np.ndarray | None = None,
+                 block: int | None = None):
         self.map = crush_map
         self.packed: PackedMap = pack_map(crush_map)
         if crush_map.tunables.chooseleaf_stable != 1:
@@ -455,34 +609,113 @@ class Mapper:
         if device_weights is None:
             device_weights = np.full(p.max_devices, WEIGHT_ONE,
                                      dtype=np.int64)
-        self.arrays = {
-            "items": jnp.asarray(p.items),
-            "weights": jnp.asarray(p.weights),
-            "cumw": jnp.asarray(p.cumw),
-            "size": jnp.asarray(p.size),
-            "alg": jnp.asarray(p.alg),
-            "btype": jnp.asarray(p.btype),
-            "bid": jnp.asarray(p.bid),
-            "device_weights": jnp.asarray(device_weights, dtype=jnp.int64),
-        }
+        with jax.enable_x64(True):
+            self.arrays = {
+                "items": jnp.asarray(p.items, dtype=jnp.int32),
+                "weights": jnp.asarray(p.weights, dtype=jnp.int64),
+                "wm1": jnp.asarray(p.wm1, dtype=jnp.uint64),
+                "wm0": jnp.asarray(p.wm0, dtype=jnp.uint64),
+                "wsh": jnp.asarray(p.wsh, dtype=jnp.uint64),
+                "cumw": jnp.asarray(p.cumw, dtype=jnp.int64),
+                "size": jnp.asarray(p.size, dtype=jnp.int32),
+                "alg": jnp.asarray(p.alg, dtype=jnp.int32),
+                "btype": jnp.asarray(p.btype, dtype=jnp.int32),
+                "bid": jnp.asarray(p.bid, dtype=jnp.int32),
+                "device_weights": jnp.asarray(device_weights,
+                                              dtype=jnp.int64),
+                "negln": jnp.asarray(_negln_table(), dtype=jnp.int64),
+            }
         self.cfg = {"max_depth": p.max_depth,
-                    "present": p.algs_present}
+                    "present": p.algs_present,
+                    "type_depth": p.type_depth}
+        # Tile size bounding the (block, S) int64 straw2 temps: target
+        # ~2 GiB of transient state assuming ~8 live (S-wide int64) temps
+        # across numrep*SPEC_TRIES speculative lanes per PG.
+        if block is None:
+            budget = 2 << 30
+            per_lane = max(1, p.max_size) * 8 * 8 * (3 * SPEC_TRIES)
+            block = max(1 << 14, min(1 << 20, budget // per_lane))
+            block = 1 << (block.bit_length() - 1)       # power of two
+        self.block = block
 
     def set_device_weights(self, device_weights: np.ndarray) -> None:
         """Update reweights (is_out vector) without recompiling."""
-        self.arrays["device_weights"] = jnp.asarray(device_weights,
-                                                    dtype=jnp.int64)
+        with jax.enable_x64(True):
+            self.arrays["device_weights"] = jnp.asarray(device_weights,
+                                                        dtype=jnp.int64)
+
+    def _rule_key(self, ruleno: int, result_max: int):
+        rule = self.map.rules[ruleno]
+        # TAKE steps carry the taken bucket's (static) type so the rule VM
+        # can unroll exact descent depths on uniform hierarchies.
+        steps = []
+        for s in rule.steps:
+            if s.op == OP_TAKE and s.arg1 < 0 and s.arg1 in self.map.buckets:
+                steps.append((s.op, s.arg1, s.arg2,
+                              self.map.buckets[s.arg1].type))
+            else:
+                steps.append((s.op, s.arg1, s.arg2))
+        return (tuple(steps), result_max, _tunables_key(self.map.tunables),
+                self.cfg["max_depth"], self.cfg["present"],
+                self.cfg["type_depth"])
+
+    def _rule_fn(self, ruleno: int, result_max: int):
+        return _compiled_rule(*self._rule_key(ruleno, result_max))
+
+    def rule_is_firstn(self, ruleno: int) -> bool:
+        """True when the rule's choose steps are firstn (replicated)."""
+        return not any(s.op in (OP_CHOOSE_INDEP, OP_CHOOSELEAF_INDEP)
+                       for s in self.map.rules[ruleno].steps)
 
     def map_pgs(self, ruleno: int, xs, result_max: int) -> jax.Array:
         """Vectorized crush_do_rule over xs -> (N, result_max) device ids
-        (ITEM_NONE fills failures/indep holes)."""
-        rule = self.map.rules[ruleno]
-        steps = tuple((s.op, s.arg1, s.arg2) for s in rule.steps)
-        xs = jnp.asarray(xs, dtype=jnp.uint32)
-        fn = _compiled_rule(steps, result_max,
-                            _tunables_key(self.map.tunables),
-                            self.cfg["max_depth"], self.cfg["present"])
-        return fn(self.arrays, xs)
+        (ITEM_NONE fills failures/indep holes). Tiled into self.block-lane
+        chunks so straw2 temps stay bounded at any N."""
+        fn = self._rule_fn(ruleno, result_max)
+        with jax.enable_x64(True):
+            xs = jnp.asarray(xs, dtype=jnp.uint32)
+            n = xs.shape[0]
+            if n <= self.block:
+                return fn(self.arrays, xs)
+            pieces = []
+            for start in range(0, n, self.block):
+                piece = xs[start:start + self.block]
+                if piece.shape[0] < self.block:  # pad the tail block so the
+                    pad = self.block - piece.shape[0]  # jit cache stays at
+                    piece = jnp.pad(piece, (0, pad))   # one entry per shape
+                    pieces.append(fn(self.arrays, piece)[:-pad])
+                else:
+                    pieces.append(fn(self.arrays, piece))
+            return jnp.concatenate(pieces, axis=0)
+
+    def sweep(self, ruleno: int, start_x: int, n: int, result_max: int,
+              device_counts_size: int | None = None):
+        """Map [start_x, start_x + n) and aggregate ON DEVICE.
+
+        One dispatch: a fori_loop over fixed-size blocks; per block the
+        rule runs and a scatter-add accumulates per-device placement
+        counts; bad mappings (firstn rules only: fewer than result_max
+        live devices — indep holes are expected output, ref:
+        CrushTester's size check) are counted on device too.
+
+        Returns (counts, bad) device arrays: counts int64 (max_devices,),
+        bad int64 scalar. Nothing of O(n) touches the host.
+        """
+        fn_body = _rule_body(*self._rule_key(ruleno, result_max))
+        firstn = self.rule_is_firstn(ruleno)
+        nd = device_counts_size or self.packed.max_devices
+        block = self.block
+        nblocks = -(-n // block)
+
+        step_fn = _compiled_sweep(fn_body, firstn, nd, block, result_max)
+        with jax.enable_x64(True):
+            counts = jnp.zeros(nd + 1, dtype=jnp.int64)
+            bad = jnp.int64(0)
+            for i in range(nblocks):
+                counts, bad = step_fn(self.arrays, counts, bad,
+                                      jnp.uint32(start_x + i * block),
+                                      jnp.int64(n - i * block))
+            return counts[:nd], bad
 
 
 def _tunables_key(t):
@@ -491,9 +724,57 @@ def _tunables_key(t):
 
 
 @functools.lru_cache(maxsize=256)
-def _compiled_rule(steps, result_max, tkey, max_depth, present):
+def _compiled_rule(steps, result_max, tkey, max_depth, present,
+                   type_depth=()):
+    return jax.jit(_rule_body(steps, result_max, tkey, max_depth, present,
+                              type_depth))
+
+
+@functools.lru_cache(maxsize=256)
+def _compiled_sweep(fn_body, firstn, n_devices, block, result_max):
+    """Per-block aggregated sweep step: map one x block and scatter-add
+    per-device counts on device (the CrushTester aggregation, without the
+    (N, rep) device->host ship of round 1). The host loops over blocks —
+    dispatches are async on this platform, so consecutive blocks pipeline
+    and only the final count readback synchronizes. (A fused
+    fori_loop-over-blocks variant compiled to a program large enough to
+    crash this environment's remote TPU worker; per-block programs are
+    the same speed and far more robust.)
+
+    counts has n_devices+1 bins: the last collects ITEM_NONE/out-of-range
+    lanes and is dropped by the caller."""
+
+    def run(arrs, counts, bad, x0, remaining):
+        xs = x0 + jnp.arange(block, dtype=jnp.uint32)
+        inb = jnp.arange(block, dtype=jnp.int64) < remaining
+        w = fn_body(arrs, xs)                         # (block, rmax) int32
+        live = w != ITEM_NONE
+        flat = jnp.where(live & inb[:, None], w, n_devices)
+        counts = counts.at[flat.reshape(-1)].add(jnp.int64(1))
+        if firstn:
+            short = (live.sum(axis=1) < result_max) & inb
+            bad = bad + short.sum(dtype=jnp.int64)
+        return counts, bad
+
+    return jax.jit(run, donate_argnums=(1,))
+
+
+def _depth_between(type_depth, from_type, to_type):
+    """Static descent level count on uniform hierarchies, else None."""
+    if (from_type is None or to_type is None
+            or not (0 <= to_type < len(type_depth))
+            or not (0 <= from_type < len(type_depth))):
+        return None
+    df, dt = type_depth[from_type], type_depth[to_type]
+    if df <= 0 or dt < 0 or df <= dt:
+        return None
+    return df - dt
+
+
+@functools.lru_cache(maxsize=256)
+def _rule_body(steps, result_max, tkey, max_depth, present, type_depth=()):
     total_tries, descend_once, vary_r, stable = tkey
-    cfg = {"max_depth": max_depth, "present": present}
+    base_cfg = {"max_depth": max_depth, "present": present}
 
     def run(arrs, xs):
         n = xs.shape[0]
@@ -505,11 +786,14 @@ def _compiled_rule(steps, result_max, tkey, max_depth, present):
         w_cols: list = []
         emitted: list = []
         any_firstn = False
-        for op, arg1, arg2 in steps:
+        cur_type = None   # static type of the current columns' items
+        for step in steps:
+            op, arg1, arg2 = step[0], step[1], step[2]
             if op == OP_NOOP:
                 continue
             if op == OP_TAKE:
                 w_cols = [jnp.full(n, arg1, dtype=jnp.int32)]
+                cur_type = step[3] if len(step) > 3 else None
             elif op == OP_SET_CHOOSE_TRIES:
                 if arg1 > 0:
                     choose_tries = arg1
@@ -537,6 +821,12 @@ def _compiled_rule(steps, result_max, tkey, max_depth, present):
                                      (1 if descend_once else choose_tries))
                 else:
                     recurse_tries = choose_leaf_tries or 1
+                # exact static descent depths on uniform hierarchies
+                cfg = dict(base_cfg)
+                cfg["levels_main"] = _depth_between(type_depth, cur_type,
+                                                    arg2)
+                cfg["levels_leaf"] = (_depth_between(type_depth, arg2, 0)
+                                      if recurse else None)
                 new_cols = []
                 osize = 0
                 for col in w_cols:
@@ -569,6 +859,7 @@ def _compiled_rule(steps, result_max, tkey, max_depth, present):
                         new_cols.append(chosen[:, j])
                     osize += blk
                 w_cols = new_cols
+                cur_type = 0 if recurse else arg2
             elif op == OP_EMIT:
                 emitted.extend(w_cols)
                 w_cols = []
@@ -586,4 +877,4 @@ def _compiled_rule(steps, result_max, tkey, max_depth, present):
             w = jnp.concatenate([w, pad], axis=1)
         return w[:, :result_max]
 
-    return jax.jit(run)
+    return run

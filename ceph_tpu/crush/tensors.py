@@ -30,6 +30,16 @@ class PackedMap:
     items: np.ndarray          # int32 child ids (pad 0)
     weights: np.ndarray        # int64 16.16 weights (pad 0)
     cumw: np.ndarray           # int64 inclusive cumsum of weights (list alg)
+    # Magic-divide tables for the straw2 draw q = neg // w (w >= 3):
+    # q = ((n1*m1 + (n1*m0 + n0*m1 + (n0*m0 >> 32)) >> 32) >> sh) with
+    # neg = n1*2^32 + n0, M = m1*2^32 + m0 = ceil(2^(64+sh)/w),
+    # sh = max(1, ceil(log2 w) - 15). Exact for neg < 2^49 (proof: with
+    # e = M*w - 2^(64+sh) < w, the error term neg*e < 2^(49+ceil(log2 w))
+    # <= 2^(64+sh)). TPUs have no 64-bit divider; XLA's emulated s64 //
+    # measured 6.5x slower than this multiply chain.
+    wm1: np.ndarray            # uint64 M >> 32
+    wm0: np.ndarray            # uint64 M & 0xffffffff
+    wsh: np.ndarray            # uint64 sh
     # (B,) per-bucket scalars.
     size: np.ndarray           # int32
     alg: np.ndarray            # int32
@@ -41,6 +51,12 @@ class PackedMap:
     max_devices: int
     max_depth: int
     algs_present: tuple[int, ...]
+    # type_depth[t] = uniform distance (in choose levels) from every bucket
+    # of type t down to devices, or -1 when buckets of that type disagree
+    # (the mapper then falls back to max_depth unrolling). Index 0 = device
+    # level = 0. Lets the rule VM unroll EXACTLY the levels a descent
+    # needs instead of max_depth everywhere.
+    type_depth: tuple[int, ...] = ()
 
     def row(self, item: int) -> int:
         return -1 - item
@@ -66,12 +82,61 @@ def pack_map(m: CrushMap) -> PackedMap:
         items[r, :b.size] = b.items
         weights[r, :b.size] = b.weights
     cumw = np.cumsum(weights, axis=1)
+    wm1, wm0, wsh = magic_divide_tables(weights)
     return PackedMap(
-        items=items, weights=weights, cumw=cumw, size=size, alg=alg,
+        items=items, weights=weights, cumw=cumw,
+        wm1=wm1, wm0=wm0, wsh=wsh, size=size, alg=alg,
         btype=btype, bid=bid,
         n_buckets=n_buckets, max_size=S, max_devices=m.max_devices,
         max_depth=_max_depth(m),
-        algs_present=tuple(sorted({b.alg for b in m.buckets.values()})))
+        algs_present=tuple(sorted({b.alg for b in m.buckets.values()})),
+        type_depth=_type_depths(m))
+
+
+def magic_divide_tables(weights: np.ndarray):
+    """Per-slot magic constants for exact ``neg // w`` (see PackedMap).
+
+    Slots with w < 3 get M=0 (the kernel uses a shift for w in {1,2} and
+    masks w == 0)."""
+    flat = weights.reshape(-1)
+    m1 = np.zeros(flat.shape, dtype=np.uint64)
+    m0 = np.zeros(flat.shape, dtype=np.uint64)
+    sh = np.ones(flat.shape, dtype=np.uint64)
+    for i, wv in enumerate(flat):
+        w = int(wv)
+        if w < 3:
+            continue
+        ell = (w - 1).bit_length()
+        s = max(1, ell - 15)
+        M = -((-(1 << (64 + s))) // w)          # ceil(2^(64+s)/w) < 2^64
+        m1[i] = M >> 32
+        m0[i] = M & 0xFFFFFFFF
+        sh[i] = s
+    shape = weights.shape
+    return m1.reshape(shape), m0.reshape(shape), sh.reshape(shape)
+
+
+def _type_depths(m: CrushMap) -> tuple[int, ...]:
+    """Per-type uniform depth (see PackedMap.type_depth)."""
+    memo: dict[int, int] = {}
+
+    def depth(item: int) -> int:
+        if item >= 0:
+            return 0
+        if item in memo:
+            return memo[item]
+        memo[item] = 0
+        b = m.buckets[item]
+        memo[item] = 1 + max((depth(c) for c in b.items), default=0)
+        return memo[item]
+
+    by_type: dict[int, int] = {0: 0}
+    for bid, b in m.buckets.items():
+        d = depth(bid)
+        if by_type.setdefault(b.type, d) != d:
+            by_type[b.type] = -1
+    max_t = max(by_type)
+    return tuple(by_type.get(t, -1) for t in range(max_t + 1))
 
 
 def _max_depth(m: CrushMap) -> int:

@@ -54,35 +54,50 @@ class CrushTester:
 
     def __init__(self, crush_map: CrushMap,
                  device_weights: np.ndarray | None = None,
-                 batch: int = 1 << 20):
+                 batch: int | None = None):
         self.map = crush_map
-        self.mapper = Mapper(crush_map, device_weights)
-        self.batch = batch
+        # batch bounds device memory: it becomes the Mapper's tile size
+        # (None = auto-sized from the map's bucket width)
+        self.mapper = Mapper(crush_map, device_weights, block=batch)
+        self.batch = self.mapper.block
 
     def test(self, rule: int, num_rep: int, min_x: int = 0,
              max_x: int = 1023, keep_mappings: bool = False) -> TestResult:
+        """Aggregated sweep over [min_x, max_x].
+
+        Without keep_mappings this is ONE device program (Mapper.sweep):
+        per-device counts accumulate via on-device scatter-add and only
+        the (max_devices,) count vector is read back — round 1 shipped
+        every (N, rep) mapping block to the host and bincounted there.
+
+        Bad mappings follow CrushTester's meaning (result size < num_rep):
+        counted for firstn rules only — indep/EC rules emit ITEM_NONE
+        holes as *expected* degraded output (ref: src/crush/CrushTester.cc
+        CrushTester::test size check on do_rule's result vector).
+        """
         n = max_x - min_x + 1
-        counts = np.zeros(self.map.max_devices, dtype=np.int64)
-        bad = 0
-        kept = [] if keep_mappings else None
         t0 = time.perf_counter()
-        for start in range(min_x, max_x + 1, self.batch):
-            stop = min(start + self.batch - 1, max_x)
-            xs = np.arange(start, stop + 1, dtype=np.uint32)
-            out = np.asarray(self.mapper.map_pgs(rule, xs, num_rep))
+        if keep_mappings:
+            out = np.asarray(self.mapper.map_pgs(
+                rule, np.arange(min_x, max_x + 1, dtype=np.uint32), num_rep))
             valid = out != ITEM_NONE
-            flat = out[valid]
-            counts += np.bincount(flat, minlength=self.map.max_devices)
-            # bad mapping: fewer than num_rep distinct live devices
-            per_x = valid.sum(axis=1)
-            bad += int((per_x < num_rep).sum())
-            if keep_mappings:
-                kept.append(out)
+            counts = np.bincount(out[valid],
+                                 minlength=self.map.max_devices)
+            if self.mapper.rule_is_firstn(rule):
+                bad = int((valid.sum(axis=1) < num_rep).sum())
+            else:
+                bad = 0
+            kept = out
+        else:
+            counts_dev, bad_dev = self.mapper.sweep(rule, min_x, n, num_rep)
+            counts = np.asarray(counts_dev)     # readback = execution anchor
+            bad = int(bad_dev)
+            kept = None
         seconds = time.perf_counter() - t0
         res = TestResult(
             rule=rule, num_rep=num_rep, total_x=n,
             device_counts=counts, bad_mappings=bad, seconds=seconds,
-            mappings=np.concatenate(kept) if kept else None)
+            mappings=kept)
         log.dout(5, "test done", rule=rule, num_rep=num_rep, n=n,
                  secs=round(seconds, 3))
         return res
