@@ -56,8 +56,14 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
     """Measure mappings/s via the two-size slope method."""
     if mapper is None:
         mapper = Mapper(canonical_map(n_osds), block=block)
-    n_hi = max(n_pgs, mapper.block)
-    n_lo = min(n_hi // 2, max(mapper.block, n_pgs // 4))
+    # quantize both sizes to DISTINCT block counts: the per-block program
+    # does full-block work regardless of the tail mask, so sizes that
+    # round to the same block count would make the slope pure noise
+    blk = mapper.block
+    hi_blocks = max(2, -(-n_pgs // blk))
+    lo_blocks = max(1, hi_blocks // 4)
+    n_hi = hi_blocks * blk
+    n_lo = lo_blocks * blk if lo_blocks < hi_blocks else 0
     # warm/compile (the per-block program is size-independent, but warm so
     # the first-compile cost is excluded from timing)
     _timed_sweep(mapper, rule, n_lo or n_hi, num_rep)
@@ -80,7 +86,7 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
     return {
         "metric": "crush_mappings_per_s",
         "mappings_per_s": round(rate, 1),
-        "n_pgs": n_pgs,
+        "n_pgs": n_hi,
         "n_osds": n_osds,
         "num_rep": num_rep,
         "seconds_per_batch": t_hi,

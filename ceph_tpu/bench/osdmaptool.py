@@ -64,6 +64,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--mark-out", type=int, action="append", default=[])
     p.add_argument("--churn", type=int, metavar="STEPS", default=0,
                    help="random thrash steps (down/out + revive)")
+    p.add_argument("--upmap", action="store_true",
+                   help="run the upmap balancer (OSDMap::calc_pg_upmaps) "
+                        "and report the deviation before/after")
+    p.add_argument("--upmap-deviation", type=int, default=5,
+                   help="max per-OSD PG-count deviation to aim for "
+                        "(ref: mgr balancer upmap_max_deviation)")
+    p.add_argument("--upmap-max", type=int, default=200,
+                   help="max balancer optimization iterations")
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--format", choices=("plain", "json"), default="plain")
     return p.parse_args(argv)
@@ -95,6 +103,26 @@ def main(argv=None) -> int:
             "min": int(in_osds.min()), "max": int(in_osds.max()),
             "stddev": round(float(in_osds.std()), 2),
             "degraded_pgs": int((up == ITEM_NONE).any(axis=1).sum()),
+        }
+
+    if args.upmap:
+        def devstats():
+            util = m.pool_utilization(1).astype(np.float64)
+            inmask = np.asarray(m.osd_weight) > 0
+            tgt = util[inmask].sum() / max(inmask.sum(), 1)
+            dev = util[inmask] - tgt
+            return {"max_deviation": round(float(np.abs(dev).max()), 2),
+                    "stddev": round(float(dev.std()), 2)}
+        before = devstats()
+        t0 = time.perf_counter()
+        changes = m.calc_pg_upmaps(max_deviation=args.upmap_deviation,
+                                   max_iterations=args.upmap_max)
+        out["upmap"] = {
+            "seconds": round(time.perf_counter() - t0, 3),
+            "changes": changes,
+            "upmap_items": len(m.pg_upmap_items),
+            "before": before,
+            "after": devstats(),
         }
 
     if args.churn:

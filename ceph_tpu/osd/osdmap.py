@@ -429,3 +429,196 @@ class OSDMap:
         up, _, _, _ = self.map_pool(pool_id)
         flat = up[up != ITEM_NONE]
         return np.bincount(flat, minlength=self.max_osd)
+
+    # -- upmap balancer ----------------------------------------------------
+    def _crush_parents(self) -> dict[int, int]:
+        parents: dict[int, int] = {}
+        for b in self.crush.buckets.values():
+            for child in b.items:
+                parents[child] = b.id
+        return parents
+
+    def _failure_domain_of(self, parents: dict[int, int], osd: int,
+                           fd_type: int) -> int:
+        """Ancestor bucket of `osd` at fd_type (the chooseleaf domain);
+        the osd itself when fd_type is 0/absent."""
+        if fd_type <= 0:
+            return osd
+        node = osd
+        while node in parents:
+            node = parents[node]
+            b = self.crush.buckets.get(node)
+            if b is not None and b.type == fd_type:
+                return node
+        return osd
+
+    def _rule_failure_domain(self, ruleno: int) -> int:
+        """The separation type the rule's choose steps enforce."""
+        from ceph_tpu.crush.types import (
+            OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP, OP_CHOOSE_FIRSTN,
+            OP_CHOOSE_INDEP)
+        fd = 0
+        for s in self.crush.rules[ruleno].steps:
+            if s.op in (OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP,
+                        OP_CHOOSE_FIRSTN, OP_CHOOSE_INDEP):
+                fd = max(fd, s.arg2)
+        return fd
+
+    def calc_pg_upmaps(self, pool_ids=None, max_deviation: int = 5,
+                       max_iterations: int = 200,
+                       inc: "Incremental | None" = None) -> int:
+        """Generate pg_upmap_items flattening the PG distribution.
+
+        ref: src/osd/OSDMap.cc OSDMap::calc_pg_upmaps — the mgr
+        balancer's upmap mode. Same shape as upstream: compute per-OSD
+        deviation from the weight-proportional target, then repeatedly
+        move one PG shard from the most-overfull OSD to an underfull one
+        via a pg_upmap_items pair, preferring to DROP an existing upmap
+        entry that feeds the overfull OSD before adding new ones. Every
+        candidate move is validated by remapping the PG through the full
+        pipeline (no duplicate OSDs, no holes, failure-domain separation
+        preserved — upstream delegates that to crush->try_remap_rule).
+
+        Batched twist: placement is computed once per pool with the
+        vectorized mapper; counts update incrementally per move.
+
+        Returns the number of upmap changes recorded (and applied to this
+        map; pass ``inc`` to also record them Incremental-style).
+        """
+        pools = {pid: self.pools[pid]
+                 for pid in (pool_ids or self.pools)}
+        if not pools:
+            return 0
+        parents = self._crush_parents()
+
+        # per-osd weight share: crush weight x reweight (out osds get 0).
+        # A device's crush weight lives in its parent bucket's weights
+        # slot (ref: crush_bucket.weights), not on the device itself.
+        crush_w = np.zeros(self.max_osd, dtype=np.float64)
+        for b in self.crush.buckets.values():
+            for child, w in zip(b.items, b.weights):
+                if 0 <= child < self.max_osd:
+                    crush_w[child] = w / WEIGHT_ONE
+        base_w = np.zeros(self.max_osd, dtype=np.float64)
+        for o in range(self.max_osd):
+            if not self.exists(np.asarray(o)) or self.osd_weight[o] == 0:
+                continue
+            base_w[o] = crush_w[o] * (self.osd_weight[o] / WEIGHT_ONE)
+
+        # initial placement + per-pg bookkeeping
+        up_by_pool: dict[int, np.ndarray] = {}
+        counts = np.zeros(self.max_osd, dtype=np.int64)
+        for pid in pools:
+            up, _, _, _ = self.map_pool(pid)
+            up_by_pool[pid] = up
+            flat = up[up != ITEM_NONE]
+            counts += np.bincount(flat, minlength=self.max_osd)
+        total = int(counts.sum())
+        if total == 0 or base_w.sum() == 0:
+            return 0
+        target = base_w / base_w.sum() * total
+
+        def deviation():
+            dev = counts - target
+            dev[base_w == 0] = 0            # out osds: not balanceable
+            return dev
+
+        def remap_pg(pid, seed):
+            up, _, _, _ = self.pg_to_up_acting_osds(
+                pid, np.asarray([seed], dtype=np.uint32))
+            return up[0]
+
+        changes = 0
+        for _ in range(max_iterations):
+            dev = deviation()
+            over = int(np.argmax(dev))
+            # both tails count (upstream fills underfull OSDs from the
+            # most-loaded ones even when no OSD exceeds +max_deviation)
+            if dev[over] <= max_deviation and \
+                    dev.min() >= -max_deviation:
+                break
+            under_order = np.argsort(dev)
+            moved = False
+            # candidate PGs currently holding a shard on `over`
+            for pid, up in up_by_pool.items():
+                pool = pools[pid]
+                fd_type = self._rule_failure_domain(pool.crush_rule)
+                rows = np.flatnonzero((up == over).any(axis=1))
+                for row in rows:
+                    pg = pg_t(pid, int(row))
+                    if pg in self.pg_upmap:
+                        continue    # full override settles the PG; items
+                    pairs = self.pg_upmap_items.get(pg, [])
+                    # prefer reverting an existing remap feeding `over`
+                    reverted = [p for p in pairs if p[1] != over]
+                    if len(reverted) != len(pairs):
+                        if reverted:
+                            self.pg_upmap_items[pg] = reverted
+                        else:
+                            self.pg_upmap_items.pop(pg, None)
+                        new_row = remap_pg(pid, row)
+                        if (inc is not None):
+                            if reverted:
+                                inc.new_pg_upmap_items[pg] = reverted
+                            else:
+                                inc.old_pg_upmap_items.append(pg)
+                    else:
+                        # cheap pre-filters (dup/up/failure-domain) reject
+                        # most candidates in O(1); the full pipeline then
+                        # confirms — in the common case exactly one
+                        # pipeline call per accepted move.
+                        new_row = None
+                        row_domains = {
+                            self._failure_domain_of(parents, int(o),
+                                                    fd_type)
+                            for o in up[row] if o != ITEM_NONE and
+                            o != over}
+                        cur = set(int(o) for o in up[row]
+                                  if o != ITEM_NONE)
+                        for u in under_order:
+                            u = int(u)
+                            if base_w[u] == 0:
+                                continue
+                            if dev[u] >= dev[over] - 1:
+                                break   # ascending: no target improves max
+                            if u in cur or not bool(
+                                    self.is_up(np.asarray(u))):
+                                continue
+                            if self._failure_domain_of(
+                                    parents, u, fd_type) in row_domains:
+                                continue
+                            self.pg_upmap_items[pg] = pairs + [(over, u)]
+                            cand = remap_pg(pid, row)
+                            vals = cand[cand != ITEM_NONE]
+                            if (cand != ITEM_NONE).all() and \
+                                    len(set(vals.tolist())) == len(vals) \
+                                    and u in vals and over not in vals:
+                                new_row = cand
+                                if inc is not None:
+                                    inc.new_pg_upmap_items[pg] = \
+                                        pairs + [(over, u)]
+                                break
+                            # pipeline disagreed: roll back
+                            if pairs:
+                                self.pg_upmap_items[pg] = pairs
+                            else:
+                                self.pg_upmap_items.pop(pg, None)
+                        if new_row is None:
+                            continue
+                    # bookkeeping: update counts with the actual delta
+                    old_row = up[row]
+                    for o in old_row[old_row != ITEM_NONE]:
+                        counts[o] -= 1
+                    for o in new_row[new_row != ITEM_NONE]:
+                        counts[o] += 1
+                    up_by_pool[pid][row] = new_row
+                    changes += 1
+                    moved = True
+                    break
+                if moved:
+                    break
+            if not moved:
+                break
+        if changes:
+            self._dirty()
+        return changes

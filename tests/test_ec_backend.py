@@ -1,0 +1,189 @@
+"""ECBackend-lite tests: stripe math, RMW partial writes, recovery via
+minimum_to_decode, scrub localization, and churn-sim hole recovery
+(VERDICT round-1 item #4; ref: src/osd/ECUtil.h, ECCommon.h, ECBackend.cc)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import factory
+from ceph_tpu.osd.ec_backend import ECBackendLite, ShardMissing
+from ceph_tpu.osd.ecutil import StripeInfo
+
+
+class TestStripeInfo:
+    def test_bounds(self):
+        si = StripeInfo(k=4, chunk_size=256)   # stripe width 1024
+        assert si.stripe_width == 1024
+        assert si.logical_to_prev_stripe_offset(1023) == 0
+        assert si.logical_to_prev_stripe_offset(1024) == 1024
+        assert si.logical_to_next_stripe_offset(1) == 1024
+        assert si.logical_to_next_stripe_offset(1024) == 1024
+        assert si.offset_len_to_stripe_bounds(100, 2000) == (0, 3072)
+        assert si.stripe_range(1024, 1024) == (1, 1)
+        assert si.stripe_range(1000, 100) == (0, 2)
+
+    def test_chunk_offsets(self):
+        si = StripeInfo(k=4, chunk_size=256)
+        assert si.aligned_logical_offset_to_chunk_offset(2048) == 512
+        assert si.chunk_aligned_logical_offset(512) == 2048
+        assert si.logical_to_stripe_chunk(0) == (0, 0, 0)
+        assert si.logical_to_stripe_chunk(256) == (0, 1, 0)
+        assert si.logical_to_stripe_chunk(1024 + 300) == (1, 1, 44)
+        assert si.object_stripes(0) == 0
+        assert si.object_stripes(1) == 1
+        assert si.object_stripes(1025) == 2
+
+
+def make_backend(k=4, m=2, chunk=256, plugin="jax"):
+    ec = factory(f"plugin={plugin} technique=reed_sol_van k={k} m={m}")
+    return ECBackendLite(ec, chunk_size=chunk, name=f"test_{k}_{m}_{chunk}")
+
+
+class TestRmwWrites:
+    def test_aligned_roundtrip(self):
+        be = make_backend()
+        data = bytes(range(256)) * 16          # 4 stripes exactly
+        be.write("obj", 0, data)
+        assert be.read("obj", 0, len(data)) == data
+
+    def test_unaligned_offsets_match_model(self):
+        """Random writes at unaligned offsets: backend == bytearray model."""
+        be = make_backend()
+        rng = np.random.default_rng(5)
+        model = bytearray(16 << 10)
+        high = 0
+        for _ in range(25):
+            off = int(rng.integers(0, 12 << 10))
+            ln = int(rng.integers(1, 3 << 10))
+            payload = rng.integers(0, 256, ln, dtype=np.uint8).tobytes()
+            be.write("obj", off, payload)
+            model[off:off + ln] = payload
+            high = max(high, off + ln)
+            assert be.read("obj", 0, high) == bytes(model[:high])
+        # every shard consistent after arbitrary RMW history
+        assert be.scrub("obj") == []
+
+    def test_rmw_counts_partial_stripes(self):
+        be = make_backend()
+        be.write("obj", 0, b"x" * 1024)         # aligned: no RMW
+        assert be.perf.dump()["rmw_stripes"] == 0
+        be.write("obj", 100, b"y" * 10)         # partial: RMW
+        assert be.perf.dump()["rmw_stripes"] == 1
+        want = b"x" * 100 + b"y" * 10 + b"x" * 914
+        assert be.read("obj", 0, 1024) == want
+
+    def test_sparse_write_zero_fills(self):
+        be = make_backend()
+        be.write("obj", 3000, b"tail")
+        assert be.read("obj", 0, 3000) == b"\0" * 3000
+        assert be.read("obj", 3000, 4) == b"tail"
+
+
+class TestRecovery:
+    @pytest.mark.parametrize("lost", [[0], [5], [1, 4], [2, 3]])
+    def test_recover_lost_shards(self, lost):
+        be = make_backend()
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+        be.write("obj", 0, data)
+        for s in lost:
+            be.lose_shard(s, "obj")
+        assert be.missing_shards("obj") == set(lost)
+        plan_lost, to_read = be.recovery_plan("obj")
+        assert plan_lost == set(lost)
+        assert to_read <= set(range(6)) - set(lost)
+        assert len(to_read) <= 4                # MDS: k reads suffice
+        recovered = be.recover("obj")
+        assert recovered == set(lost)
+        assert be.missing_shards("obj") == set()
+        assert be.read("obj", 0, len(data)) == data
+        assert be.scrub("obj") == []
+
+    def test_data_read_blocked_until_recovered(self):
+        be = make_backend()
+        be.write("obj", 0, b"a" * 4096)
+        be.lose_shard(1, "obj")
+        with pytest.raises(ShardMissing):
+            be.read("obj", 0, 4096)
+        be.recover("obj")
+        assert be.read("obj", 0, 4096) == b"a" * 4096
+
+    def test_recover_all_multiple_objects(self):
+        be = make_backend()
+        payloads = {}
+        rng = np.random.default_rng(9)
+        for i in range(4):
+            payloads[f"o{i}"] = rng.integers(0, 256, 2048,
+                                             dtype=np.uint8).tobytes()
+            be.write(f"o{i}", 0, payloads[f"o{i}"])
+        be.lose_shard(2)                        # whole-shard loss (OSD died)
+        fixed = be.recover_all()
+        assert set(fixed) == {f"o{i}" for i in range(4)}
+        for oid, want in payloads.items():
+            assert be.read(oid, 0, len(want)) == want
+
+    def test_lrc_recovery_reads_fewer_than_k(self):
+        """LRC local repair: single lost shard needs only its layer."""
+        ec = factory("plugin=lrc k=4 m=2 l=3")
+        be = ECBackendLite(ec, chunk_size=128, name="test_lrc")
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+        be.write("obj", 0, data)
+        be.lose_shard(0, "obj")
+        _, to_read = be.recovery_plan("obj")
+        assert len(to_read) < ec.get_data_chunk_count() + \
+            ec.get_coding_chunk_count() - 1   # strictly local, not global
+        be.recover("obj")
+        assert be.read("obj", 0, len(data)) == data
+
+
+class TestScrub:
+    def test_detects_and_localizes_corruption(self):
+        be = make_backend()
+        rng = np.random.default_rng(11)
+        be.write("obj", 0, rng.integers(0, 256, 4096,
+                                        dtype=np.uint8).tobytes())
+        assert be.scrub("obj") == []
+        be.shards[3]["obj"][1, 7] ^= 0xFF       # silent single-shard flip
+        assert be.scrub("obj") == [3]
+        # parity shard corruption localizes too
+        be.shards[3]["obj"][1, 7] ^= 0xFF       # restore
+        be.shards[5]["obj"][0, 0] ^= 1
+        assert be.scrub("obj") == [5]
+
+
+class TestChurnRecovery:
+    def test_churn_holes_recovered_by_decode(self):
+        """The round-1 churn sim only *reported* EC holes; holes must now
+        be repaired by decode: when an OSD dies, each degraded PG's
+        object recovers its lost shard and the data survives."""
+        from ceph_tpu.bench import osdmaptool
+        from ceph_tpu.sim import ChurnEvent, ChurnSim
+
+        m = osdmaptool.create_simple(12, 16, 5, erasure=True)  # k=3 m=2
+        sim = ChurnSim(m, 1)
+        rng = np.random.default_rng(13)
+        # one object per PG, stored in a per-PG EC backend keyed by shard
+        backends = {}
+        payloads = {}
+        for pg in range(16):
+            be = make_backend(k=3, m=2, chunk=128)
+            data = rng.integers(0, 256, 1536, dtype=np.uint8).tobytes()
+            be.write(f"pg{pg}", 0, data)
+            backends[pg] = be
+            payloads[pg] = data
+        victim = int(sim._up[0, 0])
+        up_before = sim._up.copy()
+        sim.apply(ChurnEvent("down", victim))
+        # shard s of pg is lost iff the victim held slot s before
+        for pg in range(16):
+            for slot in range(5):
+                if up_before[pg, slot] == victim:
+                    backends[pg].lose_shard(slot, f"pg{pg}")
+        recovered = 0
+        for pg in range(16):
+            fixed = backends[pg].recover(f"pg{pg}")
+            recovered += len(fixed)
+            assert backends[pg].read(f"pg{pg}", 0, 1536) == payloads[pg]
+            assert backends[pg].scrub(f"pg{pg}") == []
+        assert recovered > 0                    # the victim held shards
