@@ -28,7 +28,10 @@ from ceph_tpu.crush.types import (
 )
 from ceph_tpu.utils.platform import cli_main
 
-ALGS = {"straw2": ALG_STRAW2, "uniform": ALG_UNIFORM, "list": ALG_LIST}
+from ceph_tpu.crush.types import ALG_STRAW, ALG_TREE
+
+ALGS = {"straw2": ALG_STRAW2, "uniform": ALG_UNIFORM, "list": ALG_LIST,
+        "straw": ALG_STRAW, "tree": ALG_TREE}
 
 
 def parse_args(argv=None):

@@ -8,7 +8,8 @@ hierarchies (root -> rack -> host -> osd) and replicated/erasure rules.
 from __future__ import annotations
 
 from ceph_tpu.crush.types import (
-    ALG_STRAW2, OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP, OP_CHOOSE_FIRSTN,
+    ALG_STRAW, ALG_STRAW2, ALG_TREE,
+    OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP, OP_CHOOSE_FIRSTN,
     OP_CHOOSE_INDEP, OP_EMIT, OP_TAKE, WEIGHT_ONE,
     Bucket, CrushMap, Rule, RuleStep, Tunables,
 )
@@ -44,7 +45,96 @@ def make_bucket(map_: CrushMap, type_: int, items: list[int],
         weights = [item_weight(map_, i) for i in items]
     b = Bucket(id=bucket_id, type=type_, alg=alg, items=list(items),
                weights=list(weights))
+    finish_bucket(b)
     return add_bucket(map_, b, name)
+
+
+def finish_bucket(b: Bucket) -> None:
+    """(Re)build alg-specific derived state (straw lengths / tree
+    nodes). MUST be called after any items/weights mutation of a
+    straw/tree bucket — the reference's crush_bucket_*_adjust_item_weight
+    recalculates the same state (ref: builder.c)."""
+    if b.alg == ALG_STRAW:
+        b.straws = calc_straws(b.weights)
+    elif b.alg == ALG_TREE:
+        b.node_weights = make_tree_nodes(b.weights)
+
+
+def calc_straws(weights: list[int]) -> list[int]:
+    """straw(v1) scaling factors (ref: src/crush/builder.c
+    crush_calc_straw, straw_calc_version=1 semantics).
+
+    Walk items by ascending weight; every item whose weight ties the
+    previous keeps the same straw; at each weight step the straw grows by
+    (1/pbelow)^(1/numleft) where pbelow is the probability mass already
+    'below' the boundary. Float math exactly like the reference (the
+    shipped straws are double-computed too). Zero-weight items get zero
+    straws. Provenance: reimplemented from the published algorithm; the
+    reference tree was unavailable for byte comparison (SURVEY.md)."""
+    size = len(weights)
+    order = sorted(range(size), key=lambda i: (weights[i], i))
+    straws = [0] * size
+    straw = 1.0
+    numleft = size
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if weights[order[i]] == 0:
+            straws[order[i]] = 0
+            i += 1
+            numleft -= 1
+            continue
+        straws[order[i]] = int(straw * 0x10000)
+        i += 1
+        numleft -= 1
+        if i == size:
+            break
+        if weights[order[i]] == weights[order[i - 1]]:
+            continue
+        wbelow += (weights[order[i - 1]] - lastw) * (numleft + 1)
+        wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= (1.0 / pbelow) ** (1.0 / numleft)
+        lastw = weights[order[i - 1]]
+    return straws
+
+
+def tree_depth(size: int) -> int:
+    """ref: builder.c calc_depth: leaves live at odd nodes 2i+1, so the
+    tree needs 2*size node slots rounded up to a power of two."""
+    if size <= 1:
+        return 1
+    return (size - 1).bit_length() + 1
+
+
+def _tree_height(n: int) -> int:
+    h = 0
+    while (n & 1) == 0 and n:
+        h += 1
+        n >>= 1
+    return h
+
+
+def make_tree_nodes(weights: list[int]) -> list[int]:
+    """Binary-tree node weights (ref: builder.c crush_make_tree_bucket):
+    item i sits at node 2i+1; each internal node holds its subtree sum."""
+    size = len(weights)
+    num_nodes = 1 << tree_depth(size)
+    nodes = [0] * num_nodes
+    for i, w in enumerate(weights):
+        node = ((i + 1) << 1) - 1
+        nodes[node] = w
+        # propagate to ancestors: parent(t) clears height bit, sets next
+        t = node
+        while True:
+            h = _tree_height(t)
+            parent = (t & ~(1 << h)) | (1 << (h + 1))
+            if parent >= num_nodes:
+                break
+            nodes[parent] += w
+            t = parent
+    return nodes
 
 
 def item_weight(map_: CrushMap, item: int) -> int:
@@ -116,6 +206,7 @@ def insert_item(map_: CrushMap, item: int, weight: int,
         raise ValueError(f"item {item} already in bucket {bucket_id}")
     b.items.append(item)
     b.weights.append(weight)
+    finish_bucket(b)
     if item >= 0:
         map_.max_devices = max(map_.max_devices, item + 1)
     _adjust_ancestors(map_, bucket_id, weight)
@@ -130,6 +221,7 @@ def remove_item(map_: CrushMap, item: int) -> None:
             w = b.weights[i]
             del b.items[i]
             del b.weights[i]
+            finish_bucket(b)
             _adjust_ancestors(map_, b.id, -w)
             return
     raise ValueError(f"item {item} not in any bucket")
@@ -143,6 +235,7 @@ def adjust_item_weight(map_: CrushMap, item: int, weight: int) -> None:
             i = b.items.index(item)
             delta = weight - b.weights[i]
             b.weights[i] = weight
+            finish_bucket(b)
             _adjust_ancestors(map_, b.id, delta)
 
 
@@ -153,6 +246,7 @@ def _adjust_ancestors(map_: CrushMap, bucket_id: int, delta: int) -> None:
         parent = map_.buckets[parents[cur]]
         i = parent.items.index(cur)
         parent.weights[i] += delta
+        finish_bucket(parent)
         cur = parent.id
 
 

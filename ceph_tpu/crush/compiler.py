@@ -112,6 +112,68 @@ def compile_crushmap(text: str) -> CrushMap:
                     body.append(_strip(lines[i]))
                 i += 1
             rule_lines.append((name, body))
+        elif tok[0] == "choose_args":
+            # "choose_args <id> {" ... blocks of
+            # "{ bucket_id <bid> / weight_set [ [w ...] ... ] / ids [..] }"
+            # (ref: CrushCompiler::parse_choose_args / decompile format)
+            if len(tok) < 2:
+                err("choose_args <id> {")
+            ca_id = int(tok[1])
+            from ceph_tpu.crush.types import ChooseArg
+            args: dict[int, ChooseArg] = {}
+            i += 1
+            depth = 1
+            cur: ChooseArg | None = None
+            cur_bid: int | None = None
+            while i < len(lines) and depth > 0:
+                cl = _strip(lines[i])
+                i += 1
+                if not cl:
+                    continue
+                ct = cl.replace("[", " [ ").replace("]", " ] ").split()
+                if ct[0] == "{":
+                    depth += 1
+                    cur = ChooseArg()
+                    cur_bid = None
+                    continue
+                if ct[0] == "}":
+                    depth -= 1
+                    if depth == 1 and cur is not None:
+                        if cur_bid is None:
+                            err("choose_args block missing bucket_id")
+                        args[cur_bid] = cur
+                        cur = None
+                    continue
+                if ct[0] == "bucket_id":
+                    cur_bid = int(ct[1])
+                elif ct[0] == "weight_set":
+                    # flatten possibly-multiline "[ [ w w ] [ w w ] ]"
+                    toks = ct[1:]
+                    while i < len(lines) and toks.count("[") > \
+                            toks.count("]"):
+                        toks += _strip(lines[i]).replace(
+                            "[", " [ ").replace("]", " ] ").split()
+                        i += 1
+                    vec: list[int] = []
+                    depth2 = 0
+                    for t in toks:
+                        if t == "[":
+                            depth2 += 1
+                            if depth2 == 2:
+                                vec = []
+                        elif t == "]":
+                            if depth2 == 2:
+                                cur.weight_set.append(vec)
+                            depth2 -= 1
+                        else:
+                            vec.append(int(round(float(t) * WEIGHT_ONE)))
+                elif ct[0] == "ids":
+                    cur.ids = [int(t) for t in ct[1:]
+                               if t not in ("[", "]")]
+                else:
+                    err(f"unknown choose_args attribute {ct[0]!r}")
+            m.choose_args[ca_id] = args
+            i -= 1  # outer loop re-increments
         elif len(tok) >= 3 and tok[-1] == "{":
             # bucket: "<typename> <name> {"
             tname, bname = tok[0], tok[1]
@@ -320,6 +382,26 @@ def decompile_crushmap(m: CrushMap) -> str:
                 out.append(f"\tstep {verb} {s.arg1} type "
                            f"{m.type_names.get(s.arg2, s.arg2)}")
         out.append("}")
+    if m.choose_args:
+        out.append("")
+        out.append("# choose_args")
+        for ca_id in sorted(m.choose_args):
+            out.append(f"choose_args {ca_id} {{")
+            for bid in sorted(m.choose_args[ca_id], reverse=True):
+                arg = m.choose_args[ca_id][bid]
+                out.append("  {")
+                out.append(f"    bucket_id {bid}")
+                if arg.weight_set:
+                    out.append("    weight_set [")
+                    for ws in arg.weight_set:
+                        row = " ".join(f"{w / WEIGHT_ONE:.5f}" for w in ws)
+                        out.append(f"      [ {row} ]")
+                    out.append("    ]")
+                if arg.ids:
+                    row = " ".join(str(i) for i in arg.ids)
+                    out.append(f"    ids [ {row} ]")
+                out.append("  }")
+            out.append("}")
     out.append("")
     out.append("# end crush map")
     return "\n".join(out) + "\n"

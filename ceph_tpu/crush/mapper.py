@@ -9,12 +9,18 @@ nearly all lanes finish in one pass); bucket descent is a fixed unroll to
 the map's max depth; per-bucket variable arity is padding + masks.
 
 Semantics deltas vs the scalar spec (``mapper_ref``), all documented:
-- requires chooseleaf_stable=1 (the modern default; legacy stable=0 renames
-  replica slots on failure in a way that needs data-dependent loop bounds);
+- legacy tunables (chooseleaf_stable=0, local retries) transparently fall
+  back to the scalar spec per map (data-dependent loop bounds don't
+  vectorize); modern maps take the device path;
 - firstn blocks are fixed-width with failure holes compacted at EMIT, which
   reproduces the scalar output except when a multi-root step underfills
   mid-rule (astronomically rare, needs a near-full cluster of failures);
-- straw(v1)/tree buckets: not yet (straw2/uniform/list cover modern maps).
+- all five bucket algorithms vectorize (straw2/uniform/list/straw/tree);
+- choose_args: multi-position weight-sets use position = block-relative
+  slot index (upstream restarts outpos per root column, ref: crush_do_rule),
+  which matches the scalar outpos except after an earlier same-block slot
+  failure (upstream feeds the dynamic outpos; single-position sets — the
+  balancer's output — are always exact).
 
 The straw2 draw is 48-bit fixed point, so the draw math needs 64-bit
 integers; x64 is enabled ONLY inside this module's entry points via the
@@ -50,7 +56,7 @@ from ceph_tpu.crush import hash as h
 from ceph_tpu.crush.ln_table import crush_ln
 from ceph_tpu.crush.tensors import PackedMap, pack_map
 from ceph_tpu.crush.types import (
-    ALG_LIST, ALG_STRAW2, ALG_UNIFORM,
+    ALG_LIST, ALG_STRAW, ALG_STRAW2, ALG_TREE, ALG_UNIFORM,
     ITEM_NONE,
     OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP, OP_CHOOSE_FIRSTN,
     OP_CHOOSE_INDEP, OP_EMIT, OP_NOOP, OP_SET_CHOOSELEAF_STABLE,
@@ -84,27 +90,44 @@ def _u32(v):
 # Vectorized bucket choose
 # ---------------------------------------------------------------------------
 
-def _straw2_choose(arrs, rows, x, r):
+def _straw2_choose(arrs, rows, x, r, pos=None):
     """(N,) lanes: straw2 argmax draw (ref: mapper.c bucket_straw2_choose).
 
     The 48-bit fixed-point ln is ONE gather from the precomputed 64K-entry
     ``negln`` table (negln[u] = 2^48 - crush_ln(u), the negated draw
     numerator) — measured ~5x cheaper on TPU than evaluating crush_ln's
     normalize/multiply chain in emulated int64 per item.
+
+    pos: (N,) replica positions, consulted only when a choose_args
+    weight-set is packed (arrs["cw"]): position p draws with
+    weight_set[p % P] and the override ids (ref: crush_choose_arg).
     """
     items = arrs["items"][rows]            # (N, S) int32
-    w = arrs["weights"][rows]              # (N, S) int64
     size = arrs["size"][rows]              # (N,)
     S = items.shape[1]
-    u = (h.hash32_3(_u32(x)[:, None], _u32(items), _u32(r)[:, None],
+    if "cw" in arrs:
+        P = arrs["cw"].shape[0]
+        # out-of-range positions clamp to the last set (ref: mapper.c
+        # get_choose_arg_weights)
+        p = jnp.clip(pos, 0, P - 1).astype(jnp.int32) \
+            if (pos is not None and P > 1) else jnp.zeros_like(rows)
+        w = arrs["cw"][p, rows]
+        hash_ids = arrs["cids"][rows]
+        m1 = arrs["cm1"][p, rows]
+        m0 = arrs["cm0"][p, rows]
+        sh = arrs["csh"][p, rows]
+    else:
+        w = arrs["weights"][rows]          # (N, S) int64
+        hash_ids = items
+        m1 = arrs["wm1"][rows]
+        m0 = arrs["wm0"][rows]
+        sh = arrs["wsh"][rows]
+    u = (h.hash32_3(_u32(x)[:, None], _u32(hash_ids), _u32(r)[:, None],
                     xp=jnp) & jnp.uint32(0xFFFF)).astype(jnp.int32)
     neg = arrs["negln"][u].astype(jnp.uint64)   # (N, S), <= 2^48
     # draw = trunc((ln - 2^48)/w) = -(neg // w); maximize draw = minimize q.
     # neg // w via the per-slot magic multiply (exact; see PackedMap.wm1)
     # — TPUs have no 64-bit divider and XLA's emulation is ~6.5x slower.
-    m1 = arrs["wm1"][rows]
-    m0 = arrs["wm0"][rows]
-    sh = arrs["wsh"][rows]
     n1 = neg >> jnp.uint64(32)
     n0 = neg & jnp.uint64(0xFFFFFFFF)
     mid = n1 * m0 + n0 * m1 + ((n0 * m0) >> jnp.uint64(32))
@@ -169,9 +192,50 @@ def _list_choose(arrs, rows, x, r):
     return jnp.take_along_axis(items, idx[:, None], axis=1)[:, 0]
 
 
-def _bucket_choose(arrs, present, rows, x, r):
+def _straw_choose(arrs, rows, x, r):
+    """(N,) lanes: legacy straw(v1) — draw = hash16 * straw_i, first max
+    (ref: mapper.c bucket_straw_choose; straws from crush_calc_straw)."""
+    items = arrs["items"][rows]
+    straws = arrs["straws"][rows]          # (N, S) uint64
+    size = arrs["size"][rows]
+    S = items.shape[1]
+    u = (h.hash32_3(_u32(x)[:, None], _u32(items), _u32(r)[:, None],
+                    xp=jnp) & jnp.uint32(0xFFFF)).astype(jnp.uint64)
+    draw = u * straws
+    posmask = jnp.arange(S, dtype=jnp.int32)[None, :] < size[:, None]
+    draw = jnp.where(posmask, draw, jnp.uint64(0))
+    idx = jnp.argmax(draw, axis=1)         # first max, like the scalar
+    return jnp.take_along_axis(items, idx[:, None], axis=1)[:, 0]
+
+
+def _tree_choose(arrs, cfg, rows, x, r):
+    """(N,) lanes: tree-bucket binary descent (ref: mapper.c
+    bucket_tree_choose). Unrolls tree_depth_max levels; terminal (odd)
+    lanes hold their node."""
+    nodes = arrs["tree_nodes"]             # (B, NT) int64
+    items = arrs["items"][rows]
+    NT = nodes.shape[1]
+    n = (arrs["tree_num"][rows] >> 1).astype(jnp.int32)   # per-lane root
+    for _ in range(cfg.get("tree_depth", 0)):
+        term = (n & 1) == 1
+        safe_n = jnp.clip(n, 0, NT - 1)
+        w = nodes[rows, safe_n].astype(jnp.uint64)
+        t = (h.hash32_4(_u32(x), _u32(n), _u32(r),
+                        _u32(arrs["bid"][rows]), xp=jnp)
+             .astype(jnp.uint64) * w) >> jnp.uint64(32)
+        half = (n & -n) >> 1
+        left = n - half
+        wl = nodes[rows, jnp.clip(left, 0, NT - 1)].astype(jnp.uint64)
+        n_next = jnp.where(t < wl, left, n + half)
+        n = jnp.where(term, n, n_next)
+    leaf_slot = jnp.clip(n >> 1, 0, items.shape[1] - 1)
+    return jnp.take_along_axis(items, leaf_slot[:, None], axis=1)[:, 0]
+
+
+def _bucket_choose(arrs, cfg, rows, x, r, pos=None):
     """Dispatch on bucket alg (ref: mapper.c crush_bucket_choose)."""
-    item = _straw2_choose(arrs, rows, x, r)
+    present = cfg["present"]
+    item = _straw2_choose(arrs, rows, x, r, pos)
     alg = arrs["alg"][rows]
     if ALG_UNIFORM in present:
         item = jnp.where(alg == ALG_UNIFORM,
@@ -179,6 +243,12 @@ def _bucket_choose(arrs, present, rows, x, r):
     if ALG_LIST in present:
         item = jnp.where(alg == ALG_LIST,
                          _list_choose(arrs, rows, x, r), item)
+    if ALG_STRAW in present:
+        item = jnp.where(alg == ALG_STRAW,
+                         _straw_choose(arrs, rows, x, r), item)
+    if ALG_TREE in present:
+        item = jnp.where(alg == ALG_TREE,
+                         _tree_choose(arrs, cfg, rows, x, r), item)
     return item
 
 
@@ -198,7 +268,8 @@ def _is_out(arrs, item, x):
 # ---------------------------------------------------------------------------
 
 def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
-             target_type, indep_numrep, levels: int | None = None):
+             target_type, indep_numrep, levels: int | None = None,
+             pos=None):
     """Walk from start buckets down to an item of target_type.
 
     base_r: (N,) int32 = rep + parent_r. ftotal: (N,) or scalar retry count.
@@ -234,7 +305,7 @@ def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
                 (alg_c == ALG_UNIFORM) & (size_c % indep_numrep == 0),
                 indep_numrep + 1, indep_numrep)
             r = base_r + stride * ftotal
-        item = _bucket_choose(arrs, cfg["present"], cur, x, r)
+        item = _bucket_choose(arrs, cfg, cur, x, r, pos)
         empty = size_c == 0
         row = -1 - item
         is_bucket = item < 0
@@ -257,7 +328,8 @@ def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
 # choose_firstn / choose_indep, one replica slot at a time
 # ---------------------------------------------------------------------------
 
-def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries):
+def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries,
+                 pos=None):
     """The chooseleaf recursion: pick one device under `item`
     (ref: crush_choose_firstn recursive call with numrep=1, stable=1).
 
@@ -276,7 +348,7 @@ def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries):
         active = ~c["done"]
         item_l, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
                                  sub_r, c["ftotal"], 0, None,
-                                 levels=cfg.get("levels_leaf"))
+                                 levels=cfg.get("levels_leaf"), pos=pos)
         collide = jnp.zeros(n, dtype=bool)
         if prior_leaves is not None and prior_leaves.shape[1]:
             collide = jnp.any(item_l[:, None] == prior_leaves, axis=1)
@@ -307,7 +379,7 @@ def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries):
 def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
                        prior_out, prior_leaves, target_type,
                        recurse_to_leaf, tries, recurse_tries, vary_r,
-                       ftotal0: int = 0):
+                       ftotal0: int = 0, pos: int = 0):
     """One replica slot of crush_choose_firstn, all lanes at once.
 
     ftotal0 > 0 resumes after the caller's speculative tries: the while
@@ -321,9 +393,10 @@ def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
 
     def body(c):
         active = ~c["done"]
+        pos_v = jnp.full(n, pos, dtype=jnp.int32)
         item, ok, r_fin = _descend(arrs, cfg, root_rows, root_valid, x,
                                    base_r, c["ftotal"], target_type, None,
-                                   levels=cfg.get("levels_main"))
+                                   levels=cfg.get("levels_main"), pos=pos_v)
         collide = jnp.zeros(n, dtype=bool)
         if prior_out.shape[1]:
             collide = jnp.any(item[:, None] == prior_out, axis=1)
@@ -335,7 +408,7 @@ def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
             else:
                 sub_r = jnp.zeros_like(r_cur)
             leaf, ok = _leaf_choose(arrs, cfg, item, ok, x, sub_r,
-                                    prior_leaves, recurse_tries)
+                                    prior_leaves, recurse_tries, pos=pos_v)
         else:
             leaf = item
             if target_type == 0:
@@ -368,7 +441,7 @@ SPEC_TRIES = 2  # speculative parallel tries per replica slot (try 0
                 # while_loop fallback catches the tail exactly)
 
 
-def _leaf_once(arrs, cfg, item, item_ok, x, sub_r):
+def _leaf_once(arrs, cfg, item, item_ok, x, sub_r, pos=None):
     """Single-pass chooseleaf recursion (descend_once semantics): one
     descent from `item` to a device; no retry loop. Device items pass
     through unchecked (the scalar code only is_out-checks at type 0)."""
@@ -377,7 +450,7 @@ def _leaf_once(arrs, cfg, item, item_ok, x, sub_r):
     rows = jnp.clip(-1 - item, 0, B - 1)
     leaf, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
                            sub_r, jnp.zeros_like(sub_r), 0, None,
-                           levels=cfg.get("levels_leaf"))
+                           levels=cfg.get("levels_leaf"), pos=pos)
     leaf = jnp.where(is_bucket, leaf, item)
     ok = jnp.where(is_bucket, ok, item_ok)
     return leaf, ok
@@ -385,7 +458,7 @@ def _leaf_once(arrs, cfg, item, item_ok, x, sub_r):
 
 def _choose_firstn_block(arrs, cfg, root_rows, root_valid, x, numrep,
                          target_type, recurse_to_leaf, tries, recurse_tries,
-                         vary_r):
+                         vary_r, pos_base: int = 0):
     """numrep replica slots from one root column -> (N, numrep) x2.
 
     Structure (round 2): the first SPEC_TRIES tries of EVERY slot descend
@@ -420,15 +493,19 @@ def _choose_firstn_block(arrs, cfg, root_rows, root_valid, x, numrep,
         valid_f = jnp.broadcast_to(root_valid[:, None], (n, M)).reshape(-1)
         base_r = jnp.broadcast_to(r_all[None, :], (n, M)).reshape(-1)
         ftot0 = jnp.zeros_like(base_r)
+        pos_f = jnp.broadcast_to(
+            jnp.asarray(reps + pos_base, dtype=jnp.int32)[None, :],
+            (n, M)).reshape(-1)
         item_f, ok_f, _ = _descend(arrs, cfg, rows_f, valid_f, x_f,
                                    base_r, ftot0, target_type, None,
-                                   levels=cfg.get("levels_main"))
+                                   levels=cfg.get("levels_main"), pos=pos_f)
         if recurse_to_leaf:
             if vary_r:
                 sub_r = base_r >> (vary_r - 1)
             else:
                 sub_r = jnp.zeros_like(base_r)
-            leaf_f, ok_f = _leaf_once(arrs, cfg, item_f, ok_f, x_f, sub_r)
+            leaf_f, ok_f = _leaf_once(arrs, cfg, item_f, ok_f, x_f, sub_r,
+                                      pos=pos_f)
             # is_out applies to recursed leaves only; a device item sitting
             # directly at the target level passes through unchecked (same
             # as the loop path / scalar spec).
@@ -464,7 +541,7 @@ def _choose_firstn_block(arrs, cfg, root_rows, root_valid, x, numrep,
                 arrs, cfg, root_rows, root_valid & ~any_ok, x, rep,
                 out[:, :rep], leaves[:, :rep], target_type,
                 recurse_to_leaf, tries, recurse_tries, vary_r,
-                ftotal0=K)
+                ftotal0=K, pos=pos_base + rep)
             ok = any_ok | ok2
             item = jnp.where(any_ok, item, item2)
             leaf = jnp.where(any_ok, leaf, leaf2)
@@ -472,14 +549,15 @@ def _choose_firstn_block(arrs, cfg, root_rows, root_valid, x, numrep,
             item, leaf, ok = _choose_one_firstn(
                 arrs, cfg, root_rows, root_valid, x, rep,
                 out[:, :rep], leaves[:, :rep], target_type,
-                recurse_to_leaf, tries, recurse_tries, vary_r)
+                recurse_to_leaf, tries, recurse_tries, vary_r,
+                pos=pos_base + rep)
         out = out.at[:, rep].set(jnp.where(ok, item, ITEM_NONE))
         leaves = leaves.at[:, rep].set(jnp.where(ok, leaf, ITEM_NONE))
     return out, leaves
 
 
 def _leaf_choose_indep(arrs, cfg, item, item_ok, x, parent_r, rep, numrep,
-                       tries):
+                       tries, pos=None):
     """Indep leaf recursion (ref: crush_choose_indep recursive call with
     left=1, outpos=rep, parent_r=r)."""
     n = item.shape[0]
@@ -495,7 +573,7 @@ def _leaf_choose_indep(arrs, cfg, item, item_ok, x, parent_r, rep, numrep,
         active = ~c["done"]
         item_l, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
                                  base_r, c["ftotal"], 0, numrep,
-                                 levels=cfg.get("levels_leaf"))
+                                 levels=cfg.get("levels_leaf"), pos=pos)
         reject = ~ok | _is_out(arrs, item_l, x)
         succeed = active & ~reject
         ftotal_next = c["ftotal"] + 1
@@ -521,7 +599,7 @@ def _leaf_choose_indep(arrs, cfg, item, item_ok, x, parent_r, rep, numrep,
 
 def _choose_indep_block(arrs, cfg, root_rows, root_valid, x, out_size,
                         numrep, target_type, recurse_to_leaf, tries,
-                        recurse_tries):
+                        recurse_tries, pos_base: int = 0):
     """ref: mapper.c crush_choose_indep — position-stable EC placement."""
     n = x.shape[0]
     out0 = jnp.full((n, out_size), ITEM_NONE - 1, dtype=jnp.int32)  # UNDEF
@@ -537,11 +615,13 @@ def _choose_indep_block(arrs, cfg, root_rows, root_valid, x, out_size,
         for rep in range(out_size):
             need = out[:, rep] == UNDEF
             base_r = jnp.full(n, rep, dtype=jnp.int32)
+            pos_v = jnp.full(n, pos_base + rep, dtype=jnp.int32)
             item, ok, r_parent = _descend(arrs, cfg, root_rows,
                                           root_valid & need, x,
                                           base_r, ftotal, target_type,
                                           numrep,
-                                          levels=cfg.get("levels_main"))
+                                          levels=cfg.get("levels_main"),
+                                          pos=pos_v)
             real = jnp.where(out == UNDEF, ITEM_NONE, out)
             collide = jnp.any(item[:, None] == real, axis=1)
             ok = ok & ~collide
@@ -550,7 +630,7 @@ def _choose_indep_block(arrs, cfg, root_rows, root_valid, x, out_size,
                 # its loop-local r into the recursion).
                 leaf, ok = _leaf_choose_indep(arrs, cfg, item, ok, x,
                                               r_parent, rep, numrep,
-                                              recurse_tries)
+                                              recurse_tries, pos=pos_v)
             else:
                 leaf = item
                 if target_type == 0:
@@ -594,17 +674,26 @@ class Mapper:
 
     def __init__(self, crush_map: CrushMap,
                  device_weights: np.ndarray | None = None,
-                 block: int | None = None):
+                 block: int | None = None,
+                 choose_args: int | None = None):
         self.map = crush_map
         self.packed: PackedMap = pack_map(crush_map)
+        self.choose_args_key = choose_args
+        # Legacy tunables (chooseleaf_stable=0 renames replica slots on
+        # failure with data-dependent loop bounds; local retries change
+        # the retry ladder shape): fall back to the scalar spec for the
+        # whole map rather than refuse (round 1 raised here).
+        self._scalar_reason = None
         if crush_map.tunables.chooseleaf_stable != 1:
-            raise NotImplementedError(
-                "vectorized mapper requires chooseleaf_stable=1 "
-                "(the modern default); use mapper_ref for legacy maps")
-        if crush_map.tunables.choose_local_tries or \
+            self._scalar_reason = "chooseleaf_stable=0"
+        elif crush_map.tunables.choose_local_tries or \
                 crush_map.tunables.choose_local_fallback_tries:
-            raise NotImplementedError(
-                "legacy local retries unsupported in the vectorized mapper")
+            self._scalar_reason = "legacy local retries"
+        if self._scalar_reason:
+            from ceph_tpu.utils.logging import get_logger
+            get_logger("crush").dout(
+                1, "vectorized mapper falling back to the scalar spec",
+                reason=self._scalar_reason)
         p = self.packed
         if device_weights is None:
             device_weights = np.full(p.max_devices, WEIGHT_ONE,
@@ -625,9 +714,28 @@ class Mapper:
                                               dtype=jnp.int64),
                 "negln": jnp.asarray(_negln_table(), dtype=jnp.int64),
             }
+            if p.tree_depth_max:
+                self.arrays["tree_nodes"] = jnp.asarray(p.tree_nodes,
+                                                        dtype=jnp.int64)
+                self.arrays["tree_num"] = jnp.asarray(p.tree_num,
+                                                      dtype=jnp.int32)
+            if ALG_STRAW in p.algs_present:
+                self.arrays["straws"] = jnp.asarray(p.straws,
+                                                    dtype=jnp.uint64)
+            if choose_args is not None and \
+                    choose_args in crush_map.choose_args:
+                from ceph_tpu.crush.tensors import pack_choose_args
+                cw, cids, cm1, cm0, csh = pack_choose_args(
+                    crush_map, choose_args, p)
+                self.arrays["cw"] = jnp.asarray(cw, dtype=jnp.int64)
+                self.arrays["cids"] = jnp.asarray(cids, dtype=jnp.int32)
+                self.arrays["cm1"] = jnp.asarray(cm1, dtype=jnp.uint64)
+                self.arrays["cm0"] = jnp.asarray(cm0, dtype=jnp.uint64)
+                self.arrays["csh"] = jnp.asarray(csh, dtype=jnp.uint64)
         self.cfg = {"max_depth": p.max_depth,
                     "present": p.algs_present,
-                    "type_depth": p.type_depth}
+                    "type_depth": p.type_depth,
+                    "tree_depth": p.tree_depth_max}
         # Tile size bounding the (block, S) int64 straw2 temps: target
         # ~2 GiB of transient state assuming ~8 live (S-wide int64) temps
         # across numrep*SPEC_TRIES speculative lanes per PG.
@@ -657,7 +765,7 @@ class Mapper:
                 steps.append((s.op, s.arg1, s.arg2))
         return (tuple(steps), result_max, _tunables_key(self.map.tunables),
                 self.cfg["max_depth"], self.cfg["present"],
-                self.cfg["type_depth"])
+                self.cfg["type_depth"], self.cfg["tree_depth"])
 
     def _rule_fn(self, ruleno: int, result_max: int):
         return _compiled_rule(*self._rule_key(ruleno, result_max))
@@ -667,10 +775,26 @@ class Mapper:
         return not any(s.op in (OP_CHOOSE_INDEP, OP_CHOOSELEAF_INDEP)
                        for s in self.map.rules[ruleno].steps)
 
+    def _scalar_map(self, ruleno: int, xs, result_max: int) -> np.ndarray:
+        """Legacy-tunable fallback: per-x scalar walk of the executable
+        spec (bit-exact by definition; slow — legacy maps only)."""
+        from ceph_tpu.crush import mapper_ref
+        weight = np.asarray(self.arrays["device_weights"]).tolist()
+        cargs = self.map.choose_args.get(self.choose_args_key) \
+            if self.choose_args_key is not None else None
+        out = np.full((len(xs), result_max), ITEM_NONE, dtype=np.int32)
+        for i, x in enumerate(np.asarray(xs)):
+            got = mapper_ref.do_rule(self.map, ruleno, int(x), result_max,
+                                     weight, cargs)
+            out[i, :len(got[:result_max])] = got[:result_max]
+        return out
+
     def map_pgs(self, ruleno: int, xs, result_max: int) -> jax.Array:
         """Vectorized crush_do_rule over xs -> (N, result_max) device ids
         (ITEM_NONE fills failures/indep holes). Tiled into self.block-lane
         chunks so straw2 temps stay bounded at any N."""
+        if self._scalar_reason:
+            return self._scalar_map(ruleno, xs, result_max)
         fn = self._rule_fn(ruleno, result_max)
         with jax.enable_x64(True):
             xs = jnp.asarray(xs, dtype=jnp.uint32)
@@ -701,6 +825,16 @@ class Mapper:
         Returns (counts, bad) device arrays: counts int64 (max_devices,),
         bad int64 scalar. Nothing of O(n) touches the host.
         """
+        nd_ = device_counts_size or self.packed.max_devices
+        if self._scalar_reason:    # legacy fallback: host aggregation
+            out = self._scalar_map(
+                ruleno, np.arange(start_x, start_x + n, dtype=np.uint32),
+                result_max)
+            live = out != ITEM_NONE
+            counts = np.bincount(out[live], minlength=nd_)[:nd_]
+            bad = int((live.sum(axis=1) < result_max).sum()) \
+                if self.rule_is_firstn(ruleno) else 0
+            return np.asarray(counts, dtype=np.int64), np.int64(bad)
         fn_body = _rule_body(*self._rule_key(ruleno, result_max))
         firstn = self.rule_is_firstn(ruleno)
         nd = device_counts_size or self.packed.max_devices
@@ -725,9 +859,9 @@ def _tunables_key(t):
 
 @functools.lru_cache(maxsize=256)
 def _compiled_rule(steps, result_max, tkey, max_depth, present,
-                   type_depth=()):
+                   type_depth=(), tree_depth=0):
     return jax.jit(_rule_body(steps, result_max, tkey, max_depth, present,
-                              type_depth))
+                              type_depth, tree_depth))
 
 
 @functools.lru_cache(maxsize=256)
@@ -772,9 +906,11 @@ def _depth_between(type_depth, from_type, to_type):
 
 
 @functools.lru_cache(maxsize=256)
-def _rule_body(steps, result_max, tkey, max_depth, present, type_depth=()):
+def _rule_body(steps, result_max, tkey, max_depth, present, type_depth=(),
+               tree_depth=0):
     total_tries, descend_once, vary_r, stable = tkey
-    base_cfg = {"max_depth": max_depth, "present": present}
+    base_cfg = {"max_depth": max_depth, "present": present,
+                "tree_depth": tree_depth}
 
     def run(arrs, xs):
         n = xs.shape[0]

@@ -7,8 +7,9 @@ written for clarity, not speed. The vectorized JAX mapper
 (``ceph_tpu.crush.mapper``) and the C++ oracle (``interop/``) are both
 tested against this module on randomized maps.
 
-Supported bucket algorithms: straw2 (default), uniform, list. tree and
-straw(v1) are legacy and raise NotImplementedError for now.
+Supported bucket algorithms: straw2 (default), uniform, list, straw(v1),
+tree. choose_args weight-sets override straw2 weights/ids per replica
+position (ref: mapper.c bucket_straw2_choose crush_choose_arg handling).
 """
 
 from __future__ import annotations
@@ -58,14 +59,25 @@ def _div_trunc(a: int, b: int) -> int:
 # Bucket choose functions
 # ---------------------------------------------------------------------------
 
-def bucket_straw2_choose(bucket: Bucket, x: int, r: int) -> int:
+def bucket_straw2_choose(bucket: Bucket, x: int, r: int,
+                         arg=None, position: int = 0) -> int:
     """argmax_i crush_ln(hash16(x, item_i, r)) / weight_i
-    (ref: mapper.c bucket_straw2_choose)."""
+    (ref: mapper.c bucket_straw2_choose, incl. the crush_choose_arg
+    weight-set/ids override keyed by replica position)."""
+    weights = bucket.weights
+    ids = bucket.items
+    if arg is not None:
+        if arg.weight_set:
+            # out-of-range positions clamp to the last set (ref: mapper.c
+            # get_choose_arg_weights)
+            weights = arg.weight_set[min(position, len(arg.weight_set) - 1)]
+        if arg.ids:
+            ids = arg.ids
     high = 0
     high_draw = 0
-    for i, (item, w) in enumerate(zip(bucket.items, bucket.weights)):
+    for i, (hid, w) in enumerate(zip(ids, weights)):
         if w:
-            u = _h3(x, item, r) & 0xFFFF
+            u = _h3(x, hid, r) & 0xFFFF
             ln = int(crush_ln(u)) - (1 << 48)  # <= 0
             draw = _div_trunc(ln, w)
         else:
@@ -74,6 +86,44 @@ def bucket_straw2_choose(bucket: Bucket, x: int, r: int) -> int:
             high = i
             high_draw = draw
     return bucket.items[high]
+
+
+def bucket_straw_choose(bucket: Bucket, x: int, r: int) -> int:
+    """Legacy straw(v1): draw = hash16(x, item, r) * straw_i, keep max
+    (ref: mapper.c bucket_straw_choose; straws precomputed by the
+    builder's crush_calc_straw)."""
+    if bucket.straws is None:
+        from ceph_tpu.crush.builder import calc_straws
+        bucket.straws = calc_straws(bucket.weights)
+    high = 0
+    high_draw = 0
+    for i, item in enumerate(bucket.items):
+        draw = (_h3(x, item, r) & 0xFFFF) * bucket.straws[i]
+        if i == 0 or draw > high_draw:
+            high = i
+            high_draw = draw
+    return bucket.items[high]
+
+
+def bucket_tree_choose(bucket: Bucket, x: int, r: int) -> int:
+    """Binary descent by weighted coin flips
+    (ref: mapper.c bucket_tree_choose; terminal nodes are odd, item i at
+    node 2i+1, left(n) = n - 2^(h-1) with h = trailing zeros of n)."""
+    if bucket.node_weights is None:
+        from ceph_tpu.crush.builder import make_tree_nodes
+        bucket.node_weights = make_tree_nodes(bucket.weights)
+    nodes = bucket.node_weights
+    n = len(nodes) >> 1                      # root
+    while not (n & 1):
+        w = nodes[n]
+        t = (_h4(x, n, r, bucket.id) * w) >> 32
+        half = (n & -n) >> 1
+        left = n - half
+        if t < nodes[left]:
+            n = left
+        else:
+            n = n + half
+    return bucket.items[n >> 1]
 
 
 def bucket_perm_choose(bucket: Bucket, x: int, r: int) -> int:
@@ -107,17 +157,19 @@ def bucket_list_choose(bucket: Bucket, x: int, r: int) -> int:
     return bucket.items[0]
 
 
-def bucket_choose(bucket: Bucket, x: int, r: int) -> int:
+def bucket_choose(bucket: Bucket, x: int, r: int,
+                  arg=None, position: int = 0) -> int:
     """ref: mapper.c crush_bucket_choose."""
     if bucket.alg == ALG_STRAW2:
-        return bucket_straw2_choose(bucket, x, r)
+        return bucket_straw2_choose(bucket, x, r, arg, position)
     if bucket.alg == ALG_UNIFORM:
         return bucket_uniform_choose(bucket, x, r)
     if bucket.alg == ALG_LIST:
         return bucket_list_choose(bucket, x, r)
-    if bucket.alg in (ALG_TREE, ALG_STRAW):
-        raise NotImplementedError(
-            f"legacy bucket alg {bucket.alg} not supported yet")
+    if bucket.alg == ALG_STRAW:
+        return bucket_straw_choose(bucket, x, r)
+    if bucket.alg == ALG_TREE:
+        return bucket_tree_choose(bucket, x, r)
     raise ValueError(f"unknown bucket alg {bucket.alg}")
 
 
@@ -145,7 +197,8 @@ def choose_firstn(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
                   out_size: int, tries: int, recurse_tries: int,
                   local_retries: int, local_fallback_retries: int,
                   recurse_to_leaf: bool, vary_r: int, stable: int,
-                  out2: list | None, parent_r: int) -> int:
+                  out2: list | None, parent_r: int,
+                  choose_args: dict | None = None) -> int:
     """ref: mapper.c crush_choose_firstn. Returns the new outpos.
 
     Chooses numrep distinct items of type_ below bucket, retrying on
@@ -175,7 +228,10 @@ def choose_firstn(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
                             and flocal > local_fallback_retries):
                         item = bucket_perm_choose(in_, x, r)
                     else:
-                        item = bucket_choose(in_, x, r)
+                        item = bucket_choose(
+                            in_, x, r,
+                            choose_args.get(in_.id) if choose_args else None,
+                            outpos)
                     if item >= map_.max_devices:
                         skip_rep = True
                         break
@@ -198,7 +254,8 @@ def choose_firstn(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
                                 out2, outpos, count,
                                 recurse_tries, 0,
                                 local_retries, local_fallback_retries,
-                                False, vary_r, stable, None, sub_r)
+                                False, vary_r, stable, None, sub_r,
+                                choose_args)
                             if placed <= outpos:
                                 reject = True
                         else:
@@ -228,7 +285,8 @@ def choose_firstn(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
 def choose_indep(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
                  left: int, numrep: int, type_: int, out: list, outpos: int,
                  tries: int, recurse_tries: int, recurse_to_leaf: bool,
-                 out2: list | None, parent_r: int) -> None:
+                 out2: list | None, parent_r: int,
+                 choose_args: dict | None = None) -> None:
     """ref: mapper.c crush_choose_indep. Fills out[outpos:outpos+left] with
     items (position-stable; failures become ITEM_NONE for EC shards)."""
     endpos = outpos + left
@@ -253,7 +311,9 @@ def choose_indep(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
                     if out2 is not None:
                         out2[rep] = ITEM_NONE
                     break
-                item = bucket_choose(in_, x, r)
+                item = bucket_choose(
+                    in_, x, r,
+                    choose_args.get(in_.id) if choose_args else None, rep)
                 if item >= map_.max_devices:
                     break  # stays UNDEF, retried next ftotal
                 itemtype = map_.item_type(item)
@@ -268,7 +328,8 @@ def choose_indep(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
                     if item < 0:
                         choose_indep(map_, map_.buckets[item], weight, x,
                                      1, numrep, 0, out2, rep,
-                                     recurse_tries, 0, False, None, r)
+                                     recurse_tries, 0, False, None, r,
+                                     choose_args)
                         if out2[rep] == ITEM_NONE:
                             break
                     else:
@@ -291,7 +352,8 @@ def choose_indep(map_: CrushMap, bucket: Bucket, weight: list[int], x: int,
 # ---------------------------------------------------------------------------
 
 def do_rule(map_: CrushMap, ruleno: int, x: int, result_max: int,
-            weight: list[int] | None = None) -> list[int]:
+            weight: list[int] | None = None,
+            choose_args: dict | None = None) -> list[int]:
     """Execute rule `ruleno` for input x (ref: mapper.c crush_do_rule).
 
     weight: per-device 16.16 reweights for is_out; default all-in.
@@ -369,7 +431,8 @@ def do_rule(map_: CrushMap, ruleno: int, x: int, result_max: int,
                         block, 0, result_max - osize,
                         choose_tries, recurse_tries,
                         local_retries, local_fallback_retries,
-                        recurse_to_leaf, vary_r, stable, block2, 0)
+                        recurse_to_leaf, vary_r, stable, block2, 0,
+                        choose_args)
                     o.extend(block[:placed])
                     c.extend(block2[:placed])
                     osize += placed
@@ -381,7 +444,7 @@ def do_rule(map_: CrushMap, ruleno: int, x: int, result_max: int,
                         map_, bucket, weight, x, out_size, numrep,
                         step.arg2, block, 0, choose_tries,
                         choose_leaf_tries if choose_leaf_tries else 1,
-                        recurse_to_leaf, block2, 0)
+                        recurse_to_leaf, block2, 0, choose_args)
                     o.extend(block)
                     c.extend(block2)
                     osize += out_size

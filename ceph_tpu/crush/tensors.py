@@ -13,7 +13,7 @@ import dataclasses
 import numpy as np
 
 from ceph_tpu.crush.types import (
-    ALG_LIST, ALG_STRAW2, ALG_UNIFORM, CrushMap,
+    ALG_LIST, ALG_STRAW, ALG_STRAW2, ALG_TREE, ALG_UNIFORM, CrushMap,
 )
 
 
@@ -40,6 +40,12 @@ class PackedMap:
     wm1: np.ndarray            # uint64 M >> 32
     wm0: np.ndarray            # uint64 M & 0xffffffff
     wsh: np.ndarray            # uint64 sh
+    # straw(v1): per-slot straw lengths (uint64, 0 when absent).
+    straws: np.ndarray
+    # tree: padded per-bucket node-weight arrays (B, NT) + node counts;
+    # NT = 1 when no tree bucket exists.
+    tree_nodes: np.ndarray     # int64
+    tree_num: np.ndarray       # int32 num_nodes per bucket (0 = not tree)
     # (B,) per-bucket scalars.
     size: np.ndarray           # int32
     alg: np.ndarray            # int32
@@ -51,6 +57,7 @@ class PackedMap:
     max_devices: int
     max_depth: int
     algs_present: tuple[int, ...]
+    tree_depth_max: int = 0    # deepest tree-bucket descent (static unroll)
     # type_depth[t] = uniform distance (in choose levels) from every bucket
     # of type t down to devices, or -1 when buckets of that type disagree
     # (the mapper then falls back to max_depth unrolling). Index 0 = device
@@ -74,6 +81,20 @@ def pack_map(m: CrushMap) -> PackedMap:
     alg = np.full(n_buckets, ALG_STRAW2, dtype=np.int32)
     btype = np.zeros(n_buckets, dtype=np.int32)
     bid = np.array([-(i + 1) for i in range(n_buckets)], dtype=np.int32)
+    from ceph_tpu.crush import builder as _builder
+
+    straws = np.zeros((n_buckets, S), dtype=np.uint64)
+    has_tree = any(b.alg == ALG_TREE for b in m.buckets.values())
+    NT = 1
+    if has_tree:
+        for b in m.buckets.values():
+            if b.alg == ALG_TREE and b.node_weights is None:
+                _builder.finish_bucket(b)
+        NT = max(b.num_nodes for b in m.buckets.values()
+                 if b.alg == ALG_TREE)
+    tree_nodes = np.zeros((n_buckets, NT), dtype=np.int64)
+    tree_num = np.zeros(n_buckets, dtype=np.int32)
+    tree_depth_max = 0
     for b in m.buckets.values():
         r = -1 - b.id
         size[r] = b.size
@@ -81,16 +102,29 @@ def pack_map(m: CrushMap) -> PackedMap:
         btype[r] = b.type
         items[r, :b.size] = b.items
         weights[r, :b.size] = b.weights
+        if b.alg == ALG_STRAW:
+            if b.straws is None:
+                _builder.finish_bucket(b)
+            straws[r, :b.size] = b.straws
+        if b.alg == ALG_TREE:
+            nw = b.node_weights
+            tree_nodes[r, :len(nw)] = nw
+            tree_num[r] = len(nw)
+            tree_depth_max = max(tree_depth_max,
+                                 _builder.tree_depth(b.size))
     cumw = np.cumsum(weights, axis=1)
     wm1, wm0, wsh = magic_divide_tables(weights)
     return PackedMap(
         items=items, weights=weights, cumw=cumw,
-        wm1=wm1, wm0=wm0, wsh=wsh, size=size, alg=alg,
+        wm1=wm1, wm0=wm0, wsh=wsh,
+        straws=straws, tree_nodes=tree_nodes, tree_num=tree_num,
+        size=size, alg=alg,
         btype=btype, bid=bid,
         n_buckets=n_buckets, max_size=S, max_devices=m.max_devices,
         max_depth=_max_depth(m),
         algs_present=tuple(sorted({b.alg for b in m.buckets.values()})),
-        type_depth=_type_depths(m))
+        type_depth=_type_depths(m),
+        tree_depth_max=tree_depth_max)
 
 
 def magic_divide_tables(weights: np.ndarray):
@@ -114,6 +148,49 @@ def magic_divide_tables(weights: np.ndarray):
         sh[i] = s
     shape = weights.shape
     return m1.reshape(shape), m0.reshape(shape), sh.reshape(shape)
+
+
+def pack_choose_args(m: CrushMap, key: int, packed: PackedMap):
+    """Pack one choose_args weight-set for the vectorized mapper.
+
+    Returns (cw, cids, cm1, cm0, csh): cw (P, B, S) int64 per-position
+    straw2 weights (base weights where a bucket has no override), cids
+    (B, S) int32 hash ids, and the magic-divide tables for cw.
+    (ref: src/crush/crush.h crush_choose_arg_map; mapper.c
+    bucket_straw2_choose arg handling.)
+    """
+    args = m.choose_args[key]
+    B, S = packed.weights.shape
+    P = max((len(a.weight_set) for a in args.values() if a.weight_set),
+            default=1)
+    cw = np.repeat(packed.weights[None], P, axis=0).copy()
+    cids = packed.items.copy()
+    for bid, arg in args.items():
+        r = -1 - bid
+        if not (0 <= r < B):
+            continue
+        if arg.weight_set:
+            for p in range(P):
+                # clamp like mapper.c get_choose_arg_weights
+                ws = arg.weight_set[min(p, len(arg.weight_set) - 1)]
+                cw[p, r, :len(ws)] = ws[:S]
+        if arg.ids:
+            cids[r, :len(arg.ids)] = arg.ids[:S]
+    # magic tables: reuse the base-weight tables for every bucket and
+    # recompute only the (few) overridden rows — the python magic loop
+    # over the full (P, B, S) volume dominated Mapper construction
+    cm1 = np.repeat(packed.wm1[None], P, axis=0).copy()
+    cm0 = np.repeat(packed.wm0[None], P, axis=0).copy()
+    csh = np.repeat(packed.wsh[None], P, axis=0).copy()
+    for bid, arg in args.items():
+        r = -1 - bid
+        if not (0 <= r < B) or not arg.weight_set:
+            continue
+        om1, om0, osh = magic_divide_tables(cw[:, r, :])
+        cm1[:, r, :] = om1
+        cm0[:, r, :] = om0
+        csh[:, r, :] = osh
+    return cw, cids, cm1, cm0, csh
 
 
 def _type_depths(m: CrushMap) -> tuple[int, ...]:

@@ -69,6 +69,13 @@ class Bucket:
     items: list[int] = field(default_factory=list)
     weights: list[int] = field(default_factory=list)
 
+    # straw(v1) only: per-item straw lengths scaled 16.16, computed by the
+    # builder (ref: src/crush/builder.c crush_calc_straw); None until built.
+    straws: list[int] | None = None
+    # tree only: binary-tree node weights (ref: crush.h crush_bucket_tree
+    # node_weights; items live at odd nodes 2i+1); None until built.
+    node_weights: list[int] | None = None
+
     @property
     def size(self) -> int:
         return len(self.items)
@@ -76,6 +83,25 @@ class Bucket:
     @property
     def weight(self) -> int:
         return sum(self.weights)
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_weights) if self.node_weights else 0
+
+
+@dataclass
+class ChooseArg:
+    """Per-bucket weight-set override (ref: src/crush/crush.h
+    struct crush_choose_arg: weight_set[positions][size] + ids[size]).
+
+    weight_set: one weight vector per replica position (16.16); the draw
+    for replica slot p uses weight_set[min(p, positions-1)] (out-of-range
+    positions clamp to the last set, ref: mapper.c get_choose_arg_weights).
+    ids: optional substitute item ids fed to the straw2 hash.
+    """
+
+    weight_set: list[list[int]] = field(default_factory=list)
+    ids: list[int] | None = None
 
 
 @dataclass
@@ -126,6 +152,11 @@ class CrushMap:
     type_names: dict[int, str] = field(default_factory=lambda: {0: "osd"})
     bucket_names: dict[int, str] = field(default_factory=dict)
     device_classes: dict[int, str] = field(default_factory=dict)
+    # Weight-sets (ref: src/crush/crush.h crush_choose_arg_map;
+    # CrushWrapper choose_args): key (int id, -1 = the compat weight-set)
+    # -> {bucket_id -> ChooseArg}. Only straw2 draws consult them.
+    choose_args: dict[int, dict[int, "ChooseArg"]] = field(
+        default_factory=dict)
 
     def bucket(self, item: int) -> Bucket:
         return self.buckets[item]

@@ -90,7 +90,7 @@ class OSDMap:
         self.primary_temp: dict[pg_t, int] = {}
         self.pg_upmap: dict[pg_t, tuple] = {}
         self.pg_upmap_items: dict[pg_t, list] = {}
-        self._mapper: Mapper | None = None
+        self._mappers: dict[int | None, Mapper] = {}
 
     # -- state predicates (array-capable) ---------------------------------
     def exists(self, osd):
@@ -109,7 +109,7 @@ class OSDMap:
     def _dirty(self, crush_changed: bool = False) -> None:
         self.epoch += 1
         if crush_changed:
-            self._mapper = None
+            self._mappers.clear()
 
     def set_max_osd(self, n: int) -> None:
         grow = n - self.max_osd
@@ -153,8 +153,8 @@ class OSDMap:
     def set_weight(self, osd: int, weight: int) -> None:
         """The in/out reweight (16.16), consumed by CRUSH's is_out check."""
         self.osd_weight[osd] = weight
-        if self._mapper is not None:
-            self._mapper.set_device_weights(self._device_weights())
+        for mp in self._mappers.values():
+            mp.set_device_weights(self._device_weights())
         self._dirty()
 
     def set_primary_affinity(self, osd: int, aff: int) -> None:
@@ -202,7 +202,7 @@ class OSDMap:
                 f"incremental epoch {inc.epoch} != {self.epoch + 1}")
         if inc.new_crush is not None:
             self.crush = inc.new_crush
-            self._mapper = None
+            self._mappers.clear()
         if inc.new_max_osd is not None:
             self.set_max_osd(inc.new_max_osd)
             self.epoch -= 1  # counted once below
@@ -233,8 +233,8 @@ class OSDMap:
         self.pg_upmap_items.update(inc.new_pg_upmap_items)
         for pg in inc.old_pg_upmap_items:
             self.pg_upmap_items.pop(pg, None)
-        if self._mapper is not None:
-            self._mapper.set_device_weights(self._device_weights())
+        for mp in self._mappers.values():
+            mp.set_device_weights(self._device_weights())
         self.epoch += 1
 
     # -- mapper -----------------------------------------------------------
@@ -244,11 +244,24 @@ class OSDMap:
         w[:self.max_osd] = self.osd_weight
         return w
 
-    def mapper(self) -> Mapper:
-        if self._mapper is None:
-            self._mapper = Mapper(self.crush,
-                                  device_weights=self._device_weights())
-        return self._mapper
+    def _choose_args_key(self, pool_id: int) -> int | None:
+        """Weight-set selection: a pool-keyed entry wins, else the
+        compat/default set (-1), else none (ref: CrushWrapper::
+        choose_args_get_with_fallback)."""
+        if pool_id in self.crush.choose_args:
+            return pool_id
+        if -1 in self.crush.choose_args:
+            return -1
+        return None
+
+    def mapper(self, choose_args_key: int | None = None) -> Mapper:
+        mp = self._mappers.get(choose_args_key)
+        if mp is None:
+            mp = Mapper(self.crush,
+                        device_weights=self._device_weights(),
+                        choose_args=choose_args_key)
+            self._mappers[choose_args_key] = mp
+        return mp
 
     # -- object -> PG ------------------------------------------------------
     def object_locator_to_pg(self, name: str, loc: ObjectLocator) -> pg_t:
@@ -269,7 +282,8 @@ class OSDMap:
         pool = self.pools[pool_id]
         seeds = np.asarray(seeds, dtype=np.uint32)
         pps = pool.raw_pg_to_pps(seeds, xp=np)
-        raw = np.asarray(self.mapper().map_pgs(pool.crush_rule, pps,
+        mp = self.mapper(self._choose_args_key(pool.id))
+        raw = np.asarray(mp.map_pgs(pool.crush_rule, pps,
                                                pool.size))
         return self._remove_nonexistent(pool, raw), pps
 

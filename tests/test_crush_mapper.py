@@ -192,8 +192,131 @@ class TestJaxMatchesScalar:
         assert not np.array_equal(a, b)
         assert 0 not in b
 
-    def test_legacy_tunables_rejected(self):
-        m, root = builder.build_flat(4, tunables=Tunables.legacy())
-        builder.add_simple_rule(m, root, builder.TYPE_OSD)
-        with pytest.raises(NotImplementedError):
-            Mapper(m)
+    def test_legacy_tunables_fall_back_to_scalar(self):
+        """stable=0 / local-retries maps route through the scalar spec
+        transparently (round 1 raised NotImplementedError)."""
+        m, root = builder.build_flat(6, tunables=Tunables.legacy())
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        mapper = Mapper(m)
+        assert mapper._scalar_reason
+        xs = np.arange(64, dtype=np.uint32)
+        got = np.asarray(mapper.map_pgs(rid, xs, 3))
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 3)
+            ref = ref + [ITEM_NONE] * (3 - len(ref))
+            assert list(got[i]) == ref
+        counts, bad = mapper.sweep(rid, 0, 64, 3)
+        assert np.asarray(counts).sum() == (got != ITEM_NONE).sum()
+
+    def test_straw_v1_matches_scalar(self):
+        from ceph_tpu.crush.types import ALG_STRAW
+        rng = np.random.default_rng(3)
+        weights = [int(w) for w in rng.integers(
+            1, 4 * WEIGHT_ONE, size=12)]
+        m, root = builder.build_hierarchy(4, 3, alg=ALG_STRAW,
+                                          osd_weights=weights)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        assert_match(m, rid, 3)
+
+    def test_tree_matches_scalar(self):
+        from ceph_tpu.crush.types import ALG_TREE
+        rng = np.random.default_rng(4)
+        weights = [int(w) for w in rng.integers(
+            1, 4 * WEIGHT_ONE, size=10)]  # 5 hosts x 2: non-pow2 sizes
+        m, root = builder.build_hierarchy(5, 2, alg=ALG_TREE,
+                                          osd_weights=weights)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        assert_match(m, rid, 3)
+
+    def test_straw_tree_distribution_weight_proportional(self):
+        """Statistical: straw/tree selection tracks weights (the property
+        the algorithms exist for), single-level argmax."""
+        from ceph_tpu.crush.types import ALG_STRAW, ALG_TREE
+        for alg in (ALG_STRAW, ALG_TREE):
+            weights = [WEIGHT_ONE, 2 * WEIGHT_ONE, WEIGHT_ONE,
+                       4 * WEIGHT_ONE]
+            m, root = builder.build_flat(4, alg=alg, weights=weights)
+            rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+            mapper = Mapper(m)
+            xs = np.arange(8000, dtype=np.uint32)
+            got = np.asarray(mapper.map_pgs(rid, xs, 1))[:, 0]
+            counts = np.bincount(got, minlength=4).astype(float)
+            frac = counts / counts.sum()
+            want = np.asarray(weights, dtype=float)
+            want /= want.sum()
+            assert np.abs(frac - want).max() < 0.04, (alg, frac, want)
+
+
+class TestChooseArgs:
+    def _map_with_args(self, positions=1):
+        from ceph_tpu.crush.types import ChooseArg
+        m, root = builder.build_flat(6)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        ws = [[WEIGHT_ONE, WEIGHT_ONE, 3 * WEIGHT_ONE, WEIGHT_ONE,
+               0, WEIGHT_ONE][:6] for _ in range(positions)]
+        if positions > 1:
+            ws[1] = [2 * WEIGHT_ONE] * 6
+        m.choose_args[0] = {root: ChooseArg(weight_set=ws)}
+        return m, rid, root
+
+    def test_weight_set_changes_placement_and_matches_scalar(self):
+        m, rid, root = self._map_with_args()
+        xs = np.arange(256, dtype=np.uint32)
+        plain = np.asarray(Mapper(m).map_pgs(rid, xs, 2))
+        witharg = np.asarray(Mapper(m, choose_args=0).map_pgs(rid, xs, 2))
+        assert not np.array_equal(plain, witharg)
+        assert 4 not in witharg            # zero weight in the weight-set
+        cargs = m.choose_args[0]
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 2, choose_args=cargs)
+            assert list(witharg[i]) == ref
+
+    def test_multi_position_weight_set(self):
+        m, rid, root = self._map_with_args(positions=2)
+        xs = np.arange(128, dtype=np.uint32)
+        got = np.asarray(Mapper(m, choose_args=0).map_pgs(rid, xs, 2))
+        cargs = m.choose_args[0]
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 2, choose_args=cargs)
+            assert list(got[i]) == ref
+
+    def test_ids_override_changes_hash(self):
+        from ceph_tpu.crush.types import ChooseArg
+        m, root = builder.build_flat(4)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        m.choose_args[0] = {root: ChooseArg(ids=[100, 101, 102, 103])}
+        xs = np.arange(256, dtype=np.uint32)
+        plain = np.asarray(Mapper(m).map_pgs(rid, xs, 1))
+        withids = np.asarray(Mapper(m, choose_args=0).map_pgs(rid, xs, 1))
+        assert not np.array_equal(plain, withids)
+        cargs = m.choose_args[0]
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 1, choose_args=cargs)
+            assert list(withids[i]) == ref
+
+
+class TestDerivedStateInvalidation:
+    def test_straw_weight_adjust_recomputes(self):
+        """Mutating a straw bucket's weight must recompute straws (ref:
+        crush_bucket_adjust_item_weight recalculation)."""
+        from ceph_tpu.crush.types import ALG_STRAW
+        m, root = builder.build_flat(4, alg=ALG_STRAW)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        before = list(m.buckets[root].straws)
+        builder.adjust_item_weight(m, 0, 8 * WEIGHT_ONE)
+        after = list(m.buckets[root].straws)
+        assert before != after
+        assert_match(m, rid, 2)   # vectorized still matches the spec
+
+    def test_tree_insert_adds_leaf(self):
+        from ceph_tpu.crush.types import ALG_TREE
+        m, root = builder.build_flat(4, alg=ALG_TREE)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        m.max_devices = 5
+        builder.insert_item(m, 4, WEIGHT_ONE, root)
+        assert len(m.buckets[root].node_weights) >= 10
+        mapper = Mapper(m)
+        xs = np.arange(4096, dtype=np.uint32)
+        got = np.asarray(mapper.map_pgs(rid, xs, 1))[:, 0]
+        assert (got == 4).any()   # new item reachable
+        assert_match(m, rid, 2)

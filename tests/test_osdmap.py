@@ -361,3 +361,30 @@ class TestUtilization:
         util = m.pool_utilization(1)
         assert util.sum() == 64 * 3
         assert (util > 0).all()  # 16 osds, 192 slots
+
+
+class TestChooseArgsSelection:
+    def test_compat_weight_set_changes_placement(self):
+        """A -1 (compat) weight-set is picked up by the mapping pipeline
+        (ref: CrushWrapper::choose_args_get_with_fallback)."""
+        import numpy as np
+        from ceph_tpu.bench import osdmaptool
+        from ceph_tpu.crush.types import ChooseArg, WEIGHT_ONE
+
+        m = osdmaptool.create_simple(16, 128, 3, erasure=False)
+        up_before, _, _, _ = m.map_pool(1)
+        root = next(b.id for b in m.crush.buckets.values()
+                    if b.type == osdmaptool.builder.TYPE_ROOT)
+        hosts = m.crush.buckets[root].items
+        m.crush.choose_args[-1] = {
+            root: ChooseArg(weight_set=[[3 * WEIGHT_ONE] +
+                                        [WEIGHT_ONE] * (len(hosts) - 1)])}
+        m._dirty(crush_changed=True)
+        up_after, _, _, _ = m.map_pool(1)
+        assert not np.array_equal(up_before, up_after)
+        # the overweighted first host appears in nearly every PG's set
+        # (baseline: 3 distinct hosts of 4 => 75% of PGs; 3x weight
+        # pushes it toward the 100% cap)
+        h0 = m.crush.buckets[hosts[0]].items
+        util = m.pool_utilization(1)
+        assert util[h0].sum() > 0.9 * 128
