@@ -24,11 +24,10 @@ import numpy as np
 from ceph_tpu.crush import builder
 from ceph_tpu.crush.tester import CrushTester
 from ceph_tpu.crush.types import (
+    ALG_STRAW, ALG_TREE,
     ALG_LIST, ALG_STRAW2, ALG_UNIFORM, ITEM_NONE, WEIGHT_ONE,
 )
 from ceph_tpu.utils.platform import cli_main
-
-from ceph_tpu.crush.types import ALG_STRAW, ALG_TREE
 
 ALGS = {"straw2": ALG_STRAW2, "uniform": ALG_UNIFORM, "list": ALG_LIST,
         "straw": ALG_STRAW, "tree": ALG_TREE}

@@ -144,6 +144,9 @@ def compile_crushmap(text: str) -> CrushMap:
                         args[cur_bid] = cur
                         cur = None
                     continue
+                if cur is None:
+                    err(f"choose_args attribute {ct[0]!r} outside a "
+                        f"{{ ... }} block")
                 if ct[0] == "bucket_id":
                     cur_bid = int(ct[1])
                 elif ct[0] == "weight_set":

@@ -100,7 +100,8 @@ def _straw2_choose(arrs, rows, x, r, pos=None):
 
     pos: (N,) replica positions, consulted only when a choose_args
     weight-set is packed (arrs["cw"]): position p draws with
-    weight_set[p % P] and the override ids (ref: crush_choose_arg).
+    weight_set[min(p, P-1)] (out-of-range clamps to the last set, like
+    mapper.c get_choose_arg_weights) and the override ids.
     """
     items = arrs["items"][rows]            # (N, S) int32
     size = arrs["size"][rows]              # (N,)

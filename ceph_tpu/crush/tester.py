@@ -60,6 +60,15 @@ class CrushTester:
         # (None = auto-sized from the map's bucket width)
         self.mapper = Mapper(crush_map, device_weights, block=batch)
         self.batch = self.mapper.block
+        from ceph_tpu.utils.perf_counters import (PerfCountersBuilder,
+                                                  PerfCountersCollection)
+        existing = PerfCountersCollection.instance().get("crush_tester")
+        self.perf = existing or (
+            PerfCountersBuilder("crush_tester")
+            .add_u64_counter("mappings", "PGs mapped")
+            .add_u64_counter("bad_mappings", "short firstn results")
+            .add_time("map_seconds", "time in test sweeps")
+            .create_perf_counters())
 
     def test(self, rule: int, num_rep: int, min_x: int = 0,
              max_x: int = 1023, keep_mappings: bool = False) -> TestResult:
@@ -94,6 +103,9 @@ class CrushTester:
             bad = int(bad_dev)
             kept = None
         seconds = time.perf_counter() - t0
+        self.perf.inc("mappings", n)
+        self.perf.inc("bad_mappings", bad)
+        self.perf.tinc("map_seconds", seconds)
         res = TestResult(
             rule=rule, num_rep=num_rep, total_x=n,
             device_counts=counts, bad_mappings=bad, seconds=seconds,

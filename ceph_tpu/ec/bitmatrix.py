@@ -1,0 +1,360 @@
+"""Bit-matrix (array-code) constructions: liberation, blaum_roth,
+liber8tion — jerasure's minimal-density RAID-6 family.
+
+ref: src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc}
+(ErasureCodeJerasureLiberation / BlaumRoth / Liber8tion) over the vendored
+jerasure liberation.c / minimal-density codes from Plank's papers.
+
+These are m=2 codes defined directly as (2w x kw) binary matrices acting
+on w "packets" per chunk (bit-planes at packet granularity, not byte
+granularity). On the reference's CPU path their selling point is
+XOR-schedule minimality; on the MXU the whole bitmatrix is one binary
+matmul, so density is irrelevant to speed — but the codes themselves (and
+their w-packet chunk geometry) are implemented faithfully:
+
+- blaum_roth: w with w+1 prime. Q-block for drive i is the matrix of
+  multiplication by x^i in the ring GF(2)[x]/(1+x+...+x^w) — the
+  published Blaum-Roth construction.
+- liberation: w prime, k <= w. Q-block for drive i is the cyclic shift
+  sigma^i plus one extra bit (the paper's minimal-density trick); the
+  extra-bit position follows the paper's formula and every construction
+  is verified MDS at build time (all 1- and 2-erasure patterns), with a
+  deterministic search fallback should the formula position fail.
+- liber8tion: the w=8 member of the same family.
+
+Byte-compatibility with jerasure's shipped tables could not be verified
+(reference mount empty — SURVEY.md provenance warning); the constructions
+are MDS-verified against their published definitions instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# GF(2) linear algebra
+# ---------------------------------------------------------------------------
+
+def gf2_inv(a: np.ndarray) -> np.ndarray:
+    """Inverse of a square 0/1 matrix over GF(2); raises if singular."""
+    n = a.shape[0]
+    work = np.concatenate([a.astype(np.uint8) & 1,
+                           np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        piv = None
+        for row in range(col, n):
+            if work[row, col]:
+                piv = row
+                break
+        if piv is None:
+            raise np.linalg.LinAlgError("singular over GF(2)")
+        if piv != col:
+            work[[col, piv]] = work[[piv, col]]
+        for row in range(n):
+            if row != col and work[row, col]:
+                work[row] ^= work[col]
+    return work[:, n:]
+
+
+def gf2_rank(a: np.ndarray) -> int:
+    work = (a.astype(np.uint8) & 1).copy()
+    rank = 0
+    rows, cols = work.shape
+    for col in range(cols):
+        piv = None
+        for row in range(rank, rows):
+            if work[row, col]:
+                piv = row
+                break
+        if piv is None:
+            continue
+        work[[rank, piv]] = work[[piv, rank]]
+        for row in range(rows):
+            if row != rank and work[row, col]:
+                work[row] ^= work[rank]
+        rank += 1
+        if rank == rows:
+            break
+    return rank
+
+
+def is_mds(bitmatrix: np.ndarray, k: int, m: int, w: int) -> bool:
+    """Every erasure of <= m of the k+m drives leaves full rank."""
+    from itertools import combinations
+    g = np.concatenate([np.eye(k * w, dtype=np.uint8),
+                        bitmatrix.astype(np.uint8)], axis=0)
+    drives = k + m
+    rows_of = [list(range(d * w, (d + 1) * w)) for d in range(drives)]
+    for r in range(1, m + 1):
+        for erased in combinations(range(drives), r):
+            keep = [i for d in range(drives) if d not in erased
+                    for i in rows_of[d]]
+            if gf2_rank(g[keep]) < k * w:
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Constructions
+# ---------------------------------------------------------------------------
+
+def _sigma(w: int, i: int) -> np.ndarray:
+    """Cyclic shift matrix: ones at (r, (r + i) mod w)."""
+    m = np.zeros((w, w), dtype=np.uint8)
+    r = np.arange(w)
+    m[r, (r + i) % w] = 1
+    return m
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    for p in range(2, int(n ** 0.5) + 1):
+        if n % p == 0:
+            return False
+    return True
+
+
+@functools.lru_cache(maxsize=None)
+def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
+    """(2w, kw): P row-block all-identity; Q-block i = mult-by-x^i in
+    GF(2)[x]/(1 + x + ... + x^w) (requires w+1 prime, k <= w)."""
+    if not _is_prime(w + 1):
+        raise ValueError(f"blaum_roth requires w+1 prime (w={w})")
+    if not (1 <= k <= w):
+        raise ValueError(f"blaum_roth requires k <= w (k={k}, w={w})")
+    # multiplication-by-x matrix on basis (1, x, .., x^(w-1)):
+    # x * x^j = x^(j+1); x^w = 1 + x + ... + x^(w-1)  (char 2, M_p = 0)
+    mx = np.zeros((w, w), dtype=np.uint8)
+    for j in range(w - 1):
+        mx[j + 1, j] = 1
+    mx[:, w - 1] = 1
+    blocks_p = [np.eye(w, dtype=np.uint8) for _ in range(k)]
+    xi = np.eye(w, dtype=np.uint8)
+    blocks_q = []
+    for i in range(k):
+        blocks_q.append(xi.copy())
+        xi = (mx @ xi) & 1
+    out = np.concatenate([np.concatenate(blocks_p, axis=1),
+                          np.concatenate(blocks_q, axis=1)], axis=0)
+    assert is_mds(out, k, 2, w), "blaum_roth construction not MDS"
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def liberation_bitmatrix(k: int, w: int) -> np.ndarray:
+    """(2w, kw) liberation code: Q-block i = sigma^i plus one extra bit
+    (minimal density, w+1 ones per block for i > 0). The extra-bit
+    position starts from the paper's formula and is search-adjusted until
+    the whole code verifies MDS (deterministic, cached)."""
+    if not _is_prime(w):
+        raise ValueError(f"liberation requires prime w (w={w})")
+    if not (1 <= k <= w):
+        raise ValueError(f"liberation requires k <= w (k={k}, w={w})")
+    blocks_q = [np.eye(w, dtype=np.uint8)]
+    for i in range(1, k):
+        placed = None
+        # paper formula first, then deterministic search
+        y = (i * (w - 1) // 2) % w
+        candidates = [(y, (y + i - 1) % w)] + [
+            (r, c) for r in range(w) for c in range(w)]
+        for r, c in candidates:
+            blk = _sigma(w, i)
+            if blk[r, c]:
+                continue
+            blk[r, c] = 1
+            trial = blocks_q + [blk]
+            if _pairwise_invertible(trial, w):
+                placed = blk
+                break
+        if placed is None:
+            raise ValueError(f"no liberation extra-bit found (k={k} w={w})")
+        blocks_q.append(placed)
+    out = np.concatenate(
+        [np.concatenate([np.eye(w, dtype=np.uint8)] * k, axis=1),
+         np.concatenate(blocks_q, axis=1)], axis=0)
+    assert is_mds(out, k, 2, w), "liberation construction not MDS"
+    return out
+
+
+def _pairwise_invertible(blocks: list[np.ndarray], w: int) -> bool:
+    """MDS conditions for m=2 array codes with identity P-blocks:
+    every Q-block invertible and every pairwise XOR invertible."""
+    for i, bi in enumerate(blocks):
+        if gf2_rank(bi) < w:
+            return False
+        for bj in blocks[:i]:
+            if gf2_rank(bi ^ bj) < w:
+                return False
+    return True
+
+
+@functools.lru_cache(maxsize=None)
+def liber8tion_bitmatrix(k: int) -> np.ndarray:
+    """The w=8 member (ref: ErasureCodeJerasureLiber8tion; w=8 is not
+    prime, so the extra-bit search carries the construction)."""
+    w = 8
+    if not (1 <= k <= w):
+        raise ValueError(f"liber8tion requires k <= 8 (k={k})")
+    # w=8 is even, so sigma^i + sigma^j can be singular; the paper's w=8
+    # flats carry up to two extra bits, and greedy per-drive choices can
+    # dead-end — deterministic backtracking over 1- then 2-extra-bit
+    # candidates per drive, with blocks bit-packed as row-integers so the
+    # GF(2) invertibility checks are integer elimination.
+    from itertools import combinations
+
+    def pack(blk) -> tuple[int, ...]:
+        return tuple(int("".join(str(int(b)) for b in row[::-1]), 2)
+                     for row in blk)
+
+    def inv_rows(rows) -> bool:
+        rows = list(rows)
+        for col in range(w):
+            bit = 1 << col
+            piv = next((ri for ri in range(col, w) if rows[ri] & bit), None)
+            if piv is None:
+                return False
+            rows[col], rows[piv] = rows[piv], rows[col]
+            for ri in range(w):
+                if ri != col and rows[ri] & bit:
+                    rows[ri] ^= rows[col]
+        return True
+
+    def candidates(i):
+        base = pack(_sigma(w, i))
+        cells = [(r, 1 << c) for r in range(w) for c in range(w)]
+        for n_extra in (1, 2):
+            for extra in combinations(cells, n_extra):
+                rows = list(base)
+                ok = True
+                for r, bit in extra:
+                    if rows[r] & bit:
+                        ok = False
+                        break
+                    rows[r] |= bit
+                if ok and inv_rows(rows):
+                    yield tuple(rows)
+
+    budget = [200_000]          # pairwise-check budget before fallback
+
+    def search(blocks, i):
+        if i == k:
+            return blocks
+        for blk in candidates(i):
+            budget[0] -= len(blocks)
+            if budget[0] < 0:
+                return None
+            if all(inv_rows([a ^ b for a, b in zip(blk, prev)])
+                   for prev in blocks):
+                got = search(blocks + [blk], i + 1)
+                if got is not None:
+                    return got
+        return None
+
+    packed = search([pack(np.eye(w, dtype=np.uint8))], 1)
+    if packed is not None:
+        blocks_q = []
+        for rows in packed:
+            blk = np.zeros((w, w), dtype=np.uint8)
+            for r, bits in enumerate(rows):
+                for c in range(w):
+                    blk[r, c] = (bits >> c) & 1
+            blocks_q.append(blk)
+    else:
+        # Search budget exhausted: fall back to GF(256) companion-power
+        # blocks X_i = bitmatrix(2^i) — always MDS (2^i are distinct
+        # nonzero field elements), denser than the paper's flats; the
+        # XOR-density difference is irrelevant on the MXU and byte-compat
+        # with jerasure's shipped tables is unverifiable regardless
+        # (reference mount empty).
+        from ceph_tpu.gf import tables as gft
+        acc = 1
+        blocks_q = []
+        for _ in range(k):
+            blocks_q.append(
+                gft.expand_bitmatrix(
+                    np.asarray([[acc]], dtype=np.uint8)).astype(np.uint8))
+            acc = gft.gf_mul(acc, 2)
+    out = np.concatenate(
+        [np.concatenate([np.eye(w, dtype=np.uint8)] * k, axis=1),
+         np.concatenate(blocks_q, axis=1)], axis=0)
+    assert is_mds(out, k, 2, w), "liber8tion construction not MDS"
+    return out
+
+
+# default word sizes per technique (ref: ErasureCodeJerasure.cc
+# DEFAULT_W per subclass)
+def default_w(technique: str, k: int) -> int:
+    if technique == "liber8tion":
+        return 8
+    if technique == "liberation":
+        w = max(k, 3)
+        while not _is_prime(w):
+            w += 1
+        return w
+    if technique == "blaum_roth":
+        w = max(k, 4)
+        while not _is_prime(w + 1):
+            w += 1
+        return w
+    raise ValueError(technique)
+
+
+def bitmatrix_for(technique: str, k: int, m: int, w: int) -> np.ndarray:
+    if m != 2:
+        raise ValueError(f"{technique} is a RAID-6 code: m must be 2, "
+                         f"got {m}")
+    if technique == "liberation":
+        return liberation_bitmatrix(k, w)
+    if technique == "blaum_roth":
+        return blaum_roth_bitmatrix(k, w)
+    if technique == "liber8tion":
+        if w != 8:
+            raise ValueError("liber8tion fixes w=8")
+        return liber8tion_bitmatrix(k)
+    raise ValueError(f"unknown bitmatrix technique {technique!r}")
+
+
+def decode_bitmatrix(bitmatrix: np.ndarray, k: int, m: int, w: int,
+                     available: tuple[int, ...],
+                     want: tuple[int, ...]) -> np.ndarray:
+    """(len(want)*w, len(available)*w) GF(2) matrix reconstructing the
+    wanted drives' packets from the available drives' packets — the
+    per-erasure-pattern inversion, bitmatrix flavor."""
+    g = np.concatenate([np.eye(k * w, dtype=np.uint8),
+                        bitmatrix.astype(np.uint8)], axis=0)
+    avail = list(available)
+    rows = [r for d in avail for r in range(d * w, (d + 1) * w)]
+    sub = g[rows]                              # (len(avail)*w, kw)
+    # solve sub @ data = chunks: pick kw independent rows
+    # (gaussian elimination with row tracking)
+    need = k * w
+    work = sub.copy()
+    chosen: list[int] = []
+    cols_done = 0
+    order = list(range(work.shape[0]))
+    for col in range(need):
+        piv = None
+        for ri in range(cols_done, work.shape[0]):
+            if work[ri, col]:
+                piv = ri
+                break
+        if piv is None:
+            raise np.linalg.LinAlgError("not decodable from available set")
+        work[[cols_done, piv]] = work[[piv, cols_done]]
+        order[cols_done], order[piv] = order[piv], order[cols_done]
+        for ri in range(work.shape[0]):
+            if ri != cols_done and work[ri, col]:
+                work[ri] ^= work[cols_done]
+        cols_done += 1
+    chosen = order[:need]
+    inv = gf2_inv(sub[chosen])                 # data = inv @ chunks[chosen]
+    wanted_rows = [r for d in want for r in range(d * w, (d + 1) * w)]
+    d = (g[wanted_rows].astype(np.int32) @ inv.astype(np.int32)) & 1
+    out = np.zeros((len(want) * w, len(avail) * w), dtype=np.uint8)
+    for j, src in enumerate(chosen):
+        out[:, src] = d[:, j]
+    return out

@@ -52,8 +52,30 @@ class _MatrixKernel:
                                   else "bitmatmul")
 
 
+class _BitmatrixKernel:
+    """A raw GF(2) bitmatrix (array code) compiled for the MXU: operates
+    on w packets per chunk (ref: jerasure bitmatrix techniques)."""
+
+    def __init__(self, bm: np.ndarray, w: int):
+        self.bm = jnp.asarray(np.asarray(bm, dtype=np.int8))
+        self.w = w
+
+    def apply_batch(self, data: jax.Array) -> jax.Array:
+        """(batch, drives_in, C) -> (batch, drives_out, C); C % w == 0."""
+        return ops.bitmatrix_encode_stripes(self.bm, data, self.w)
+
+    def apply(self, data: jax.Array) -> jax.Array:
+        return self.apply_batch(data[None])[0]
+
+
 class ErasureCodeJax(ErasureCodeInterface):
-    """plugin=jax technique={reed_sol_van,cauchy_orig,cauchy_good} k=K m=M"""
+    """plugin=jax k=K m=M technique= reed_sol_van | reed_sol_r6_op |
+    cauchy_orig | cauchy_good | liberation | blaum_roth | liber8tion
+
+    GF(2^8) techniques run as (8m)x(8k) bit-plane matmuls; the bitmatrix
+    (array-code) techniques run as (2w)x(kw) packet-plane matmuls — both
+    land on the MXU, so jerasure's XOR-schedule machinery (whose entire
+    point is CPU XOR minimality) has no analog here by design."""
 
     DEFAULT_TECHNIQUE = "reed_sol_van"
 
@@ -62,8 +84,10 @@ class ErasureCodeJax(ErasureCodeInterface):
         super().__init__()
         self.technique = self.DEFAULT_TECHNIQUE
         self.backend = backend
-        self._encode_kernel: _MatrixKernel | None = None
-        self._decode_cache: dict[tuple, _MatrixKernel] = {}
+        self.w = 8
+        self._bitmatrix = None
+        self._encode_kernel = None
+        self._decode_cache: dict[tuple, object] = {}
         if profile is not None:
             self.init(ErasureCodeProfile.parse(profile))
 
@@ -83,11 +107,32 @@ class ErasureCodeJax(ErasureCodeInterface):
         if self.backend not in ("bitmatmul", "lut"):
             raise ValueError(f"unknown backend {self.backend!r}; "
                              f"supported: bitmatmul, lut, auto")
-        coeffs = rs.coding_matrix(self.technique, self.k, self.m)
-        self._encode_kernel = _MatrixKernel(coeffs, self.backend)
+        if self.technique in rs.BITMATRIX_TECHNIQUES:
+            from ceph_tpu.ec import bitmatrix as bmx
+            self.w = profile.get_int("w", 0) or bmx.default_w(
+                self.technique, self.k)
+            self._bitmatrix = bmx.bitmatrix_for(self.technique, self.k,
+                                                self.m, self.w)
+            self._encode_kernel = _BitmatrixKernel(self._bitmatrix, self.w)
+        else:
+            self.w = 8
+            self._bitmatrix = None
+            coeffs = rs.coding_matrix(self.technique, self.k, self.m)
+            self._encode_kernel = _MatrixKernel(coeffs, self.backend)
         self._decode_cache.clear()
         log.dout(5, "init", k=self.k, m=self.m, technique=self.technique,
                  backend=self.backend)
+
+    def get_alignment(self) -> int:
+        # bitmatrix chunks are w packets; keep packets lane-aligned
+        # (lcm, not product: w=8 already divides the lane width)
+        import math
+
+        from ceph_tpu.ec.interface import DEFAULT_ALIGNMENT
+        if self._bitmatrix is not None:
+            return DEFAULT_ALIGNMENT * self.w // math.gcd(
+                DEFAULT_ALIGNMENT, self.w)
+        return DEFAULT_ALIGNMENT
 
     def is_mds(self) -> bool:
         return True
@@ -106,12 +151,19 @@ class ErasureCodeJax(ErasureCodeInterface):
 
     # -- decode -----------------------------------------------------------
     def _decode_kernel(self, avail: tuple[int, ...],
-                       want: tuple[int, ...]) -> _MatrixKernel:
+                       want: tuple[int, ...]):
         key = (avail, want)
         kern = self._decode_cache.get(key)
         if kern is None:
-            d = rs.decode_matrix(self.technique, self.k, self.m, avail, want)
-            kern = _MatrixKernel(d, self.backend)
+            if self._bitmatrix is not None:
+                from ceph_tpu.ec import bitmatrix as bmx
+                d = bmx.decode_bitmatrix(self._bitmatrix, self.k, self.m,
+                                         self.w, avail, want)
+                kern = _BitmatrixKernel(d, self.w)
+            else:
+                d = rs.decode_matrix(self.technique, self.k, self.m,
+                                     avail, want)
+                kern = _MatrixKernel(d, self.backend)
             self._decode_cache[key] = kern
             log.dout(10, "decode matrix built", avail=avail, want=want)
         return kern

@@ -128,14 +128,33 @@ def cauchy_good(k: int, m: int) -> np.ndarray:
     return c
 
 
+@functools.lru_cache(maxsize=None)
+def reed_sol_r6_op(k: int, m: int) -> np.ndarray:
+    """RAID-6 optimized RS: P = XOR of data, Q = sum 2^i * d_i
+    (ref: jerasure reed_sol.c reed_sol_r6_coding_matrix; m must be 2)."""
+    if m != 2:
+        raise ValueError(f"reed_sol_r6_op requires m=2, got {m}")
+    out = np.ones((2, k), dtype=np.uint8)
+    acc = 1
+    for i in range(1, k):
+        acc = tables.gf_mul(acc, 2)
+        out[1, i] = acc
+    return out
+
+
 TECHNIQUES = {
     "reed_sol_van": reed_sol_van,
+    "reed_sol_r6_op": reed_sol_r6_op,
     "cauchy_orig": cauchy_orig,
     "cauchy_good": cauchy_good,
     # ISA-L's two techniques are the same constructions
     # (ref: src/erasure-code/isa/ErasureCodeIsa.cc).
     "cauchy": cauchy_good,
 }
+
+# Techniques defined as raw GF(2) bitmatrices over w packets per chunk
+# (see ceph_tpu/ec/bitmatrix.py).
+BITMATRIX_TECHNIQUES = ("liberation", "blaum_roth", "liber8tion")
 
 
 def coding_matrix(technique: str, k: int, m: int) -> np.ndarray:

@@ -93,6 +93,46 @@ def gf_matmul_bytes(matrix: jax.Array, data: jax.Array) -> jax.Array:
     return xor_reduce(jnp.take(table, idx), axis=1)
 
 
+def gf2_matmul_bytes(bm: jax.Array, planes: jax.Array) -> jax.Array:
+    """GF(2) combine of byte rows on the MXU: out[i] = XOR_{j: bm[i,j]=1}
+    planes[j], for planes (R_in, L) uint8 -> (R_out, L) uint8.
+
+    The packet-granularity bitmatrix product of jerasure's array codes
+    (liberation/blaum_roth/liber8tion): each byte's 8 bits ride as
+    parallel lanes; the contraction is over packet rows only, realized as
+    one int8 matmul with a mod-2 epilogue.
+    """
+    r_in, L = planes.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((planes[:, None, :] >> shifts[None, :, None]) &
+            jnp.uint8(1)).astype(jnp.int8)          # (R_in, 8, L)
+    acc = jax.lax.dot_general(
+        bm.astype(jnp.int8), bits.reshape(r_in, 8 * L),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)           # (R_out, 8L)
+    b = (acc & 1).astype(jnp.uint8).reshape(-1, 8, L)
+    weights = (jnp.uint8(1) << shifts)
+    return jnp.sum(b * weights[None, :, None], axis=1, dtype=jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("w",))
+def bitmatrix_encode_stripes(bm: jax.Array, data: jax.Array,
+                             w: int) -> jax.Array:
+    """Batched packet-plane encode: data (B, k, C) with C % w == 0 ->
+    (B, rows_out/w, C). Each chunk is w packets of C/w bytes (jerasure's
+    word/packet layout); drive d's packets are bitmatrix rows
+    [d*w, (d+1)*w)."""
+    B, k, C = data.shape
+    ps = C // w
+    planes = data.reshape(B, k * w, ps)             # (B, kw, ps)
+    flat = jnp.transpose(planes, (1, 0, 2)).reshape(k * w, B * ps)
+    out = gf2_matmul_bytes(bm, flat)                # (mw, B*ps)
+    mw = out.shape[0]
+    m = mw // w
+    out = jnp.transpose(out.reshape(mw, B, ps), (1, 0, 2))
+    return out.reshape(B, m, C)
+
+
 @functools.partial(jax.jit, static_argnames=("backend",))
 def encode_stripes(bitmatrix: jax.Array, lo: jax.Array, hi: jax.Array,
                    data: jax.Array, backend: str = "bitmatmul") -> jax.Array:

@@ -206,3 +206,80 @@ class TestClay:
     def test_unsupported_d(self):
         with pytest.raises(NotImplementedError):
             ErasureCodeClay("plugin=clay k=4 m=2 d=4")
+
+
+class TestJerasureTechniqueBreadth:
+    """VERDICT round-1 item #8: reed_sol_r6_op + the bitmatrix family
+    (ref: ErasureCodeJerasure subclasses)."""
+
+    def test_r6_matrix_structure(self):
+        from ceph_tpu.ec.matrix import reed_sol_r6_op
+        from ceph_tpu.gf import tables
+        m = reed_sol_r6_op(6, 2)
+        assert (m[0] == 1).all()                 # P row = XOR
+        acc = 1
+        for i in range(6):
+            assert int(m[1, i]) == acc           # Q row = powers of 2
+            acc = tables.gf_mul(acc, 2)
+
+    @pytest.mark.parametrize("technique,k,params", [
+        ("reed_sol_r6_op", 4, ""),
+        ("reed_sol_r6_op", 6, ""),
+        ("liberation", 4, " w=7"),
+        ("liberation", 5, " w=5"),
+        ("blaum_roth", 4, " w=4"),
+        ("blaum_roth", 5, " w=6"),
+        ("liber8tion", 4, ""),
+        ("liber8tion", 6, ""),
+    ])
+    def test_roundtrip_all_erasure_patterns(self, technique, k, params):
+        from itertools import combinations
+
+        from ceph_tpu.ec import factory
+        ec = factory(f"plugin=jerasure technique={technique} k={k} m=2"
+                     + params)
+        rng = np.random.default_rng(17)
+        size = 4096
+        payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        enc = ec.encode(range(k + 2), payload)
+        # every 1- and 2-erasure pattern must decode byte-exactly (MDS)
+        for r in (1, 2):
+            for erased in combinations(range(k + 2), r):
+                have = {i: c for i, c in enc.items() if i not in erased}
+                got = ec.decode(list(erased), have)
+                for e in erased:
+                    assert got[e] == enc[e], (technique, erased, e)
+        assert ec.decode_concat({i: c for i, c in enc.items()
+                                 if i >= 2})[:size] == payload
+
+    def test_bitmatrix_mds_verified_at_build(self):
+        from ceph_tpu.ec.bitmatrix import (blaum_roth_bitmatrix, is_mds,
+                                           liber8tion_bitmatrix,
+                                           liberation_bitmatrix)
+        assert is_mds(liberation_bitmatrix(5, 7), 5, 2, 7)
+        assert is_mds(blaum_roth_bitmatrix(6, 6), 6, 2, 6)
+        assert is_mds(liber8tion_bitmatrix(5), 5, 2, 8)
+
+    def test_geometry_guards(self):
+        from ceph_tpu.ec import factory
+        with pytest.raises(Exception):
+            factory("plugin=jerasure technique=reed_sol_r6_op k=4 m=3")
+        with pytest.raises(Exception):
+            factory("plugin=jerasure technique=liberation k=4 m=2 w=6")
+        with pytest.raises(Exception):
+            factory("plugin=jerasure technique=blaum_roth k=4 m=2 w=7")
+
+    def test_bitmatrix_batched_device_path(self):
+        from ceph_tpu.ec import factory
+        ec = factory("plugin=jax technique=liber8tion k=4 m=2")
+        rng = np.random.default_rng(23)
+        data = rng.integers(0, 256, (5, 4, 1024), dtype=np.uint8)
+        parity = np.asarray(ec.encode_batch(data))
+        assert parity.shape == (5, 2, 1024)
+        # P drive is the XOR of data packets in every array code here
+        assert (parity[:, 0] == np.bitwise_xor.reduce(data, axis=1)).all()
+        full = np.concatenate([data, parity], axis=1)
+        out = np.asarray(ec.decode_batch([1, 4], [0, 2, 3, 5],
+                                         full[:, [0, 2, 3, 5]]))
+        assert (out[:, 0] == data[:, 1]).all()
+        assert (out[:, 1] == parity[:, 0]).all()
