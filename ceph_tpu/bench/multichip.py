@@ -1,0 +1,116 @@
+"""Device-scaling table: EC encode + CRUSH sweep at 1..N devices.
+
+Run under the virtual CPU mesh (multi-chip TPU hardware is unavailable in
+this environment; the driver validates the same shardings via
+__graft_entry__.dryrun_multichip):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python -m ceph_tpu.bench.multichip
+
+Scaling here demonstrates the SPMD structure (the EC path has zero
+collectives; the CRUSH sweep's only collective is one (max_devices,)
+psum), not absolute speed — virtual CPU devices share one physical core
+in this sandbox, so ideal speedups appear only on real meshes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from ceph_tpu.utils.platform import cli_main
+
+
+def ec_rate(mesh, n_devices: int, batch: int, C: int) -> float:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ceph_tpu.ec import matrix as rs
+    from ceph_tpu.gf import tables
+    from ceph_tpu.parallel import sharded_encode
+
+    k, m = 8, 3
+    coding = rs.coding_matrix("reed_sol_van", k, m)
+    bitmatrix = jnp.asarray(tables.expand_bitmatrix(coding), dtype=jnp.int8)
+    lo, hi = map(jnp.asarray, tables.nibble_tables(coding))
+    rng = np.random.default_rng(0)
+    data = jax.device_put(
+        jnp.asarray(rng.integers(0, 256, (batch, k, C), np.uint8)),
+        NamedSharding(mesh, P(mesh.axis_names[0], None, None)))
+    out = sharded_encode(mesh, bitmatrix, lo, hi, data)
+    np.asarray(out[0, 0, :1])            # sync
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = sharded_encode(mesh, bitmatrix, lo, hi, data)
+        np.asarray(out[0, 0, :1])
+        best = min(best, time.perf_counter() - t0)
+    return batch * k * C / best
+
+
+def crush_rate(mesh, mapper, n_pgs: int) -> float:
+    from ceph_tpu.parallel import sharded_crush_sweep
+
+    counts, _ = sharded_crush_sweep(mesh, mapper, 0, 0, n_pgs, 3)
+    np.asarray(counts)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        counts, _ = sharded_crush_sweep(mesh, mapper, 0, 0, n_pgs, 3)
+        np.asarray(counts)
+        best = min(best, time.perf_counter() - t0)
+    return n_pgs / best
+
+
+@cli_main
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(prog="multichip_bench")
+    ap.add_argument("--max-devices", type=int, default=0,
+                    help="0 = all available")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=64 << 10)
+    ap.add_argument("--crush-pgs", type=int, default=1 << 15)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from ceph_tpu.bench.crush_sweep import canonical_map
+    from ceph_tpu.crush.mapper import Mapper
+    from ceph_tpu.parallel import make_mesh
+
+    all_devices = jax.devices()
+    maxd = args.max_devices or len(all_devices)
+    mapper = Mapper(canonical_map(1024),
+                    block=max(1024, args.crush_pgs // maxd))
+    rows = []
+    sizes = []
+    d = 1
+    while d < maxd:
+        sizes.append(d)
+        d *= 2
+    sizes.append(maxd)          # always include the full device count
+    for d in sizes:
+        mesh = make_mesh(all_devices[:d])
+        ec = ec_rate(mesh, d, args.batch, args.chunk)
+        cr = crush_rate(mesh, mapper, args.crush_pgs)
+        rows.append({"devices": d,
+                     "ec_encode_MBps": round(ec / 1e6, 1),
+                     "crush_mappings_per_s": round(cr, 1)})
+        print(json.dumps(rows[-1]), flush=True)
+    out = {"platform": all_devices[0].platform, "table": rows}
+    if len(rows) > 1:
+        out["ec_scaling"] = round(rows[-1]["ec_encode_MBps"]
+                                  / rows[0]["ec_encode_MBps"], 2)
+        out["crush_scaling"] = round(
+            rows[-1]["crush_mappings_per_s"]
+            / rows[0]["crush_mappings_per_s"], 2)
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

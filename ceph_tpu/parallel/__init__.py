@@ -11,4 +11,5 @@ from ceph_tpu.parallel.mesh import make_mesh, local_mesh
 from ceph_tpu.parallel.sharded import (
     sharded_encode,
     sharded_decode,
+    sharded_crush_sweep,
 )

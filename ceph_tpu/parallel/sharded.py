@@ -42,3 +42,83 @@ def sharded_encode(mesh: Mesh, bitmatrix: jax.Array, lo: jax.Array,
 # shards to the primary (ref: src/osd/ECCommon.cc ReadPipeline); here the
 # stripe batch is already device-local, so reconstruction is collective-free.
 sharded_decode = sharded_encode
+
+
+_SWEEP_CACHE: dict = {}
+
+
+def sharded_crush_sweep(mesh: Mesh, mapper, ruleno: int, start_x: int,
+                        n: int, result_max: int):
+    """Aggregated CRUSH sweep with the PG range sharded over the mesh.
+
+    Multi-chip analog of Mapper.sweep: each device maps its local PG
+    range in mapper.block-sized tiles (bounding the straw2 int64 temps
+    exactly like the single-device path — pure SPMD, the packed map
+    tensors replicated, the x axis sharded) and accumulates local
+    per-device placement counts; ONE ``psum`` over ICI merges the count
+    vectors. This is the whole communication cost of scaling CRUSH: a
+    (max_devices,) reduction per sweep (SURVEY.md §5.8 — map
+    distribution is the only shared state).
+
+    n must divide evenly by the mesh size (caller pads). Returns
+    (counts (max_devices,), bad) replicated on every device.
+    """
+    from ceph_tpu.crush.mapper import ITEM_NONE, _rule_body
+
+    if getattr(mapper, "_scalar_reason", None):
+        raise ValueError(
+            f"map uses legacy tunables ({mapper._scalar_reason}); the "
+            f"scalar fallback cannot shard — use Mapper.sweep")
+    rule_key = mapper._rule_key(ruleno, result_max)
+    nd = mapper.packed.max_devices
+    firstn = mapper.rule_is_firstn(ruleno)
+    axis = mesh.axis_names[0]
+    ndev = mesh.devices.size
+    if n % ndev:
+        raise ValueError(f"n={n} must divide by {ndev} devices")
+    block = min(mapper.block, n // ndev)
+
+    cache_key = (rule_key, firstn, nd, mesh, block)
+    fn = _SWEEP_CACHE.get(cache_key)
+    if fn is None:
+        fn_body = _rule_body(*rule_key)
+
+        def local(arrs, xs):
+            local_n = xs.shape[0]
+            counts = jnp.zeros(nd + 1, dtype=jnp.int64)
+            bad = jnp.int64(0)
+            for lo in range(0, local_n, block):  # static tile loop
+                piece = xs[lo:lo + block]
+                if piece.shape[0] < block:
+                    piece = jnp.pad(piece, (0, block - piece.shape[0]))
+                    valid = jnp.arange(block) < local_n - lo
+                else:
+                    valid = None
+                w = fn_body(arrs, piece)         # (block, rmax)
+                live = w != ITEM_NONE
+                if valid is not None:
+                    live = live & valid[:, None]
+                flat = jnp.where(live, w, nd)
+                counts = counts.at[flat.reshape(-1)].add(jnp.int64(1))
+                if firstn:
+                    short = live.sum(axis=1) < result_max
+                    if valid is not None:
+                        short = short & valid
+                    bad = bad + short.sum(dtype=jnp.int64)
+            return (jax.lax.psum(counts[:nd], axis),
+                    jax.lax.psum(bad, axis))
+
+        # check_vma off: the rule VM's while_loop carries start from
+        # unvarying constants, which the varying-manual-axes checker
+        # rejects even though the computation is correctly per-shard
+        fn = jax.jit(jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=(P(), P()),
+            check_vma=False))
+        _SWEEP_CACHE[cache_key] = fn
+
+    with jax.enable_x64(True):
+        xs = start_x + jnp.arange(n, dtype=jnp.uint32)
+        counts, bad = fn(mapper.arrays, xs)
+        return counts, bad
