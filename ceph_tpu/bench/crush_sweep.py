@@ -107,9 +107,37 @@ def main(argv=None) -> dict:
     ap.add_argument("--num-rep", type=int, default=3)
     ap.add_argument("--block", type=int, default=None,
                     help="PGs per device block (default: auto from HBM)")
+    ap.add_argument("--checkpoint", default=None, metavar="PATH",
+                    help="resumable full sweep with per-chunk checkpoint "
+                         "(SURVEY.md §5.4); rerun with the same path to "
+                         "resume after an interruption")
+    ap.add_argument("--chunk", type=int, default=1 << 22,
+                    help="PGs per checkpoint chunk")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="write a jax.profiler trace of the sweep")
     args = ap.parse_args(argv)
-    res = sweep_rate(args.num_osds, args.num_pgs, args.num_rep,
-                     block=args.block)
+    from ceph_tpu.utils.profiling import trace
+    if args.checkpoint:
+        from ceph_tpu.utils.checkpoint import resumable_sweep
+        m = canonical_map(args.num_osds)
+        t0 = time.perf_counter()
+        with trace(args.profile):
+            state, done = resumable_sweep(
+                m, 0, args.num_pgs, args.num_rep, args.checkpoint,
+                chunk=args.chunk, mapper=Mapper(m, block=args.block))
+        res = {
+            "metric": "crush_resumable_sweep",
+            "done": done,
+            "cursor": state.cursor,
+            "n_pgs": state.n_total,
+            "bad_mappings": state.bad,
+            "placements": int(state.counts.sum()),
+            "seconds_this_run": round(time.perf_counter() - t0, 3),
+        }
+    else:
+        with trace(args.profile):
+            res = sweep_rate(args.num_osds, args.num_pgs, args.num_rep,
+                             block=args.block)
     print(json.dumps(res))
     return res
 

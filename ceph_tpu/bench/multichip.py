@@ -96,7 +96,8 @@ def main(argv=None) -> dict:
     for d in sizes:
         mesh = make_mesh(all_devices[:d])
         ec = ec_rate(mesh, d, args.batch, args.chunk)
-        cr = crush_rate(mesh, mapper, args.crush_pgs)
+        n_pgs = args.crush_pgs - args.crush_pgs % d   # shardable count
+        cr = crush_rate(mesh, mapper, n_pgs)
         rows.append({"devices": d,
                      "ec_encode_MBps": round(ec / 1e6, 1),
                      "crush_mappings_per_s": round(cr, 1)})

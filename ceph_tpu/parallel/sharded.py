@@ -44,7 +44,48 @@ def sharded_encode(mesh: Mesh, bitmatrix: jax.Array, lo: jax.Array,
 sharded_decode = sharded_encode
 
 
-_SWEEP_CACHE: dict = {}
+@functools.lru_cache(maxsize=64)
+def _compiled_sharded_sweep(rule_key, firstn, nd, mesh, block, local_n,
+                            result_max):
+    """Compiled shard_map sweep step (bounded cache, mirroring the
+    single-device _compiled_sweep's lru discipline)."""
+    from ceph_tpu.crush.mapper import ITEM_NONE, _rule_body
+
+    fn_body = _rule_body(*rule_key)
+    axis = mesh.axis_names[0]
+
+    def local(arrs, start_x):
+        # per-shard iota: nothing of O(n) is ever materialized globally
+        base = start_x + (jax.lax.axis_index(axis) *
+                          jnp.uint32(local_n))
+        counts = jnp.zeros(nd + 1, dtype=jnp.int64)
+        bad = jnp.int64(0)
+        for lo in range(0, local_n, block):      # static tile loop
+            width = min(block, local_n - lo)
+            xs = base + jnp.uint32(lo) + jnp.arange(block,
+                                                    dtype=jnp.uint32)
+            w = fn_body(arrs, xs)                # (block, rmax)
+            live = w != ITEM_NONE
+            if width < block:
+                live = live & (jnp.arange(block) < width)[:, None]
+            flat = jnp.where(live, w, nd)
+            counts = counts.at[flat.reshape(-1)].add(jnp.int64(1))
+            if firstn:
+                short = live.sum(axis=1) < result_max
+                if width < block:
+                    short = short & (jnp.arange(block) < width)
+                bad = bad + short.sum(dtype=jnp.int64)
+        return (jax.lax.psum(counts[:nd], axis),
+                jax.lax.psum(bad, axis))
+
+    # check_vma off: the rule VM's while_loop carries start from
+    # unvarying constants, which the varying-manual-axes checker
+    # rejects even though the computation is correctly per-shard
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False))
 
 
 def sharded_crush_sweep(mesh: Mesh, mapper, ruleno: int, start_x: int,
@@ -63,62 +104,18 @@ def sharded_crush_sweep(mesh: Mesh, mapper, ruleno: int, start_x: int,
     n must divide evenly by the mesh size (caller pads). Returns
     (counts (max_devices,), bad) replicated on every device.
     """
-    from ceph_tpu.crush.mapper import ITEM_NONE, _rule_body
-
     if getattr(mapper, "_scalar_reason", None):
         raise ValueError(
             f"map uses legacy tunables ({mapper._scalar_reason}); the "
             f"scalar fallback cannot shard — use Mapper.sweep")
-    rule_key = mapper._rule_key(ruleno, result_max)
-    nd = mapper.packed.max_devices
-    firstn = mapper.rule_is_firstn(ruleno)
-    axis = mesh.axis_names[0]
     ndev = mesh.devices.size
     if n % ndev:
         raise ValueError(f"n={n} must divide by {ndev} devices")
-    block = min(mapper.block, n // ndev)
-
-    cache_key = (rule_key, firstn, nd, mesh, block)
-    fn = _SWEEP_CACHE.get(cache_key)
-    if fn is None:
-        fn_body = _rule_body(*rule_key)
-
-        def local(arrs, xs):
-            local_n = xs.shape[0]
-            counts = jnp.zeros(nd + 1, dtype=jnp.int64)
-            bad = jnp.int64(0)
-            for lo in range(0, local_n, block):  # static tile loop
-                piece = xs[lo:lo + block]
-                if piece.shape[0] < block:
-                    piece = jnp.pad(piece, (0, block - piece.shape[0]))
-                    valid = jnp.arange(block) < local_n - lo
-                else:
-                    valid = None
-                w = fn_body(arrs, piece)         # (block, rmax)
-                live = w != ITEM_NONE
-                if valid is not None:
-                    live = live & valid[:, None]
-                flat = jnp.where(live, w, nd)
-                counts = counts.at[flat.reshape(-1)].add(jnp.int64(1))
-                if firstn:
-                    short = live.sum(axis=1) < result_max
-                    if valid is not None:
-                        short = short & valid
-                    bad = bad + short.sum(dtype=jnp.int64)
-            return (jax.lax.psum(counts[:nd], axis),
-                    jax.lax.psum(bad, axis))
-
-        # check_vma off: the rule VM's while_loop carries start from
-        # unvarying constants, which the varying-manual-axes checker
-        # rejects even though the computation is correctly per-shard
-        fn = jax.jit(jax.shard_map(
-            local, mesh=mesh,
-            in_specs=(P(), P(axis)),
-            out_specs=(P(), P()),
-            check_vma=False))
-        _SWEEP_CACHE[cache_key] = fn
-
+    local_n = n // ndev
+    block = min(mapper.block, local_n)
+    fn = _compiled_sharded_sweep(
+        mapper._rule_key(ruleno, result_max),
+        mapper.rule_is_firstn(ruleno), mapper.packed.max_devices,
+        mesh, block, local_n, result_max)
     with jax.enable_x64(True):
-        xs = start_x + jnp.arange(n, dtype=jnp.uint32)
-        counts, bad = fn(mapper.arrays, xs)
-        return counts, bad
+        return fn(mapper.arrays, jnp.uint32(start_x))

@@ -1,0 +1,55 @@
+"""Profiler harness: jax.profiler traces + MFU reporting.
+
+TPU analog of the reference's tracing stack (SURVEY.md §5.1: PerfCounters
++ LTTng/Blkin spans): ``trace()`` wraps ``jax.profiler.trace`` (Perfetto/
+TensorBoard-readable) around a benchmark region, degrading to a no-op on
+platforms where the profiler backend is unavailable (the remote-TPU
+tunnel in this sandbox does not export a profiler endpoint). MFU numbers
+come from ceph_tpu.utils.roofline and are embedded in every benchmark
+record, not here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("prof")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Profile the enclosed region into log_dir (None = no-op).
+
+    View with TensorBoard or ui.perfetto.dev. Failures to start the
+    profiler (unsupported backend) log and continue — profiling must
+    never break a benchmark run.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # pragma: no cover - platform dependent
+        log.dout(1, "profiler unavailable", error=str(e))
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                log.dout(1, "profile written", dir=log_dir)
+            except Exception as e:  # pragma: no cover
+                log.dout(1, "profiler stop failed", error=str(e))
+
+
+def annotate(name: str):
+    """Named sub-region (TraceAnnotation) for kernel attribution."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
