@@ -38,11 +38,16 @@ def parse_args(argv=None):
                                  description="CRUSH map tool (TPU-batched)")
     ap.add_argument("--build", action="store_true")
     ap.add_argument("-c", "--compile", metavar="FILE", default=None,
-                    help="load a crushmap text file")
-    ap.add_argument("-d", "--decompile", action="store_true",
-                    help="print the map back as crushmap text")
+                    help="compile a crushmap text file")
+    ap.add_argument("-i", "--infn", metavar="FILE", default=None,
+                    help="load a binary crushmap (crushtool -i)")
+    ap.add_argument("-d", "--decompile", metavar="FILE", nargs="?",
+                    const="", default=None,
+                    help="decompile to crushmap text (optionally from a "
+                         "binary FILE)")
     ap.add_argument("-o", "--outfn", metavar="FILE", default=None,
-                    help="write decompiled text here instead of stdout")
+                    help="output file: binary map after -c/--build, text "
+                         "after -d (ref crushtool semantics)")
     ap.add_argument("--num-osds", type=int, default=16)
     ap.add_argument("--hosts", type=int, default=0,
                     help="host count (0 = flat map)")
@@ -86,17 +91,21 @@ def build_map(args):
 @cli_main
 def main(argv=None) -> dict:
     args = parse_args(argv)
+    if args.compile and (args.infn or args.decompile):
+        raise SystemExit("-c conflicts with -i/-d FILE: one input source")
     if args.compile:
         from ceph_tpu.crush.compiler import compile_crushmap
         with open(args.compile) as f:
             m = compile_crushmap(f.read())
+    elif args.infn or args.decompile:
+        from ceph_tpu.encoding import decode_crush_map
+        with open(args.infn or args.decompile, "rb") as f:
+            m = decode_crush_map(f.read())
     elif args.build:
         m = build_map(args)
     else:
-        raise SystemExit("pass --build or --compile FILE")
-    if args.decompile or args.outfn:
-        # -o without -d writes the canonical text form too (our "compiled"
-        # representation IS the text format; there is no binary blob)
+        raise SystemExit("pass --build, --compile FILE, -i FILE or -d FILE")
+    if args.decompile is not None:
         from ceph_tpu.crush.compiler import decompile_crushmap
         text = decompile_crushmap(m)
         if args.outfn:
@@ -104,6 +113,10 @@ def main(argv=None) -> dict:
                 f.write(text)
         else:
             print(text, end="")
+    elif args.outfn:
+        from ceph_tpu.encoding import encode_crush_map
+        with open(args.outfn, "wb") as f:
+            f.write(encode_crush_map(m))
     out: dict = {"max_devices": m.max_devices,
                  "rules": {r.id: r.name for r in m.rules.values()}}
     if args.test:

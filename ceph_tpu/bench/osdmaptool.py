@@ -74,31 +74,56 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="max balancer optimization iterations")
     p.add_argument("--seed", type=int, default=1234)
     p.add_argument("--format", choices=("plain", "json"), default="plain")
+    p.add_argument("--mapfn", metavar="FILE", default=None,
+                   help="load a binary osdmap instead of --createsimple "
+                        "(ref: osdmaptool <mapfilename>)")
+    p.add_argument("--export", metavar="FILE", default=None,
+                   help="write the (possibly mutated) binary osdmap")
+    p.add_argument("--export-crush", metavar="FILE", default=None,
+                   help="write the map's crush blob "
+                        "(ref: osdmaptool --export-crush)")
+    p.add_argument("--import-crush", metavar="FILE", default=None,
+                   help="replace the map's crush blob "
+                        "(ref: osdmaptool --import-crush)")
     return p.parse_args(argv)
 
 
 @cli_main
 def main(argv=None) -> int:
     args = parse_args(argv)
-    m = create_simple(args.createsimple, args.pg_num, args.size,
-                      args.erasure, args.osds_per_host)
+    if args.mapfn:
+        from ceph_tpu.encoding import decode_osdmap
+        with open(args.mapfn, "rb") as f:
+            m = decode_osdmap(f.read())
+    else:
+        m = create_simple(args.createsimple, args.pg_num, args.size,
+                          args.erasure, args.osds_per_host)
+    if args.import_crush:
+        from ceph_tpu.encoding import decode_crush_map
+        with open(args.import_crush, "rb") as f:
+            m.set_crush(decode_crush_map(f.read()))
+    if not m.pools:
+        raise SystemExit("osdmap has no pools")
+    pool_id = next(iter(m.pools))
     for o in args.mark_down:
         m.mark_down(o)
     for o in args.mark_out:
         m.mark_out(o)
-    out: dict = {"osds": args.createsimple, "pg_num": args.pg_num,
-                 "size": args.size,
-                 "pool_type": "erasure" if args.erasure else "replicated"}
+    pool = m.pools[pool_id]
+    out: dict = {"osds": m.max_osd, "pg_num": pool.pg_num,
+                 "size": pool.size,
+                 "pool_type": "erasure" if pool.is_erasure()
+                 else "replicated"}
 
     if args.test_map_pgs or not args.churn:
         t0 = time.perf_counter()
-        up, upp, _, _ = m.map_pool(1)
+        up, upp, _, _ = m.map_pool(pool_id)
         dt = time.perf_counter() - t0
         util = np.bincount(up[up != ITEM_NONE], minlength=m.max_osd)
         in_osds = util[np.asarray(m.osd_weight) > 0]
         out["map_pgs"] = {
             "seconds": round(dt, 4),
-            "mappings_per_s": round(args.pg_num / max(dt, 1e-9)),
+            "mappings_per_s": round(pool.pg_num / max(dt, 1e-9)),
             "avg": round(float(in_osds.mean()), 2),
             "min": int(in_osds.min()), "max": int(in_osds.max()),
             "stddev": round(float(in_osds.std()), 2),
@@ -107,7 +132,7 @@ def main(argv=None) -> int:
 
     if args.upmap:
         def devstats():
-            util = m.pool_utilization(1).astype(np.float64)
+            util = m.pool_utilization(pool_id).astype(np.float64)
             inmask = np.asarray(m.osd_weight) > 0
             tgt = util[inmask].sum() / max(inmask.sum(), 1)
             dev = util[inmask] - tgt
@@ -126,7 +151,7 @@ def main(argv=None) -> int:
         }
 
     if args.churn:
-        sim = ChurnSim(m, 1)
+        sim = ChurnSim(m, pool_id)
         rng = np.random.default_rng(args.seed)
         t0 = time.perf_counter()
         reports = sim.random_thrash(rng, args.churn)
@@ -137,6 +162,14 @@ def main(argv=None) -> int:
             **sim.summary(),
         }
 
+    if args.export:
+        from ceph_tpu.encoding import encode_osdmap
+        with open(args.export, "wb") as f:
+            f.write(encode_osdmap(m))
+    if args.export_crush:
+        from ceph_tpu.encoding import encode_crush_map
+        with open(args.export_crush, "wb") as f:
+            f.write(encode_crush_map(m.crush))
     if args.format == "json":
         print(json.dumps(out, indent=2))
     else:

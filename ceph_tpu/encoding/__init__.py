@@ -1,0 +1,14 @@
+from ceph_tpu.encoding.denc import (
+    BufferList, Decoder, Encoder, EncodingError,
+)
+from ceph_tpu.encoding.maps import (
+    decode_crush_map, decode_incremental, decode_osdmap,
+    encode_crush_map, encode_incremental, encode_osdmap,
+)
+
+__all__ = [
+    "BufferList", "Decoder", "Encoder", "EncodingError",
+    "encode_crush_map", "decode_crush_map",
+    "encode_osdmap", "decode_osdmap",
+    "encode_incremental", "decode_incremental",
+]
