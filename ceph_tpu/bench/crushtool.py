@@ -91,8 +91,11 @@ def build_map(args):
 @cli_main
 def main(argv=None) -> dict:
     args = parse_args(argv)
-    if args.compile and (args.infn or args.decompile):
-        raise SystemExit("-c conflicts with -i/-d FILE: one input source")
+    sources = [s for s in (args.compile, args.infn, args.decompile or None)
+               if s]
+    if len(sources) > 1 or (sources and args.build):
+        raise SystemExit("conflicting input sources: pick ONE of "
+                         "--build / -c FILE / -i FILE / -d FILE")
     if args.compile:
         from ceph_tpu.crush.compiler import compile_crushmap
         with open(args.compile) as f:

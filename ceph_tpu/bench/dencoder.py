@@ -164,6 +164,19 @@ def main(argv=None) -> int:
             if typ is None:
                 print(f"unknown type {args[i]}", file=sys.stderr)
                 return 1
+        elif cmd in ("count_tests", "select_test", "encode", "decode",
+                     "dump_json") and typ is None:
+            print(f"'{cmd}' requires a preceding 'type T'",
+                  file=sys.stderr)
+            return 1
+        elif cmd in ("decode", "export", "hexdump") and blob is None:
+            print(f"'{cmd}' requires encoded bytes (encode/import first)",
+                  file=sys.stderr)
+            return 1
+        elif cmd in ("encode", "dump_json") and obj is None:
+            print(f"'{cmd}' requires an object (select_test/decode first)",
+                  file=sys.stderr)
+            return 1
         elif cmd == "count_tests":
             print(len(typ["tests"]), file=out)
         elif cmd == "select_test":

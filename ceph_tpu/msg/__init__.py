@@ -1,0 +1,13 @@
+from ceph_tpu.msg.auth import AuthError, Authenticator, Keyring
+from ceph_tpu.msg.message import Message, message_class, register
+from ceph_tpu.msg.messenger import (
+    MODE_CRC, MODE_SECURE, Connection, ConnectionError_, Dispatcher,
+    EntityAddr, Messenger, Policy, Throttle,
+)
+
+__all__ = [
+    "AuthError", "Authenticator", "Keyring",
+    "Message", "message_class", "register",
+    "Connection", "ConnectionError_", "Dispatcher", "EntityAddr",
+    "Messenger", "Policy", "Throttle", "MODE_CRC", "MODE_SECURE",
+]

@@ -1,0 +1,495 @@
+"""Async messenger: connections, dispatch, policies — msgr2-lite.
+
+ref: src/msg/async/AsyncMessenger.{h,cc} + ProtocolV2.{h,cc}. Same
+architecture mapped onto asyncio instead of epoll threads:
+
+- ``Messenger`` owns a listening socket plus a connection table keyed by
+  peer address; ``Dispatcher``s get ms_dispatch/ms_handle_reset
+  callbacks (ref: src/msg/Dispatcher.h).
+- The wire protocol performs a banner + cephx-lite auth exchange, then
+  length-prefixed frames carrying MSG/ACK/KEEPALIVE tags with a crc32
+  trailer ('crc' mode) or an HMAC trailer ('secure' mode)
+  (ref: ProtocolV2 banner/auth frames, crc vs secure modes).
+- ``Policy`` decides lossy vs lossless: lossless client connections
+  keep unacked messages and resend them after a reconnect (the
+  stateful-session half of ProtocolV2's reconnect/replay); lossy
+  connections drop state on failure (ref: Messenger::Policy).
+- Fault injection: ``inject_socket_failures=N`` kills roughly one in N
+  frame sends/receives (ref: 'ms inject socket failures' config used by
+  the qa suites).
+
+The reference's throttles (Policy::throttler_bytes) become a bytes
+semaphore gating dispatch of incoming messages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import traceback
+import zlib
+from dataclasses import dataclass
+
+from ceph_tpu.msg.auth import Authenticator, AuthError, Keyring
+from ceph_tpu.msg.message import Message
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("ms")
+
+BANNER = b"ceph_tpu msgr2.1\n"
+
+TAG_MSG = 1
+TAG_ACK = 2
+TAG_KEEPALIVE = 3
+
+MODE_CRC = 1
+MODE_SECURE = 2
+
+
+class ConnectionError_(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class EntityAddr:
+    """ref: src/msg/msg_types.h entity_addr_t (host:port; the nonce that
+    distinguishes daemon restarts is the messenger's session id)."""
+
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclass
+class Policy:
+    """ref: Messenger::Policy — lossy connections are dropped on error
+    (client->osd); lossless ones resend (osd->osd, mon peers)."""
+
+    lossy: bool = True
+    throttler_bytes: int = 0     # 0 = unthrottled
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False)
+
+
+class Throttle:
+    """Byte-budget gate (ref: src/common/Throttle.{h,cc})."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self._used = 0
+        self._cond = asyncio.Condition()
+
+    async def acquire(self, n: int) -> None:
+        if not self.limit:
+            return
+        n = min(n, self.limit)
+        async with self._cond:
+            while self._used + n > self.limit:
+                await self._cond.wait()
+            self._used += n
+
+    async def release(self, n: int) -> None:
+        if not self.limit:
+            return
+        n = min(n, self.limit)
+        async with self._cond:
+            self._used -= n
+            self._cond.notify_all()
+
+
+class Connection:
+    """One established session (ref: AsyncConnection). Owned by a
+    Messenger; users only call send_message / close."""
+
+    def __init__(self, msgr: "Messenger", reader, writer,
+                 peer_name: str, peer_addr: EntityAddr | None,
+                 auth: Authenticator | None, policy: Policy,
+                 peer_session: int = 0):
+        self.msgr = msgr
+        self.reader = reader
+        self.writer = writer
+        self.peer_name = peer_name
+        self.peer_addr = peer_addr        # set for outgoing connections
+        self.peer_session = peer_session  # peer's messenger instance nonce
+        self.auth = auth
+        self.policy = policy
+        self.out_seq = 0
+        self.in_seq = 0
+        self.unacked: list[tuple[int, bytes]] = []   # lossless replay queue
+        self.closed = False
+        self._send_lock = asyncio.Lock()
+        self._reader_task: asyncio.Task | None = None
+
+    # -- framing -----------------------------------------------------------
+    def _trailer(self, seq: int, body: bytes) -> bytes:
+        if self.msgr.mode == MODE_SECURE and self.auth:
+            return self.auth.frame_mac(seq, body)
+        return zlib.crc32(body).to_bytes(4, "little")
+
+    async def _send_frame(self, tag: int, seq: int, body: bytes) -> None:
+        if self.msgr._inject_failure():
+            self._abort()
+            raise ConnectionError_("injected socket failure (send)")
+        head = tag.to_bytes(1, "little") + seq.to_bytes(8, "little")
+        frame = head + body
+        trailer = self._trailer(seq, frame)
+        try:
+            self.writer.write(len(frame).to_bytes(4, "little") + frame +
+                              trailer)
+            await self.writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._abort()
+            raise ConnectionError_(str(e)) from e
+
+    async def _recv_frame(self) -> tuple[int, int, bytes]:
+        try:
+            ln = int.from_bytes(await self.reader.readexactly(4), "little")
+            if ln > self.msgr.max_frame:
+                raise ConnectionError_(f"oversized frame {ln}")
+            frame = await self.reader.readexactly(ln)
+            tlen = 16 if (self.msgr.mode == MODE_SECURE and self.auth) \
+                else 4
+            trailer = await self.reader.readexactly(tlen)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            raise ConnectionError_(str(e)) from e
+        if self.msgr._inject_failure():
+            self._abort()
+            raise ConnectionError_("injected socket failure (recv)")
+        tag = frame[0]
+        seq = int.from_bytes(frame[1:9], "little")
+        if self._trailer(seq, frame) != trailer:
+            raise ConnectionError_("frame integrity check failed")
+        return tag, seq, frame[9:]
+
+    # -- public ------------------------------------------------------------
+    async def send_message(self, msg: Message) -> None:
+        """Queue-and-send with at-least-once semantics on lossless
+        connections (resent after reconnect until acked)."""
+        async with self._send_lock:
+            self.out_seq += 1
+            msg.seq = self.out_seq
+            body = msg.encode()
+            if not self.policy.lossy:
+                self.unacked.append((self.out_seq, body))
+            try:
+                await self._send_frame(TAG_MSG, self.out_seq, body)
+            except ConnectionError_:
+                if self.policy.lossy:
+                    raise
+                # lossless: reconnect + replay happens in _resend path
+                await self.msgr._reconnect_and_replay(self)
+
+    async def _ack(self, seq: int) -> None:
+        await self._send_frame(TAG_ACK, seq, b"")
+
+    def _handle_ack(self, seq: int) -> None:
+        self.unacked = [(s, b) for s, b in self.unacked if s > seq]
+
+    def _abort(self) -> None:
+        self.closed = True
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    async def close(self) -> None:
+        self._abort()
+        if self._reader_task:
+            self._reader_task.cancel()
+
+
+class Dispatcher:
+    """ref: src/msg/Dispatcher.h — implement in daemons."""
+
+    async def ms_dispatch(self, msg: Message) -> bool:
+        raise NotImplementedError
+
+    async def ms_handle_reset(self, conn: Connection) -> None:
+        pass
+
+
+class Messenger:
+    """ref: Messenger::create + AsyncMessenger. One per daemon."""
+
+    def __init__(self, name: str, keyring: Keyring | None = None,
+                 mode: int = MODE_CRC,
+                 default_policy: Policy | None = None,
+                 inject_socket_failures: int = 0,
+                 max_frame: int = 64 << 20,
+                 seed: int | None = None):
+        self.name = name                  # entity name, e.g. "osd.3"
+        self.keyring = keyring
+        if mode == MODE_SECURE and keyring is None:
+            raise ValueError("secure mode requires a keyring "
+                             "(frame MACs need a session key)")
+        self.mode = mode
+        self.handshake_timeout = 5.0
+        self.policy = default_policy or Policy()
+        self.peer_policies: dict[str, Policy] = {}  # entity type -> policy
+        self.max_frame = max_frame
+        self.inject_socket_failures = inject_socket_failures
+        self._rng = random.Random(seed)
+        # instance nonce: distinguishes this daemon incarnation so peers
+        # reset replay-dedup state after a restart (ref: entity_addr_t
+        # nonce + ProtocolV2 session cookies)
+        self.session_id = random.SystemRandom().getrandbits(63)
+        # lossless replay dedup survives TCP reconnects: peer name ->
+        # [peer session_id, last delivered seq]
+        self._peer_in_seq: dict[str, list[int]] = {}
+        self.dispatchers: list[Dispatcher] = []
+        self.conns: dict[EntityAddr, Connection] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self.addr: EntityAddr | None = None
+        self.throttle: Throttle | None = None
+        self._accepted: set[Connection] = set()
+
+    # -- setup -------------------------------------------------------------
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def set_policy(self, entity_type: str, policy: Policy) -> None:
+        """Per-peer-type policy (ref: Messenger::set_policy)."""
+        self.peer_policies[entity_type] = policy
+
+    def _policy_for(self, peer_name: str) -> Policy:
+        etype = peer_name.split(".", 1)[0]
+        return self.peer_policies.get(etype, self.policy)
+
+    def _restore_in_seq(self, conn: Connection) -> None:
+        """Lossless replay dedup across TCP reconnects: the same peer
+        incarnation resumes at its last delivered seq; a restarted peer
+        (new session id) starts fresh."""
+        if conn.policy.lossy:
+            return
+        state = self._peer_in_seq.get(conn.peer_name)
+        if state is None or state[0] != conn.peer_session:
+            state = [conn.peer_session, 0]
+            self._peer_in_seq[conn.peer_name] = state
+        conn.in_seq = state[1]
+
+    def _inject_failure(self) -> bool:
+        n = self.inject_socket_failures
+        return bool(n) and self._rng.randrange(n) == 0
+
+    async def bind(self, host: str = "127.0.0.1",
+                   port: int = 0) -> EntityAddr:
+        self._server = await asyncio.start_server(self._accept, host, port)
+        sock = self._server.sockets[0]
+        self.addr = EntityAddr(*sock.getsockname()[:2])
+        if self.policy.throttler_bytes:
+            self.throttle = Throttle(self.policy.throttler_bytes)
+        return self.addr
+
+    # -- handshake ---------------------------------------------------------
+    async def _accept(self, reader, writer) -> None:
+        try:
+            conn = await asyncio.wait_for(
+                self._server_handshake(reader, writer),
+                timeout=self.handshake_timeout)
+        except (AuthError, ConnectionError_, ConnectionError, OSError,
+                asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+            log.dout(5, f"accept failed: {e}")
+            writer.close()
+            return
+        self._accepted.add(conn)
+        conn._reader_task = asyncio.ensure_future(self._reader_loop(conn))
+
+    async def _server_handshake(self, reader, writer) -> Connection:
+        # banner carries the auth-required flag so an auth-mode mismatch
+        # fails fast instead of deadlocking mid-handshake
+        writer.write(BANNER + (b"\x01" if self.keyring else b"\x00"))
+        await writer.drain()
+        if await reader.readexactly(len(BANNER)) != BANNER:
+            raise ConnectionError_("bad banner")
+        peer_auth = await reader.readexactly(1)
+        if (peer_auth == b"\x01") != (self.keyring is not None):
+            raise AuthError("auth-mode mismatch with peer")
+        # client hello: name + session id + nonce
+        nlen = int.from_bytes(await reader.readexactly(2), "little")
+        peer_name = (await reader.readexactly(nlen)).decode()
+        peer_session = int.from_bytes(await reader.readexactly(8), "little")
+        client_nonce = await reader.readexactly(16)
+        auth = None
+        if self.keyring is not None:
+            auth = Authenticator(self.name, self.keyring.get(peer_name))
+            # send our nonce + server proof
+            proof = auth.server_respond(client_nonce)
+            writer.write(auth.nonce + proof)
+            await writer.drain()
+            client_proof = await reader.readexactly(32)
+            auth.verify_client(client_nonce, client_proof)
+            writer.write(b"OK")
+        else:
+            writer.write(b"NA")
+        await writer.drain()
+        conn = Connection(self, reader, writer, peer_name, None, auth,
+                          self._policy_for(peer_name),
+                          peer_session=peer_session)
+        self._restore_in_seq(conn)
+        return conn
+
+    async def _client_handshake(self, addr: EntityAddr,
+                                peer_name: str) -> Connection:
+        reader, writer = await asyncio.open_connection(addr.host, addr.port)
+        try:
+            return await asyncio.wait_for(
+                self._client_handshake_inner(reader, writer, addr,
+                                             peer_name),
+                timeout=self.handshake_timeout)
+        except BaseException:
+            writer.close()
+            raise
+
+    async def _client_handshake_inner(self, reader, writer,
+                                      addr: EntityAddr,
+                                      peer_name: str) -> Connection:
+        if await reader.readexactly(len(BANNER)) != BANNER:
+            raise ConnectionError_("bad banner")
+        peer_auth = await reader.readexactly(1)
+        if (peer_auth == b"\x01") != (self.keyring is not None):
+            raise AuthError("auth-mode mismatch with peer")
+        writer.write(BANNER + (b"\x01" if self.keyring else b"\x00"))
+        name_b = self.name.encode()
+        hello = len(name_b).to_bytes(2, "little") + name_b + \
+            self.session_id.to_bytes(8, "little")
+        auth = None
+        if self.keyring is not None:
+            auth = Authenticator(self.name, self.keyring.get(self.name))
+            writer.write(hello + auth.nonce)
+            await writer.drain()
+            server_nonce = await reader.readexactly(16)
+            server_proof = await reader.readexactly(32)
+            auth.verify_server(server_nonce, server_proof)
+            writer.write(auth.client_prove(server_nonce))
+            await writer.drain()
+        else:
+            writer.write(hello + b"\x00" * 16)
+            await writer.drain()
+        status = await reader.readexactly(2)
+        if status not in (b"OK", b"NA"):
+            raise AuthError("handshake rejected")
+        return Connection(self, reader, writer, peer_name, addr, auth,
+                          self._policy_for(peer_name))
+
+    # -- connection table --------------------------------------------------
+    async def connect(self, addr: EntityAddr,
+                      peer_name: str = "?") -> Connection:
+        conn = self.conns.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        if conn is not None and not conn.policy.lossy:
+            # a dead lossless conn carries session state (out_seq +
+            # unacked); a fresh handshake would restart at seq 1 and the
+            # peer's dedup would drop everything — resume instead
+            await self._reconnect_and_replay(conn)
+            return self.conns[addr]
+        conn = await self._client_handshake(addr, peer_name)
+        self.conns[addr] = conn
+        conn._reader_task = asyncio.ensure_future(self._reader_loop(conn))
+        return conn
+
+    async def send_message(self, msg: Message, addr: EntityAddr,
+                           peer_name: str = "?") -> None:
+        conn = await self.connect(addr, peer_name)
+        await conn.send_message(msg)
+
+    async def _reconnect_and_replay(self, conn: Connection) -> None:
+        """Lossless reconnect: new session, replay unacked in order
+        (ref: ProtocolV2 session reconnect + out_queue replay)."""
+        if conn.peer_addr is None:
+            return      # server side waits for the client to come back
+        # Generous retry budget: under fault injection each attempt may
+        # die mid-replay, but acks prune the queue so attempts shrink
+        # (the reference retries forever with backoff; we bound it)
+        for attempt in range(40):
+            try:
+                fresh = await self._client_handshake(conn.peer_addr,
+                                                     conn.peer_name)
+            except (ConnectionError_, ConnectionError, OSError,
+                    asyncio.IncompleteReadError):
+                await asyncio.sleep(0.05 * (attempt + 1))
+                continue
+            fresh.out_seq = conn.out_seq
+            fresh.unacked = list(conn.unacked)
+            self.conns[conn.peer_addr] = fresh
+            fresh._reader_task = asyncio.ensure_future(
+                self._reader_loop(fresh))
+            try:
+                for seq, body in fresh.unacked:
+                    await fresh._send_frame(TAG_MSG, seq, body)
+                return
+            except ConnectionError_:
+                continue
+        raise ConnectionError_(
+            f"reconnect to {conn.peer_addr} failed after retries")
+
+    # -- dispatch ----------------------------------------------------------
+    async def _reader_loop(self, conn: Connection) -> None:
+        while not conn.closed:
+            try:
+                tag, seq, body = await conn._recv_frame()
+            except ConnectionError_:
+                conn._abort()
+                for d in self.dispatchers:
+                    await d.ms_handle_reset(conn)
+                return
+            except asyncio.CancelledError:
+                return
+            if tag == TAG_ACK:
+                conn._handle_ack(seq)
+                continue
+            if tag == TAG_KEEPALIVE:
+                continue
+            if not conn.policy.lossy:
+                # ack even duplicates so a replaying peer can prune
+                try:
+                    await conn._ack(seq)
+                except ConnectionError_:
+                    pass
+            if seq <= conn.in_seq:
+                continue        # duplicate after replay
+            conn.in_seq = seq
+            if not conn.policy.lossy:
+                state = self._peer_in_seq.get(conn.peer_name)
+                if state is not None and state[0] == conn.peer_session:
+                    state[1] = seq
+            try:
+                msg = Message.decode(body)
+            except Exception as e:
+                log.dout(1, f"undecodable message from {conn.peer_name}: {e}")
+                continue
+            msg.src = conn.peer_name
+            msg.conn = conn
+            if self.throttle:
+                await self.throttle.acquire(len(body))
+            try:
+                handled = False
+                for d in self.dispatchers:
+                    if await d.ms_dispatch(msg):
+                        handled = True
+                        break
+                if not handled:
+                    log.dout(10, f"unhandled {msg!r} from {conn.peer_name}")
+            except Exception:
+                log.error(f"dispatch of {type(msg).__name__} failed: "
+                          f"{traceback.format_exc()}")
+            finally:
+                if self.throttle:
+                    await self.throttle.release(len(body))
+
+    # -- teardown ----------------------------------------------------------
+    async def shutdown(self) -> None:
+        if self._server:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        for conn in list(self.conns.values()) + list(self._accepted):
+            await conn.close()
+        self.conns.clear()
+        self._accepted.clear()

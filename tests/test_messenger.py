@@ -1,0 +1,271 @@
+"""Messenger tier: handshake, dispatch, auth, loss, injection.
+
+ref test model: src/test/msgr/test_msgr.cc (MessengerTest) — client/
+server pairs exercising delivery, policies, reconnect and fault
+injection on localhost sockets.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import (
+    MODE_SECURE, AuthError, Dispatcher, Keyring, Message, Messenger,
+    Policy, register,
+)
+from ceph_tpu.msg.messenger import ConnectionError_
+
+
+@register
+class MPing(Message):
+    TYPE = 900
+    FIELDS = [("x", "u64"), ("note", "str")]
+
+
+@register
+class MData(Message):
+    TYPE = 901
+    FIELDS = [("oid", "str"), ("data", "blob"), ("osds", "list:s32")]
+
+
+class Collector(Dispatcher):
+    def __init__(self):
+        self.got = []
+        self.resets = 0
+        self.event = asyncio.Event()
+
+    async def ms_dispatch(self, msg):
+        self.got.append(msg)
+        self.event.set()
+        return True
+
+    async def ms_handle_reset(self, conn):
+        self.resets += 1
+
+
+async def _wait(pred, timeout=5.0):
+    t0 = asyncio.get_event_loop().time()
+    while not pred():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise TimeoutError
+        await asyncio.sleep(0.01)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _keyring(*names):
+    kr = Keyring()
+    for n in names:
+        kr.add(n)
+    return kr
+
+
+def test_basic_roundtrip_with_auth():
+    async def go():
+        kr = _keyring("osd.1", "client.a")
+        server = Messenger("osd.1", keyring=kr)
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("client.a", keyring=kr)
+        await client.send_message(
+            MData(oid="obj1", data=b"\x01\x02", osds=[3, -1]), addr,
+            "osd.1")
+        await _wait(lambda: sink.got)
+        m = sink.got[0]
+        assert isinstance(m, MData)
+        assert (m.oid, m.data, m.osds) == ("obj1", b"\x01\x02", [3, -1])
+        assert m.src == "client.a"
+        # reply over the incoming connection
+        reply_sink = Collector()
+        client.add_dispatcher(reply_sink)
+        await m.conn.send_message(MPing(x=7, note="reply"))
+        await _wait(lambda: reply_sink.got)
+        assert reply_sink.got[0].x == 7
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_auth_rejects_wrong_key():
+    async def go():
+        server = Messenger("mon.a", keyring=_keyring("mon.a", "client.x"))
+        await server.bind()
+        bad = Messenger("client.x", keyring=_keyring("mon.a", "client.x"))
+        # tamper: different secret than the server's for client.x
+        bad.keyring.add("client.x")
+        with pytest.raises((AuthError, ConnectionError_, OSError,
+                            asyncio.IncompleteReadError)):
+            await bad.send_message(MPing(x=1, note=""), server.addr,
+                                   "mon.a")
+        await bad.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_unknown_entity_rejected():
+    async def go():
+        server = Messenger("mon.a", keyring=_keyring("mon.a"))
+        await server.bind()
+        kr = _keyring("mon.a")
+        kr.add("client.ghost")
+        ghost = Messenger("client.ghost", keyring=kr)
+        with pytest.raises((AuthError, ConnectionError_, OSError,
+                            asyncio.IncompleteReadError)):
+            await ghost.send_message(MPing(x=1, note=""), server.addr,
+                                     "mon.a")
+        await ghost.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_secure_mode_frames():
+    async def go():
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr, mode=MODE_SECURE)
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr, mode=MODE_SECURE)
+        for i in range(5):
+            await client.send_message(MPing(x=i, note="s"), addr, "osd.1")
+        await _wait(lambda: len(sink.got) == 5)
+        assert [m.x for m in sink.got] == list(range(5))
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_lossless_replay_exactly_once_under_injection():
+    """Injected socket failures on a lossless peer link: every message
+    still arrives, in order, exactly once (the qa thrash invariant)."""
+    async def go():
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr)
+        server.set_policy("osd", Policy.lossless_peer())
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr,
+                           inject_socket_failures=12, seed=7)
+        client.set_policy("osd", Policy.lossless_peer())
+        n = 40
+        for i in range(n):
+            # injected failures surface as reconnect+replay inside
+            await client.send_message(MPing(x=i, note="inj"), addr,
+                                      "osd.1")
+        client.inject_socket_failures = 0
+        await _wait(lambda: len(sink.got) >= n, timeout=15)
+        xs = [m.x for m in sink.got]
+        assert xs == sorted(set(xs)), "duplicates or reordering"
+        assert xs == list(range(n))
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_lossy_connection_raises_on_failure():
+    async def go():
+        kr = _keyring("client.a", "osd.1")
+        server = Messenger("osd.1", keyring=kr)
+        server.add_dispatcher(Collector())
+        addr = await server.bind()
+        client = Messenger("client.a", keyring=kr,
+                           inject_socket_failures=1, seed=3)
+        with pytest.raises(ConnectionError_):
+            for _ in range(50):
+                await client.send_message(MPing(x=0, note=""), addr,
+                                          "osd.1")
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_throttled_dispatch_delivers_all():
+    async def go():
+        kr = _keyring("client.a", "osd.1")
+        server = Messenger("osd.1", keyring=kr,
+                           default_policy=Policy(lossy=True,
+                                                 throttler_bytes=256))
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("client.a", keyring=kr)
+        for i in range(20):
+            await client.send_message(
+                MData(oid=f"o{i}", data=b"x" * 100, osds=[]), addr,
+                "osd.1")
+        await _wait(lambda: len(sink.got) == 20)
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_no_auth_mode():
+    async def go():
+        server = Messenger("mon.a")       # no keyring: auth disabled
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("client.a")
+        await client.send_message(MPing(x=3, note="open"), addr, "mon.a")
+        await _wait(lambda: sink.got)
+        assert sink.got[0].x == 3
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_message_registry_duplicate_type_rejected():
+    with pytest.raises(ValueError):
+        @register
+        class Clash(Message):
+            TYPE = 900
+            FIELDS = []
+
+
+def test_auth_mode_mismatch_fails_fast():
+    async def go():
+        server = Messenger("mon.a")               # no auth
+        await server.bind()
+        kr = _keyring("mon.a", "client.a")
+        client = Messenger("client.a", keyring=kr)  # auth required
+        with pytest.raises((AuthError, ConnectionError_, OSError,
+                            asyncio.IncompleteReadError)):
+            await client.send_message(MPing(x=1, note=""), server.addr,
+                                      "mon.a")
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_secure_mode_requires_keyring():
+    with pytest.raises(ValueError):
+        Messenger("osd.0", mode=MODE_SECURE)
+
+
+def test_lossless_resumes_after_reader_side_abort():
+    """A conn killed from the reader path must not silently lose later
+    messages (the fresh handshake must inherit seq + unacked)."""
+    async def go():
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr)
+        server.set_policy("osd", Policy.lossless_peer())
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr)
+        client.set_policy("osd", Policy.lossless_peer())
+        await client.send_message(MPing(x=1, note=""), addr, "osd.1")
+        await _wait(lambda: len(sink.got) == 1)
+        # simulate a reader-side failure: abort the live connection
+        conn = client.conns[addr]
+        conn._abort()
+        await client.send_message(MPing(x=2, note=""), addr, "osd.1")
+        await _wait(lambda: len(sink.got) == 2)
+        assert [m.x for m in sink.got] == [1, 2]
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
