@@ -1,5 +1,5 @@
 from ceph_tpu.msg.auth import AuthError, Authenticator, Keyring
-from ceph_tpu.msg.message import Message, message_class, register
+from ceph_tpu.msg.message import Message, register
 from ceph_tpu.msg.messenger import (
     MODE_CRC, MODE_SECURE, Connection, ConnectionError_, Dispatcher,
     EntityAddr, Messenger, Policy, Throttle,
@@ -7,7 +7,7 @@ from ceph_tpu.msg.messenger import (
 
 __all__ = [
     "AuthError", "Authenticator", "Keyring",
-    "Message", "message_class", "register",
+    "Message", "register",
     "Connection", "ConnectionError_", "Dispatcher", "EntityAddr",
     "Messenger", "Policy", "Throttle", "MODE_CRC", "MODE_SECURE",
 ]

@@ -58,7 +58,7 @@ def _mac(key: bytes, *parts: bytes) -> bytes:
 class Authenticator:
     """One side of the handshake. The messenger drives:
 
-    client: c = client_hello(); (send c) ... verify_server(reply)
+    client: send (name, nonce) ... client_prove / verify_server
     server: reply = server_respond(c) ... session key agreed
     """
 
@@ -69,9 +69,6 @@ class Authenticator:
         self.session_key = b""
 
     # -- client side -------------------------------------------------------
-    def client_hello(self) -> tuple[str, bytes]:
-        return self.name, self.nonce
-
     def client_prove(self, server_nonce: bytes) -> bytes:
         """MAC over both nonces — proves we hold the secret."""
         self.session_key = _mac(self.secret, b"session", self.nonce,

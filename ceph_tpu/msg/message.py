@@ -26,10 +26,6 @@ def register(cls: type["Message"]) -> type["Message"]:
     return cls
 
 
-def message_class(code: int) -> type["Message"]:
-    return _REGISTRY[code]
-
-
 # field codecs for the declarative spec
 _ENC: dict[str, Callable] = {
     "u8": lambda e, v: e.u8(v), "u16": lambda e, v: e.u16(v),

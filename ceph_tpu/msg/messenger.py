@@ -25,6 +25,7 @@ semaphore gating dispatch of incoming messages.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import random
 import traceback
 import zlib
@@ -101,6 +102,16 @@ class Throttle:
             self._cond.notify_all()
 
 
+class _Session:
+    """Per-peer-address lossless session state shared by every TCP
+    connection to that peer (ref: ProtocolV2 session cookies/out_queue:
+    the logical session outlives individual sockets)."""
+
+    def __init__(self) -> None:
+        self.out_seq = 0
+        self.unacked: list[tuple[int, bytes]] = []
+
+
 class Connection:
     """One established session (ref: AsyncConnection). Owned by a
     Messenger; users only call send_message / close."""
@@ -120,6 +131,9 @@ class Connection:
         self.out_seq = 0
         self.in_seq = 0
         self.unacked: list[tuple[int, bytes]] = []   # lossless replay queue
+        # outgoing lossless conns share per-peer-address session state
+        # (seq counter + replay queue) across reconnects
+        self.session: "_Session | None" = None
         self.closed = False
         self._send_lock = asyncio.Lock()
         self._reader_task: asyncio.Task | None = None
@@ -148,8 +162,8 @@ class Connection:
     async def _recv_frame(self) -> tuple[int, int, bytes]:
         try:
             ln = int.from_bytes(await self.reader.readexactly(4), "little")
-            if ln > self.msgr.max_frame:
-                raise ConnectionError_(f"oversized frame {ln}")
+            if ln < 9 or ln > self.msgr.max_frame:
+                raise ConnectionError_(f"bad frame length {ln}")
             frame = await self.reader.readexactly(ln)
             tlen = 16 if (self.msgr.mode == MODE_SECURE and self.auth) \
                 else 4
@@ -161,33 +175,47 @@ class Connection:
             raise ConnectionError_("injected socket failure (recv)")
         tag = frame[0]
         seq = int.from_bytes(frame[1:9], "little")
-        if self._trailer(seq, frame) != trailer:
+        if not hmac.compare_digest(self._trailer(seq, frame), trailer):
             raise ConnectionError_("frame integrity check failed")
         return tag, seq, frame[9:]
 
     # -- public ------------------------------------------------------------
     async def send_message(self, msg: Message) -> None:
-        """Queue-and-send with at-least-once semantics on lossless
-        connections (resent after reconnect until acked)."""
+        """Queue-and-send with at-least-once semantics on outgoing
+        lossless connections (resent after reconnect until acked).
+        Server-side (accepted) connections cannot reconnect — a failed
+        send raises so the caller knows the reply was lost and the peer
+        must re-request (ref: OSD replies on reset client sessions)."""
         async with self._send_lock:
-            self.out_seq += 1
-            msg.seq = self.out_seq
+            sess = self.session
+            if sess is not None:
+                sess.out_seq += 1
+                seq = sess.out_seq
+            else:
+                self.out_seq += 1
+                seq = self.out_seq
+            msg.seq = seq
             body = msg.encode()
             if not self.policy.lossy:
-                self.unacked.append((self.out_seq, body))
+                (sess.unacked if sess is not None
+                 else self.unacked).append((seq, body))
             try:
-                await self._send_frame(TAG_MSG, self.out_seq, body)
+                await self._send_frame(TAG_MSG, seq, body)
             except ConnectionError_:
-                if self.policy.lossy:
+                if self.policy.lossy or sess is None:
                     raise
-                # lossless: reconnect + replay happens in _resend path
-                await self.msgr._reconnect_and_replay(self)
+                await self.msgr._reconnect_and_replay(self.peer_addr,
+                                                      self.peer_name)
 
     async def _ack(self, seq: int) -> None:
         await self._send_frame(TAG_ACK, seq, b"")
 
     def _handle_ack(self, seq: int) -> None:
-        self.unacked = [(s, b) for s, b in self.unacked if s > seq]
+        if self.session is not None:
+            self.session.unacked = [
+                (s, b) for s, b in self.session.unacked if s > seq]
+        else:
+            self.unacked = [(s, b) for s, b in self.unacked if s > seq]
 
     def _abort(self) -> None:
         self.closed = True
@@ -242,6 +270,8 @@ class Messenger:
         self._peer_in_seq: dict[str, list[int]] = {}
         self.dispatchers: list[Dispatcher] = []
         self.conns: dict[EntityAddr, Connection] = {}
+        self._sessions: dict[EntityAddr, _Session] = {}
+        self._conn_locks: dict[EntityAddr, asyncio.Lock] = {}
         self._server: asyncio.AbstractServer | None = None
         self.addr: EntityAddr | None = None
         self.throttle: Throttle | None = None
@@ -271,6 +301,10 @@ class Messenger:
             self._peer_in_seq[conn.peer_name] = state
         conn.in_seq = state[1]
 
+    def _banner_flags(self) -> int:
+        return (1 if self.keyring is not None else 0) | \
+            (2 if self.mode == MODE_SECURE else 0)
+
     def _inject_failure(self) -> bool:
         n = self.inject_socket_failures
         return bool(n) and self._rng.randrange(n) == 0
@@ -299,15 +333,15 @@ class Messenger:
         conn._reader_task = asyncio.ensure_future(self._reader_loop(conn))
 
     async def _server_handshake(self, reader, writer) -> Connection:
-        # banner carries the auth-required flag so an auth-mode mismatch
-        # fails fast instead of deadlocking mid-handshake
-        writer.write(BANNER + (b"\x01" if self.keyring else b"\x00"))
+        # banner carries auth+mode flags so a mismatch fails fast
+        # instead of deadlocking/desyncing mid-stream
+        writer.write(BANNER + bytes([self._banner_flags()]))
         await writer.drain()
         if await reader.readexactly(len(BANNER)) != BANNER:
             raise ConnectionError_("bad banner")
-        peer_auth = await reader.readexactly(1)
-        if (peer_auth == b"\x01") != (self.keyring is not None):
-            raise AuthError("auth-mode mismatch with peer")
+        peer_flags = (await reader.readexactly(1))[0]
+        if peer_flags != self._banner_flags():
+            raise AuthError("auth/mode mismatch with peer")
         # client hello: name + session id + nonce
         nlen = int.from_bytes(await reader.readexactly(2), "little")
         peer_name = (await reader.readexactly(nlen)).decode()
@@ -349,10 +383,10 @@ class Messenger:
                                       peer_name: str) -> Connection:
         if await reader.readexactly(len(BANNER)) != BANNER:
             raise ConnectionError_("bad banner")
-        peer_auth = await reader.readexactly(1)
-        if (peer_auth == b"\x01") != (self.keyring is not None):
-            raise AuthError("auth-mode mismatch with peer")
-        writer.write(BANNER + (b"\x01" if self.keyring else b"\x00"))
+        peer_flags = (await reader.readexactly(1))[0]
+        if peer_flags != self._banner_flags():
+            raise AuthError("auth/mode mismatch with peer")
+        writer.write(BANNER + bytes([self._banner_flags()]))
         name_b = self.name.encode()
         hello = len(name_b).to_bytes(2, "little") + name_b + \
             self.session_id.to_bytes(8, "little")
@@ -376,68 +410,85 @@ class Messenger:
                           self._policy_for(peer_name))
 
     # -- connection table --------------------------------------------------
+    def _attach(self, addr: EntityAddr, conn: Connection) -> None:
+        if not conn.policy.lossy:
+            conn.session = self._sessions.setdefault(addr, _Session())
+        self.conns[addr] = conn
+        conn._reader_task = asyncio.ensure_future(self._reader_loop(conn))
+
     async def connect(self, addr: EntityAddr,
                       peer_name: str = "?") -> Connection:
         conn = self.conns.get(addr)
         if conn is not None and not conn.closed:
             return conn
-        if conn is not None and not conn.policy.lossy:
-            # a dead lossless conn carries session state (out_seq +
-            # unacked); a fresh handshake would restart at seq 1 and the
-            # peer's dedup would drop everything — resume instead
-            await self._reconnect_and_replay(conn)
-            return self.conns[addr]
-        conn = await self._client_handshake(addr, peer_name)
-        self.conns[addr] = conn
-        conn._reader_task = asyncio.ensure_future(self._reader_loop(conn))
-        return conn
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self.conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+            if conn is not None and not conn.policy.lossy:
+                # the logical session (seq + unacked) outlives sockets:
+                # resume it so the peer's dedup state stays coherent
+                await self._reconnect_locked(addr, conn.peer_name)
+                return self.conns[addr]
+            conn = await self._client_handshake(addr, peer_name)
+            self._attach(addr, conn)
+            return conn
 
     async def send_message(self, msg: Message, addr: EntityAddr,
                            peer_name: str = "?") -> None:
         conn = await self.connect(addr, peer_name)
         await conn.send_message(msg)
 
-    async def _reconnect_and_replay(self, conn: Connection) -> None:
-        """Lossless reconnect: new session, replay unacked in order
-        (ref: ProtocolV2 session reconnect + out_queue replay)."""
-        if conn.peer_addr is None:
-            return      # server side waits for the client to come back
-        # Generous retry budget: under fault injection each attempt may
-        # die mid-replay, but acks prune the queue so attempts shrink
-        # (the reference retries forever with backoff; we bound it)
+    async def _reconnect_and_replay(self, addr: EntityAddr,
+                                    peer_name: str) -> None:
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            await self._reconnect_locked(addr, peer_name)
+
+    async def _reconnect_locked(self, addr: EntityAddr,
+                                peer_name: str) -> None:
+        """Lossless reconnect: fresh socket, same session; replay the
+        session's unacked queue in order (ref: ProtocolV2 session
+        reconnect + out_queue replay). Acks prune the queue between
+        attempts, so retries shrink under fault injection."""
+        sess = self._sessions.setdefault(addr, _Session())
         for attempt in range(40):
+            conn = self.conns.get(addr)
+            if conn is None or conn.closed:
+                try:
+                    conn = await self._client_handshake(addr, peer_name)
+                except (ConnectionError_, ConnectionError, OSError,
+                        asyncio.IncompleteReadError):
+                    await asyncio.sleep(0.05 * (attempt + 1))
+                    continue
+                self._attach(addr, conn)
             try:
-                fresh = await self._client_handshake(conn.peer_addr,
-                                                     conn.peer_name)
-            except (ConnectionError_, ConnectionError, OSError,
-                    asyncio.IncompleteReadError):
-                await asyncio.sleep(0.05 * (attempt + 1))
-                continue
-            fresh.out_seq = conn.out_seq
-            fresh.unacked = list(conn.unacked)
-            self.conns[conn.peer_addr] = fresh
-            fresh._reader_task = asyncio.ensure_future(
-                self._reader_loop(fresh))
-            try:
-                for seq, body in fresh.unacked:
-                    await fresh._send_frame(TAG_MSG, seq, body)
+                for seq, body in list(sess.unacked):
+                    await conn._send_frame(TAG_MSG, seq, body)
                 return
             except ConnectionError_:
                 continue
         raise ConnectionError_(
-            f"reconnect to {conn.peer_addr} failed after retries")
+            f"reconnect to {addr} failed after retries")
 
     # -- dispatch ----------------------------------------------------------
     async def _reader_loop(self, conn: Connection) -> None:
+        try:
+            await self._reader_loop_inner(conn)
+        finally:
+            self._accepted.discard(conn)
+
+    async def _reader_loop_inner(self, conn: Connection) -> None:
         while not conn.closed:
             try:
                 tag, seq, body = await conn._recv_frame()
-            except ConnectionError_:
+            except asyncio.CancelledError:
+                return
+            except Exception:           # ConnectionError_ or corrupt peer
                 conn._abort()
                 for d in self.dispatchers:
                     await d.ms_handle_reset(conn)
-                return
-            except asyncio.CancelledError:
                 return
             if tag == TAG_ACK:
                 conn._handle_ack(seq)

@@ -1,0 +1,11 @@
+from ceph_tpu.os_.kv import WALDB, KeyValueDB, KVTransaction, MemDB
+from ceph_tpu.os_.objectstore import (
+    ChecksumError, MemStore, ObjectStore, StoreError, Transaction,
+    WALStore,
+)
+
+__all__ = [
+    "KeyValueDB", "KVTransaction", "MemDB", "WALDB",
+    "ObjectStore", "Transaction", "MemStore", "WALStore",
+    "StoreError", "ChecksumError",
+]

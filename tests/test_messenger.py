@@ -269,3 +269,40 @@ def test_lossless_resumes_after_reader_side_abort():
         await client.shutdown()
         await server.shutdown()
     run(go())
+
+
+def test_concurrent_first_sends_single_connection():
+    """Racing first sends must share one connection + session."""
+    async def go():
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr)
+        server.set_policy("osd", Policy.lossless_peer())
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr)
+        client.set_policy("osd", Policy.lossless_peer())
+        await asyncio.gather(*[
+            client.send_message(MPing(x=i, note="race"), addr, "osd.1")
+            for i in range(10)])
+        await _wait(lambda: len(sink.got) == 10)
+        assert sorted(m.x for m in sink.got) == list(range(10))
+        assert len(client.conns) == 1
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_crc_vs_secure_mode_mismatch_fails_fast():
+    async def go():
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr, mode=MODE_SECURE)
+        await server.bind()
+        client = Messenger("osd.0", keyring=kr)   # MODE_CRC
+        with pytest.raises((AuthError, ConnectionError_, OSError,
+                            asyncio.IncompleteReadError)):
+            await client.send_message(MPing(x=1, note=""), server.addr,
+                                      "osd.1")
+        await client.shutdown()
+        await server.shutdown()
+    run(go())

@@ -1,0 +1,199 @@
+"""ObjectStore tier: transactions, stores, crash recovery, fsck.
+
+ref test model: src/test/objectstore/store_test.cc — the same op
+sequences run against every store implementation, plus WAL crash
+semantics and checksum verification for the durable store.
+"""
+
+import os
+import zlib
+
+import pytest
+
+from ceph_tpu.os_ import (
+    ChecksumError, KVTransaction, MemDB, MemStore, StoreError,
+    Transaction, WALDB, WALStore,
+)
+
+
+def stores(tmp_path):
+    return [MemStore(), WALStore(str(tmp_path / "w"))]
+
+
+def test_kv_memdb_batch_and_iter():
+    db = MemDB()
+    t = db.get_transaction()
+    t.set("p", "b", b"2").set("p", "a", b"1").set("q", "x", b"9")
+    t.rmkey("p", "missing")
+    db.submit_transaction(t)
+    assert db.get("p", "a") == b"1"
+    assert list(db.get_iterator("p")) == [("a", b"1"), ("b", b"2")]
+    t2 = db.get_transaction().rmkeys_by_prefix("p")
+    db.submit_transaction(t2)
+    assert db.get("p", "a") is None
+    assert db.get("q", "x") == b"9"
+
+
+def test_waldb_durability_and_compaction(tmp_path):
+    path = str(tmp_path / "kv")
+    db = WALDB(path)
+    for i in range(10):
+        db.submit_transaction(
+            db.get_transaction().set("p", f"k{i}", bytes([i])))
+    db.compact()
+    db.submit_transaction(db.get_transaction().set("p", "after", b"z"))
+    db.close()
+    db2 = WALDB(path)
+    assert db2.get("p", "k7") == bytes([7])
+    assert db2.get("p", "after") == b"z"
+    db2.close()
+
+
+def test_waldb_torn_tail_discarded(tmp_path):
+    path = str(tmp_path / "kv")
+    db = WALDB(path)
+    db.submit_transaction(db.get_transaction().set("p", "good", b"1"))
+    db.submit_transaction(db.get_transaction().set("p", "torn", b"2"))
+    db.close()
+    wal = os.path.join(path, WALDB.WAL)
+    sz = os.path.getsize(wal)
+    with open(wal, "r+b") as f:      # simulate crash mid-append
+        f.truncate(sz - 3)
+    db2 = WALDB(path)
+    assert db2.get("p", "good") == b"1"
+    assert db2.get("p", "torn") is None      # torn record discarded
+    # and the tail was reset cleanly: new writes replay fine
+    db2.submit_transaction(db2.get_transaction().set("p", "new", b"3"))
+    db2.close()
+    db3 = WALDB(path)
+    assert db3.get("p", "new") == b"3"
+    db3.close()
+
+
+def test_kv_transaction_codec():
+    t = KVTransaction()
+    t.set("a", "k", b"v").rmkey("b", "x").rmkeys_by_prefix("c")
+    t2 = KVTransaction.decode(t.encode())
+    assert t2.ops == t.ops
+
+
+def test_transaction_codec_all_ops():
+    t = Transaction()
+    t.create_collection("1.0").touch("1.0", "o")
+    t.write("1.0", "o", 4, b"abc").zero("1.0", "o", 0, 2)
+    t.truncate("1.0", "o", 100)
+    t.setattrs("1.0", "o", {"_": b"oi"}).rmattr("1.0", "o", "_")
+    t.clone("1.0", "o", "o2").omap_setkeys("1.0", "o", {"k": b"v"})
+    t.omap_rmkeys("1.0", "o", ["k"]).omap_clear("1.0", "o")
+    t.remove("1.0", "o2").remove_collection("1.0")
+    t2 = Transaction.decode(t.encode())
+    assert t2.ops == t.ops
+
+
+@pytest.mark.parametrize("which", ["mem", "wal"])
+def test_object_semantics(tmp_path, which):
+    st = MemStore() if which == "mem" else WALStore(str(tmp_path / "w"))
+    t = Transaction().create_collection("1.0")
+    t.write("1.0", "obj", 0, b"hello world")
+    t.write("1.0", "obj", 6, b"ceph!")       # overwrite tail
+    t.setattrs("1.0", "obj", {"_": b"meta"})
+    t.omap_setkeys("1.0", "obj", {"snap": b"1"})
+    st.queue_transaction(t)
+    assert st.read("1.0", "obj") == b"hello ceph!"
+    assert st.read("1.0", "obj", 6, 4) == b"ceph"
+    assert st.stat("1.0", "obj") == 11
+    assert st.getattrs("1.0", "obj") == {"_": b"meta"}
+    assert st.omap_get("1.0", "obj") == {"snap": b"1"}
+    # zero extends, truncate shrinks
+    st.queue_transaction(Transaction().zero("1.0", "obj", 9, 4))
+    assert st.read("1.0", "obj") == b"hello cep\x00\x00\x00\x00"
+    st.queue_transaction(Transaction().truncate("1.0", "obj", 5))
+    assert st.read("1.0", "obj") == b"hello"
+    # clone copies everything
+    st.queue_transaction(Transaction().clone("1.0", "obj", "obj2"))
+    assert st.read("1.0", "obj2") == b"hello"
+    assert st.omap_get("1.0", "obj2") == {"snap": b"1"}
+    assert st.list_objects("1.0") == ["obj", "obj2"]
+    # remove
+    st.queue_transaction(Transaction().remove("1.0", "obj"))
+    assert not st.exists("1.0", "obj")
+    assert st.exists("1.0", "obj2")
+    with pytest.raises(StoreError):
+        st.read("1.0", "obj")
+
+
+def test_missing_collection_raises(tmp_path):
+    st = MemStore()
+    with pytest.raises(StoreError):
+        st.queue_transaction(Transaction().touch("nope", "o"))
+
+
+def test_walstore_reopen_preserves_state(tmp_path):
+    path = str(tmp_path / "w")
+    st = WALStore(path)
+    t = Transaction().create_collection("2.1")
+    t.write("2.1", "a", 0, b"x" * 1000)
+    t.omap_setkeys("2.1", "a", {"pglog.1": b"entry"})
+    t.create_collection("2.2")
+    st.queue_transaction(t)
+    st.umount()
+    st2 = WALStore(path)
+    assert st2.list_collections() == ["2.1", "2.2"]
+    assert st2.read("2.1", "a") == b"x" * 1000
+    assert st2.omap_get("2.1", "a") == {"pglog.1": b"entry"}
+    assert st2.fsck() == []
+    st2.umount()
+
+
+def test_walstore_crash_atomicity(tmp_path):
+    """A transaction torn mid-WAL-append is entirely absent on reopen."""
+    path = str(tmp_path / "w")
+    st = WALStore(path)
+    st.queue_transaction(
+        Transaction().create_collection("1.0").write("1.0", "a", 0, b"A"))
+    st.queue_transaction(
+        Transaction().write("1.0", "a", 0, b"B").write("1.0", "b", 0,
+                                                       b"new"))
+    st.umount()
+    wal = os.path.join(path, WALDB.WAL)
+    with open(wal, "r+b") as f:
+        f.truncate(os.path.getsize(wal) - 2)   # tear the second txn
+    st2 = WALStore(path)
+    assert st2.read("1.0", "a") == b"A"        # first txn intact
+    assert not st2.exists("1.0", "b")          # second fully gone
+    assert st2.fsck() == []
+    st2.umount()
+
+
+def test_walstore_checksum_detects_corruption(tmp_path):
+    path = str(tmp_path / "w")
+    st = WALStore(path)
+    st.queue_transaction(
+        Transaction().create_collection("1.0").write(
+            "1.0", "a", 0, b"payload-payload-payload"))
+    # corrupt the in-kv record's data bytes directly (bit rot)
+    key = WALStore._okey("1.0", "a")
+    rec = bytearray(st.db.get("O", key))
+    rec[10] ^= 0xFF
+    st.db.submit_transaction(
+        st.db.get_transaction().set("O", key, bytes(rec)))
+    st.umount()
+    st2 = WALStore(path)
+    assert any("checksum" in e for e in st2.fsck())
+    with pytest.raises(ChecksumError):
+        st2.read("1.0", "a")
+    st2.umount()
+
+
+def test_walstore_rmcoll_removes_objects(tmp_path):
+    path = str(tmp_path / "w")
+    st = WALStore(path)
+    st.queue_transaction(
+        Transaction().create_collection("1.0")
+        .write("1.0", "a", 0, b"1").write("1.0", "b", 0, b"2"))
+    st.queue_transaction(Transaction().remove_collection("1.0"))
+    st.umount()
+    st2 = WALStore(path)
+    assert st2.list_collections() == []
+    assert list(st2.db.get_iterator("O")) == []
+    st2.umount()
