@@ -160,6 +160,13 @@ def _dec_pool(d: Decoder) -> PGPool:
 
 # -- OSDMap ---------------------------------------------------------------
 
+def _enc_addr(e: Encoder, a: tuple) -> None:
+    e.string(a[0]).u32(a[1]).u32(a[2] if len(a) > 2 else 0)
+
+
+def _dec_addr(d: Decoder) -> tuple:
+    return (d.string(), d.u32(), d.u32())
+
 def _enc_i64_array(e: Encoder, a: np.ndarray) -> None:
     e.blob(np.asarray(a, dtype="<i8").tobytes())
 
@@ -173,7 +180,7 @@ def encode_osdmap(m) -> bytes:
     monitor store value)."""
     e = Encoder()
     e.u32(OSDMAP_MAGIC)
-    with e.start(1):
+    with e.start(2):
         e.u32(m.epoch)
         e.blob(encode_crush_map(m.crush))
         e.u32(m.max_osd)
@@ -189,6 +196,7 @@ def encode_osdmap(m) -> bytes:
         e.map(m.pg_upmap_items, enc_pg_t,
               lambda e, v: e.list(
                   v, lambda e, pr: e.s32(pr[0]).s32(pr[1])))
+        e.map(m.osd_addrs, lambda e, k: e.s32(k), _enc_addr)   # v2
     return e.tobytes()
 
 
@@ -197,7 +205,7 @@ def decode_osdmap(data: bytes):
     d = Decoder(data)
     if d.u32() != OSDMAP_MAGIC:
         raise EncodingError("bad osdmap magic")
-    with d.start(1):
+    with d.start(2) as _v:
         epoch = d.u32()
         crush = decode_crush_map(d.blob())
         max_osd = d.u32()
@@ -213,6 +221,8 @@ def decode_osdmap(data: bytes):
             dec_pg_t, lambda d: tuple(d.list(lambda d: d.s32())))
         m.pg_upmap_items = d.map(
             dec_pg_t, lambda d: d.list(lambda d: (d.s32(), d.s32())))
+        if _v >= 2:
+            m.osd_addrs = d.map(lambda d: d.s32(), _dec_addr)
     return m
 
 
@@ -220,7 +230,7 @@ def encode_incremental(inc) -> bytes:
     """ref: OSDMap::Incremental::encode — the delta the monitor commits
     per epoch and OSDs apply on subscription."""
     e = Encoder()
-    with e.start(1):
+    with e.start(2):
         e.u32(inc.epoch)
         e.optional(inc.new_max_osd, lambda e, v: e.u32(v))
         e.map(inc.new_pools, lambda e, k: e.s64(k), _enc_pool)
@@ -243,6 +253,9 @@ def encode_incremental(inc) -> bytes:
         e.list(inc.old_pg_upmap_items, enc_pg_t)
         e.optional(inc.new_crush,
                    lambda e, c: e.blob(encode_crush_map(c)))
+        e.map(inc.new_addrs, lambda e, k: e.s32(k), _enc_addr)    # v2
+        e.map(inc.new_state, lambda e, k: e.s32(k),
+              lambda e, v: e.s32(v))                              # v2
     return e.tobytes()
 
 
@@ -250,7 +263,7 @@ def decode_incremental(data: bytes):
     from ceph_tpu.osd.osdmap import Incremental
     d = Decoder(data)
     inc = Incremental()
-    with d.start(1):
+    with d.start(2) as _v:
         inc.epoch = d.u32()
         inc.new_max_osd = d.optional(lambda d: d.u32())
         inc.new_pools = d.map(lambda d: d.s64(), _dec_pool)
@@ -270,4 +283,7 @@ def decode_incremental(data: bytes):
             dec_pg_t, lambda d: d.list(lambda d: (d.s32(), d.s32())))
         inc.old_pg_upmap_items = d.list(dec_pg_t)
         inc.new_crush = d.optional(lambda d: decode_crush_map(d.blob()))
+        if _v >= 2:
+            inc.new_addrs = d.map(lambda d: d.s32(), _dec_addr)
+            inc.new_state = d.map(lambda d: d.s32(), lambda d: d.s32())
     return inc

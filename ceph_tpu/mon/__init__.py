@@ -1,0 +1,5 @@
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.mon.monitor import Monitor, MonMap
+from ceph_tpu.mon.store import MonitorDBStore
+
+__all__ = ["Monitor", "MonMap", "MonClient", "MonitorDBStore"]

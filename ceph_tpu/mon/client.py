@@ -1,0 +1,143 @@
+"""MonClient: the daemons'/clients' window into the monitor cluster.
+
+ref: src/mon/MonClient.{h,cc} — connects to a monitor, authenticates
+(messenger handshake), sends commands with leader-redirect retry,
+subscribes to maps, and maintains the local OSDMap by applying
+published incrementals (ref: MonClient::_send_command hunting +
+sub_want/renew_subs; Objecter applies the maps).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.encoding import decode_incremental, decode_osdmap
+from ceph_tpu.mon.messages import (
+    MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe, MOSDMap,
+)
+from ceph_tpu.mon.monitor import MonMap
+from ceph_tpu.msg import Dispatcher, Keyring, Messenger
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("monc")
+
+
+class MonClient(Dispatcher):
+    def __init__(self, name: str, monmap: MonMap,
+                 keyring: Keyring | None = None,
+                 messenger: Messenger | None = None):
+        self.name = name
+        self.monmap = monmap
+        self.msgr = messenger or Messenger(name, keyring=keyring)
+        self.msgr.add_dispatcher(self)
+        self._tid = 0
+        self._command_waiters: dict[int, asyncio.Future] = {}
+        self._cur_rank = self.monmap.ranks()[0]
+        self.osdmap = None
+        self._osdmap_waiters: list[asyncio.Future] = []
+        self.map_callbacks: list = []          # async fn(osdmap)
+
+    # -- dispatch ----------------------------------------------------------
+    async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MMonCommandAck):
+            fut = self._command_waiters.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result((msg.retcode, msg.rs, msg.outbl))
+            return True
+        if isinstance(msg, MOSDMap):
+            await self._handle_osdmap(msg)
+            return True
+        if isinstance(msg, MMonMap):
+            self.monmap = MonMap.decode(msg.monmap)
+            return True
+        return False
+
+    async def _handle_osdmap(self, m: MOSDMap) -> None:
+        if m.full:
+            epoch = max(m.full)
+            self.osdmap = decode_osdmap(m.full[epoch])
+        for e in sorted(m.incrementals):
+            if self.osdmap is not None and \
+                    e == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(
+                    decode_incremental(m.incrementals[e]))
+        for fut in self._osdmap_waiters:
+            if not fut.done():
+                fut.set_result(self.osdmap)
+        self._osdmap_waiters.clear()
+        for cb in self.map_callbacks:
+            await cb(self.osdmap)
+
+    # -- commands ----------------------------------------------------------
+    async def command(self, cmd: dict | str, inbl: bytes = b"",
+                      timeout: float = 30.0) -> tuple[int, str, bytes]:
+        """Send a command, following leader redirects
+        (ref: MonClient::start_mon_command + forwarding)."""
+        payload = json.dumps(cmd) if isinstance(cmd, dict) else \
+            json.dumps({"prefix": cmd})
+        deadline = asyncio.get_event_loop().time() + timeout
+        last_err = "timed out"
+        tried_hunt = 0
+        while asyncio.get_event_loop().time() < deadline:
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_event_loop().create_future()
+            self._command_waiters[tid] = fut
+            try:
+                await self.msgr.send_message(
+                    MMonCommand(tid=tid, cmd=payload, inbl=inbl),
+                    self.monmap.addr_of_rank(self._cur_rank),
+                    f"mon.{self.monmap.name_of_rank(self._cur_rank)}")
+                # generous per-attempt wait: a first CRUSH-mapper jit
+                # compile on a small host can block the mon for >10 s
+                ret, rs, outbl = await asyncio.wait_for(
+                    fut, timeout=min(15.0, deadline -
+                                     asyncio.get_event_loop().time()))
+            except (asyncio.TimeoutError, Exception) as e:
+                self._command_waiters.pop(tid, None)
+                last_err = str(e) or type(e).__name__
+                # hunt: try the next monitor (ref: MonClient::_reopen)
+                ranks = self.monmap.ranks()
+                tried_hunt += 1
+                self._cur_rank = ranks[(ranks.index(self._cur_rank) + 1)
+                                       % len(ranks)]
+                await asyncio.sleep(0.05)
+                continue
+            if ret == -11:               # EAGAIN: redirect or retry
+                if rs.startswith("leader="):
+                    leader = int(rs.split("=", 1)[1])
+                    if leader >= 0:
+                        self._cur_rank = leader
+                await asyncio.sleep(0.05)
+                continue
+            return ret, rs, outbl
+        return -110, f"command timed out ({last_err})", b""   # -ETIMEDOUT
+
+    # -- maps --------------------------------------------------------------
+    async def subscribe(self, what: str = "osdmap",
+                        start: int = 0) -> None:
+        """ref: MonClient::sub_want + renew_subs."""
+        await self.msgr.send_message(
+            MMonSubscribe(what={what: str(start)}),
+            self.monmap.addr_of_rank(self._cur_rank),
+            f"mon.{self.monmap.name_of_rank(self._cur_rank)}")
+
+    async def wait_for_osdmap(self, min_epoch: int = 1,
+                              timeout: float = 10.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.osdmap is None or self.osdmap.epoch < min_epoch:
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("no osdmap")
+            fut = asyncio.get_event_loop().create_future()
+            self._osdmap_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=1.0)
+            except asyncio.TimeoutError:
+                await self.subscribe("osdmap",
+                                     0 if self.osdmap is None
+                                     else self.osdmap.epoch + 1)
+        return self.osdmap
+
+    async def shutdown(self) -> None:
+        await self.msgr.shutdown()

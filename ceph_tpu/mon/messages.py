@@ -1,0 +1,134 @@
+"""Monitor wire messages.
+
+ref: src/messages/MMonElection.h, MMonPaxos.h, MMonCommand.h,
+MMonSubscribe.h, MOSDBoot.h, MOSDFailure.h, MOSDMap.h — the control
+plane's message set, declared with the msg field-spec codecs.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.msg.message import Message, register
+
+# election ops (ref: MMonElection::OP_*)
+ELECTION_PROPOSE = 1
+ELECTION_ACK = 2
+ELECTION_VICTORY = 3
+
+# paxos ops (ref: MMonPaxos::OP_*)
+PAXOS_COLLECT = 1
+PAXOS_LAST = 2
+PAXOS_BEGIN = 3
+PAXOS_ACCEPT = 4
+PAXOS_COMMIT = 5
+PAXOS_LEASE = 6
+PAXOS_CATCHUP = 7
+
+
+@register
+class MMonElection(Message):
+    TYPE = 100
+    FIELDS = [("op", "u8"), ("epoch", "u32"), ("rank", "s32"),
+              ("quorum", "list:s32")]
+
+
+@register
+class MMonPaxos(Message):
+    TYPE = 110
+    FIELDS = [
+        ("op", "u8"),
+        ("pn", "u64"),
+        ("last_committed", "u64"),
+        ("version", "u64"),            # value version for begin/commit
+        ("value", "blob"),             # encoded store txn ('' if none)
+        ("uncommitted_pn", "u64"),     # LAST: pn of carried uncommitted
+        ("extra", "map:u64:blob"),     # LAST/share: missing commits
+    ]
+
+
+@register
+class MMonProposeForward(Message):
+    """Peon -> leader: a service proposal forwarded for commit
+    (ref: src/messages/MForward.h, narrowed to store txns)."""
+
+    TYPE = 111
+    FIELDS = [("service", "str"), ("value", "blob")]
+
+
+@register
+class MMonCommand(Message):
+    TYPE = 120
+    FIELDS = [("tid", "u64"), ("cmd", "str"), ("inbl", "blob")]
+
+
+@register
+class MMonCommandAck(Message):
+    TYPE = 121
+    FIELDS = [("tid", "u64"), ("retcode", "s32"), ("rs", "str"),
+              ("outbl", "blob")]
+
+
+@register
+class MMonSubscribe(Message):
+    """what -> start epoch (ref: MMonSubscribe::what)."""
+
+    TYPE = 122
+    FIELDS = [("what", "map:str:str")]
+
+
+@register
+class MMonMap(Message):
+    """monmap blob: the mon addresses (ref: MMonMap)."""
+
+    TYPE = 123
+    FIELDS = [("monmap", "blob")]
+
+
+@register
+class MOSDBoot(Message):
+    TYPE = 140
+    FIELDS = [("osd", "s32"), ("addr_host", "str"), ("addr_port", "u32"),
+              ("hb_port", "u32"), ("boot_epoch", "u32")]
+
+
+@register
+class MOSDFailure(Message):
+    """ref: MOSDFailure — reporter accuses target of being unreachable."""
+
+    TYPE = 141
+    # reporter survives peon->leader forwarding (msg.src gets rewritten
+    # to the forwarding mon at each messenger hop)
+    FIELDS = [("target", "s32"), ("failed_for", "u32"), ("epoch", "u32"),
+              ("reporter", "str")]
+
+
+@register
+class MOSDAlive(Message):
+    """Target refutes a failure report (ref: MOSDAlive/implicit via boot)."""
+
+    TYPE = 142
+    FIELDS = [("osd", "s32"), ("epoch", "u32")]
+
+
+@register
+class MOSDMap(Message):
+    """Map publication: incrementals keyed by epoch, or a full map for
+    far-behind subscribers (ref: MOSDMap::incremental_maps/maps)."""
+
+    TYPE = 143
+    FIELDS = [("fsid", "str"), ("incrementals", "map:u64:blob"),
+              ("full", "map:u64:blob")]
+
+
+@register
+class MMonGetOSDMap(Message):
+    TYPE = 144
+    FIELDS = [("start_epoch", "u32")]
+
+
+@register
+class MPGStats(Message):
+    """OSD -> mon pg stat report (ref: src/messages/MPGStats.h);
+    per-pg stats as an encoded blob map keyed by 'pool.seed'."""
+
+    TYPE = 145
+    FIELDS = [("osd", "s32"), ("epoch", "u32"), ("stats", "map:str:blob")]

@@ -1,0 +1,386 @@
+"""Monitor daemon: quorum membership, paxos, services, command entry.
+
+ref: src/mon/Monitor.{h,cc} — the daemon that glues Elector + Paxos +
+PaxosServices behind one messenger. Command handling mirrors
+Monitor::handle_command (clients may hit any mon; peons redirect to the
+leader); map subscriptions mirror Monitor::handle_subscribe +
+send_latest; fire-and-forget OSD reports are forwarded leader-ward like
+MForward does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.encoding.denc import Decoder, Encoder
+from ceph_tpu.mon.elector import Elector
+from ceph_tpu.mon.messages import (
+    MMonCommand, MMonCommandAck, MMonElection, MMonGetOSDMap, MMonMap,
+    MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDBoot, MOSDFailure,
+    MOSDMap, MPGStats,
+)
+from ceph_tpu.mon.paxos import Paxos
+from ceph_tpu.mon.store import MonitorDBStore
+from ceph_tpu.msg import Dispatcher, EntityAddr, Keyring, Messenger, Policy
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("mon")
+
+
+class MonMap:
+    """ref: src/mon/MonMap.h — name -> (rank, addr)."""
+
+    def __init__(self, fsid: str = "tpu-cluster"):
+        self.fsid = fsid
+        self.mons: dict[str, tuple[int, str, int]] = {}
+
+    def add(self, name: str, rank: int, host: str, port: int) -> None:
+        self.mons[name] = (rank, host, port)
+
+    def ranks(self) -> list[int]:
+        return sorted(r for r, _, _ in self.mons.values())
+
+    def addr_of_rank(self, rank: int) -> EntityAddr:
+        for r, host, port in self.mons.values():
+            if r == rank:
+                return EntityAddr(host, port)
+        raise KeyError(rank)
+
+    def name_of_rank(self, rank: int) -> str:
+        for name, (r, _, _) in self.mons.items():
+            if r == rank:
+                return name
+        raise KeyError(rank)
+
+    def rank_of_name(self, name: str) -> int:
+        return self.mons[name][0]
+
+    def addrs(self) -> list[EntityAddr]:
+        return [EntityAddr(h, p) for _, h, p in
+                sorted(self.mons.values())]
+
+    def encode(self) -> bytes:
+        e = Encoder()
+        with e.start(1):
+            e.string(self.fsid)
+            e.map(self.mons, lambda e, k: e.string(k),
+                  lambda e, v: e.s32(v[0]).string(v[1]).u32(v[2]))
+        return e.tobytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "MonMap":
+        d = Decoder(data)
+        m = cls()
+        with d.start(1):
+            m.fsid = d.string()
+            m.mons = d.map(lambda d: d.string(),
+                           lambda d: (d.s32(), d.string(), d.u32()))
+        return m
+
+
+class Monitor(Dispatcher):
+    def __init__(self, name: str, monmap: MonMap,
+                 store: MonitorDBStore | None = None,
+                 keyring: Keyring | None = None,
+                 config: dict | None = None):
+        self.name = name                      # e.g. "a"
+        self.monmap = monmap
+        self.rank = monmap.rank_of_name(name)
+        self.store = store or MonitorDBStore()
+        self.keyring = keyring
+        cfg = config or {}
+        self.election_timeout = cfg.get("mon_election_timeout", 0.3)
+        self.lease_interval = cfg.get("mon_lease_interval", 0.5)
+        self.lease_timeout = cfg.get("mon_lease", 2.0)
+        self.paxos_timeout = cfg.get("mon_paxos_timeout", 2.0)
+        self.tick_interval = cfg.get("mon_tick_interval", 0.2)
+        self.config = cfg
+
+        self.msgr = Messenger(f"mon.{name}", keyring=keyring)
+        self.msgr.set_policy("mon", Policy.lossless_peer())
+        self.msgr.add_dispatcher(self)
+
+        self.elector = Elector(self)
+        self.paxos = Paxos(self)
+        self.leader_rank: int | None = None
+        self.quorum: list[int] = []
+        self.state = "probing"               # probing|electing|leader|peon
+
+        from ceph_tpu.mon.osd_monitor import OSDMonitor
+        from ceph_tpu.mon.service import ConfigMonitor, HealthMonitor
+        self.osdmon = OSDMonitor(self)
+        self.configmon = ConfigMonitor(self)
+        self.healthmon = HealthMonitor(self)
+        self.services = [self.osdmon, self.configmon, self.healthmon]
+
+        # subscriptions: conn -> {what: next_epoch}
+        self.subs: dict[object, dict[str, int]] = {}
+        self._tick_task: asyncio.Task | None = None
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> EntityAddr:
+        addr = await self.msgr.bind(host, port)
+        self._tick_task = asyncio.ensure_future(self._tick_loop())
+        await self.elector.start()
+        return addr
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._tick_task:
+            self._tick_task.cancel()
+        if self.elector._timer:
+            self.elector._timer.cancel()
+        await self.msgr.shutdown()
+
+    def is_leader(self) -> bool:
+        return self.state == "leader"
+
+    def request_election(self) -> None:
+        if not self._stopped:
+            asyncio.ensure_future(self.elector.start())
+
+    # -- election outcomes -------------------------------------------------
+    async def win_election(self, epoch: int, quorum: list[int]) -> None:
+        self.state = "leader"
+        self.leader_rank = self.rank
+        self.quorum = quorum
+        ok = await self.paxos.leader_collect()
+        if not ok:
+            self.request_election()
+            return
+        for svc in self.services:
+            await svc.on_active()
+        log.dout(1, f"mon.{self.name} leader; quorum {quorum}")
+
+    async def lose_election(self, epoch: int, leader: int,
+                            quorum: list[int]) -> None:
+        self.state = "peon"
+        self.leader_rank = leader
+        self.quorum = quorum
+        self.paxos.lease_deadline = asyncio.get_event_loop().time() + \
+            self.lease_timeout
+
+    # -- ticking -----------------------------------------------------------
+    async def _tick_loop(self) -> None:
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.tick_interval)
+                now = asyncio.get_event_loop().time()
+                if self.is_leader():
+                    await self.paxos.send_lease()
+                    for svc in self.services:
+                        await svc.tick()
+                elif self.state == "peon" and \
+                        self.paxos.lease_deadline and \
+                        now > self.paxos.lease_deadline:
+                    log.dout(1, f"mon.{self.name} lease expired; electing")
+                    self.state = "electing"
+                    await self.elector.start()
+        except asyncio.CancelledError:
+            pass
+
+    # -- messaging ---------------------------------------------------------
+    async def send_mon(self, rank: int, msg) -> bool:
+        if rank == self.rank:
+            msg.src = f"mon.{self.name}"
+            await self._dispatch_mon_msg(msg)
+            return True
+        try:
+            # bounded: a dead peer must not stall elections/leases
+            # behind lossless reconnect retries
+            await asyncio.wait_for(self.msgr.send_message(
+                msg, self.monmap.addr_of_rank(rank),
+                f"mon.{self.monmap.name_of_rank(rank)}"), timeout=1.0)
+            return True
+        except Exception as e:
+            log.dout(5, f"send to mon rank {rank} failed: {e}")
+            return False
+
+    def _src_rank(self, msg) -> int:
+        name = (msg.src or "").split(".", 1)[-1]
+        try:
+            return self.monmap.rank_of_name(name)
+        except KeyError:
+            return -1
+
+    async def ms_dispatch(self, msg) -> bool:
+        # Handlers that wait on paxos round-trips (propose/collect) are
+        # spawned as tasks: run inline they would block the connection
+        # reader loop that must deliver the ACCEPT/LAST they await.
+        if isinstance(msg, (MMonElection, MMonPaxos)):
+            await self._dispatch_mon_msg(msg)
+            return True
+        if isinstance(msg, MMonProposeForward):
+            if self.is_leader():
+                asyncio.ensure_future(self.paxos.propose(msg.value))
+            return True
+        if isinstance(msg, MMonCommand):
+            asyncio.ensure_future(self._handle_command_msg(msg))
+            return True
+        if isinstance(msg, MMonSubscribe):
+            await self._handle_subscribe(msg)
+            return True
+        if isinstance(msg, MMonGetOSDMap):
+            await self._send_osdmaps(msg.conn, msg.start_epoch)
+            return True
+        if isinstance(msg, (MOSDBoot, MOSDFailure, MPGStats)):
+            if not self.is_leader():
+                if self.leader_rank is not None and \
+                        self.leader_rank != self.rank:
+                    await self.send_mon(self.leader_rank, msg)
+                return True
+            asyncio.ensure_future(self.osdmon.handle(msg))
+            return True
+        return False
+
+    async def _dispatch_mon_msg(self, msg) -> None:
+        if isinstance(msg, MMonElection):
+            await self.elector.handle(msg)
+        elif isinstance(msg, MMonPaxos):
+            msg.src_rank = self._src_rank(msg)
+            await self.paxos.dispatch(msg)
+
+    async def ms_handle_reset(self, conn) -> None:
+        self.subs.pop(conn, None)
+
+    # -- paxos commit application -----------------------------------------
+    def apply_paxos_value(self, version: int, value: bytes) -> None:
+        self.store.apply_encoded(value)
+        for svc in self.services:
+            svc.refresh()
+        asyncio.ensure_future(self._publish_maps())
+
+    async def _publish_maps(self) -> None:
+        """Push new osdmap epochs to subscribers
+        (ref: OSDMonitor::check_subs / send_incremental)."""
+        cur = self.osdmon.osdmap.epoch if self.osdmon.osdmap else 0
+        for conn, subs in list(self.subs.items()):
+            start = subs.get("osdmap")
+            if start is None or start > cur:
+                continue
+            try:
+                await self._send_osdmaps(conn, start)
+                subs["osdmap"] = cur + 1
+            except Exception:
+                self.subs.pop(conn, None)
+
+    async def _send_osdmaps(self, conn, start: int) -> None:
+        if self.osdmon.osdmap is None:
+            return
+        cur = self.osdmon.osdmap.epoch
+        incs: dict[int, bytes] = {}
+        full: dict[int, bytes] = {}
+        lo = max(start, 2)
+        if start <= 1 or (cur - lo) > 500:
+            full[cur] = self.osdmon.encode_full()
+        else:
+            for e in range(lo, cur + 1):
+                blob = self.osdmon.get_inc(e)
+                if blob is None:
+                    full[cur] = self.osdmon.encode_full()
+                    incs.clear()
+                    break
+                incs[e] = blob
+        await conn.send_message(MOSDMap(fsid=self.monmap.fsid,
+                                        incrementals=incs, full=full))
+
+    # -- subscriptions -----------------------------------------------------
+    async def _handle_subscribe(self, msg: MMonSubscribe) -> None:
+        entry = self.subs.setdefault(msg.conn, {})
+        for what, start in msg.what.items():
+            entry[what] = int(start)
+            if what == "monmap":
+                await msg.conn.send_message(
+                    MMonMap(monmap=self.monmap.encode()))
+        await self._publish_maps()
+
+    # -- commands ----------------------------------------------------------
+    async def _handle_command_msg(self, msg: MMonCommand) -> None:
+        if not self.is_leader():
+            # redirect: client retries against the leader
+            leader = self.leader_rank if self.leader_rank is not None \
+                else -1
+            await msg.conn.send_message(MMonCommandAck(
+                tid=msg.tid, retcode=-11,                  # -EAGAIN
+                rs=f"leader={leader}", outbl=b""))
+            return
+        try:
+            cmd = json.loads(msg.cmd)
+        except json.JSONDecodeError:
+            cmd = {"prefix": msg.cmd}
+        ret, rs, outbl = await self.handle_command(cmd, msg.inbl)
+        await msg.conn.send_message(MMonCommandAck(
+            tid=msg.tid, retcode=ret, rs=rs, outbl=outbl))
+
+    async def handle_command(self, cmd: dict,
+                             inbl: bytes = b"") -> tuple[int, str, bytes]:
+        """ref: Monitor::handle_command routing table."""
+        prefix = cmd.get("prefix", "")
+        if prefix in ("status", "health"):
+            return 0, "", json.dumps(self.get_status()).encode()
+        if prefix == "mon dump":
+            return 0, "", json.dumps({
+                "fsid": self.monmap.fsid, "quorum": self.quorum,
+                "leader": self.leader_rank,
+                "mons": {n: list(v) for n, v in
+                         self.monmap.mons.items()}}).encode()
+        if prefix == "quorum_status":
+            return 0, "", json.dumps({
+                "quorum": self.quorum,
+                "quorum_leader_name":
+                    self.monmap.name_of_rank(self.leader_rank)
+                    if self.leader_rank is not None else ""}).encode()
+        if prefix.startswith("config"):
+            return await self.configmon.handle_command(cmd, inbl)
+        if prefix.startswith(("osd", "pg")):
+            return await self.osdmon.handle_command(cmd, inbl)
+        return -22, f"unknown command {prefix!r}", b""    # -EINVAL
+
+    def get_status(self) -> dict:
+        health = self.healthmon.checks()
+        om = self.osdmon.osdmap
+        osd_stat = {}
+        if om is not None:
+            import numpy as np
+            from ceph_tpu.osd.osdmap import STATE_EXISTS, STATE_UP
+            up = int(np.sum((om.osd_state & STATE_UP) != 0))
+            inn = int(np.sum((np.asarray(om.osd_weight) > 0) &
+                             ((om.osd_state & STATE_EXISTS) != 0)))
+            exists = int(np.sum((om.osd_state & STATE_EXISTS) != 0))
+            osd_stat = {"epoch": om.epoch, "num_osds": exists,
+                        "num_up_osds": up, "num_in_osds": inn,
+                        "pools": len(om.pools)}
+        return {
+            "fsid": self.monmap.fsid,
+            "health": health,
+            "quorum": self.quorum,
+            "monmap": {"num_mons": len(self.monmap.mons)},
+            "osdmap": osd_stat,
+            "pgmap": self.osdmon.pg_summary(),
+        }
+
+    # -- service proposals -------------------------------------------------
+    async def propose_txn(self, txn, timeout: float = 5.0) -> bool:
+        """Commit a store transaction through paxos (leader) or forward
+        it (peon). Waits out election/collect windows instead of
+        failing spuriously (ref: PaxosService::propose_pending queueing
+        until paxos is writeable)."""
+        blob = txn.encode()
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if self.is_leader() and self.paxos.active:
+                if await self.paxos.propose(blob):
+                    return True
+            elif self.state == "peon" and self.leader_rank is not None:
+                # best-effort: True means handed to the leader's
+                # transport, not committed (callers needing commit
+                # certainty must run on the leader)
+                if await self.send_mon(self.leader_rank,
+                                       MMonProposeForward(
+                                           service="", value=blob)):
+                    return True
+            await asyncio.sleep(0.05)
+        return False

@@ -1,0 +1,486 @@
+"""OSDMonitor: the osdmap's PaxosService.
+
+ref: src/mon/OSDMonitor.{h,cc} — owns the authoritative OSDMap, turns
+boots/failure reports/admin commands into Incrementals, commits them
+through paxos (inc + full map per epoch in the store, exactly the
+reference's osdmap/osdmap_full keyspaces), auto-outs down OSDs after
+``mon_osd_down_out_interval``, and aggregates MPGStats into the pgmap
+summary (the reference moved pgmap into mgr; the mon keeps the
+summary here since this framework's mgr consumes it via commands).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+
+from ceph_tpu.crush import builder
+from ceph_tpu.crush.types import WEIGHT_ONE, CrushMap
+from ceph_tpu.encoding import (
+    decode_crush_map, decode_osdmap, encode_crush_map, encode_incremental,
+    encode_osdmap,
+)
+from ceph_tpu.mon.messages import MOSDBoot, MOSDFailure, MPGStats
+from ceph_tpu.mon.service import PaxosService
+from ceph_tpu.osd.osdmap import (
+    STATE_EXISTS, STATE_UP, Incremental, OSDMap,
+)
+from ceph_tpu.osd.types import (
+    POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED, PGPool,
+)
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("mon")
+
+PFX = "osdmap"
+
+
+class OSDMonitor(PaxosService):
+    prefix = PFX
+
+    def __init__(self, mon) -> None:
+        super().__init__(mon)
+        self.osdmap: OSDMap | None = None
+        # failure accounting (leader-side, ref: OSDMonitor failure_info)
+        self.failure_reporters: dict[int, set[str]] = {}
+        self.down_at: dict[int, float] = {}
+        self.min_down_reporters = mon.config.get(
+            "mon_osd_min_down_reporters", 2)
+        self.down_out_interval = mon.config.get(
+            "mon_osd_down_out_interval", 600.0)
+        # pg stats: "pool.seed" -> dict (latest primary report)
+        self.pg_stats: dict[str, dict] = {}
+        # serializes map mutations: concurrent handlers must not build
+        # incrementals against the same base epoch
+        self._inc_lock = asyncio.Lock()
+        self.refresh()
+
+    # -- state -------------------------------------------------------------
+    def last_epoch(self) -> int:
+        return self.store.get_u64(PFX, "last_epoch")
+
+    def refresh(self) -> None:
+        last = self.last_epoch()
+        if last and (self.osdmap is None or self.osdmap.epoch < last):
+            blob = self.store.get(PFX, f"full_{last:08x}")
+            if blob is not None:
+                self.osdmap = decode_osdmap(blob)
+
+    def encode_full(self) -> bytes:
+        return encode_osdmap(self.osdmap)
+
+    def get_inc(self, epoch: int) -> bytes | None:
+        return self.store.get(PFX, f"inc_{epoch:08x}")
+
+    async def on_active(self) -> None:
+        if self.last_epoch() == 0:
+            await self.create_initial()
+
+    async def create_initial(self) -> None:
+        """Epoch-1 map: empty root + default replicated rule
+        (ref: OSDMonitor::create_initial)."""
+        crush = CrushMap(type_names=dict(builder.DEFAULT_TYPE_NAMES))
+        root = builder.make_bucket(crush, builder.TYPE_ROOT, [],
+                                   name="default")
+        builder.add_simple_rule(crush, root, builder.TYPE_HOST,
+                                name="replicated_rule")
+        m = OSDMap(crush, max_osd=0)
+        t = self.store.transaction()
+        t.set(PFX, f"full_{1:08x}", encode_osdmap(m))
+        self.store.put_u64(t, PFX, "last_epoch", 1)
+        await self.mon.propose_txn(t)
+
+    async def _propose_inc(self, inc: Incremental) -> bool:
+        """Apply to a shadow map, commit (inc, full, last_epoch) as one
+        paxos value (ref: OSDMonitor::encode_pending). Serialized: the
+        base epoch is read under the lock so concurrent handlers can't
+        both target the same next epoch and lose one update."""
+        async with self._inc_lock:
+            cur = self.osdmap
+            inc.epoch = cur.epoch + 1
+            shadow = decode_osdmap(encode_osdmap(cur))
+            shadow.apply_incremental(inc)
+            t = self.store.transaction()
+            t.set(PFX, f"inc_{inc.epoch:08x}", encode_incremental(inc))
+            t.set(PFX, f"full_{inc.epoch:08x}", encode_osdmap(shadow))
+            self.store.put_u64(t, PFX, "last_epoch", inc.epoch)
+            return await self.mon.propose_txn(t)
+
+    # -- osd reports -------------------------------------------------------
+    async def handle(self, msg) -> None:
+        if isinstance(msg, MOSDBoot):
+            await self._handle_boot(msg)
+        elif isinstance(msg, MOSDFailure):
+            await self._handle_failure(msg)
+        elif isinstance(msg, MPGStats):
+            self._handle_pg_stats(msg)
+
+    async def _handle_boot(self, m: MOSDBoot) -> None:
+        """ref: OSDMonitor::prepare_boot — mark up, publish addrs,
+        auto-in on first boot."""
+        if self.osdmap is None or m.osd >= self.osdmap.max_osd:
+            return
+        inc = Incremental()
+        inc.new_up = [m.osd]
+        inc.new_addrs[m.osd] = (m.addr_host, m.addr_port, m.hb_port)
+        if self.osdmap.osd_weight[m.osd] == 0:
+            inc.new_weight[m.osd] = WEIGHT_ONE      # auto-in on boot
+        self.failure_reporters.pop(m.osd, None)
+        self.down_at.pop(m.osd, None)
+        await self._propose_inc(inc)
+        log.dout(1, f"osd.{m.osd} boot -> up (epoch "
+                    f"{self.osdmap.epoch})")
+
+    async def _handle_failure(self, m: MOSDFailure) -> None:
+        """ref: OSDMonitor::prepare_failure — mark down once enough
+        distinct reporters accuse the target."""
+        om = self.osdmap
+        if om is None or m.target >= om.max_osd or \
+                not bool(om.is_up(np.asarray(m.target))):
+            return
+        reporters = self.failure_reporters.setdefault(m.target, set())
+        reporters.add(m.reporter or m.src or "?")
+        if len(reporters) < self.min_down_reporters:
+            return
+        inc = Incremental()
+        inc.new_down = [m.target]
+        self.failure_reporters.pop(m.target, None)
+        self.down_at[m.target] = asyncio.get_event_loop().time()
+        await self._propose_inc(inc)
+        log.dout(1, f"osd.{m.target} marked down "
+                    f"({len(reporters)} reporters)")
+
+    def _handle_pg_stats(self, m: MPGStats) -> None:
+        for pgid, blob in m.stats.items():
+            try:
+                self.pg_stats[pgid] = json.loads(blob)
+            except json.JSONDecodeError:
+                pass
+
+    async def tick(self) -> None:
+        """Auto-out: down past the interval -> weight 0
+        (ref: OSDMonitor::tick mon_osd_down_out_interval)."""
+        om = self.osdmap
+        if om is None or not self.down_at:
+            return
+        now = asyncio.get_event_loop().time()
+        inc = Incremental()
+        for osd, t0 in list(self.down_at.items()):
+            if now - t0 >= self.down_out_interval and \
+                    om.osd_weight[osd] != 0:
+                inc.new_weight[osd] = 0
+                self.down_at.pop(osd, None)
+        if inc.new_weight:
+            await self._propose_inc(inc)
+            log.dout(1, f"auto-out: {list(inc.new_weight)}")
+
+    # -- pgmap summary -----------------------------------------------------
+    def pg_summary(self) -> dict:
+        states: dict[str, int] = {}
+        objects = 0
+        nbytes = 0
+        degraded = 0
+        for st in self.pg_stats.values():
+            s = st.get("state", "unknown")
+            states[s] = states.get(s, 0) + 1
+            objects += st.get("num_objects", 0)
+            nbytes += st.get("num_bytes", 0)
+            if "degraded" in s or "undersized" in s or "down" in s:
+                degraded += 1
+        return {"num_pgs": len(self.pg_stats), "states": states,
+                "num_objects": objects, "num_bytes": nbytes,
+                "degraded_pgs": degraded}
+
+    # -- commands ----------------------------------------------------------
+    async def handle_command(self, cmd, inbl=b""):
+        om = self.osdmap
+        if om is None:
+            return -11, "osdmap not initialized", b""
+        prefix = cmd.get("prefix", "")
+        handler = {
+            "osd new": self._cmd_new,
+            "osd crush add": self._cmd_crush_add,
+            "osd pool create": self._cmd_pool_create,
+            "osd pool rm": self._cmd_pool_rm,
+            "osd pool set": self._cmd_pool_set,
+            "osd pool ls": self._cmd_pool_ls,
+            "osd erasure-code-profile set": self._cmd_ecp_set,
+            "osd erasure-code-profile get": self._cmd_ecp_get,
+            "osd erasure-code-profile ls": self._cmd_ecp_ls,
+            "osd down": self._cmd_down,
+            "osd out": self._cmd_out,
+            "osd in": self._cmd_in,
+            "osd reweight": self._cmd_reweight,
+            "osd dump": self._cmd_dump,
+            "osd tree": self._cmd_tree,
+            "osd df": self._cmd_df,
+            "osd getmap": self._cmd_getmap,
+            "osd getcrushmap": self._cmd_getcrushmap,
+            "osd setcrushmap": self._cmd_setcrushmap,
+            "osd map": self._cmd_map,
+            "pg dump": self._cmd_pg_dump,
+        }.get(prefix)
+        if handler is None:
+            return -22, f"unknown command {prefix!r}", b""
+        return await handler(cmd, inbl)
+
+    async def _cmd_new(self, cmd, inbl):
+        """Allocate an osd id (ref: `ceph osd new`)."""
+        om = self.osdmap
+        osd = om.max_osd
+        inc = Incremental()
+        inc.new_max_osd = osd + 1
+        inc.new_state[osd] = STATE_EXISTS           # exists, down
+        if not await self._propose_inc(inc):
+            return -11, "proposal failed", b""
+        return 0, "", json.dumps({"osdid": osd}).encode()
+
+    async def _cmd_crush_add(self, cmd, inbl):
+        """`osd crush add <id> <weight> host=<h>` — link into the tree
+        (ref: OSDMonitor prepare_command osd crush add)."""
+        om = self.osdmap
+        osd = int(cmd["id"])
+        weight = int(float(cmd.get("weight", 1.0)) * WEIGHT_ONE)
+        host = cmd.get("host", f"host{osd}")
+        crush = decode_crush_map(encode_crush_map(om.crush))
+        # find/create the host bucket under the root
+        host_id = None
+        for bid, name in crush.bucket_names.items():
+            if name == host:
+                host_id = bid
+                break
+        root = min(b.id for b in crush.buckets.values()
+                   if b.type == builder.TYPE_ROOT) if any(
+            b.type == builder.TYPE_ROOT for b in crush.buckets.values()) \
+            else None
+        if host_id is None:
+            host_id = builder.make_bucket(crush, builder.TYPE_HOST, [],
+                                          name=host)
+            if root is not None:
+                builder.insert_item(crush, host_id, 0, root)
+        if osd in crush.buckets[host_id].items:
+            return 0, f"osd.{osd} already in crush", b""
+        crush.max_devices = max(crush.max_devices, osd + 1)
+        builder.insert_item(crush, osd, weight, host_id)
+        inc = Incremental()
+        inc.new_crush = crush
+        if not await self._propose_inc(inc):
+            return -11, "proposal failed", b""
+        return 0, f"add item id {osd} to {host}", b""
+
+    async def _cmd_pool_create(self, cmd, inbl):
+        om = self.osdmap
+        name = cmd["pool"]
+        if any(p.name == name for p in om.pools.values()):
+            return 0, f"pool '{name}' already exists", b""
+        pg_num = int(cmd.get("pg_num", 32))
+        pid = max(om.pools, default=0) + 1
+        pool_type = cmd.get("pool_type", "replicated")
+        if pool_type == "erasure":
+            profile_name = cmd.get("erasure_code_profile", "default")
+            prof = self._get_profile(profile_name)
+            if prof is None:
+                return -2, f"no ec profile {profile_name!r}", b""
+            k, m_ = int(prof.get("k", 2)), int(prof.get("m", 1))
+            crush = decode_crush_map(encode_crush_map(om.crush))
+            root = next(b.id for b in crush.buckets.values()
+                        if b.type == builder.TYPE_ROOT)
+            fd = builder.TYPE_HOST
+            if prof.get("crush-failure-domain") == "osd":
+                fd = builder.TYPE_OSD
+            rule = builder.add_simple_rule(
+                crush, root, fd, name=f"ec_{profile_name}", indep=True)
+            pool = PGPool(id=pid, pg_num=pg_num,
+                          type=POOL_TYPE_ERASURE, size=k + m_,
+                          min_size=k, crush_rule=rule, name=name,
+                          erasure_code_profile=profile_name,
+                          extra={"profile": prof})
+            inc = Incremental()
+            inc.new_crush = crush
+            inc.new_pools[pid] = pool
+        else:
+            pool = PGPool(id=pid, pg_num=pg_num,
+                          type=POOL_TYPE_REPLICATED,
+                          size=int(cmd.get("size", 3)),
+                          min_size=int(cmd.get("min_size", 0)) or None
+                          or max(1, int(cmd.get("size", 3)) - 1),
+                          crush_rule=0, name=name)
+            inc = Incremental()
+            inc.new_pools[pid] = pool
+        if not await self._propose_inc(inc):
+            return -11, "proposal failed", b""
+        return 0, f"pool '{name}' created", b""
+
+    async def _cmd_pool_rm(self, cmd, inbl):
+        om = self.osdmap
+        name = cmd["pool"]
+        pid = next((p.id for p in om.pools.values() if p.name == name),
+                   None)
+        if pid is None:
+            return -2, f"pool '{name}' does not exist", b""
+        inc = Incremental()
+        inc.old_pools.append(pid)
+        if not await self._propose_inc(inc):
+            return -11, "proposal failed", b""
+        return 0, f"pool '{name}' removed", b""
+
+    async def _cmd_pool_set(self, cmd, inbl):
+        om = self.osdmap
+        name, var, val = cmd["pool"], cmd["var"], cmd["val"]
+        pool = next((p for p in om.pools.values() if p.name == name),
+                    None)
+        if pool is None:
+            return -2, f"pool '{name}' does not exist", b""
+        import copy
+        newpool = copy.deepcopy(pool)
+        if var in ("size", "min_size", "pg_num", "pgp_num"):
+            setattr(newpool, var, int(val))
+        else:
+            return -22, f"unknown pool var {var!r}", b""
+        inc = Incremental()
+        inc.new_pools[pool.id] = newpool
+        if not await self._propose_inc(inc):
+            return -11, "proposal failed", b""
+        return 0, f"set pool {name} {var} to {val}", b""
+
+    async def _cmd_pool_ls(self, cmd, inbl):
+        out = [{"pool": p.id, "name": p.name, "pg_num": p.pg_num,
+                "size": p.size,
+                "type": "erasure" if p.is_erasure() else "replicated"}
+               for p in self.osdmap.pools.values()]
+        return 0, "", json.dumps(out).encode()
+
+    # ec profiles live in the store (committed via paxos txns)
+    def _get_profile(self, name: str) -> dict | None:
+        if name == "default":
+            return {"k": 2, "m": 1, "plugin": "jax",
+                    "technique": "reed_sol_van"}
+        blob = self.store.get("ecprofiles", name)
+        return json.loads(blob) if blob is not None else None
+
+    async def _cmd_ecp_set(self, cmd, inbl):
+        name = cmd["name"]
+        prof = {}
+        for kv in cmd.get("profile", []):
+            k, _, v = kv.partition("=")
+            prof[k] = v
+        t = self.store.transaction()
+        t.set("ecprofiles", name, json.dumps(prof).encode())
+        ok = await self.mon.propose_txn(t)
+        return (0, "", b"") if ok else (-11, "proposal failed", b"")
+
+    async def _cmd_ecp_get(self, cmd, inbl):
+        prof = self._get_profile(cmd["name"])
+        if prof is None:
+            return -2, f"no profile {cmd['name']!r}", b""
+        return 0, "", json.dumps(prof).encode()
+
+    async def _cmd_ecp_ls(self, cmd, inbl):
+        names = [k for k, _ in self.store.iterate("ecprofiles")]
+        return 0, "", json.dumps(["default"] + names).encode()
+
+    async def _cmd_down(self, cmd, inbl):
+        inc = Incremental()
+        inc.new_down = [int(cmd["id"])]
+        ok = await self._propose_inc(inc)
+        return (0, f"marked down osd.{cmd['id']}", b"") if ok else \
+            (-11, "proposal failed", b"")
+
+    async def _cmd_out(self, cmd, inbl):
+        inc = Incremental()
+        inc.new_weight[int(cmd["id"])] = 0
+        ok = await self._propose_inc(inc)
+        return (0, f"marked out osd.{cmd['id']}", b"") if ok else \
+            (-11, "proposal failed", b"")
+
+    async def _cmd_in(self, cmd, inbl):
+        inc = Incremental()
+        inc.new_weight[int(cmd["id"])] = WEIGHT_ONE
+        ok = await self._propose_inc(inc)
+        return (0, f"marked in osd.{cmd['id']}", b"") if ok else \
+            (-11, "proposal failed", b"")
+
+    async def _cmd_reweight(self, cmd, inbl):
+        inc = Incremental()
+        inc.new_weight[int(cmd["id"])] = \
+            int(float(cmd["weight"]) * WEIGHT_ONE)
+        ok = await self._propose_inc(inc)
+        return (0, "", b"") if ok else (-11, "proposal failed", b"")
+
+    async def _cmd_dump(self, cmd, inbl):
+        om = self.osdmap
+        out = {
+            "epoch": om.epoch, "max_osd": om.max_osd,
+            "osds": [{
+                "osd": o,
+                "up": int(bool(om.is_up(np.asarray(o)))),
+                "in": int(om.osd_weight[o] > 0),
+                "weight": float(om.osd_weight[o] / WEIGHT_ONE),
+                "addr": list(om.osd_addrs.get(o, ())),
+            } for o in range(om.max_osd)
+                if om.osd_state[o] & STATE_EXISTS],
+            "pools": [{"pool": p.id, "name": p.name,
+                       "type": p.type, "size": p.size,
+                       "min_size": p.min_size, "pg_num": p.pg_num,
+                       "crush_rule": p.crush_rule,
+                       "erasure_code_profile": p.erasure_code_profile}
+                      for p in om.pools.values()],
+            "pg_upmap_items": {str(k): [list(x) for x in v]
+                               for k, v in om.pg_upmap_items.items()},
+        }
+        return 0, "", json.dumps(out).encode()
+
+    async def _cmd_tree(self, cmd, inbl):
+        from ceph_tpu.crush.compiler import decompile_crushmap
+        return 0, "", decompile_crushmap(self.osdmap.crush).encode()
+
+    async def _cmd_df(self, cmd, inbl):
+        om = self.osdmap
+        util = np.zeros(om.max_osd, dtype=np.int64)
+        for pid in om.pools:
+            util += om.pool_utilization(pid)
+        out = [{"osd": o, "pgs": int(util[o]),
+                "weight": float(om.osd_weight[o] / WEIGHT_ONE)}
+               for o in range(om.max_osd)
+               if om.osd_state[o] & STATE_EXISTS]
+        return 0, "", json.dumps(out).encode()
+
+    async def _cmd_getmap(self, cmd, inbl):
+        return 0, "", self.encode_full()
+
+    async def _cmd_getcrushmap(self, cmd, inbl):
+        return 0, "", encode_crush_map(self.osdmap.crush)
+
+    async def _cmd_setcrushmap(self, cmd, inbl):
+        inc = Incremental()
+        inc.new_crush = decode_crush_map(inbl)
+        ok = await self._propose_inc(inc)
+        return (0, "", b"") if ok else (-11, "proposal failed", b"")
+
+    async def _cmd_map(self, cmd, inbl):
+        """`osd map <pool> <obj>` — where would this object land
+        (ref: OSDMonitor 'osd map' command)."""
+        om = self.osdmap
+        pool = next((p for p in om.pools.values()
+                     if p.name == cmd["pool"]), None)
+        if pool is None:
+            return -2, f"pool '{cmd['pool']}' does not exist", b""
+        from ceph_tpu.osd.types import ObjectLocator
+        pg = om.object_locator_to_pg(cmd["object"],
+                                     ObjectLocator(pool=pool.id))
+        seed = pool.raw_pg_to_pg(np.asarray([pg.seed]), xp=np)[0]
+        up, upp, acting, actp = om.pg_to_up_acting_osds(pool.id, [seed])
+        from ceph_tpu.crush.types import ITEM_NONE
+        return 0, "", json.dumps({
+            "pgid": f"{pool.id}.{int(seed):x}",
+            "up": [int(o) for o in up[0] if o != ITEM_NONE],
+            "up_primary": int(upp[0]),
+            "acting": [int(o) for o in acting[0] if o != ITEM_NONE],
+            "acting_primary": int(actp[0])}).encode()
+
+    async def _cmd_pg_dump(self, cmd, inbl):
+        return 0, "", json.dumps({
+            "summary": self.pg_summary(),
+            "pg_stats": self.pg_stats}).encode()

@@ -1,0 +1,117 @@
+"""PaxosService base + ConfigMonitor + HealthMonitor.
+
+ref: src/mon/PaxosService.{h,cc} — a service keeps its state under a
+store prefix, stages changes as store transactions proposed through
+paxos, and refreshes its in-memory view after every commit.
+ConfigMonitor ref: src/mon/ConfigMonitor.cc (the `ceph config ...`
+central config db with who-masks). HealthMonitor ref:
+src/mon/HealthMonitor.cc + health checks in OSDMonitor.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+class PaxosService:
+    prefix = "svc"
+
+    def __init__(self, mon) -> None:
+        self.mon = mon
+        self.store = mon.store
+
+    def refresh(self) -> None:
+        """Reload in-memory state after a paxos commit."""
+
+    async def on_active(self) -> None:
+        """Leader became active (post-collect)."""
+
+    async def tick(self) -> None:
+        """Periodic leader work."""
+
+    async def handle_command(self, cmd: dict,
+                             inbl: bytes = b"") -> tuple[int, str, bytes]:
+        return -22, "unknown command", b""
+
+
+class ConfigMonitor(PaxosService):
+    """Central config db (ref: src/mon/ConfigMonitor.cc): `config set
+    <who> <name> <value>` with who = global | <type> | <type>.<id>;
+    resolution walks most-specific first, like the reference's masks."""
+
+    prefix = "config"
+
+    async def handle_command(self, cmd, inbl=b""):
+        prefix = cmd.get("prefix", "")
+        if prefix == "config set":
+            who, name = cmd["who"], cmd["name"]
+            t = self.store.transaction()
+            t.set(self.prefix, f"{who}/{name}",
+                  str(cmd["value"]).encode())
+            ok = await self.mon.propose_txn(t)
+            return (0, f"set {who}/{name}", b"") if ok else \
+                (-11, "proposal failed", b"")
+        if prefix == "config rm":
+            who, name = cmd["who"], cmd["name"]
+            t = self.store.transaction()
+            t.rmkey(self.prefix, f"{who}/{name}")
+            ok = await self.mon.propose_txn(t)
+            return (0, "", b"") if ok else (-11, "proposal failed", b"")
+        if prefix == "config get":
+            who = cmd["who"]
+            name = cmd.get("name")
+            if name:
+                v = self.resolve(who, name)
+                if v is None:
+                    return -2, f"no config {who}/{name}", b""   # -ENOENT
+                return 0, "", v
+            out = {k: v.decode() for k, v in self.store.iterate(
+                self.prefix) if k.startswith(f"{who}/")}
+            return 0, "", json.dumps(out).encode()
+        if prefix == "config dump":
+            out = {k: v.decode()
+                   for k, v in self.store.iterate(self.prefix)}
+            return 0, "", json.dumps(out).encode()
+        return -22, f"unknown command {prefix!r}", b""
+
+    def resolve(self, who: str, name: str) -> bytes | None:
+        """Most-specific wins: <type>.<id> > <type> > global
+        (ref: ConfigMonitor mask resolution)."""
+        for scope in (who, who.split(".", 1)[0], "global"):
+            v = self.store.get(self.prefix, f"{scope}/{name}")
+            if v is not None:
+                return v
+        return None
+
+
+class HealthMonitor(PaxosService):
+    """Aggregated health checks (ref: src/mon/HealthMonitor.cc +
+    OSDMap::check_health): OSD_DOWN, OSD_OUT, PG_DEGRADED, MON_DOWN."""
+
+    prefix = "health"
+
+    def checks(self) -> dict:
+        import numpy as np
+        checks: dict[str, dict] = {}
+        mon = self.mon
+        if len(mon.quorum) < len(mon.monmap.ranks()):
+            missing = sorted(set(mon.monmap.ranks()) - set(mon.quorum))
+            checks["MON_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(missing)} monitors down: {missing}"}
+        om = mon.osdmon.osdmap
+        if om is not None:
+            from ceph_tpu.osd.osdmap import STATE_EXISTS, STATE_UP
+            exists = (om.osd_state & STATE_EXISTS) != 0
+            down = exists & ((om.osd_state & STATE_UP) == 0)
+            if down.any():
+                checks["OSD_DOWN"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"{int(down.sum())} osds down"}
+        pg = mon.osdmon.pg_summary()
+        if pg.get("degraded_pgs"):
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{pg['degraded_pgs']} pgs degraded"}
+        status = "HEALTH_OK" if not checks else "HEALTH_WARN"
+        return {"status": status, "checks": checks}

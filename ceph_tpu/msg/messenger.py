@@ -535,12 +535,16 @@ class Messenger:
     # -- teardown ----------------------------------------------------------
     async def shutdown(self) -> None:
         if self._server:
-            self._server.close()
-            try:
-                await self._server.wait_closed()
-            except Exception:
-                pass
+            self._server.close()           # stop accepting first
         for conn in list(self.conns.values()) + list(self._accepted):
             await conn.close()
         self.conns.clear()
         self._accepted.clear()
+        if self._server:
+            # Python 3.12 wait_closed blocks until every handler's
+            # transport is gone; bound it — sockets are already closed
+            try:
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       timeout=0.5)
+            except Exception:
+                pass

@@ -71,6 +71,12 @@ class Incremental:
     new_pg_upmap_items: dict[pg_t, list] = field(default_factory=dict)
     old_pg_upmap_items: list[pg_t] = field(default_factory=list)
     new_crush: CrushMap | None = None
+    # daemon addresses published at boot (ref: OSDMap::Incremental
+    # new_up_client/new_hb_back_up): osd -> (host, port, hb_port)
+    new_addrs: dict[int, tuple] = field(default_factory=dict)
+    # absolute state overrides (ref: Incremental::new_state xor — here
+    # absolute values; used by `osd new` to create EXISTS+down slots)
+    new_state: dict[int, int] = field(default_factory=dict)
 
 
 class OSDMap:
@@ -90,6 +96,8 @@ class OSDMap:
         self.primary_temp: dict[pg_t, int] = {}
         self.pg_upmap: dict[pg_t, tuple] = {}
         self.pg_upmap_items: dict[pg_t, list] = {}
+        # osd -> (host, port, hb_port); ref: OSDMap osd_addrs
+        self.osd_addrs: dict[int, tuple] = {}
         self._mappers: dict[int | None, Mapper] = {}
 
     # -- state predicates (array-capable) ---------------------------------
@@ -209,6 +217,8 @@ class OSDMap:
         for pid in inc.old_pools:
             self.pools.pop(pid, None)
         self.pools.update(inc.new_pools)
+        for o, st in inc.new_state.items():
+            self.osd_state[o] = st
         for o in inc.new_up:
             self.osd_state[o] |= STATE_EXISTS | STATE_UP
         for o in inc.new_down:
@@ -233,6 +243,7 @@ class OSDMap:
         self.pg_upmap_items.update(inc.new_pg_upmap_items)
         for pg in inc.old_pg_upmap_items:
             self.pg_upmap_items.pop(pg, None)
+        self.osd_addrs.update(inc.new_addrs)
         for mp in self._mappers.values():
             mp.set_device_weights(self._device_weights())
         self.epoch += 1

@@ -1,0 +1,265 @@
+"""Monitor tier: elections, paxos, OSDMonitor, MonClient.
+
+ref test model: src/test/mon/ + qa/standalone/mon — quorum formation,
+replicated commits, leader failover with state preservation, and the
+command surface, all over real localhost sockets.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.mon import MonClient, Monitor, MonMap
+from ceph_tpu.msg import Keyring
+
+CFG = {"mon_election_timeout": 0.15, "mon_lease_interval": 0.1,
+       "mon_lease": 0.6, "mon_paxos_timeout": 1.0,
+       "mon_tick_interval": 0.05, "mon_osd_min_down_reporters": 1,
+       "mon_osd_down_out_interval": 0.5}
+
+
+async def start_mons(n: int, cfg=None):
+    """Bind messengers first so the monmap has real ports, then start."""
+    cfg = dict(CFG, **(cfg or {}))
+    names = "abcde"[:n]
+    monmap = MonMap()
+    mons = []
+    for rank, name in enumerate(names):
+        monmap.add(name, rank, "127.0.0.1", 0)
+    # two-phase: create + bind, patch monmap ports, then elect
+    for rank, name in enumerate(names):
+        mon = Monitor(name, monmap, config=cfg)
+        addr = await mon.msgr.bind()
+        monmap.mons[name] = (rank, addr.host, addr.port)
+        mons.append(mon)
+    for mon in mons:
+        mon._tick_task = asyncio.ensure_future(mon._tick_loop())
+    for mon in mons:
+        await mon.elector.start()
+    return mons, monmap
+
+
+async def wait_for(pred, timeout=8.0, msg="condition"):
+    t0 = asyncio.get_event_loop().time()
+    while not pred():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise TimeoutError(f"timeout waiting for {msg}")
+        await asyncio.sleep(0.02)
+
+
+async def wait_quorum(mons, expect=None):
+    live = [m for m in mons if not m._stopped]
+    expect = expect if expect is not None else len(live)
+    await wait_for(
+        lambda: any(m.is_leader() and len(m.quorum) >= expect and
+                    m.paxos.active for m in live),
+        msg="quorum")
+    return next(m for m in live if m.is_leader() and
+                len(m.quorum) >= expect)
+
+
+async def stop_all(mons, clients=()):
+    for c in clients:
+        await c.shutdown()
+    for m in mons:
+        if not m._stopped:
+            await m.stop()
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_single_mon_bootstrap():
+    async def go():
+        mons, monmap = await start_mons(1)
+        leader = await wait_quorum(mons)
+        assert leader.quorum == [0]
+        await wait_for(lambda: leader.osdmon.osdmap is not None,
+                       msg="initial osdmap")
+        assert leader.osdmon.osdmap.epoch >= 1
+        await stop_all(mons)
+    run(go())
+
+
+def test_three_mon_quorum_and_replication():
+    async def go():
+        mons, monmap = await start_mons(3)
+        leader = await wait_quorum(mons)
+        assert leader.rank == 0          # lowest rank wins
+        assert sorted(leader.quorum) == [0, 1, 2]
+        # commit a config value through paxos; all mons converge
+        ret, rs, _ = await leader.handle_command(
+            {"prefix": "config set", "who": "global",
+             "name": "debug_osd", "value": "10"})
+        assert ret == 0
+        await wait_for(lambda: all(
+            m.store.get("config", "global/debug_osd") == b"10"
+            for m in mons), msg="config replication")
+        # every mon's paxos log agrees
+        lc = {m.paxos.last_committed for m in mons}
+        await wait_for(lambda: len({m.paxos.last_committed
+                                    for m in mons}) == 1,
+                       msg="paxos convergence")
+        await stop_all(mons)
+    run(go())
+
+
+def test_leader_failover_preserves_state():
+    async def go():
+        mons, monmap = await start_mons(3)
+        leader = await wait_quorum(mons)
+        ret, _, _ = await leader.handle_command(
+            {"prefix": "config set", "who": "global",
+             "name": "key1", "value": "v1"})
+        assert ret == 0
+        # kill the leader; a new one must take over with the state
+        await leader.stop()
+        survivors = [m for m in mons if m is not leader]
+        new_leader = await wait_quorum(mons, expect=2)
+        assert new_leader in survivors
+        assert sorted(new_leader.quorum) == sorted(
+            m.rank for m in survivors)
+        # committed state survived
+        assert new_leader.store.get("config", "global/key1") == b"v1"
+        # and new commits still work with the reduced quorum
+        ret, _, _ = await new_leader.handle_command(
+            {"prefix": "config set", "who": "global",
+             "name": "key2", "value": "v2"})
+        assert ret == 0
+        await wait_for(lambda: all(
+            m.store.get("config", "global/key2") == b"v2"
+            for m in survivors), msg="post-failover replication")
+        await stop_all(mons)
+    run(go())
+
+
+def test_monclient_commands_and_redirect():
+    async def go():
+        mons, monmap = await start_mons(3)
+        leader = await wait_quorum(mons)
+        mc = MonClient("client.admin", monmap)
+        # force the client to start at a peon: it must follow redirects
+        mc._cur_rank = 2
+        ret, rs, outbl = await mc.command({"prefix": "status"})
+        assert ret == 0
+        status = json.loads(outbl)
+        assert status["quorum"] == [0, 1, 2]
+        ret, rs, _ = await mc.command(
+            {"prefix": "config set", "who": "global", "name": "x",
+             "value": "1"})
+        assert ret == 0
+        ret, _, outbl = await mc.command(
+            {"prefix": "config get", "who": "global", "name": "x"})
+        assert ret == 0 and outbl == b"1"
+        ret, _, _ = await mc.command({"prefix": "bogus nonsense"})
+        assert ret == -22
+        await stop_all(mons, [mc])
+    run(go())
+
+
+def test_osdmonitor_lifecycle_via_commands():
+    async def go():
+        mons, monmap = await start_mons(1)
+        leader = await wait_quorum(mons)
+        await wait_for(lambda: leader.osdmon.osdmap is not None,
+                       msg="osdmap")
+        mc = MonClient("client.admin", monmap)
+        # osd new x3 + crush add
+        for i in range(3):
+            ret, _, out = await mc.command({"prefix": "osd new"})
+            assert ret == 0
+            assert json.loads(out)["osdid"] == i
+            ret, rs, _ = await mc.command(
+                {"prefix": "osd crush add", "id": i, "weight": 1.0,
+                 "host": f"host{i}"})
+            assert ret == 0, rs
+        # pool create + map an object
+        ret, rs, _ = await mc.command(
+            {"prefix": "osd pool create", "pool": "rbd", "pg_num": 8,
+             "size": 3})
+        assert ret == 0, rs
+        ret, _, out = await mc.command({"prefix": "osd dump"})
+        dump = json.loads(out)
+        assert len(dump["osds"]) == 3
+        assert dump["pools"][0]["name"] == "rbd"
+        ret, _, out = await mc.command(
+            {"prefix": "osd map", "pool": "rbd", "object": "obj1"})
+        assert ret == 0
+        mapping = json.loads(out)
+        assert mapping["acting_primary"] in (-1, 0, 1, 2)
+        # EC profile + EC pool
+        ret, rs, _ = await mc.command(
+            {"prefix": "osd erasure-code-profile set", "name": "p21",
+             "profile": ["k=2", "m=1", "crush-failure-domain=osd"]})
+        assert ret == 0, rs
+        ret, rs, _ = await mc.command(
+            {"prefix": "osd pool create", "pool": "ecpool",
+             "pg_num": 8, "pool_type": "erasure",
+             "erasure_code_profile": "p21"})
+        assert ret == 0, rs
+        ret, _, out = await mc.command({"prefix": "osd pool ls"})
+        pools = json.loads(out)
+        assert {p["name"] for p in pools} == {"rbd", "ecpool"}
+        ec = next(p for p in pools if p["name"] == "ecpool")
+        assert ec["size"] == 3 and ec["type"] == "erasure"
+        await stop_all(mons, [mc])
+    run(go())
+
+
+def test_osd_down_and_auto_out():
+    async def go():
+        mons, monmap = await start_mons(1)
+        leader = await wait_quorum(mons)
+        await wait_for(lambda: leader.osdmon.osdmap is not None,
+                       msg="osdmap")
+        mc = MonClient("client.admin", monmap)
+        for i in range(2):
+            await mc.command({"prefix": "osd new"})
+            await mc.command({"prefix": "osd crush add", "id": i,
+                              "weight": 1.0, "host": f"h{i}"})
+        # boot them (state up) via direct handler
+        from ceph_tpu.mon.messages import MOSDBoot, MOSDFailure
+        for i in range(2):
+            await leader.osdmon.handle(MOSDBoot(
+                osd=i, addr_host="127.0.0.1", addr_port=1000 + i,
+                hb_port=2000 + i, boot_epoch=0))
+        om = leader.osdmon.osdmap
+        assert bool(om.is_up(0)) and bool(om.is_up(1))
+        assert om.osd_addrs[1][1] == 1001
+        # failure report (min reporters = 1) -> down, then auto-out
+        fail = MOSDFailure(target=1, failed_for=5, epoch=om.epoch,
+                           reporter="osd.0")
+        await leader.osdmon.handle(fail)
+        await wait_for(
+            lambda: not bool(leader.osdmon.osdmap.is_up(1)),
+            msg="osd.1 down")
+        await wait_for(
+            lambda: leader.osdmon.osdmap.osd_weight[1] == 0,
+            timeout=5.0, msg="osd.1 auto-out")
+        # health reflects the down osd
+        status = leader.get_status()
+        assert status["health"]["status"] == "HEALTH_WARN"
+        assert "OSD_DOWN" in status["health"]["checks"]
+        await stop_all(mons, [mc])
+    run(go())
+
+
+def test_monclient_survives_mon_death():
+    async def go():
+        mons, monmap = await start_mons(3)
+        leader = await wait_quorum(mons)
+        mc = MonClient("client.admin", monmap)
+        ret, _, _ = await mc.command({"prefix": "status"})
+        assert ret == 0
+        await leader.stop()
+        await wait_quorum(mons, expect=2)
+        # client hunts to a live mon and retries
+        ret, _, out = await mc.command({"prefix": "quorum_status"},
+                                       timeout=15.0)
+        assert ret == 0
+        q = json.loads(out)
+        assert len(q["quorum"]) == 2
+        await stop_all(mons, [mc])
+    run(go())
