@@ -1,0 +1,171 @@
+"""vstart: in-process dev cluster launcher.
+
+ref: src/vstart.sh — spin N mons + N osds (+ client) on localhost,
+wait for HEALTH_OK, tear down. The qa-standalone tests and the demo
+CLI (`python -m ceph_tpu.cluster.vstart`) both drive this.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.mon.monitor import Monitor, MonMap
+from ceph_tpu.msg import Keyring
+from ceph_tpu.os_.objectstore import MemStore, WALStore
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.rados import Rados
+
+DEFAULT_CFG = {
+    "mon_election_timeout": 0.15, "mon_lease_interval": 0.1,
+    "mon_lease": 1.0, "mon_paxos_timeout": 2.0,
+    "mon_tick_interval": 0.1, "mon_osd_min_down_reporters": 1,
+    "mon_osd_down_out_interval": 5.0,
+    "osd_heartbeat_interval": 0.25, "osd_heartbeat_grace": 1.5,
+    "osd_stats_interval": 0.3,
+}
+
+
+class Cluster:
+    """A running dev cluster (the vstart.sh artifact)."""
+
+    def __init__(self, n_mons: int = 1, n_osds: int = 3,
+                 config: dict | None = None, auth: bool = True,
+                 data_dir: str | None = None):
+        self.cfg = dict(DEFAULT_CFG, **(config or {}))
+        self.n_mons = n_mons
+        self.n_osds = n_osds
+        self.auth = auth
+        self.data_dir = data_dir       # None = MemStore osds
+        self.keyring = Keyring() if auth else None
+        self.monmap = MonMap(fsid="vstart")
+        self.mons: list[Monitor] = []
+        self.osds: list[OSD] = []
+        self.client: Rados | None = None
+
+    async def start(self) -> "Cluster":
+        names = "abcdefgh"[:self.n_mons]
+        if self.keyring:
+            for n in names:
+                self.keyring.add(f"mon.{n}")
+            for i in range(self.n_osds):
+                self.keyring.add(f"osd.{i}")
+            self.keyring.add("client.admin")
+        for rank, name in enumerate(names):
+            self.monmap.add(name, rank, "127.0.0.1", 0)
+        for rank, name in enumerate(names):
+            mon = Monitor(name, self.monmap, keyring=self.keyring,
+                          config=self.cfg)
+            addr = await mon.msgr.bind()
+            self.monmap.mons[name] = (rank, addr.host, addr.port)
+            self.mons.append(mon)
+        for mon in self.mons:
+            mon._tick_task = asyncio.ensure_future(mon._tick_loop())
+        for mon in self.mons:
+            await mon.elector.start()
+        self.client = Rados(self.monmap, keyring=self.keyring)
+        # wait for a working quorum via the client path
+        ret, rs, _ = await self.client.mon_command({"prefix": "status"},
+                                                   timeout=30.0)
+        assert ret == 0, rs
+        # provision + boot osds
+        for i in range(self.n_osds):
+            ret, rs, _ = await self.client.mon_command(
+                {"prefix": "osd new"})
+            assert ret == 0, rs
+            ret, rs, _ = await self.client.mon_command(
+                {"prefix": "osd crush add", "id": i, "weight": 1.0,
+                 "host": f"host{i}"})
+            assert ret == 0, rs
+        for i in range(self.n_osds):
+            store = MemStore() if self.data_dir is None else \
+                WALStore(f"{self.data_dir}/osd{i}")
+            osd = OSD(i, self.monmap, store=store,
+                      keyring=self.keyring, config=self.cfg)
+            self.osds.append(osd)
+        await asyncio.gather(*[o.boot() for o in self.osds])
+        await self.client.connect()
+        return self
+
+    # -- helpers (ref: qa/standalone/ceph-helpers.sh) ----------------------
+    def leader(self) -> Monitor:
+        return next(m for m in self.mons
+                    if not m._stopped and m.is_leader())
+
+    async def wait_for_clean(self, timeout: float = 30.0) -> None:
+        """All PGs of all pools active+clean on their primaries
+        (ref: ceph-helpers.sh wait_for_clean)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            if self._all_clean():
+                return
+            if asyncio.get_event_loop().time() > deadline:
+                states = [
+                    (p, pg.state) for o in self.osds if not o._stopped
+                    for p, pg in o.pgs.items() if pg.is_primary()]
+                raise TimeoutError(f"not clean: {states}")
+            await asyncio.sleep(0.1)
+
+    def _all_clean(self) -> bool:
+        live = [o for o in self.osds if not o._stopped]
+        if not live:
+            return False
+        seen = set()
+        for o in live:
+            for pgid_s, pg in o.pgs.items():
+                if pg.is_primary():
+                    if pg.state not in ("clean",):
+                        return False
+                    seen.add(pgid_s)
+        # every pg of every pool must have a primary somewhere
+        om = self.leader().osdmon.osdmap
+        want = sum(p.pg_num for p in om.pools.values())
+        return len(seen) == want or want == 0
+
+    async def kill_osd(self, osd_id: int) -> None:
+        """Hard-stop (the qa kill_daemon analog)."""
+        await self.osds[osd_id].stop()
+
+    async def revive_osd(self, osd_id: int) -> None:
+        old = self.osds[osd_id]
+        osd = OSD(osd_id, self.monmap, store=old.store,
+                  keyring=self.keyring, config=self.cfg)
+        self.osds[osd_id] = osd
+        await osd.boot()
+
+    async def wait_for_osd_down(self, osd_id: int,
+                                timeout: float = 15.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            om = self.leader().osdmon.osdmap
+            if om is not None and not bool(om.is_up(osd_id)):
+                return
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"osd.{osd_id} still up")
+            await asyncio.sleep(0.1)
+
+    async def stop(self) -> None:
+        if self.client:
+            await self.client.shutdown()
+        for o in self.osds:
+            if not o._stopped:
+                await o.stop()
+        for m in self.mons:
+            if not m._stopped:
+                await m.stop()
+
+
+async def _demo() -> None:
+    c = await Cluster(n_mons=3, n_osds=3).start()
+    await c.client.pool_create("rbd", pg_num=8)
+    await c.wait_for_clean(timeout=120)
+    io = await c.client.open_ioctx("rbd")
+    await io.write_full("hello", b"world")
+    print("read back:", await io.read("hello"))
+    print("status:", (await c.client.status())["osdmap"])
+    await c.stop()
+
+
+if __name__ == "__main__":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    asyncio.run(_demo())

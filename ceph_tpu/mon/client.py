@@ -17,7 +17,9 @@ from ceph_tpu.mon.messages import (
     MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe, MOSDMap,
 )
 from ceph_tpu.mon.monitor import MonMap
-from ceph_tpu.msg import Dispatcher, Keyring, Messenger
+from ceph_tpu.msg import (AuthError, Dispatcher, Keyring,
+                          Messenger)
+from ceph_tpu.msg.messenger import ConnectionError_
 from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("monc")
@@ -56,7 +58,9 @@ class MonClient(Dispatcher):
     async def _handle_osdmap(self, m: MOSDMap) -> None:
         if m.full:
             epoch = max(m.full)
-            self.osdmap = decode_osdmap(m.full[epoch])
+            # never regress: a lagging peon may answer with an old full
+            if self.osdmap is None or epoch > self.osdmap.epoch:
+                self.osdmap = decode_osdmap(m.full[epoch])
         for e in sorted(m.incrementals):
             if self.osdmap is not None and \
                     e == self.osdmap.epoch + 1:
@@ -94,7 +98,8 @@ class MonClient(Dispatcher):
                 ret, rs, outbl = await asyncio.wait_for(
                     fut, timeout=min(15.0, deadline -
                                      asyncio.get_event_loop().time()))
-            except (asyncio.TimeoutError, Exception) as e:
+            except (asyncio.TimeoutError, ConnectionError, OSError,
+                    AuthError, ConnectionError_) as e:
                 self._command_waiters.pop(tid, None)
                 last_err = str(e) or type(e).__name__
                 # hunt: try the next monitor (ref: MonClient::_reopen)

@@ -92,13 +92,20 @@ class OSDMonitor(PaxosService):
         self.store.put_u64(t, PFX, "last_epoch", 1)
         await self.mon.propose_txn(t)
 
-    async def _propose_inc(self, inc: Incremental) -> bool:
-        """Apply to a shadow map, commit (inc, full, last_epoch) as one
-        paxos value (ref: OSDMonitor::encode_pending). Serialized: the
-        base epoch is read under the lock so concurrent handlers can't
-        both target the same next epoch and lose one update."""
+    async def _propose_change(self, build) -> tuple[bool, object]:
+        """Commit one map change (ref: OSDMonitor::encode_pending).
+
+        ``build(cur_map) -> (Incremental, result) | None`` runs UNDER
+        the serialization lock, so everything the inc derives from the
+        current map (next osd id, crush clone, pool ids) is consistent
+        with the epoch it targets — concurrent handlers can neither
+        allocate the same id nor clobber each other's crush edits."""
         async with self._inc_lock:
             cur = self.osdmap
+            out = build(cur)
+            if out is None:
+                return False, None
+            inc, result = out
             inc.epoch = cur.epoch + 1
             shadow = decode_osdmap(encode_osdmap(cur))
             shadow.apply_incremental(inc)
@@ -106,7 +113,13 @@ class OSDMonitor(PaxosService):
             t.set(PFX, f"inc_{inc.epoch:08x}", encode_incremental(inc))
             t.set(PFX, f"full_{inc.epoch:08x}", encode_osdmap(shadow))
             self.store.put_u64(t, PFX, "last_epoch", inc.epoch)
-            return await self.mon.propose_txn(t)
+            ok = await self.mon.propose_txn(t)
+            return ok, result
+
+    async def _propose_inc(self, inc: Incremental) -> bool:
+        """State-independent incs (down/out/weights/boot)."""
+        ok, _ = await self._propose_change(lambda om: (inc, None))
+        return ok
 
     # -- osd reports -------------------------------------------------------
     async def handle(self, msg) -> None:
@@ -171,10 +184,11 @@ class OSDMonitor(PaxosService):
             if now - t0 >= self.down_out_interval and \
                     om.osd_weight[osd] != 0:
                 inc.new_weight[osd] = 0
-                self.down_at.pop(osd, None)
         if inc.new_weight:
-            await self._propose_inc(inc)
-            log.dout(1, f"auto-out: {list(inc.new_weight)}")
+            if await self._propose_inc(inc):
+                for osd in inc.new_weight:
+                    self.down_at.pop(osd, None)
+                log.dout(1, f"auto-out: {list(inc.new_weight)}")
 
     # -- pgmap summary -----------------------------------------------------
     def pg_summary(self) -> dict:
@@ -228,88 +242,102 @@ class OSDMonitor(PaxosService):
 
     async def _cmd_new(self, cmd, inbl):
         """Allocate an osd id (ref: `ceph osd new`)."""
-        om = self.osdmap
-        osd = om.max_osd
-        inc = Incremental()
-        inc.new_max_osd = osd + 1
-        inc.new_state[osd] = STATE_EXISTS           # exists, down
-        if not await self._propose_inc(inc):
+        def build(om):
+            osd = om.max_osd
+            inc = Incremental()
+            inc.new_max_osd = osd + 1
+            inc.new_state[osd] = STATE_EXISTS       # exists, down
+            return inc, osd
+        ok, osd = await self._propose_change(build)
+        if not ok:
             return -11, "proposal failed", b""
         return 0, "", json.dumps({"osdid": osd}).encode()
 
     async def _cmd_crush_add(self, cmd, inbl):
         """`osd crush add <id> <weight> host=<h>` — link into the tree
         (ref: OSDMonitor prepare_command osd crush add)."""
-        om = self.osdmap
         osd = int(cmd["id"])
         weight = int(float(cmd.get("weight", 1.0)) * WEIGHT_ONE)
         host = cmd.get("host", f"host{osd}")
-        crush = decode_crush_map(encode_crush_map(om.crush))
-        # find/create the host bucket under the root
-        host_id = None
-        for bid, name in crush.bucket_names.items():
-            if name == host:
-                host_id = bid
-                break
-        root = min(b.id for b in crush.buckets.values()
-                   if b.type == builder.TYPE_ROOT) if any(
-            b.type == builder.TYPE_ROOT for b in crush.buckets.values()) \
-            else None
-        if host_id is None:
-            host_id = builder.make_bucket(crush, builder.TYPE_HOST, [],
-                                          name=host)
-            if root is not None:
-                builder.insert_item(crush, host_id, 0, root)
-        if osd in crush.buckets[host_id].items:
-            return 0, f"osd.{osd} already in crush", b""
-        crush.max_devices = max(crush.max_devices, osd + 1)
-        builder.insert_item(crush, osd, weight, host_id)
-        inc = Incremental()
-        inc.new_crush = crush
-        if not await self._propose_inc(inc):
+
+        def build(om):
+            crush = decode_crush_map(encode_crush_map(om.crush))
+            host_id = None
+            for bid, name in crush.bucket_names.items():
+                if name == host:
+                    host_id = bid
+                    break
+            root = next((b.id for b in crush.buckets.values()
+                         if b.type == builder.TYPE_ROOT), None)
+            if host_id is None:
+                host_id = builder.make_bucket(crush, builder.TYPE_HOST,
+                                              [], name=host)
+                if root is not None:
+                    builder.insert_item(crush, host_id, 0, root)
+            if osd in crush.buckets[host_id].items:
+                return None                       # already linked
+            crush.max_devices = max(crush.max_devices, osd + 1)
+            builder.insert_item(crush, osd, weight, host_id)
+            inc = Incremental()
+            inc.new_crush = crush
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            # distinguish already-linked (build returned None) from
+            # a failed proposal
+            if osd in {c for b in self.osdmap.crush.buckets.values()
+                       for c in b.items}:
+                return 0, f"osd.{osd} already in crush", b""
             return -11, "proposal failed", b""
         return 0, f"add item id {osd} to {host}", b""
 
     async def _cmd_pool_create(self, cmd, inbl):
-        om = self.osdmap
         name = cmd["pool"]
-        if any(p.name == name for p in om.pools.values()):
-            return 0, f"pool '{name}' already exists", b""
         pg_num = int(cmd.get("pg_num", 32))
-        pid = max(om.pools, default=0) + 1
         pool_type = cmd.get("pool_type", "replicated")
+        if any(p.name == name for p in self.osdmap.pools.values()):
+            return 0, f"pool '{name}' already exists", b""
         if pool_type == "erasure":
             profile_name = cmd.get("erasure_code_profile", "default")
             prof = self._get_profile(profile_name)
             if prof is None:
                 return -2, f"no ec profile {profile_name!r}", b""
-            k, m_ = int(prof.get("k", 2)), int(prof.get("m", 1))
-            crush = decode_crush_map(encode_crush_map(om.crush))
-            root = next(b.id for b in crush.buckets.values()
-                        if b.type == builder.TYPE_ROOT)
-            fd = builder.TYPE_HOST
-            if prof.get("crush-failure-domain") == "osd":
-                fd = builder.TYPE_OSD
-            rule = builder.add_simple_rule(
-                crush, root, fd, name=f"ec_{profile_name}", indep=True)
-            pool = PGPool(id=pid, pg_num=pg_num,
-                          type=POOL_TYPE_ERASURE, size=k + m_,
-                          min_size=k, crush_rule=rule, name=name,
-                          erasure_code_profile=profile_name,
-                          extra={"profile": prof})
+
+        def build(om):
+            if any(p.name == name for p in om.pools.values()):
+                return None
+            pid = max(om.pools, default=0) + 1
             inc = Incremental()
-            inc.new_crush = crush
+            if pool_type == "erasure":
+                k, m_ = int(prof.get("k", 2)), int(prof.get("m", 1))
+                crush = decode_crush_map(encode_crush_map(om.crush))
+                root = next(b.id for b in crush.buckets.values()
+                            if b.type == builder.TYPE_ROOT)
+                fd = builder.TYPE_HOST
+                if prof.get("crush-failure-domain") == "osd":
+                    fd = builder.TYPE_OSD
+                rule = builder.add_simple_rule(
+                    crush, root, fd, name=f"ec_{profile_name}",
+                    indep=True)
+                pool = PGPool(id=pid, pg_num=pg_num,
+                              type=POOL_TYPE_ERASURE, size=k + m_,
+                              min_size=k, crush_rule=rule, name=name,
+                              erasure_code_profile=profile_name,
+                              extra={"profile": prof})
+                inc.new_crush = crush
+            else:
+                pool = PGPool(id=pid, pg_num=pg_num,
+                              type=POOL_TYPE_REPLICATED,
+                              size=int(cmd.get("size", 3)),
+                              min_size=int(cmd.get("min_size", 0)) or
+                              max(1, int(cmd.get("size", 3)) - 1),
+                              crush_rule=0, name=name)
             inc.new_pools[pid] = pool
-        else:
-            pool = PGPool(id=pid, pg_num=pg_num,
-                          type=POOL_TYPE_REPLICATED,
-                          size=int(cmd.get("size", 3)),
-                          min_size=int(cmd.get("min_size", 0)) or None
-                          or max(1, int(cmd.get("size", 3)) - 1),
-                          crush_rule=0, name=name)
-            inc = Incremental()
-            inc.new_pools[pid] = pool
-        if not await self._propose_inc(inc):
+            return inc, pid
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            if any(p.name == name for p in self.osdmap.pools.values()):
+                return 0, f"pool '{name}' already exists", b""
             return -11, "proposal failed", b""
         return 0, f"pool '{name}' created", b""
 
@@ -327,21 +355,26 @@ class OSDMonitor(PaxosService):
         return 0, f"pool '{name}' removed", b""
 
     async def _cmd_pool_set(self, cmd, inbl):
-        om = self.osdmap
         name, var, val = cmd["pool"], cmd["var"], cmd["val"]
-        pool = next((p for p in om.pools.values() if p.name == name),
-                    None)
-        if pool is None:
-            return -2, f"pool '{name}' does not exist", b""
-        import copy
-        newpool = copy.deepcopy(pool)
-        if var in ("size", "min_size", "pg_num", "pgp_num"):
-            setattr(newpool, var, int(val))
-        else:
+        if var not in ("size", "min_size", "pg_num", "pgp_num"):
             return -22, f"unknown pool var {var!r}", b""
-        inc = Incremental()
-        inc.new_pools[pool.id] = newpool
-        if not await self._propose_inc(inc):
+
+        def build(om):
+            pool = next((p for p in om.pools.values()
+                         if p.name == name), None)
+            if pool is None:
+                return None
+            import copy
+            newpool = copy.deepcopy(pool)
+            setattr(newpool, var, int(val))
+            inc = Incremental()
+            inc.new_pools[pool.id] = newpool
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            if not any(p.name == name
+                       for p in self.osdmap.pools.values()):
+                return -2, f"pool '{name}' does not exist", b""
             return -11, "proposal failed", b""
         return 0, f"set pool {name} {var} to {val}", b""
 

@@ -123,12 +123,14 @@ class Paxos:
 
     async def _finish_collect(self) -> None:
         # re-propose any surrendered uncommitted value (ref: collect
-        # finishing with uncommitted -> begin)
-        self.active = True
-        if self.uncommitted is not None and \
-                self.uncommitted[0] == self.last_committed + 1:
-            version, _pn, value = self.uncommitted
-            await self._begin(version, value)
+        # finishing with uncommitted -> begin); under the propose lock
+        # so a concurrent propose() cannot reuse the same version
+        async with self._propose_lock:
+            self.active = True
+            if self.uncommitted is not None and \
+                    self.uncommitted[0] == self.last_committed + 1:
+                version, _pn, value = self.uncommitted
+                await self._begin(version, value)
 
     async def handle_collect(self, m: MMonPaxos) -> None:
         """Peon side (ref: Paxos::handle_collect)."""

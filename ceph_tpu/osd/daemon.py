@@ -1,0 +1,350 @@
+"""The OSD daemon: boot, map handling, op dispatch, heartbeats, stats.
+
+ref: src/osd/OSD.{h,cc} — the daemon that owns one ObjectStore, two
+messengers (client/cluster + heartbeat), a MonClient, and the PG table.
+Boot mirrors OSD::init/_send_boot (authenticate, subscribe to maps,
+announce addresses, wait to be marked up); map handling mirrors
+OSD::handle_osd_map + consume_map (advance every PG, instantiate new
+ones — here the whole pool's placement is computed in ONE batched
+mapper call instead of per-PG crush lookups); failure detection mirrors
+the osd_heartbeat_grace machinery with MOSDFailure reports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+
+from ceph_tpu.crush.types import ITEM_NONE
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.mon.messages import MOSDBoot, MOSDFailure, MPGStats
+from ceph_tpu.msg import Dispatcher, EntityAddr, Keyring, Messenger, Policy
+from ceph_tpu.os_.objectstore import MemStore, ObjectStore
+from ceph_tpu.osd.messages import (
+    MOSDOp, MOSDPGInfo, MOSDPGPull, MOSDPGPush, MOSDPGPushReply,
+    MOSDPGQuery, MOSDPing, MOSDRepOp, MOSDRepOpReply, PING, PING_REPLY,
+)
+from ceph_tpu.osd.pg import PG
+from ceph_tpu.osd.types import pg_t
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("osd")
+
+
+class OSD(Dispatcher):
+    def __init__(self, whoami: int, monmap, store: ObjectStore | None = None,
+                 keyring: Keyring | None = None,
+                 config: dict | None = None):
+        self.whoami = whoami
+        self.monmap = monmap
+        self.store = store or MemStore()
+        cfg = config or {}
+        self.hb_interval = cfg.get("osd_heartbeat_interval", 0.25)
+        self.hb_grace = cfg.get("osd_heartbeat_grace", 1.5)
+        self.stats_interval = cfg.get("osd_stats_interval", 0.5)
+        self.config = cfg
+        name = f"osd.{whoami}"
+        self.msgr = Messenger(name, keyring=keyring)
+        self.msgr.set_policy("osd", Policy.lossless_peer())
+        self.msgr.add_dispatcher(self)
+        self.hb_msgr = Messenger(name, keyring=keyring)
+        self.hb_msgr.add_dispatcher(_HBDispatcher(self))
+        self.monc = MonClient(name, monmap, keyring=keyring,
+                              messenger=self.msgr)
+        self.monc.map_callbacks.append(self._on_osdmap)
+        self.osdmap = None
+        self.pgs: dict[str, PG] = {}
+        self._tid = 0
+        self._hb_last_rx: dict[int, float] = {}
+        self._hb_reported: dict[int, float] = {}
+        self._hb_task: asyncio.Task | None = None
+        self._stats_task: asyncio.Task | None = None
+        self._stopped = False
+        self.up = False
+
+    # -- service facade used by PG ----------------------------------------
+    def next_tid(self) -> int:
+        self._tid += 1
+        return self._tid
+
+    def osd_is_up(self, osd: int) -> bool:
+        if self.osdmap is None or osd >= self.osdmap.max_osd:
+            return False
+        return bool(self.osdmap.is_up(np.asarray(osd)))
+
+    def osd_addr(self, osd: int) -> EntityAddr | None:
+        ent = self.osdmap.osd_addrs.get(osd) if self.osdmap else None
+        return EntityAddr(ent[0], ent[1]) if ent else None
+
+    def osd_hb_addr(self, osd: int) -> EntityAddr | None:
+        ent = self.osdmap.osd_addrs.get(osd) if self.osdmap else None
+        return EntityAddr(ent[0], ent[2]) if ent and ent[2] else None
+
+    async def send_osd(self, osd: int, msg) -> None:
+        addr = self.osd_addr(osd)
+        if addr is None:
+            raise ConnectionError(f"osd.{osd} has no address")
+        await asyncio.wait_for(
+            self.msgr.send_message(msg, addr, f"osd.{osd}"),
+            timeout=2.0)
+
+    def request_repeer(self, pg: PG, delay: float = 0.5) -> None:
+        async def later():
+            await asyncio.sleep(delay)
+            if pg.state == "peering" and pg.is_primary() and \
+                    not self._stopped:
+                pg.advance(pg.up, pg.acting, pg.primary, pg.epoch)
+        asyncio.ensure_future(later())
+
+    # -- lifecycle ---------------------------------------------------------
+    async def _send_boot(self) -> None:
+        await self.monc.msgr.send_message(MOSDBoot(
+            osd=self.whoami, addr_host=self.msgr.addr.host,
+            addr_port=self.msgr.addr.port,
+            hb_port=self.hb_msgr.addr.port,
+            boot_epoch=self.osdmap.epoch if self.osdmap else 0),
+            self.monc.monmap.addr_of_rank(self.monc._cur_rank),
+            f"mon.{self.monc.monmap.name_of_rank(self.monc._cur_rank)}")
+
+    async def boot(self, host: str = "127.0.0.1") -> None:
+        """ref: OSD::init + _send_boot."""
+        await self.msgr.bind(host, 0)
+        await self.hb_msgr.bind(host, 0)
+        await self.monc.subscribe("osdmap", 0)
+        await self.monc.wait_for_osdmap()
+        await self._send_boot()
+        # wait until the map shows us up
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while not self.up:
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"osd.{self.whoami} boot timed out")
+            await self.monc.subscribe(
+                "osdmap", (self.osdmap.epoch + 1) if self.osdmap else 0)
+            await asyncio.sleep(0.05)
+        self._hb_task = asyncio.ensure_future(self._hb_loop())
+        self._stats_task = asyncio.ensure_future(self._stats_loop())
+        log.dout(1, f"osd.{self.whoami} booted at {self.msgr.addr}")
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for task in (self._hb_task, self._stats_task):
+            if task:
+                task.cancel()
+        for pg in self.pgs.values():
+            if pg._worker:
+                pg._worker.cancel()
+            if pg._peering_task:
+                pg._peering_task.cancel()
+        await self.msgr.shutdown()
+        await self.hb_msgr.shutdown()
+
+    # -- map handling ------------------------------------------------------
+    async def _on_osdmap(self, osdmap) -> None:
+        """ref: OSD::handle_osd_map + consume_map."""
+        self.osdmap = osdmap
+        was_up = self.up
+        self.up = self.osd_is_up(self.whoami)
+        if was_up and not self.up and not self._stopped:
+            # wrongly marked down (ref: OSD::_committed_osd_maps "I was
+            # wrongly marked down" -> re-boot): announce ourselves again
+            log.dout(1, f"osd.{self.whoami} marked down but alive; "
+                        f"re-booting")
+            asyncio.ensure_future(self._send_boot())
+        for pool in osdmap.pools.values():
+            seeds = np.arange(pool.pg_num, dtype=np.uint32)
+            up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
+                pool.id, seeds)
+            mine = np.flatnonzero(
+                (acting == self.whoami).any(axis=1) |
+                (up == self.whoami).any(axis=1) |
+                (actp == self.whoami) | (upp == self.whoami))
+            for s in mine:
+                pgid = pg_t(pool.id, int(s))
+                if str(pgid) not in self.pgs:
+                    self.pgs[str(pgid)] = PG(self, pool, pgid)
+            for pgid_s, pg in list(self.pgs.items()):
+                if pg.pool.id != pool.id:
+                    continue
+                row = pg.pgid.seed
+                pg.pool = pool
+                pg.advance(
+                    [int(o) for o in up[row] if o != ITEM_NONE],
+                    [int(o) for o in acting[row] if o != ITEM_NONE],
+                    int(actp[row]), osdmap.epoch)
+        # drop PGs whose pool vanished
+        for pgid_s in [p for p, pg in self.pgs.items()
+                       if pg.pool.id not in osdmap.pools]:
+            self.pgs.pop(pgid_s)
+
+    # -- dispatch ----------------------------------------------------------
+    def _pg_for(self, pgid_s: str, create: bool = False) -> PG | None:
+        pg = self.pgs.get(pgid_s)
+        if pg is None and create and self.osdmap is not None:
+            pgid = pg_t.parse(pgid_s)
+            pool = self.osdmap.pools.get(pgid.pool)
+            if pool is None:
+                return None
+            pg = self.pgs[pgid_s] = PG(self, pool, pgid)
+            up, upp, acting, actp = self.osdmap.pg_to_up_acting_osds(
+                pgid.pool, [pgid.seed])
+            pg.advance([int(o) for o in up[0] if o != ITEM_NONE],
+                       [int(o) for o in acting[0] if o != ITEM_NONE],
+                       int(actp[0]), self.osdmap.epoch)
+        return pg
+
+    async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MOSDOp):
+            pg = self._pg_for(str(pg_t(msg.pool, msg.seed)))
+            if pg is None or not pg.is_primary():
+                # wrong target: client's map is stale; it will resend
+                from ceph_tpu.osd.messages import MOSDOpReply
+                await msg.conn.send_message(MOSDOpReply(
+                    tid=msg.tid, result=-11, epoch=self.osdmap.epoch
+                    if self.osdmap else 0, data=b"", extra=""))
+                return True
+            await pg.queue_op(msg)
+            return True
+        if isinstance(msg, MOSDRepOp):
+            pg = self._pg_for(msg.pgid, create=True)
+            if pg is not None:
+                pg.handle_rep_op(msg)
+            return True
+        if isinstance(msg, MOSDRepOpReply):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                pg.handle_rep_reply(msg)
+            return True
+        if isinstance(msg, MOSDPGQuery):
+            pg = self._pg_for(msg.pgid, create=True)
+            if pg is not None:
+                pg.handle_pg_query(msg)
+            return True
+        if isinstance(msg, MOSDPGInfo):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                pg.handle_pg_info(msg)
+            return True
+        if isinstance(msg, MOSDPGPull):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                pg.handle_pg_pull(msg)
+            return True
+        if isinstance(msg, MOSDPGPush):
+            pg = self._pg_for(msg.pgid, create=True)
+            if pg is not None:
+                pg.apply_push(msg)
+                await self.send_osd(msg.from_osd, MOSDPGPushReply(
+                    pgid=msg.pgid, oid=msg.oid, from_osd=self.whoami))
+            return True
+        if isinstance(msg, MOSDPGPushReply):
+            return True
+        return False
+
+    # -- heartbeats --------------------------------------------------------
+    async def _hb_loop(self) -> None:
+        """ref: OSD::heartbeat + heartbeat_check. Guard: when OUR event
+        loop stalls (e.g. a long jit compile elsewhere in-process), the
+        silence is ours, not the peers' — reset rx stamps instead of
+        accusing everyone (the reference's equivalent is the grace
+        adjustment by osd_heartbeat_stale / clock skew checks)."""
+        last_iter = asyncio.get_event_loop().time()
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.hb_interval)
+                if self.osdmap is None:
+                    continue
+                now = asyncio.get_event_loop().time()
+                if now - last_iter > self.hb_grace:
+                    for o in list(self._hb_last_rx):
+                        self._hb_last_rx[o] = now
+                last_iter = now
+                for o in range(self.osdmap.max_osd):
+                    if o == self.whoami or not self.osd_is_up(o):
+                        self._hb_last_rx.pop(o, None)
+                        continue
+                    addr = self.osd_hb_addr(o)
+                    if addr is None:
+                        continue
+                    self._hb_last_rx.setdefault(o, now)
+                    try:
+                        await asyncio.wait_for(
+                            self.hb_msgr.send_message(MOSDPing(
+                                op=PING, from_osd=self.whoami,
+                                epoch=self.osdmap.epoch,
+                                stamp=now), addr, f"osd.{o}"),
+                            timeout=1.0)
+                    except Exception:
+                        pass
+                    if now - self._hb_last_rx[o] > self.hb_grace and \
+                            now - self._hb_reported.get(o, 0) > \
+                            self.hb_grace:
+                        self._hb_reported[o] = now
+                        await self._report_failure(o)
+        except asyncio.CancelledError:
+            pass
+
+    async def _report_failure(self, target: int) -> None:
+        """ref: OSD::send_failures -> MOSDFailure to the mon."""
+        try:
+            await self.monc.msgr.send_message(MOSDFailure(
+                target=target,
+                failed_for=int(self.hb_grace),
+                epoch=self.osdmap.epoch,
+                reporter=f"osd.{self.whoami}"),
+                self.monc.monmap.addr_of_rank(self.monc._cur_rank),
+                f"mon."
+                f"{self.monc.monmap.name_of_rank(self.monc._cur_rank)}")
+        except Exception:
+            pass
+
+    def _hb_rx(self, m: MOSDPing) -> None:
+        self._hb_last_rx[m.from_osd] = \
+            asyncio.get_event_loop().time()
+
+    # -- stats -------------------------------------------------------------
+    async def _stats_loop(self) -> None:
+        """ref: OSD::ms_handle / MPGStats reporting loop."""
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.stats_interval)
+                if self.osdmap is None:
+                    continue
+                stats = {p: json.dumps(pg.stats()).encode()
+                         for p, pg in self.pgs.items()
+                         if pg.is_primary()}
+                if not stats:
+                    continue
+                try:
+                    await self.monc.msgr.send_message(MPGStats(
+                        osd=self.whoami, epoch=self.osdmap.epoch,
+                        stats=stats),
+                        self.monc.monmap.addr_of_rank(
+                            self.monc._cur_rank),
+                        f"mon.{self.monc.monmap.name_of_rank(self.monc._cur_rank)}")
+                except Exception:
+                    pass
+        except asyncio.CancelledError:
+            pass
+
+
+class _HBDispatcher(Dispatcher):
+    """Heartbeat messenger dispatcher (front/back network analog)."""
+
+    def __init__(self, osd: OSD):
+        self.osd = osd
+
+    async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MOSDPing):
+            self.osd._hb_rx(msg)
+            if msg.op == PING:
+                try:
+                    await msg.conn.send_message(MOSDPing(
+                        op=PING_REPLY, from_osd=self.osd.whoami,
+                        epoch=msg.epoch,
+                        stamp=msg.stamp))
+                except Exception:
+                    pass
+            return True
+        return False

@@ -1,0 +1,142 @@
+"""OSD wire messages: client ops, replication sub-ops, peering,
+recovery, heartbeats.
+
+ref: src/messages/MOSDOp.h, MOSDOpReply.h, MOSDRepOp.h,
+MOSDRepOpReply.h, MOSDPing.h, MOSDPGQuery/Info/Log/Push (peering +
+recovery), narrowed to the op surface this framework's PG implements.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.msg.message import Message, register
+
+# client op codes (ref: include/rados.h CEPH_OSD_OP_*)
+OSD_OP_READ = 1
+OSD_OP_WRITE = 2
+OSD_OP_WRITEFULL = 3
+OSD_OP_DELETE = 4
+OSD_OP_STAT = 5
+OSD_OP_TRUNCATE = 6
+OSD_OP_ZERO = 7
+OSD_OP_GETXATTR = 8
+OSD_OP_SETXATTR = 9
+OSD_OP_OMAP_GET = 10
+OSD_OP_OMAP_SET = 11
+OSD_OP_PGLS = 12           # list objects in pg (rados ls building block)
+
+# heartbeat ops (ref: MOSDPing::PING / PING_REPLY)
+PING = 1
+PING_REPLY = 2
+
+
+@register
+class MOSDOp(Message):
+    """One client op bundle on one object (ref: MOSDOp).
+
+    ops: list of encoded (op u8, offset u64, length u64, name str,
+    data blob) tuples — flattened here as parallel lists for the
+    declarative codec."""
+
+    TYPE = 160
+    FIELDS = [
+        ("tid", "u64"), ("epoch", "u32"),
+        ("pool", "s64"), ("seed", "u32"), ("oid", "str"),
+        ("op_codes", "list:u32"), ("op_offs", "list:u64"),
+        ("op_lens", "list:u64"), ("op_names", "list:str"),
+        ("op_datas", "list:blob"),
+    ]
+
+    def unpack_ops(self):
+        return list(zip(self.op_codes, self.op_offs, self.op_lens,
+                        self.op_names, self.op_datas))
+
+
+def make_osd_op(tid: int, epoch: int, pool: int, seed: int, oid: str,
+                ops: list[tuple]) -> MOSDOp:
+    """ops: (code, offset, length, name, data) tuples."""
+    return MOSDOp(
+        tid=tid, epoch=epoch, pool=pool, seed=seed, oid=oid,
+        op_codes=[o[0] for o in ops], op_offs=[o[1] for o in ops],
+        op_lens=[o[2] for o in ops], op_names=[o[3] for o in ops],
+        op_datas=[o[4] for o in ops])
+
+
+@register
+class MOSDOpReply(Message):
+    TYPE = 161
+    FIELDS = [("tid", "u64"), ("result", "s32"), ("epoch", "u32"),
+              ("data", "blob"), ("extra", "str")]   # extra: json
+
+
+@register
+class MOSDRepOp(Message):
+    """Primary -> replica shard write (ref: MOSDRepOp): the encoded
+    ObjectStore transaction plus the pg log entry it commits."""
+
+    TYPE = 162
+    FIELDS = [("tid", "u64"), ("epoch", "u32"), ("pgid", "str"),
+              ("txn", "blob"), ("log_entry", "blob")]
+
+
+@register
+class MOSDRepOpReply(Message):
+    TYPE = 163
+    FIELDS = [("tid", "u64"), ("result", "s32"), ("pgid", "str"),
+              ("from_osd", "s32")]
+
+
+@register
+class MOSDPing(Message):
+    TYPE = 180
+    FIELDS = [("op", "u8"), ("from_osd", "s32"), ("epoch", "u32"),
+              ("stamp", "f64")]
+
+
+# -- peering ---------------------------------------------------------------
+
+@register
+class MOSDPGQuery(Message):
+    """Primary asks a peer for its pg info+log (ref: MOSDPGQuery →
+    peer replies MOSDPGInfo)."""
+
+    TYPE = 170
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32")]
+
+
+@register
+class MOSDPGInfo(Message):
+    """Peer's view: last_update + full log blob (ref: MOSDPGInfo/
+    MOSDPGLog merged — logs here are small enough to ship whole)."""
+
+    TYPE = 171
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32"),
+              ("log", "blob")]
+
+
+@register
+class MOSDPGPull(Message):
+    """Primary requests a whole-object push from a peer holding the
+    authoritative copy (ref: MOSDPGPull PullOp)."""
+
+    TYPE = 174
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("oid", "str"),
+              ("from_osd", "s32")]
+
+
+@register
+class MOSDPGPush(Message):
+    """Recovery push: whole-object state at a version
+    (ref: MOSDPGPush PushOp)."""
+
+    TYPE = 172
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("oid", "str"),
+              ("version_epoch", "u32"), ("version_v", "u64"),
+              ("exists", "bool"), ("data", "blob"),
+              ("attrs", "map:str:blob"), ("omap", "map:str:blob"),
+              ("from_osd", "s32")]
+
+
+@register
+class MOSDPGPushReply(Message):
+    TYPE = 173
+    FIELDS = [("pgid", "str"), ("oid", "str"), ("from_osd", "s32")]

@@ -1,0 +1,504 @@
+"""PG: per-placement-group replicated state machine.
+
+ref: src/osd/PG.cc + PeeringState.{h,cc} + PrimaryLogPG.cc — one PG
+owns one ObjectStore collection and an ordered op pipeline. The
+reference's boost::statechart phases map to:
+
+- ``advance_map``: new acting set from the OSDMap ends the current
+  interval (ref: PeeringState::advance_map / start_peering_interval);
+- ``peering`` (primary): query every acting peer's info+log, adopt the
+  authoritative log (max last_update — ref: find_best_info), merge to
+  produce per-peer missing sets (ref: GetMissing), pull what the
+  primary itself lacks, then activate;
+- ``active``: client ops execute (PrimaryLogPG::execute_ctx):
+  writes get an eversion, a pg-log entry, and an ObjectStore
+  transaction replicated to acting peers as MOSDRepOp, acked to the
+  client when every live acting replica commits
+  (ref: ReplicatedBackend::submit_transaction);
+- ``recovery``: missing objects are pushed whole at their
+  authoritative version (ref: PGBackend::run_recovery_op); when no
+  peer is missing anything the PG is clean.
+
+The pg log + per-object versions persist in the collection's
+``_pgmeta_`` object (ref: pgmeta_oid omap), so a restarted OSD
+re-peers from durable state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.os_.objectstore import StoreError, Transaction
+from ceph_tpu.osd.messages import (
+    MOSDOp, MOSDOpReply, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
+    MOSDPGPushReply, MOSDPGQuery, MOSDRepOp, MOSDRepOpReply, OSD_OP_DELETE,
+    OSD_OP_GETXATTR, OSD_OP_OMAP_GET, OSD_OP_OMAP_SET, OSD_OP_PGLS,
+    OSD_OP_READ, OSD_OP_SETXATTR, OSD_OP_STAT, OSD_OP_TRUNCATE,
+    OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_ZERO,
+)
+from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry, PGLog, \
+    eversion
+from ceph_tpu.osd.types import pg_t
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("osd")
+
+PGMETA = "_pgmeta_"
+
+
+class PG:
+    def __init__(self, osd, pool, pgid: pg_t):
+        self.osd = osd                    # OSD daemon (service facade)
+        self.pool = pool
+        self.pgid = pgid
+        self.cid = str(pgid)
+        self.pg_log = PGLog()
+        self.state = "initial"
+        self.epoch = 0                    # interval epoch
+        self.acting: list[int] = []
+        self.up: list[int] = []
+        self.primary = -1
+        self.last_user_version = 0
+        # peering scratch
+        self.peer_logs: dict[int, PGLog] = {}
+        self.peer_missing: dict[int, dict[str, LogEntry]] = {}
+        self.my_missing: dict[str, LogEntry] = {}
+        self._peering_task: asyncio.Task | None = None
+        self._info_waiter: asyncio.Future | None = None
+        # op pipeline
+        self.op_queue: asyncio.Queue = asyncio.Queue()
+        self._worker: asyncio.Task | None = None
+        self._repop_waiters: dict[int, tuple[set[int], asyncio.Future]] = {}
+        self._push_waiters: dict[str, asyncio.Future] = {}
+        self._ensure_collection()
+        self._load_meta()
+
+    # -- persistence -------------------------------------------------------
+    def _ensure_collection(self) -> None:
+        if self.cid not in self.osd.store.list_collections():
+            t = Transaction().create_collection(self.cid)
+            t.touch(self.cid, PGMETA)
+            self.osd.store.queue_transaction(t)
+
+    def _load_meta(self) -> None:
+        try:
+            omap = self.osd.store.omap_get(self.cid, PGMETA)
+        except StoreError:
+            return
+        blob = omap.get("pg_log")
+        if blob:
+            self.pg_log = PGLog.decode(blob)
+            self.last_user_version = self.pg_log.head.v
+
+    def _meta_txn(self, t: Transaction) -> Transaction:
+        t.omap_setkeys(self.cid, PGMETA,
+                       {"pg_log": self.pg_log.encode()})
+        return t
+
+    def is_primary(self) -> bool:
+        return self.primary == self.osd.whoami
+
+    def role_active(self) -> bool:
+        return self.state in ("active", "recovering", "clean")
+
+    # -- interval changes --------------------------------------------------
+    def advance(self, up: list[int], acting: list[int], primary: int,
+                epoch: int) -> None:
+        """ref: PeeringState::advance_map — a changed acting set starts
+        a new interval; the primary re-peers."""
+        changed = (acting != self.acting or primary != self.primary)
+        self.up = up
+        self.acting = acting
+        self.primary = primary
+        self.epoch = epoch
+        if not changed and self.role_active():
+            return
+        if self._peering_task:
+            self._peering_task.cancel()
+            self._peering_task = None
+        if self.is_primary():
+            self.state = "peering"
+            self._peering_task = asyncio.ensure_future(self._peer())
+        else:
+            self.state = "replica" if self.osd.whoami in acting \
+                else "stray"
+            if self._worker:
+                self._worker.cancel()
+                self._worker = None
+
+    def live_acting(self) -> list[int]:
+        return [o for o in self.acting
+                if o >= 0 and self.osd.osd_is_up(o)]
+
+    # -- peering (primary) -------------------------------------------------
+    async def _peer(self) -> None:
+        try:
+            await self._peer_inner()
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            log.dout(1, f"pg {self.pgid} peering failed ({e}); retrying")
+            self.state = "peering"
+            self.osd.request_repeer(self, delay=0.5)
+
+    async def _peer_inner(self) -> None:
+        interval_epoch = self.epoch
+        peers = [o for o in self.live_acting() if o != self.osd.whoami]
+        self.peer_logs = {}
+        if len(self.live_acting()) < self.pool.min_size:
+            self.state = "peering"        # undersized: wait for map
+            return
+        if peers:
+            fut = asyncio.get_event_loop().create_future()
+            self._info_waiter = fut
+            for o in peers:
+                await self.osd.send_osd(o, MOSDPGQuery(
+                    pgid=self.cid, epoch=interval_epoch,
+                    from_osd=self.osd.whoami))
+            try:
+                await asyncio.wait_for(fut, timeout=3.0)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                self._info_waiter = None
+            if set(self.peer_logs) < set(peers):
+                # a peer didn't answer; retry soon (map may be stale)
+                self.state = "peering"
+                self.osd.request_repeer(self, delay=0.5)
+                return
+        if self.epoch != interval_epoch:
+            return                        # superseded interval
+        # authoritative log: max head (ref: find_best_info)
+        best_osd = self.osd.whoami
+        best = self.pg_log
+        for o, plog in self.peer_logs.items():
+            if plog.head > best.head:
+                best, best_osd = plog, o
+        if best_osd != self.osd.whoami:
+            self.my_missing = self.pg_log.merge(best)
+            t = self._meta_txn(Transaction())
+            self.osd.store.queue_transaction(t)
+            # pull objects the primary itself lacks
+            for oid, entry in list(self.my_missing.items()):
+                await self._pull(best_osd, oid)
+        self.last_user_version = max(self.last_user_version,
+                                     self.pg_log.head.v)
+        # per-peer missing sets (ref: GetMissing)
+        self.peer_missing = {
+            o: plog.missing_vs(self.pg_log)
+            for o, plog in self.peer_logs.items()}
+        self.state = "active"
+        if self._worker is None:
+            self._worker = asyncio.ensure_future(self._op_worker())
+        asyncio.ensure_future(self._recover())
+        log.dout(5, f"pg {self.pgid} active; acting {self.acting} "
+                    f"missing {sum(map(len, self.peer_missing.values()))}")
+
+    def handle_pg_query(self, m: MOSDPGQuery) -> None:
+        asyncio.ensure_future(self.osd.send_osd(m.from_osd, MOSDPGInfo(
+            pgid=self.cid, epoch=self.epoch, from_osd=self.osd.whoami,
+            log=self.pg_log.encode())))
+
+    def handle_pg_info(self, m: MOSDPGInfo) -> None:
+        self.peer_logs[m.from_osd] = PGLog.decode(m.log)
+        peers = [o for o in self.live_acting() if o != self.osd.whoami]
+        if self._info_waiter and not self._info_waiter.done() and \
+                set(self.peer_logs) >= set(peers):
+            self._info_waiter.set_result(True)
+
+    # -- recovery ----------------------------------------------------------
+    async def _pull(self, from_osd: int, oid: str) -> None:
+        """Primary pulls an object it is missing (ref: RecoveryOp pull)."""
+        fut = asyncio.get_event_loop().create_future()
+        self._push_waiters[oid] = fut
+        await self.osd.send_osd(from_osd, MOSDPGPull(
+            pgid=self.cid, epoch=self.epoch, oid=oid,
+            from_osd=self.osd.whoami))
+        try:
+            await asyncio.wait_for(fut, timeout=3.0)
+        except asyncio.TimeoutError:
+            log.dout(1, f"pg {self.pgid} pull of {oid} timed out")
+        finally:
+            self._push_waiters.pop(oid, None)
+
+    def handle_pg_pull(self, m: MOSDPGPull) -> None:
+        asyncio.ensure_future(
+            self.osd.send_osd(m.from_osd, self.make_push(m.oid)))
+
+    def _object_state(self, oid: str):
+        """(exists, data, attrs, omap, version)"""
+        try:
+            data = self.osd.store.read(self.cid, oid)
+            attrs = self.osd.store.getattrs(self.cid, oid)
+            omap = self.osd.store.omap_get(self.cid, oid)
+        except StoreError:
+            return False, b"", {}, {}, eversion()
+        vb = attrs.get("_v")
+        ver = eversion() if not vb else eversion(
+            int.from_bytes(vb[:4], "little"),
+            int.from_bytes(vb[4:12], "little"))
+        return True, data, attrs, omap, ver
+
+    def make_push(self, oid: str) -> MOSDPGPush:
+        exists, data, attrs, omap, ver = self._object_state(oid)
+        return MOSDPGPush(
+            pgid=self.cid, epoch=self.epoch, oid=oid,
+            version_epoch=ver.epoch, version_v=ver.v, exists=exists,
+            data=data, attrs=attrs, omap=omap,
+            from_osd=self.osd.whoami)
+
+    def apply_push(self, m: MOSDPGPush) -> None:
+        t = Transaction()
+        if m.exists:
+            t.remove(self.cid, m.oid)
+            t.write(self.cid, m.oid, 0, m.data)
+            if m.attrs:
+                t.setattrs(self.cid, m.oid, m.attrs)
+            if m.omap:
+                t.omap_setkeys(self.cid, m.oid, m.omap)
+        else:
+            t.remove(self.cid, m.oid)
+        try:
+            self.osd.store.queue_transaction(t)
+        except StoreError as e:
+            log.error(f"pg {self.pgid} push apply failed: {e}")
+        fut = self._push_waiters.get(m.oid)
+        if fut and not fut.done():
+            fut.set_result(True)
+            self.my_missing.pop(m.oid, None)
+
+    async def _recover(self) -> None:
+        """Push every peer's missing objects (ref: run_recovery_op)."""
+        if not self.is_primary():
+            return
+        self.state = "recovering" if any(self.peer_missing.values()) \
+            else self.state
+        for o, missing in list(self.peer_missing.items()):
+            for oid in list(missing):
+                try:
+                    await self.osd.send_osd(o, self.make_push(oid))
+                except Exception as e:
+                    log.dout(1, f"pg {self.pgid} push {oid}->{o} "
+                                f"failed: {e}")
+                    continue
+                missing.pop(oid, None)
+        if not any(self.peer_missing.values()) and \
+                self.state in ("active", "recovering"):
+            self.state = "clean" if \
+                len(self.live_acting()) >= self.pool.size else "active"
+
+    # -- op execution ------------------------------------------------------
+    async def queue_op(self, m: MOSDOp) -> None:
+        await self.op_queue.put(m)
+
+    async def _op_worker(self) -> None:
+        try:
+            while True:
+                m = await self.op_queue.get()
+                while not self.role_active():
+                    await asyncio.sleep(0.05)
+                try:
+                    await self._execute(m)
+                except Exception as e:
+                    log.error(f"pg {self.pgid} op failed: {e}")
+                    await self._reply(m, -5, b"", {})       # -EIO
+        except asyncio.CancelledError:
+            pass
+
+    async def _reply(self, m: MOSDOp, result: int, data: bytes,
+                     extra: dict) -> None:
+        if m.conn is None:
+            return
+        try:
+            await m.conn.send_message(MOSDOpReply(
+                tid=m.tid, result=result, epoch=self.epoch, data=data,
+                extra=json.dumps(extra) if extra else ""))
+        except Exception:
+            pass                          # client resends via objecter
+
+    async def _execute(self, m: MOSDOp) -> None:
+        """ref: PrimaryLogPG::execute_ctx — reads serve immediately,
+        writes run the replication pipeline."""
+        store = self.osd.store
+        cid = self.cid
+        oid = m.oid
+        data_out = b""
+        extra: dict = {}
+        t = Transaction()
+        mutated = False
+        deleted = False
+        for code, off, length, name, data in m.unpack_ops():
+            if code == OSD_OP_READ:
+                try:
+                    data_out = store.read(
+                        cid, oid, off, length if length else None)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})       # -ENOENT
+                    return
+            elif code == OSD_OP_STAT:
+                try:
+                    extra["size"] = store.stat(cid, oid)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})
+                    return
+            elif code == OSD_OP_GETXATTR:
+                try:
+                    attrs = store.getattrs(cid, oid)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})
+                    return
+                if name not in attrs:
+                    await self._reply(m, -61, b"", {})      # -ENODATA
+                    return
+                data_out = attrs[name]
+            elif code == OSD_OP_OMAP_GET:
+                try:
+                    omap = store.omap_get(cid, oid)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})
+                    return
+                extra["omap"] = {k: v.hex() for k, v in omap.items()
+                                 if not k.startswith("_")}
+            elif code == OSD_OP_PGLS:
+                objs = [o for o in store.list_objects(cid)
+                        if o != PGMETA]
+                extra["objects"] = objs
+            elif code == OSD_OP_WRITE:
+                t.write(cid, oid, off, data)
+                mutated = True
+            elif code == OSD_OP_WRITEFULL:
+                t.remove(cid, oid)
+                t.write(cid, oid, 0, data)
+                mutated = True
+            elif code == OSD_OP_TRUNCATE:
+                t.truncate(cid, oid, off)
+                mutated = True
+            elif code == OSD_OP_ZERO:
+                t.zero(cid, oid, off, length)
+                mutated = True
+            elif code == OSD_OP_DELETE:
+                if not store.exists(cid, oid):
+                    await self._reply(m, -2, b"", {})
+                    return
+                t.remove(cid, oid)
+                mutated = True
+                deleted = True
+            elif code == OSD_OP_SETXATTR:
+                t.touch(cid, oid)
+                t.setattrs(cid, oid, {name: data})
+                mutated = True
+            elif code == OSD_OP_OMAP_SET:
+                t.touch(cid, oid)
+                t.omap_setkeys(cid, oid, {name: data})
+                mutated = True
+            else:
+                await self._reply(m, -95, b"", {})   # -EOPNOTSUPP
+                return
+        if not mutated:
+            await self._reply(m, 0, data_out, extra)
+            return
+        result = await self._submit_write(oid, t, deleted)
+        extra["version"] = str(self.pg_log.head)
+        await self._reply(m, result, data_out, extra)
+
+    async def _submit_write(self, oid: str, t: Transaction,
+                            deleted: bool) -> int:
+        """The replication pipeline (ref: ReplicatedBackend::
+        submit_transaction + issue_repop)."""
+        if len(self.live_acting()) < self.pool.min_size:
+            return -11                                  # -EAGAIN
+        self.last_user_version += 1
+        version = eversion(self.epoch, self.last_user_version)
+        entry = self.pg_log.add(
+            version, oid, OP_DELETE if deleted else OP_MODIFY)
+        self.pg_log.trim()
+        if not deleted:
+            t.setattrs(self.cid, oid, {"_v":
+                       version.epoch.to_bytes(4, "little") +
+                       version.v.to_bytes(8, "little")})
+        self._meta_txn(t)
+        txn_blob = t.encode()
+        replicas = [o for o in self.live_acting()
+                    if o != self.osd.whoami]
+        tid = self.osd.next_tid()
+        waiter = None
+        if replicas:
+            waiter = asyncio.get_event_loop().create_future()
+            self._repop_waiters[tid] = (set(replicas), waiter)
+        try:
+            self.osd.store.queue_transaction(t)
+        except StoreError as e:
+            log.error(f"pg {self.pgid} local commit failed: {e}")
+            self._repop_waiters.pop(tid, None)
+            return -5
+        for o in replicas:
+            await self.osd.send_osd(o, MOSDRepOp(
+                tid=tid, epoch=self.epoch, pgid=self.cid,
+                txn=txn_blob, log_entry=entry.encode()))
+        if waiter is not None:
+            try:
+                await asyncio.wait_for(waiter, timeout=5.0)
+            except asyncio.TimeoutError:
+                # a replica died mid-write: the new interval will
+                # re-peer; the write is durable on the survivors
+                log.dout(1, f"pg {self.pgid} repop {tid} timed out")
+            finally:
+                self._repop_waiters.pop(tid, None)
+        return 0
+
+    def handle_rep_op(self, m: MOSDRepOp) -> None:
+        """Replica applies the shipped transaction (ref:
+        ReplicatedBackend::do_repop)."""
+        entry = LogEntry.decode(m.log_entry)
+        t = Transaction.decode(m.txn)
+        try:
+            self.osd.store.queue_transaction(t)
+        except StoreError as e:
+            log.error(f"pg {self.pgid} repop apply failed: {e}")
+            return
+        self.pg_log.append(entry)
+        self.pg_log.trim()
+        self.last_user_version = max(self.last_user_version,
+                                     entry.version.v)
+
+        async def _ack():
+            try:
+                # reply on the incoming connection: the replica may not
+                # have seen the map naming the primary yet
+                await m.conn.send_message(MOSDRepOpReply(
+                    tid=m.tid, result=0, pgid=self.cid,
+                    from_osd=self.osd.whoami))
+            except Exception:
+                pass      # primary's repop timeout covers the loss
+        asyncio.ensure_future(_ack())
+
+    def handle_rep_reply(self, m: MOSDRepOpReply) -> None:
+        ent = self._repop_waiters.get(m.tid)
+        if ent is None:
+            return
+        pending, fut = ent
+        pending.discard(m.from_osd)
+        if not pending and not fut.done():
+            fut.set_result(True)
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        objs = [o for o in self.osd.store.list_objects(self.cid)
+                if o != PGMETA] if self.cid in \
+            self.osd.store.list_collections() else []
+        nbytes = 0
+        for o in objs:
+            try:
+                nbytes += self.osd.store.stat(self.cid, o)
+            except StoreError:
+                pass
+        state = self.state
+        if self.is_primary():
+            live = len(self.live_acting())
+            if live < self.pool.size and self.role_active():
+                state = f"{self.state}+undersized+degraded"
+        return {"state": state, "num_objects": len(objs),
+                "num_bytes": nbytes,
+                "acting": self.acting, "up": self.up,
+                "last_update": str(self.pg_log.head)}

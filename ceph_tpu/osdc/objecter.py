@@ -1,0 +1,112 @@
+"""Objecter: the client-side op engine.
+
+ref: src/osdc/Objecter.{h,cc} — computes each op's target from the
+client's own OSDMap (object -> PG -> acting primary, the client-side
+placement that is the whole point of CRUSH), tracks in-flight ops, and
+resends when the map changes or the target replies EAGAIN/times out
+(ref: Objecter::_calc_target + handle_osd_map resend logic).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.msg import Dispatcher, EntityAddr
+from ceph_tpu.msg.messenger import ConnectionError_
+from ceph_tpu.osd.messages import MOSDOpReply, make_osd_op
+from ceph_tpu.osd.types import ObjectLocator
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("objecter")
+
+
+class ObjectOperationError(Exception):
+    def __init__(self, errno: int, msg: str = ""):
+        super().__init__(f"errno {errno}: {msg}")
+        self.errno = errno
+
+
+class Objecter(Dispatcher):
+    def __init__(self, monc: MonClient):
+        self.monc = monc
+        self.msgr = monc.msgr
+        self.msgr.add_dispatcher(self)
+        self._tid = 0
+        self._waiters: dict[int, asyncio.Future] = {}
+
+    async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MOSDOpReply):
+            fut = self._waiters.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+            return True
+        return False
+
+    def _calc_target(self, osdmap, pool_id: int, oid: str):
+        """ref: Objecter::_calc_target."""
+        pool = osdmap.pools[pool_id]
+        raw_pg = osdmap.object_locator_to_pg(
+            oid, ObjectLocator(pool=pool_id))
+        seed = int(pool.raw_pg_to_pg(np.asarray([raw_pg.seed]),
+                                     xp=np)[0])
+        _, _, acting, actp = osdmap.pg_to_up_acting_osds(pool_id,
+                                                         [seed])
+        return seed, int(actp[0])
+
+    async def pool_id(self, name: str) -> int:
+        osdmap = await self.monc.wait_for_osdmap()
+        for p in osdmap.pools.values():
+            if p.name == name:
+                return p.id
+        raise ObjectOperationError(-2, f"no pool {name!r}")
+
+    async def op_submit(self, pool_id: int, oid: str, ops: list[tuple],
+                        timeout: float = 20.0):
+        """Send one op bundle; retries across map changes.
+        Returns (result, data, extra_dict)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        attempt = 0
+        while True:
+            if asyncio.get_event_loop().time() > deadline:
+                raise ObjectOperationError(-110, f"op on {oid} timed out")
+            osdmap = await self.monc.wait_for_osdmap()
+            seed, primary = self._calc_target(osdmap, pool_id, oid)
+            if primary < 0 or primary not in osdmap.osd_addrs:
+                await self._refresh_map(osdmap)
+                continue
+            host, port, _hb = osdmap.osd_addrs[primary]
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters[tid] = fut
+            try:
+                await self.msgr.send_message(
+                    make_osd_op(tid, osdmap.epoch, pool_id, seed, oid,
+                                ops),
+                    EntityAddr(host, port), f"osd.{primary}")
+                reply = await asyncio.wait_for(
+                    fut, timeout=min(5.0 + attempt,
+                                     deadline -
+                                     asyncio.get_event_loop().time()))
+            except (asyncio.TimeoutError, ConnectionError, OSError,
+                    ConnectionError_):
+                self._waiters.pop(tid, None)
+                attempt += 1
+                await self._refresh_map(osdmap)
+                continue
+            if reply.result == -11:       # wrong target / not active
+                attempt += 1
+                await self._refresh_map(osdmap)
+                await asyncio.sleep(min(0.1 * attempt, 1.0))
+                continue
+            extra = json.loads(reply.extra) if reply.extra else {}
+            return reply.result, reply.data, extra
+
+    async def _refresh_map(self, cur) -> None:
+        await self.monc.subscribe(
+            "osdmap", cur.epoch + 1 if cur else 0)
+        await asyncio.sleep(0.1)

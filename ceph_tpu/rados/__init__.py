@@ -1,0 +1,175 @@
+"""librados analog: the public client API.
+
+ref: src/librados/librados_cxx.cc (Rados / IoCtx) — connection
+bootstrap via MonClient, pool handles, and synchronous+async object
+ops riding the Objecter. Method names mirror the reference's C++ API
+(``Rados::connect``, ``IoCtx::write/read/remove/stat``,
+``IoCtx::get_omap_vals`` …) so reference users find what they expect.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.mon.monitor import MonMap
+from ceph_tpu.msg import Keyring
+from ceph_tpu.osd.messages import (
+    OSD_OP_DELETE, OSD_OP_GETXATTR, OSD_OP_OMAP_GET, OSD_OP_OMAP_SET,
+    OSD_OP_PGLS, OSD_OP_READ, OSD_OP_SETXATTR, OSD_OP_STAT,
+    OSD_OP_TRUNCATE, OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_ZERO,
+)
+from ceph_tpu.osdc.objecter import Objecter, ObjectOperationError
+
+__all__ = ["Rados", "IoCtx", "ObjectOperationError"]
+
+
+class Rados:
+    """ref: librados::Rados."""
+
+    def __init__(self, monmap: MonMap, name: str = "client.admin",
+                 keyring: Keyring | None = None):
+        self.monc = MonClient(name, monmap, keyring=keyring)
+        self.objecter = Objecter(self.monc)
+
+    async def connect(self) -> None:
+        await self.monc.subscribe("osdmap", 0)
+        await self.monc.wait_for_osdmap()
+
+    async def shutdown(self) -> None:
+        await self.monc.shutdown()
+
+    async def mon_command(self, cmd, inbl: bytes = b"",
+                          timeout: float = 30.0):
+        return await self.monc.command(cmd, inbl, timeout=timeout)
+
+    async def pool_create(self, name: str, pg_num: int = 32,
+                          **kw) -> None:
+        ret, rs, _ = await self.mon_command(
+            dict({"prefix": "osd pool create", "pool": name,
+                  "pg_num": pg_num}, **kw))
+        if ret != 0:
+            raise ObjectOperationError(ret, rs)
+
+    async def pool_delete(self, name: str) -> None:
+        ret, rs, _ = await self.mon_command(
+            {"prefix": "osd pool rm", "pool": name})
+        if ret != 0:
+            raise ObjectOperationError(ret, rs)
+
+    async def open_ioctx(self, pool_name: str) -> "IoCtx":
+        pid = await self.objecter.pool_id(pool_name)
+        return IoCtx(self, pid, pool_name)
+
+    async def status(self) -> dict:
+        ret, rs, out = await self.mon_command({"prefix": "status"})
+        if ret != 0:
+            raise ObjectOperationError(ret, rs)
+        return json.loads(out)
+
+
+class IoCtx:
+    """ref: librados::IoCtx — per-pool I/O handle."""
+
+    def __init__(self, rados: Rados, pool_id: int, pool_name: str):
+        self.rados = rados
+        self.pool_id = pool_id
+        self.pool_name = pool_name
+
+    async def _op(self, oid: str, ops, timeout: float = 20.0):
+        res, data, extra = await self.rados.objecter.op_submit(
+            self.pool_id, oid, ops, timeout=timeout)
+        if res < 0:
+            raise ObjectOperationError(res, f"{oid}")
+        return data, extra
+
+    # -- writes ------------------------------------------------------------
+    async def write(self, oid: str, data: bytes, offset: int = 0):
+        await self._op(oid, [(OSD_OP_WRITE, offset, len(data), "",
+                              bytes(data))])
+
+    async def write_full(self, oid: str, data: bytes):
+        await self._op(oid, [(OSD_OP_WRITEFULL, 0, len(data), "",
+                              bytes(data))])
+
+    async def truncate(self, oid: str, size: int):
+        await self._op(oid, [(OSD_OP_TRUNCATE, size, 0, "", b"")])
+
+    async def zero(self, oid: str, offset: int, length: int):
+        await self._op(oid, [(OSD_OP_ZERO, offset, length, "", b"")])
+
+    async def remove(self, oid: str):
+        await self._op(oid, [(OSD_OP_DELETE, 0, 0, "", b"")])
+
+    async def setxattr(self, oid: str, name: str, value: bytes):
+        await self._op(oid, [(OSD_OP_SETXATTR, 0, 0, name,
+                              bytes(value))])
+
+    async def set_omap(self, oid: str, key: str, value: bytes):
+        await self._op(oid, [(OSD_OP_OMAP_SET, 0, 0, key,
+                              bytes(value))])
+
+    # -- reads -------------------------------------------------------------
+    async def read(self, oid: str, length: int = 0,
+                   offset: int = 0) -> bytes:
+        data, _ = await self._op(
+            oid, [(OSD_OP_READ, offset, length, "", b"")])
+        return data
+
+    async def stat(self, oid: str) -> int:
+        _, extra = await self._op(oid, [(OSD_OP_STAT, 0, 0, "", b"")])
+        return extra["size"]
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        data, _ = await self._op(
+            oid, [(OSD_OP_GETXATTR, 0, 0, name, b"")])
+        return data
+
+    async def get_omap_vals(self, oid: str) -> dict[str, bytes]:
+        _, extra = await self._op(
+            oid, [(OSD_OP_OMAP_GET, 0, 0, "", b"")])
+        return {k: bytes.fromhex(v)
+                for k, v in extra.get("omap", {}).items()}
+
+    async def list_objects(self) -> list[str]:
+        """rados ls: union of per-PG listings (ref: librados
+        nobjects_begin over pgls)."""
+        osdmap = await self.rados.monc.wait_for_osdmap()
+        pool = osdmap.pools[self.pool_id]
+        names: set[str] = set()
+        for seed in range(pool.pg_num):
+            try:
+                _, extra = await self._pg_op(
+                    seed, [(OSD_OP_PGLS, 0, 0, "", b"")])
+                names.update(extra.get("objects", []))
+            except ObjectOperationError:
+                continue
+        return sorted(names)
+
+    async def _pg_op(self, seed: int, ops):
+        """Address a specific PG (pgls needs per-PG targeting)."""
+        import numpy as np
+        osdmap = await self.rados.monc.wait_for_osdmap()
+        _, _, acting, actp = osdmap.pg_to_up_acting_osds(
+            self.pool_id, [seed])
+        primary = int(actp[0])
+        if primary < 0 or primary not in osdmap.osd_addrs:
+            raise ObjectOperationError(-11, f"pg {seed} has no primary")
+        from ceph_tpu.msg import EntityAddr
+        from ceph_tpu.osd.messages import make_osd_op
+        obj = self.rados.objecter
+        obj._tid += 1
+        tid = obj._tid
+        import asyncio
+        fut = asyncio.get_event_loop().create_future()
+        obj._waiters[tid] = fut
+        host, port, _ = osdmap.osd_addrs[primary]
+        await obj.msgr.send_message(
+            make_osd_op(tid, osdmap.epoch, self.pool_id, seed,
+                        f".pgls.{seed}", ops),
+            EntityAddr(host, port), f"osd.{primary}")
+        reply = await asyncio.wait_for(fut, timeout=10.0)
+        if reply.result < 0:
+            raise ObjectOperationError(reply.result, f"pgls {seed}")
+        extra = json.loads(reply.extra) if reply.extra else {}
+        return reply.data, extra

@@ -98,7 +98,6 @@ def test_three_mon_quorum_and_replication():
             m.store.get("config", "global/debug_osd") == b"10"
             for m in mons), msg="config replication")
         # every mon's paxos log agrees
-        lc = {m.paxos.last_committed for m in mons}
         await wait_for(lambda: len({m.paxos.last_committed
                                     for m in mons}) == 1,
                        msg="paxos convergence")
