@@ -1,0 +1,132 @@
+"""The `ceph` admin CLI.
+
+ref: src/ceph.in — argv is translated into mon command dicts and sent
+through MonClient, mirroring the reference's command spellings:
+
+    python -m ceph_tpu.bench.ceph_cli -c /tmp/ceph_tpu.conf status
+    ... osd tree | osd dump | osd df | osd pool ls | pg dump
+    ... osd pool create <name> <pg_num> [replicated|erasure [profile]]
+    ... osd pool set <name> <var> <val>
+    ... osd out <id> | osd in <id> | osd down <id>
+    ... osd map <pool> <object>
+    ... osd erasure-code-profile set <name> k=2 m=1 ...
+    ... config set <who> <name> <value> | config get <who> [<name>]
+    ... quorum_status | mon dump | health
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+
+from ceph_tpu.cluster.conf import read_conf
+from ceph_tpu.mon.client import MonClient
+
+
+def parse_command(words: list[str]) -> tuple[dict, bytes]:
+    """argv words -> mon command dict (ref: ceph CLI's cmdmap)."""
+    w = words
+    j = " ".join(w)
+    if j in ("status", "-s", "health", "mon dump", "quorum_status",
+             "osd dump", "osd tree", "osd df", "osd pool ls",
+             "pg dump", "osd getmap", "osd getcrushmap",
+             "config dump", "osd new"):
+        return {"prefix": "status" if j == "-s" else j}, b""
+    if w[:3] == ["osd", "pool", "create"]:
+        cmd = {"prefix": "osd pool create", "pool": w[3]}
+        if len(w) > 4:
+            cmd["pg_num"] = int(w[4])
+        if len(w) > 5:
+            cmd["pool_type"] = w[5]
+        if len(w) > 6:
+            cmd["erasure_code_profile"] = w[6]
+        return cmd, b""
+    if w[:3] == ["osd", "pool", "rm"]:
+        return {"prefix": "osd pool rm", "pool": w[3]}, b""
+    if w[:3] == ["osd", "pool", "set"]:
+        return {"prefix": "osd pool set", "pool": w[3], "var": w[4],
+                "val": w[5]}, b""
+    if w[:2] == ["osd", "map"]:
+        return {"prefix": "osd map", "pool": w[2], "object": w[3]}, b""
+    if w[:2] == ["osd", "crush"] and w[2] == "add":
+        cmd = {"prefix": "osd crush add", "id": int(w[3]),
+               "weight": float(w[4])}
+        for extra in w[5:]:
+            if extra.startswith("host="):
+                cmd["host"] = extra[5:]
+        return cmd, b""
+    if w[0] == "osd" and w[1] in ("out", "in", "down"):
+        return {"prefix": f"osd {w[1]}", "id": int(w[2])}, b""
+    if w[:2] == ["osd", "reweight"]:
+        return {"prefix": "osd reweight", "id": int(w[2]),
+                "weight": float(w[3])}, b""
+    if w[:2] == ["osd", "erasure-code-profile"]:
+        if w[2] == "set":
+            return {"prefix": "osd erasure-code-profile set",
+                    "name": w[3], "profile": w[4:]}, b""
+        if w[2] == "get":
+            return {"prefix": "osd erasure-code-profile get",
+                    "name": w[3]}, b""
+        if w[2] == "ls":
+            return {"prefix": "osd erasure-code-profile ls"}, b""
+    if w[0] == "config":
+        if w[1] == "set":
+            return {"prefix": "config set", "who": w[2], "name": w[3],
+                    "value": w[4]}, b""
+        if w[1] == "get":
+            cmd = {"prefix": "config get", "who": w[2]}
+            if len(w) > 3:
+                cmd["name"] = w[3]
+            return cmd, b""
+        if w[1] == "rm":
+            return {"prefix": "config rm", "who": w[2],
+                    "name": w[3]}, b""
+    raise SystemExit(f"unrecognized command: {j!r}")
+
+
+async def _run(conf: str, words: list[str], out_file: str | None) -> int:
+    monmap, keyring = read_conf(conf)
+    mc = MonClient("client.admin", monmap, keyring=keyring)
+    try:
+        cmd, inbl = parse_command(words)
+        ret, rs, outbl = await mc.command(cmd)
+        if ret != 0:
+            print(f"Error: {rs} ({ret})", file=sys.stderr)
+            return 1
+        if out_file:
+            with open(out_file, "wb") as f:
+                f.write(outbl)
+        elif outbl:
+            try:
+                print(json.dumps(json.loads(outbl), indent=2))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                sys.stdout.write(outbl.decode(errors="replace"))
+        if rs:
+            print(rs, file=sys.stderr)
+        return 0
+    finally:
+        await mc.shutdown()
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    conf = "/tmp/ceph_tpu.conf"
+    out_file = None
+    if args and args[0] in ("-c", "--conf"):
+        conf = args[1]
+        args = args[2:]
+    if "-o" in args:
+        i = args.index("-o")
+        out_file = args[i + 1]
+        args = args[:i] + args[i + 2:]
+    if not args:
+        print(__doc__)
+        return 0
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return asyncio.run(_run(conf, args, out_file))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

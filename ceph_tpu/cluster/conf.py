@@ -1,0 +1,39 @@
+"""Cluster conf file: how external tools find a running cluster.
+
+ref: ceph.conf + keyring files — a json document holding the fsid,
+monmap addresses and entity keys, written by vstart --serve and read
+by the ceph/rados CLIs (ref: rados -c ceph.conf --keyring ...).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from ceph_tpu.mon.monitor import MonMap
+from ceph_tpu.msg import Keyring
+
+
+def write_conf(path: str, monmap: MonMap,
+               keyring: Keyring | None) -> None:
+    doc = {
+        "fsid": monmap.fsid,
+        "mons": {n: list(v) for n, v in monmap.mons.items()},
+        "keys": {n: base64.b64encode(k).decode()
+                 for n, k in keyring.keys.items()} if keyring else {},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def read_conf(path: str) -> tuple[MonMap, Keyring | None]:
+    with open(path) as f:
+        doc = json.load(f)
+    monmap = MonMap(fsid=doc.get("fsid", ""))
+    for name, (rank, host, port) in doc["mons"].items():
+        monmap.add(name, rank, host, port)
+    keyring = None
+    if doc.get("keys"):
+        keyring = Keyring({n: base64.b64decode(k)
+                           for n, k in doc["keys"].items()})
+    return monmap, keyring

@@ -87,9 +87,10 @@ class Cluster:
         return self
 
     # -- helpers (ref: qa/standalone/ceph-helpers.sh) ----------------------
-    def leader(self) -> Monitor:
-        return next(m for m in self.mons
-                    if not m._stopped and m.is_leader())
+    def leader(self) -> Monitor | None:
+        """The current lead mon, or None mid-election."""
+        return next((m for m in self.mons
+                     if not m._stopped and m.is_leader()), None)
 
     async def wait_for_clean(self, timeout: float = 30.0) -> None:
         """All PGs of all pools active+clean on their primaries
@@ -117,7 +118,10 @@ class Cluster:
                         return False
                     seen.add(pgid_s)
         # every pg of every pool must have a primary somewhere
-        om = self.leader().osdmon.osdmap
+        lead = self.leader()
+        if lead is None or lead.osdmon.osdmap is None:
+            return False
+        om = lead.osdmon.osdmap
         want = sum(p.pg_num for p in om.pools.values())
         return len(seen) == want or want == 0
 
@@ -136,7 +140,8 @@ class Cluster:
                                 timeout: float = 15.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
-            om = self.leader().osdmon.osdmap
+            lead = self.leader()
+            om = lead.osdmon.osdmap if lead else None
             if om is not None and not bool(om.is_up(osd_id)):
                 return
             if asyncio.get_event_loop().time() > deadline:
@@ -165,7 +170,47 @@ async def _demo() -> None:
     await c.stop()
 
 
+async def _serve(args) -> None:
+    """Run a cluster until killed, publishing its conf for the ceph/
+    rados CLIs (the long-lived half of vstart.sh)."""
+    from ceph_tpu.cluster.conf import write_conf
+    c = await Cluster(n_mons=args.mon_num, n_osds=args.osd_num,
+                      data_dir=args.data_dir).start()
+    if args.pool:
+        await c.client.pool_create(args.pool, pg_num=args.pg_num)
+        await c.wait_for_clean(timeout=300)
+    write_conf(args.conf, c.monmap, c.keyring)
+    print(f"cluster up; conf at {args.conf}", flush=True)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except asyncio.CancelledError:
+        pass
+    finally:
+        await c.stop()
+
+
+def main(argv=None) -> None:
+    import argparse
+    p = argparse.ArgumentParser(prog="vstart", description=__doc__)
+    p.add_argument("--serve", action="store_true",
+                   help="run until killed; write --conf for the CLIs")
+    p.add_argument("--mon-num", type=int, default=1)
+    p.add_argument("--osd-num", type=int, default=3)
+    p.add_argument("--pool", default=None,
+                   help="create this pool and wait for clean")
+    p.add_argument("--pg-num", type=int, default=8)
+    p.add_argument("--conf", default="/tmp/ceph_tpu.conf")
+    p.add_argument("--data-dir", default=None,
+                   help="durable WALStore osd data under this dir")
+    args = p.parse_args(argv)
+    if args.serve:
+        asyncio.run(_serve(args))
+    else:
+        asyncio.run(_demo())
+
+
 if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
-    asyncio.run(_demo())
+    main()

@@ -119,6 +119,25 @@ class MonClient(Dispatcher):
             return ret, rs, outbl
         return -110, f"command timed out ({last_err})", b""   # -ETIMEDOUT
 
+    async def send_report(self, msg) -> bool:
+        """Fire-and-forget daemon report (boot/failure/pgstats) with mon
+        hunting: a dead current mon rotates to the next rank instead of
+        silently dropping reports (ref: MonClient::_reopen_session)."""
+        ranks = self.monmap.ranks()
+        for _ in range(len(ranks)):
+            rank = self._cur_rank
+            try:
+                await asyncio.wait_for(self.msgr.send_message(
+                    msg, self.monmap.addr_of_rank(rank),
+                    f"mon.{self.monmap.name_of_rank(rank)}"),
+                    timeout=2.0)
+                return True
+            except (asyncio.TimeoutError, ConnectionError, OSError,
+                    AuthError, ConnectionError_):
+                self._cur_rank = ranks[(ranks.index(rank) + 1)
+                                       % len(ranks)]
+        return False
+
     # -- maps --------------------------------------------------------------
     async def subscribe(self, what: str = "osdmap",
                         start: int = 0) -> None:

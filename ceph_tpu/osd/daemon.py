@@ -100,13 +100,11 @@ class OSD(Dispatcher):
 
     # -- lifecycle ---------------------------------------------------------
     async def _send_boot(self) -> None:
-        await self.monc.msgr.send_message(MOSDBoot(
+        await self.monc.send_report(MOSDBoot(
             osd=self.whoami, addr_host=self.msgr.addr.host,
             addr_port=self.msgr.addr.port,
             hb_port=self.hb_msgr.addr.port,
-            boot_epoch=self.osdmap.epoch if self.osdmap else 0),
-            self.monc.monmap.addr_of_rank(self.monc._cur_rank),
-            f"mon.{self.monc.monmap.name_of_rank(self.monc._cur_rank)}")
+            boot_epoch=self.osdmap.epoch if self.osdmap else 0))
 
     async def boot(self, host: str = "127.0.0.1") -> None:
         """ref: OSD::init + _send_boot."""
@@ -152,6 +150,9 @@ class OSD(Dispatcher):
             log.dout(1, f"osd.{self.whoami} marked down but alive; "
                         f"re-booting")
             asyncio.ensure_future(self._send_boot())
+        by_pool: dict[int, list[PG]] = {}
+        for pg in self.pgs.values():
+            by_pool.setdefault(pg.pool.id, []).append(pg)
         for pool in osdmap.pools.values():
             seeds = np.arange(pool.pg_num, dtype=np.uint32)
             up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
@@ -163,10 +164,9 @@ class OSD(Dispatcher):
             for s in mine:
                 pgid = pg_t(pool.id, int(s))
                 if str(pgid) not in self.pgs:
-                    self.pgs[str(pgid)] = PG(self, pool, pgid)
-            for pgid_s, pg in list(self.pgs.items()):
-                if pg.pool.id != pool.id:
-                    continue
+                    pg = self.pgs[str(pgid)] = PG(self, pool, pgid)
+                    by_pool.setdefault(pool.id, []).append(pg)
+            for pg in by_pool.get(pool.id, []):
                 row = pg.pgid.seed
                 pg.pool = pool
                 pg.advance(
@@ -287,17 +287,10 @@ class OSD(Dispatcher):
 
     async def _report_failure(self, target: int) -> None:
         """ref: OSD::send_failures -> MOSDFailure to the mon."""
-        try:
-            await self.monc.msgr.send_message(MOSDFailure(
-                target=target,
-                failed_for=int(self.hb_grace),
-                epoch=self.osdmap.epoch,
-                reporter=f"osd.{self.whoami}"),
-                self.monc.monmap.addr_of_rank(self.monc._cur_rank),
-                f"mon."
-                f"{self.monc.monmap.name_of_rank(self.monc._cur_rank)}")
-        except Exception:
-            pass
+        await self.monc.send_report(MOSDFailure(
+            target=target, failed_for=int(self.hb_grace),
+            epoch=self.osdmap.epoch,
+            reporter=f"osd.{self.whoami}"))
 
     def _hb_rx(self, m: MOSDPing) -> None:
         self._hb_last_rx[m.from_osd] = \
@@ -316,15 +309,9 @@ class OSD(Dispatcher):
                          if pg.is_primary()}
                 if not stats:
                     continue
-                try:
-                    await self.monc.msgr.send_message(MPGStats(
-                        osd=self.whoami, epoch=self.osdmap.epoch,
-                        stats=stats),
-                        self.monc.monmap.addr_of_rank(
-                            self.monc._cur_rank),
-                        f"mon.{self.monc.monmap.name_of_rank(self.monc._cur_rank)}")
-                except Exception:
-                    pass
+                await self.monc.send_report(MPGStats(
+                    osd=self.whoami, epoch=self.osdmap.epoch,
+                    stats=stats))
         except asyncio.CancelledError:
             pass
 

@@ -71,6 +71,10 @@ class PG:
         self._worker: asyncio.Task | None = None
         self._repop_waiters: dict[int, tuple[set[int], asyncio.Future]] = {}
         self._push_waiters: dict[str, asyncio.Future] = {}
+        # (client, tid) -> (result, extra): replays of mutating ops whose
+        # reply was lost return the recorded outcome instead of
+        # re-executing (ref: pg_log_entry_t reqid dedup)
+        self._reqid_results: dict[tuple, tuple] = {}
         self._ensure_collection()
         self._load_meta()
 
@@ -182,6 +186,12 @@ class PG:
             # pull objects the primary itself lacks
             for oid, entry in list(self.my_missing.items()):
                 await self._pull(best_osd, oid)
+            if self.my_missing:
+                # do NOT activate with stale objects: a client read
+                # would serve pre-outage data. Retry the interval.
+                self.state = "peering"
+                self.osd.request_repeer(self, delay=0.5)
+                return
         self.last_user_version = max(self.last_user_version,
                                      self.pg_log.head.v)
         # per-peer missing sets (ref: GetMissing)
@@ -263,10 +273,10 @@ class PG:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
             log.error(f"pg {self.pgid} push apply failed: {e}")
+        self.my_missing.pop(m.oid, None)
         fut = self._push_waiters.get(m.oid)
         if fut and not fut.done():
             fut.set_result(True)
-            self.my_missing.pop(m.oid, None)
 
     async def _recover(self) -> None:
         """Push every peer's missing objects (ref: run_recovery_op)."""
@@ -319,7 +329,24 @@ class PG:
 
     async def _execute(self, m: MOSDOp) -> None:
         """ref: PrimaryLogPG::execute_ctx — reads serve immediately,
-        writes run the replication pipeline."""
+        writes run the replication pipeline. Mutations are deduped by
+        (client, tid) so objecter resends of an applied-but-unacked op
+        (e.g. a non-idempotent DELETE) return the original result."""
+        # reqid = (entity, messenger incarnation, tid) — distinct client
+        # processes sharing a name must not collide
+        reqid = (m.src, getattr(m.conn, "peer_session", 0), m.tid)
+        mutating = {OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_TRUNCATE,
+                    OSD_OP_ZERO, OSD_OP_DELETE, OSD_OP_SETXATTR,
+                    OSD_OP_OMAP_SET}
+        if any(c in mutating for c in m.op_codes) and \
+                reqid in self._reqid_results:
+            # resend of an applied-but-unacked mutation: return the
+            # recorded outcome, never re-execute (a DELETE replay would
+            # spuriously return -ENOENT; a write would duplicate log
+            # entries). ref: PrimaryLogPG::already_complete (reqids)
+            result, extra = self._reqid_results[reqid]
+            await self._reply(m, result, b"", extra)
+            return
         store = self.osd.store
         cid = self.cid
         oid = m.oid
@@ -400,6 +427,10 @@ class PG:
             return
         result = await self._submit_write(oid, t, deleted)
         extra["version"] = str(self.pg_log.head)
+        self._reqid_results[reqid] = (result, extra)
+        if len(self._reqid_results) > 2000:      # bounded (log-trim analog)
+            for k in list(self._reqid_results)[:1000]:
+                self._reqid_results.pop(k, None)
         await self._reply(m, result, data_out, extra)
 
     async def _submit_write(self, oid: str, t: Transaction,

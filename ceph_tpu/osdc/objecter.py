@@ -65,8 +65,9 @@ class Objecter(Dispatcher):
         raise ObjectOperationError(-2, f"no pool {name!r}")
 
     async def op_submit(self, pool_id: int, oid: str, ops: list[tuple],
-                        timeout: float = 20.0):
+                        timeout: float = 20.0, seed: int | None = None):
         """Send one op bundle; retries across map changes.
+        ``seed`` overrides name hashing for PG-targeted ops (pgls).
         Returns (result, data, extra_dict)."""
         deadline = asyncio.get_event_loop().time() + timeout
         attempt = 0
@@ -74,7 +75,14 @@ class Objecter(Dispatcher):
             if asyncio.get_event_loop().time() > deadline:
                 raise ObjectOperationError(-110, f"op on {oid} timed out")
             osdmap = await self.monc.wait_for_osdmap()
-            seed, primary = self._calc_target(osdmap, pool_id, oid)
+            if seed is not None:
+                import numpy as np_
+                _, _, _, actp = osdmap.pg_to_up_acting_osds(
+                    pool_id, [seed])
+                pg_seed, primary = seed, int(actp[0])
+            else:
+                pg_seed, primary = self._calc_target(osdmap, pool_id,
+                                                     oid)
             if primary < 0 or primary not in osdmap.osd_addrs:
                 await self._refresh_map(osdmap)
                 continue
@@ -85,8 +93,8 @@ class Objecter(Dispatcher):
             self._waiters[tid] = fut
             try:
                 await self.msgr.send_message(
-                    make_osd_op(tid, osdmap.epoch, pool_id, seed, oid,
-                                ops),
+                    make_osd_op(tid, osdmap.epoch, pool_id, pg_seed,
+                                oid, ops),
                     EntityAddr(host, port), f"osd.{primary}")
                 reply = await asyncio.wait_for(
                     fut, timeout=min(5.0 + attempt,

@@ -147,29 +147,10 @@ class IoCtx:
         return sorted(names)
 
     async def _pg_op(self, seed: int, ops):
-        """Address a specific PG (pgls needs per-PG targeting)."""
-        import numpy as np
-        osdmap = await self.rados.monc.wait_for_osdmap()
-        _, _, acting, actp = osdmap.pg_to_up_acting_osds(
-            self.pool_id, [seed])
-        primary = int(actp[0])
-        if primary < 0 or primary not in osdmap.osd_addrs:
-            raise ObjectOperationError(-11, f"pg {seed} has no primary")
-        from ceph_tpu.msg import EntityAddr
-        from ceph_tpu.osd.messages import make_osd_op
-        obj = self.rados.objecter
-        obj._tid += 1
-        tid = obj._tid
-        import asyncio
-        fut = asyncio.get_event_loop().create_future()
-        obj._waiters[tid] = fut
-        host, port, _ = osdmap.osd_addrs[primary]
-        await obj.msgr.send_message(
-            make_osd_op(tid, osdmap.epoch, self.pool_id, seed,
-                        f".pgls.{seed}", ops),
-            EntityAddr(host, port), f"osd.{primary}")
-        reply = await asyncio.wait_for(fut, timeout=10.0)
-        if reply.result < 0:
-            raise ObjectOperationError(reply.result, f"pgls {seed}")
-        extra = json.loads(reply.extra) if reply.extra else {}
-        return reply.data, extra
+        """Address a specific PG (pgls needs per-PG targeting) through
+        the Objecter's full resend machinery."""
+        res, data, extra = await self.rados.objecter.op_submit(
+            self.pool_id, f".pgls.{seed}", ops, seed=seed, timeout=10.0)
+        if res < 0:
+            raise ObjectOperationError(res, f"pgls {seed}")
+        return data, extra

@@ -1,0 +1,99 @@
+"""Multi-process CLI tier: vstart --serve in a subprocess, ceph/rados
+CLIs against it from this process.
+
+ref test model: qa/workunits (rados CLI loops against a live vstart
+cluster). This is the only tier where client and daemons are in
+DIFFERENT processes, exercising the full wire path with no shared
+event loop.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.bench import ceph_cli, rados_cli
+
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def cluster_conf(tmp_path_factory):
+    conf = str(tmp_path_factory.mktemp("cli") / "ceph_tpu.conf")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(REPO))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ceph_tpu.cluster.vstart", "--serve",
+         "--mon-num", "1", "--osd-num", "3", "--pool", "rbd",
+         "--pg-num", "8", "--conf", conf],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    deadline = time.time() + 180
+    while not os.path.exists(conf):
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace")
+            raise RuntimeError(f"vstart died:\n{out[-2000:]}")
+        if time.time() > deadline:
+            proc.kill()
+            raise TimeoutError("vstart never published its conf")
+        time.sleep(0.5)
+    yield conf
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_ceph_status_and_pool_admin(cluster_conf, capsys):
+    assert ceph_cli.main(["-c", cluster_conf, "status"]) == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["osdmap"]["num_up_osds"] == 3
+    assert ceph_cli.main(["-c", cluster_conf, "osd", "tree"]) == 0
+    out = capsys.readouterr().out
+    assert "host0" in out
+    assert ceph_cli.main(["-c", cluster_conf, "osd", "pool", "ls"]) == 0
+    pools = json.loads(capsys.readouterr().out)
+    assert any(p["name"] == "rbd" for p in pools)
+    assert ceph_cli.main(["-c", cluster_conf, "osd", "map", "rbd",
+                          "someobj"]) == 0
+    mapping = json.loads(capsys.readouterr().out)
+    assert len(mapping["acting"]) == 3
+    assert ceph_cli.main(["-c", cluster_conf, "config", "set",
+                          "global", "debug_osd", "5"]) == 0
+    capsys.readouterr()
+    assert ceph_cli.main(["-c", cluster_conf, "config", "get",
+                          "global", "debug_osd"]) == 0
+    assert capsys.readouterr().out.strip() == "5"
+
+
+def test_rados_put_get_ls_bench(cluster_conf, tmp_path, capsys):
+    src = tmp_path / "in.bin"
+    src.write_bytes(os.urandom(4096))
+    dst = tmp_path / "out.bin"
+    assert rados_cli.main(["-c", cluster_conf, "-p", "rbd", "put",
+                           "cliobj", str(src)]) == 0
+    assert rados_cli.main(["-c", cluster_conf, "-p", "rbd", "get",
+                           "cliobj", str(dst)]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+    capsys.readouterr()
+    assert rados_cli.main(["-c", cluster_conf, "-p", "rbd",
+                           "stat", "cliobj"]) == 0
+    assert "size 4096" in capsys.readouterr().out
+    assert rados_cli.main(["-c", cluster_conf, "-p", "rbd", "ls"]) == 0
+    assert "cliobj" in capsys.readouterr().out.split()
+    # a short bench: the reference's `rados bench 3 write`
+    assert rados_cli.main(["-c", cluster_conf, "-p", "rbd", "bench",
+                           "3", "write", "-b", "65536", "-t", "8"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ops"] > 0 and rep["mb_per_sec"] > 0
+    assert rados_cli.main(["-c", cluster_conf, "-p", "rbd", "rm",
+                           "cliobj"]) == 0
+    assert rados_cli.main(["-c", cluster_conf, "lspools"]) == 0
+    assert "rbd" in capsys.readouterr().out.split()
