@@ -26,6 +26,14 @@ from ceph_tpu.mon.client import MonClient
 
 def parse_command(words: list[str]) -> tuple[dict, bytes]:
     """argv words -> mon command dict (ref: ceph CLI's cmdmap)."""
+    try:
+        return _parse_command(words)
+    except IndexError:
+        raise SystemExit(
+            f"unrecognized/incomplete command: {' '.join(words)!r}")
+
+
+def _parse_command(words: list[str]) -> tuple[dict, bytes]:
     w = words
     j = " ".join(w)
     if j in ("status", "-s", "health", "mon dump", "quorum_status",

@@ -65,13 +65,19 @@ async def _run(conf: str, pool: str | None, words: list[str]) -> int:
         await r.connect()
         cmd = words[0]
         if cmd == "lspools":
-            ret, _, out = await r.mon_command(
+            ret, rs, out = await r.mon_command(
                 {"prefix": "osd pool ls"})
+            if ret != 0:
+                print(f"error: {rs} ({ret})", file=sys.stderr)
+                return 1
             for p in json.loads(out):
                 print(p["name"])
             return 0
         if cmd == "df":
-            ret, _, out = await r.mon_command({"prefix": "osd df"})
+            ret, rs, out = await r.mon_command({"prefix": "osd df"})
+            if ret != 0:
+                print(f"error: {rs} ({ret})", file=sys.stderr)
+                return 1
             print(json.dumps(json.loads(out), indent=2))
             return 0
         if pool is None:

@@ -22,9 +22,12 @@ from ceph_tpu.mon.client import MonClient
 from ceph_tpu.mon.messages import MOSDBoot, MOSDFailure, MPGStats
 from ceph_tpu.msg import Dispatcher, EntityAddr, Keyring, Messenger, Policy
 from ceph_tpu.os_.objectstore import MemStore, ObjectStore
+from ceph_tpu.osd.ec_pg import ECPG
 from ceph_tpu.osd.messages import (
-    MOSDOp, MOSDPGInfo, MOSDPGPull, MOSDPGPush, MOSDPGPushReply,
-    MOSDPGQuery, MOSDPing, MOSDRepOp, MOSDRepOpReply, PING, PING_REPLY,
+    MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply, MOSDOp, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
+    MOSDPGPushReply, MOSDPGQuery, MOSDPing, MOSDRepOp, MOSDRepOpReply,
+    PING, PING_REPLY,
 )
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import pg_t
@@ -161,17 +164,21 @@ class OSD(Dispatcher):
                 (acting == self.whoami).any(axis=1) |
                 (up == self.whoami).any(axis=1) |
                 (actp == self.whoami) | (upp == self.whoami))
+            cls = ECPG if pool.is_erasure() else PG
             for s in mine:
                 pgid = pg_t(pool.id, int(s))
                 if str(pgid) not in self.pgs:
-                    pg = self.pgs[str(pgid)] = PG(self, pool, pgid)
+                    pg = self.pgs[str(pgid)] = cls(self, pool, pgid)
                     by_pool.setdefault(pool.id, []).append(pg)
             for pg in by_pool.get(pool.id, []):
                 row = pg.pgid.seed
                 pg.pool = pool
+                # EC sets are positional: holes stay as -1 markers
                 pg.advance(
-                    [int(o) for o in up[row] if o != ITEM_NONE],
-                    [int(o) for o in acting[row] if o != ITEM_NONE],
+                    [int(o) if o != ITEM_NONE else -1
+                     for o in up[row]],
+                    [int(o) if o != ITEM_NONE else -1
+                     for o in acting[row]],
                     int(actp[row]), osdmap.epoch)
         # drop PGs whose pool vanished
         for pgid_s in [p for p, pg in self.pgs.items()
@@ -186,11 +193,14 @@ class OSD(Dispatcher):
             pool = self.osdmap.pools.get(pgid.pool)
             if pool is None:
                 return None
-            pg = self.pgs[pgid_s] = PG(self, pool, pgid)
+            cls = ECPG if pool.is_erasure() else PG
+            pg = self.pgs[pgid_s] = cls(self, pool, pgid)
             up, upp, acting, actp = self.osdmap.pg_to_up_acting_osds(
                 pgid.pool, [pgid.seed])
-            pg.advance([int(o) for o in up[0] if o != ITEM_NONE],
-                       [int(o) for o in acting[0] if o != ITEM_NONE],
+            pg.advance([int(o) if o != ITEM_NONE else -1
+                        for o in up[0]],
+                       [int(o) if o != ITEM_NONE else -1
+                        for o in acting[0]],
                        int(actp[0]), self.osdmap.epoch)
         return pg
 
@@ -215,6 +225,33 @@ class OSD(Dispatcher):
             pg = self._pg_for(msg.pgid)
             if pg is not None:
                 pg.handle_rep_reply(msg)
+            return True
+        if isinstance(msg, MOSDECSubOpWrite):
+            pg = self._pg_for(msg.pgid, create=True)
+            if isinstance(pg, ECPG):
+                pg.handle_ec_sub_write(msg)
+            else:
+                log.dout(1, f"ec sub-write for non-ec pg {msg.pgid}")
+                await msg.conn.send_message(MOSDECSubOpWriteReply(
+                    tid=msg.tid, result=-22, pgid=msg.pgid,
+                    from_osd=self.whoami))
+            return True
+        if isinstance(msg, MOSDECSubOpWriteReply):
+            pg = self._pg_for(msg.pgid)
+            if isinstance(pg, ECPG):
+                pg.handle_ec_sub_write_reply(msg)
+            return True
+        if isinstance(msg, MOSDECSubOpRead):
+            pg = self._pg_for(msg.pgid, create=True)
+            if isinstance(pg, ECPG):
+                pg.handle_ec_sub_read(msg)
+            else:
+                log.dout(1, f"ec sub-read for non-ec pg {msg.pgid}")
+            return True
+        if isinstance(msg, MOSDECSubOpReadReply):
+            pg = self._pg_for(msg.pgid)
+            if isinstance(pg, ECPG):
+                pg.handle_ec_sub_read_reply(msg)
             return True
         if isinstance(msg, MOSDPGQuery):
             pg = self._pg_for(msg.pgid, create=True)

@@ -1,0 +1,608 @@
+"""ECPG: erasure-coded placement groups in the live cluster.
+
+ref: src/osd/ECBackend.{h,cc} + ECCommon.h — the EC strategy under a
+PG: objects are striped (ECUtil::stripe_info_t); each acting POSITION
+holds one shard; the primary widens partial writes to whole stripes
+(RMWPipeline: sub-read old chunks, merge, re-encode), fans per-shard
+chunk writes out as sub-ops (MOSDECSubOpWrite), reassembles reads from
+k shards (ReadPipeline) and decodes around missing/stale shards via
+``minimum_to_decode`` + ``decode_chunks``; recovery regenerates a lost
+shard from any k live shards (ECBackend::handle_recovery_read_complete).
+
+TPU-first: every encode/decode over a stripe range is ONE batched
+device call ((B, k, C) -> (B, m, C)) through the jax EC plugin — the
+reference encodes stripe-by-stripe on CPU.
+
+Shard object layout: the collection object holds this shard's
+concatenated chunks; xattrs ``_v`` (object version) and ``_size``
+(logical size) are written with every sub-op so any shard can answer
+stat and staleness checks (ref: EC objects carry identical xattrs on
+every shard).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+
+from ceph_tpu.ec.registry import factory as ec_factory
+from ceph_tpu.os_.objectstore import StoreError, Transaction
+from ceph_tpu.osd.ecutil import StripeInfo
+from ceph_tpu.osd.messages import (
+    MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply, MOSDOp, OSD_OP_DELETE, OSD_OP_GETXATTR,
+    OSD_OP_OMAP_GET, OSD_OP_OMAP_SET, OSD_OP_PGLS, OSD_OP_READ,
+    OSD_OP_SETXATTR, OSD_OP_STAT, OSD_OP_TRUNCATE, OSD_OP_WRITE,
+    OSD_OP_WRITEFULL, OSD_OP_ZERO,
+)
+from ceph_tpu.osd.pg import PG, PGMETA
+from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry, eversion
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("osd")
+
+
+def _vblob(v: eversion) -> bytes:
+    return v.epoch.to_bytes(4, "little") + v.v.to_bytes(8, "little")
+
+
+def _vparse(b: bytes | None) -> eversion:
+    if not b:
+        return eversion()
+    return eversion(int.from_bytes(b[:4], "little"),
+                    int.from_bytes(b[4:12], "little"))
+
+
+class ECPG(PG):
+    def __init__(self, osd, pool, pgid):
+        super().__init__(osd, pool, pgid)
+        prof = dict(pool.extra.get("profile") or
+                    {"k": 2, "m": 1, "plugin": "jax"})
+        prof.setdefault("plugin", "jax")
+        self.ec = ec_factory(prof)
+        self.k = self.ec.get_data_chunk_count()
+        self.m = self.ec.get_coding_chunk_count()
+        self.sinfo = StripeInfo(
+            self.k, int(prof.get("stripe_unit", 4096)))
+        self._subop_waiters: dict[int, tuple[set[int], asyncio.Future]] = {}
+        self._subread_waiters: dict[int, asyncio.Future] = {}
+
+    # -- shard helpers -----------------------------------------------------
+    def my_shard(self) -> int:
+        try:
+            return self.acting.index(self.osd.whoami)
+        except ValueError:
+            return -1
+
+    def _local_shard_state(self, oid: str):
+        """(exists, shard bytes, version, logical size)."""
+        try:
+            data = self.osd.store.read(self.cid, oid)
+            attrs = self.osd.store.getattrs(self.cid, oid)
+        except StoreError:
+            return False, b"", eversion(), 0
+        return True, data, _vparse(attrs.get("_v")), \
+            int.from_bytes(attrs.get("_size", b"\0" * 8), "little")
+
+    def _obj_version(self, oid: str) -> eversion:
+        return self._local_shard_state(oid)[2]
+
+    def _obj_size(self, oid: str) -> int:
+        exists, _, _, size = self._local_shard_state(oid)
+        if not exists:
+            raise StoreError(f"no object {oid}")
+        return size
+
+    # -- chunk gathering (the ReadPipeline) --------------------------------
+    async def _subread(self, osd_id: int, oid: str, chunk_off: int,
+                       chunk_len: int):
+        tid = self.osd.next_tid()
+        fut = asyncio.get_event_loop().create_future()
+        self._subread_waiters[tid] = fut
+        try:
+            await self.osd.send_osd(osd_id, MOSDECSubOpRead(
+                tid=tid, epoch=self.epoch, pgid=self.cid, oid=oid,
+                chunk_off=chunk_off, chunk_len=chunk_len,
+                from_osd=self.osd.whoami))
+            return await asyncio.wait_for(fut, timeout=5.0)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            return None
+        finally:
+            self._subread_waiters.pop(tid, None)
+
+    async def _gather(self, oid: str, first: int, count: int,
+                      version: eversion):
+        """Collect this stripe range's chunks from live, fresh shards
+        and reconstruct data chunks 0..k-1 -> (count, k, C) uint8.
+
+        Shards whose object version differs (missed writes / stale
+        after outage) are excluded; decode fills the gaps
+        (ref: ECCommon::ReadPipeline get_remaining_shards)."""
+        C = self.sinfo.chunk_size
+        off, ln = first * C, count * C
+        avail: dict[int, np.ndarray] = {}
+        for pos, osd_id in enumerate(self.acting):
+            # stop once decodable: all data shards, or any k once the
+            # data positions have been tried (MDS property)
+            if set(range(self.k)) <= set(avail) or \
+                    (pos >= self.k and len(avail) >= self.k):
+                break
+            if osd_id < 0 or not self.osd.osd_is_up(osd_id):
+                continue
+            if osd_id == self.osd.whoami:
+                exists, data, ver, _size = self._local_shard_state(oid)
+                if not exists or ver != version:
+                    continue
+                chunk = np.zeros(ln, dtype=np.uint8)
+                piece = data[off:off + ln]
+                chunk[:len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+                avail[pos] = chunk.reshape(count, C)
+                continue
+            reply = await self._subread(osd_id, oid, off, ln)
+            if reply is None or not reply.exists:
+                continue
+            if eversion(reply.version_epoch, reply.version_v) != version:
+                continue
+            chunk = np.zeros(ln, dtype=np.uint8)
+            piece = reply.data[:ln]
+            chunk[:len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+            avail[pos] = chunk.reshape(count, C)
+        want = set(range(self.k))
+        if want <= set(avail):
+            return np.stack([avail[c] for c in range(self.k)], axis=1)
+        # degraded: decode missing data chunks from what we have
+        need = self.ec.minimum_to_decode(want, list(avail))
+        if not set(need) <= set(avail):
+            raise StoreError(
+                f"{oid}: cannot decode (have {sorted(avail)})")
+        use = sorted(need)
+        stacked = np.stack([avail[c] for c in use], axis=1)
+        missing = sorted(want - set(avail))
+        decoded = self.ec.decode_batch(missing, use, stacked)
+        out = np.zeros((count, self.k, C), dtype=np.uint8)
+        for c in range(self.k):
+            if c in avail:
+                out[:, c] = avail[c]
+            else:
+                out[:, c] = np.asarray(decoded[:, missing.index(c)])
+        return out
+
+    # -- client op execution ----------------------------------------------
+    async def _execute(self, m: MOSDOp) -> None:
+        reqid = (m.src, getattr(m.conn, "peer_session", 0), m.tid)
+        store = self.osd.store
+        oid = m.oid
+        data_out = b""
+        extra: dict = {}
+        # edits: (offset, bytes) merges; specials for truncate/delete
+        edits: list[tuple[int, bytes]] = []
+        new_size: int | None = None
+        attrs_delta: dict[str, bytes] = {}
+        omap_delta: dict[str, bytes] = {}
+        deleted = False
+        write_full = None
+        for code, off, length, name, data in m.unpack_ops():
+            if code == OSD_OP_READ:
+                try:
+                    data_out = await self._read_range(oid, off, length)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})
+                    return
+            elif code == OSD_OP_STAT:
+                try:
+                    extra["size"] = self._obj_size(oid)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})
+                    return
+            elif code == OSD_OP_GETXATTR:
+                try:
+                    attrs = store.getattrs(self.cid, oid)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})
+                    return
+                if name not in attrs:
+                    await self._reply(m, -61, b"", {})
+                    return
+                data_out = attrs[name]
+            elif code == OSD_OP_OMAP_GET:
+                try:
+                    omap = store.omap_get(self.cid, oid)
+                except StoreError:
+                    await self._reply(m, -2, b"", {})
+                    return
+                extra["omap"] = {k: v.hex() for k, v in omap.items()
+                                 if not k.startswith("_")}
+            elif code == OSD_OP_PGLS:
+                extra["objects"] = [o for o in
+                                    store.list_objects(self.cid)
+                                    if o != PGMETA]
+            elif code == OSD_OP_WRITE:
+                edits.append((off, bytes(data)))
+            elif code == OSD_OP_WRITEFULL:
+                write_full = bytes(data)
+            elif code == OSD_OP_ZERO:
+                edits.append((off, b"\x00" * length))
+            elif code == OSD_OP_TRUNCATE:
+                new_size = off
+            elif code == OSD_OP_DELETE:
+                deleted = True
+            elif code == OSD_OP_SETXATTR:
+                attrs_delta[name] = bytes(data)
+            elif code == OSD_OP_OMAP_SET:
+                omap_delta[name] = bytes(data)
+            else:
+                await self._reply(m, -95, b"", {})
+                return
+        mutated = bool(edits or attrs_delta or omap_delta or deleted or
+                       write_full is not None or new_size is not None)
+        if not mutated:
+            await self._reply(m, 0, data_out, extra)
+            return
+        if reqid in self._reqid_results:
+            result, rextra = self._reqid_results[reqid]
+            await self._reply(m, result, b"", rextra)
+            return
+        if deleted and not self.osd.store.exists(self.cid, oid):
+            await self._reply(m, -2, b"", {})
+            return
+        result = await self._submit_ec_write(
+            oid, edits, write_full, new_size, deleted, attrs_delta,
+            omap_delta)
+        extra["version"] = str(self.pg_log.head)
+        self._reqid_results[reqid] = (result, extra)
+        if len(self._reqid_results) > 2000:
+            for k in list(self._reqid_results)[:1000]:
+                self._reqid_results.pop(k, None)
+        await self._reply(m, result, data_out, extra)
+
+    async def _read_range(self, oid: str, off: int,
+                          length: int) -> bytes:
+        size = self._obj_size(oid)          # raises if absent
+        end = size if not length else min(off + length, size)
+        if off >= end:
+            return b""
+        version = self._obj_version(oid)
+        first, count = self.sinfo.stripe_range(off, end - off)
+        stripes = await self._gather(oid, first, count, version)
+        flat = stripes.reshape(-1).tobytes()
+        W = self.sinfo.stripe_width
+        lo = off - first * W
+        return flat[lo:lo + (end - off)]
+
+    # -- the RMW + sub-op write pipeline -----------------------------------
+    async def _submit_ec_write(self, oid, edits, write_full, new_size,
+                               deleted, attrs_delta, omap_delta) -> int:
+        live = self.live_acting()
+        if len(live) < self.pool.min_size:
+            return -11
+        exists, _, old_version, old_size = self._local_shard_state(oid)
+        self.last_user_version += 1
+        version = eversion(self.epoch, self.last_user_version)
+        entry = self.pg_log.add(
+            version, oid, OP_DELETE if deleted else OP_MODIFY)
+        self.pg_log.trim()
+        self._meta_txn_store()
+        if deleted:
+            return await self._fan_out_delete(oid, entry)
+        if write_full is not None:
+            logical = write_full
+            size = len(logical)
+            first, count = 0, self.sinfo.object_stripes(size) or 1
+            buf = np.zeros(count * self.sinfo.stripe_width,
+                           dtype=np.uint8)
+            buf[:size] = np.frombuffer(logical, dtype=np.uint8)
+            trunc_stripes = count
+        else:
+            size = old_size if exists else 0
+            hi = max([off + len(b) for off, b in edits], default=0)
+            size = max(size, hi)
+            if new_size is not None:
+                size = new_size
+            span_lo = min([off for off, _ in edits], default=0)
+            span_hi = max(hi, size if new_size is not None else 0)
+            if new_size is not None and exists:
+                span_lo = 0 if not edits else min(span_lo, new_size)
+                span_hi = max(span_hi, old_size)
+            first, count = self.sinfo.stripe_range(
+                span_lo, max(span_hi - span_lo, 1))
+            # RMW: read the touched stripes' old contents
+            if exists:
+                old = await self._gather(oid, first, count, old_version)
+            else:
+                old = np.zeros((count, self.k, self.sinfo.chunk_size),
+                               dtype=np.uint8)
+            buf = old.reshape(-1).copy()
+            W = self.sinfo.stripe_width
+            base = first * W
+            for off, data in edits:
+                lo = off - base
+                buf[lo:lo + len(data)] = np.frombuffer(data,
+                                                       dtype=np.uint8)
+            if new_size is not None and new_size < old_size:
+                # zero everything past the new size within the range
+                lo = max(new_size - base, 0)
+                buf[lo:] = 0
+            trunc_stripes = self.sinfo.object_stripes(size)
+        # encode the touched range in one device call
+        C = self.sinfo.chunk_size
+        data_chunks = buf.reshape(count, self.k, C)
+        parity = np.asarray(self.ec.encode_batch(data_chunks))
+        attrs_delta = dict(attrs_delta)
+        attrs_delta["_v"] = _vblob(version)
+        attrs_delta["_size"] = size.to_bytes(8, "little")
+        # fan the per-shard sub-ops out (ref: ECBackend sub writes)
+        tid = self.osd.next_tid()
+        entry_blob = entry.encode()
+        per_osd: dict[int, MOSDECSubOpWrite] = {}
+        for pos, osd_id in enumerate(self.acting):
+            if osd_id < 0 or not self.osd.osd_is_up(osd_id):
+                continue                   # hole: recovery rebuilds it
+            shard = data_chunks[:, pos, :] if pos < self.k else \
+                parity[:, pos - self.k, :]
+            per_osd[osd_id] = MOSDECSubOpWrite(
+                tid=tid, epoch=self.epoch, pgid=self.cid, oid=oid,
+                first_stripe=first, data=shard.tobytes(),
+                truncate_stripes=trunc_stripes, size=size,
+                remove=False, attrs=attrs_delta, omap=omap_delta,
+                log_entry=entry_blob)
+        committed = await self._fan_out_subops(tid, per_osd)
+        if committed < self.k:
+            # fewer than k durable shards: the object would be
+            # unreadable — fail the op loudly (ref: EC writes require
+            # a decodable shard set)
+            log.error(f"pg {self.pgid} ec write {oid}: only "
+                      f"{committed} shards committed (< k={self.k})")
+            return -5                                 # -EIO
+        return 0
+
+    async def _fan_out_delete(self, oid: str, entry: LogEntry) -> int:
+        tid = self.osd.next_tid()
+        per_osd = {}
+        for osd_id in set(o for o in self.acting if o >= 0):
+            if self.osd.osd_is_up(osd_id):
+                per_osd[osd_id] = MOSDECSubOpWrite(
+                    tid=tid, epoch=self.epoch, pgid=self.cid, oid=oid,
+                    first_stripe=0, data=b"", truncate_stripes=0,
+                    size=0, remove=True, attrs={}, omap={},
+                    log_entry=entry.encode())
+        await self._fan_out_subops(tid, per_osd)
+        return 0
+
+    async def _fan_out_subops(self, tid: int,
+                              per_osd: dict[int, "MOSDECSubOpWrite"]
+                              ) -> int:
+        """Apply locally + send to peers + await acks. Returns how many
+        shards actually committed (local apply counts as one)."""
+        committed = 0
+        pending: set[int] = set()
+        waiter = asyncio.get_event_loop().create_future()
+        remote = []
+        for osd_id, msg in per_osd.items():
+            if osd_id == self.osd.whoami:
+                self._apply_sub_write(msg, local=True)
+                committed += 1
+            else:
+                pending.add(osd_id)
+                remote.append((osd_id, msg))
+        self._subop_waiters[tid] = (pending, waiter)
+        sent = set()
+        for osd_id, msg in remote:
+            try:
+                await self.osd.send_osd(osd_id, msg)
+                sent.add(osd_id)
+            except Exception:
+                pending.discard(osd_id)
+        if pending:
+            try:
+                await asyncio.wait_for(waiter, timeout=5.0)
+            except asyncio.TimeoutError:
+                log.dout(1, f"pg {self.pgid} ec sub-op {tid} timed out")
+        remaining, _ = self._subop_waiters.pop(tid, (set(), None))
+        committed += len(sent - remaining)
+        return committed
+
+    def _meta_txn_store(self) -> None:
+        self.osd.store.queue_transaction(self._meta_txn(Transaction()))
+
+    # -- sub-op handling (shard side) --------------------------------------
+    def _apply_sub_write(self, m: MOSDECSubOpWrite,
+                         local: bool = False) -> None:
+        t = Transaction()
+        C = self.sinfo.chunk_size
+        if m.remove:
+            t.remove(self.cid, m.oid)
+        else:
+            t.touch(self.cid, m.oid)
+            if m.data:
+                t.write(self.cid, m.oid, m.first_stripe * C, m.data)
+            t.truncate(self.cid, m.oid, m.truncate_stripes * C)
+            if m.attrs:
+                t.setattrs(self.cid, m.oid, m.attrs)
+            if m.omap:
+                t.omap_setkeys(self.cid, m.oid, m.omap)
+        if not local:
+            entry = LogEntry.decode(m.log_entry)
+            self.pg_log.append(entry)
+            self.pg_log.trim()
+            self.last_user_version = max(self.last_user_version,
+                                         entry.version.v)
+        self._meta_txn(t)
+        try:
+            self.osd.store.queue_transaction(t)
+        except StoreError as e:
+            log.error(f"pg {self.pgid} ec sub-write failed: {e}")
+
+    def handle_ec_sub_write(self, m: MOSDECSubOpWrite) -> None:
+        self._apply_sub_write(m)
+
+        async def _ack():
+            try:
+                await m.conn.send_message(MOSDECSubOpWriteReply(
+                    tid=m.tid, result=0, pgid=self.cid,
+                    from_osd=self.osd.whoami))
+            except Exception:
+                pass
+        asyncio.ensure_future(_ack())
+
+    def handle_ec_sub_write_reply(self, m: MOSDECSubOpWriteReply) -> None:
+        ent = self._subop_waiters.get(m.tid)
+        if ent is None:
+            return
+        pending, fut = ent
+        pending.discard(m.from_osd)
+        if not pending and not fut.done():
+            fut.set_result(True)
+
+    def handle_ec_sub_read(self, m: MOSDECSubOpRead) -> None:
+        exists, data, ver, size = self._local_shard_state(m.oid)
+        piece = data[m.chunk_off:m.chunk_off + m.chunk_len] if exists \
+            else b""
+
+        async def _reply():
+            try:
+                await m.conn.send_message(MOSDECSubOpReadReply(
+                    tid=m.tid, pgid=self.cid, oid=m.oid, exists=exists,
+                    data=piece, version_epoch=ver.epoch,
+                    version_v=ver.v, size=size,
+                    from_osd=self.osd.whoami))
+            except Exception:
+                pass
+        asyncio.ensure_future(_reply())
+
+    def handle_ec_sub_read_reply(self, m: MOSDECSubOpReadReply) -> None:
+        fut = self._subread_waiters.get(m.tid)
+        if fut and not fut.done():
+            fut.set_result(m)
+
+    # -- recovery -----------------------------------------------------------
+    async def _pull(self, from_osd: int, oid: str) -> None:
+        """EC primary reconstructs its OWN shard from live peers
+        instead of pulling a byte-identical copy."""
+        try:
+            await self._reconstruct_local(oid)
+            self.my_missing.pop(oid, None)
+        except (StoreError, ConnectionError, OSError,
+                asyncio.TimeoutError) as e:
+            log.dout(1, f"pg {self.pgid} ec self-recover {oid}: {e}")
+
+    async def _reconstruct_local(self, oid: str) -> None:
+        ver, size = await self._authoritative_meta(oid)
+        if size is None:
+            # deleted everywhere / never existed: drop local
+            t = Transaction().remove(self.cid, oid)
+            self.osd.store.queue_transaction(t)
+            return
+        await self._rebuild_shard(oid, self.my_shard(), ver, size,
+                                  apply_local=True)
+
+    async def _authoritative_meta(self, oid: str):
+        """(version, size) of the newest live shard copy."""
+        best = (eversion(), None)
+        for osd_id in set(o for o in self.acting if o >= 0):
+            if not self.osd.osd_is_up(osd_id):
+                continue
+            if osd_id == self.osd.whoami:
+                exists, _, ver, size = self._local_shard_state(oid)
+            else:
+                reply = await self._subread(osd_id, oid, 0, 0)
+                if reply is None:
+                    continue
+                exists = reply.exists
+                ver = eversion(reply.version_epoch, reply.version_v)
+                size = reply.size
+            if exists and (best[1] is None or ver > best[0]):
+                best = (ver, size)
+        return best
+
+    async def _rebuild_shard(self, oid: str, shard: int, ver: eversion,
+                             size: int, apply_local: bool = False,
+                             push_to: int | None = None) -> bytes:
+        count = self.sinfo.object_stripes(size) or 1
+        data_chunks = await self._gather(oid, 0, count, ver)
+        if shard < self.k:
+            shard_bytes = data_chunks[:, shard, :].tobytes()
+        else:
+            parity = np.asarray(self.ec.encode_batch(data_chunks))
+            shard_bytes = parity[:, shard - self.k, :].tobytes()
+        if apply_local:
+            t = Transaction()
+            t.remove(self.cid, oid)
+            t.write(self.cid, oid, 0, shard_bytes)
+            attrs = {"_v": _vblob(ver),
+                     "_size": size.to_bytes(8, "little")}
+            t.setattrs(self.cid, oid, attrs)
+            self.osd.store.queue_transaction(t)
+        return shard_bytes
+
+    def make_push(self, oid: str, target: int | None = None):
+        raise NotImplementedError("EC pushes are built asynchronously")
+
+    async def _recover(self) -> None:
+        """Regenerate each missing peer shard from k live shards
+        (ref: ECBackend recovery reads + pushes)."""
+        if not self.is_primary():
+            return
+        if any(self.peer_missing.values()):
+            self.state = "recovering"
+        from ceph_tpu.osd.messages import MOSDPGPush
+        for o, missing in list(self.peer_missing.items()):
+            if not self.osd.osd_is_up(o):
+                continue
+            try:
+                pos = self.acting.index(o)
+            except ValueError:
+                missing.clear()
+                continue
+            for oid in list(missing):
+                try:
+                    ver, size = await self._authoritative_meta(oid)
+                    if size is None:
+                        push = MOSDPGPush(
+                            pgid=self.cid, epoch=self.epoch, oid=oid,
+                            version_epoch=0, version_v=0, exists=False,
+                            data=b"", attrs={}, omap={},
+                            from_osd=self.osd.whoami)
+                    else:
+                        shard_bytes = await self._rebuild_shard(
+                            oid, pos, ver, size)
+                        omap = {}
+                        try:
+                            omap = {
+                                k: v for k, v in
+                                self.osd.store.omap_get(
+                                    self.cid, oid).items()}
+                        except StoreError:
+                            pass
+                        push = MOSDPGPush(
+                            pgid=self.cid, epoch=self.epoch, oid=oid,
+                            version_epoch=ver.epoch, version_v=ver.v,
+                            exists=True, data=shard_bytes,
+                            attrs={"_v": _vblob(ver),
+                                   "_size": size.to_bytes(8, "little")},
+                            omap=omap, from_osd=self.osd.whoami)
+                    await self.osd.send_osd(o, push)
+                except Exception as e:
+                    log.dout(1, f"pg {self.pgid} ec push {oid}->{o} "
+                                f"failed: {e}")
+                    continue
+                missing.pop(oid, None)
+        if not any(self.peer_missing.values()) and \
+                self.state in ("active", "recovering"):
+            self.state = "clean" if \
+                len(self.live_acting()) >= self.pool.size else "active"
+
+    # -- stats --------------------------------------------------------------
+    def stats(self) -> dict:
+        base = super().stats()
+        # logical bytes: shard bytes are size/k each
+        try:
+            objs = [o for o in self.osd.store.list_objects(self.cid)
+                    if o != PGMETA]
+            base["num_bytes"] = sum(
+                self._obj_size(o) for o in objs
+                if self.osd.store.exists(self.cid, o))
+        except StoreError:
+            pass
+        return base

@@ -92,6 +92,50 @@ class MOSDPing(Message):
               ("stamp", "f64")]
 
 
+# -- EC sub-ops ------------------------------------------------------------
+
+@register
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard: this shard's chunk bytes for a stripe range
+    plus object metadata (ref: MOSDECSubOpWrite / ECSubWrite)."""
+
+    TYPE = 164
+    FIELDS = [("tid", "u64"), ("epoch", "u32"), ("pgid", "str"),
+              ("oid", "str"), ("first_stripe", "u64"),
+              ("data", "blob"),             # n_stripes*chunk_size bytes
+              ("truncate_stripes", "u64"),  # shard truncated to this
+              ("size", "u64"),              # logical object size
+              ("remove", "bool"),
+              ("attrs", "map:str:blob"), ("omap", "map:str:blob"),
+              ("log_entry", "blob")]
+
+
+@register
+class MOSDECSubOpWriteReply(Message):
+    TYPE = 165
+    FIELDS = [("tid", "u64"), ("result", "s32"), ("pgid", "str"),
+              ("from_osd", "s32")]
+
+
+@register
+class MOSDECSubOpRead(Message):
+    """Primary -> shard: read chunk bytes (ref: MOSDECSubOpRead)."""
+
+    TYPE = 166
+    FIELDS = [("tid", "u64"), ("epoch", "u32"), ("pgid", "str"),
+              ("oid", "str"), ("chunk_off", "u64"),
+              ("chunk_len", "u64"), ("from_osd", "s32")]
+
+
+@register
+class MOSDECSubOpReadReply(Message):
+    TYPE = 167
+    FIELDS = [("tid", "u64"), ("pgid", "str"), ("oid", "str"),
+              ("exists", "bool"), ("data", "blob"),
+              ("version_epoch", "u32"), ("version_v", "u64"),
+              ("size", "u64"), ("from_osd", "s32")]
+
+
 # -- peering ---------------------------------------------------------------
 
 @register

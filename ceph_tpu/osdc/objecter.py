@@ -76,7 +76,6 @@ class Objecter(Dispatcher):
                 raise ObjectOperationError(-110, f"op on {oid} timed out")
             osdmap = await self.monc.wait_for_osdmap()
             if seed is not None:
-                import numpy as np_
                 _, _, _, actp = osdmap.pg_to_up_acting_osds(
                     pool_id, [seed])
                 pg_seed, primary = seed, int(actp[0])

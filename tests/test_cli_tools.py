@@ -28,16 +28,18 @@ def cluster_conf(tmp_path_factory):
     conf = str(tmp_path_factory.mktemp("cli") / "ceph_tpu.conf")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=str(REPO))
-    proc = subprocess.Popen(
+    logf = open(conf + ".log", "wb")      # a pipe would deadlock once
+    proc = subprocess.Popen(               # the buffer fills
         [sys.executable, "-m", "ceph_tpu.cluster.vstart", "--serve",
          "--mon-num", "1", "--osd-num", "3", "--pool", "rbd",
          "--pg-num", "8", "--conf", conf],
-        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        cwd=str(REPO), env=env, stdout=logf,
         stderr=subprocess.STDOUT)
     deadline = time.time() + 180
     while not os.path.exists(conf):
         if proc.poll() is not None:
-            out = proc.stdout.read().decode(errors="replace")
+            out = pathlib.Path(conf + ".log").read_bytes().decode(
+                errors="replace")
             raise RuntimeError(f"vstart died:\n{out[-2000:]}")
         if time.time() > deadline:
             proc.kill()
@@ -49,6 +51,7 @@ def cluster_conf(tmp_path_factory):
         proc.wait(timeout=10)
     except subprocess.TimeoutExpired:
         proc.kill()
+    logf.close()
 
 
 def test_ceph_status_and_pool_admin(cluster_conf, capsys):
