@@ -30,7 +30,8 @@ class Cluster:
 
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
                  config: dict | None = None, auth: bool = True,
-                 data_dir: str | None = None):
+                 data_dir: str | None = None,
+                 mgr_modules: list | None = None):
         self.cfg = dict(DEFAULT_CFG, **(config or {}))
         self.n_mons = n_mons
         self.n_osds = n_osds
@@ -40,6 +41,8 @@ class Cluster:
         self.monmap = MonMap(fsid="vstart")
         self.mons: list[Monitor] = []
         self.osds: list[OSD] = []
+        self.mgr = None
+        self.mgr_modules = mgr_modules       # None = no mgr
         self.client: Rados | None = None
 
     async def start(self) -> "Cluster":
@@ -50,6 +53,7 @@ class Cluster:
             for i in range(self.n_osds):
                 self.keyring.add(f"osd.{i}")
             self.keyring.add("client.admin")
+            self.keyring.add("mgr.x")
         for rank, name in enumerate(names):
             self.monmap.add(name, rank, "127.0.0.1", 0)
         for rank, name in enumerate(names):
@@ -83,6 +87,11 @@ class Cluster:
                       keyring=self.keyring, config=self.cfg)
             self.osds.append(osd)
         await asyncio.gather(*[o.boot() for o in self.osds])
+        if self.mgr_modules is not None:
+            from ceph_tpu.mgr import Mgr
+            self.mgr = Mgr("x", self.monmap, keyring=self.keyring,
+                           modules=self.mgr_modules, config=self.cfg)
+            await self.mgr.start(active=True)
         await self.client.connect()
         return self
 
@@ -151,6 +160,8 @@ class Cluster:
     async def stop(self) -> None:
         if self.client:
             await self.client.shutdown()
+        if self.mgr:
+            await self.mgr.stop()
         for o in self.osds:
             if not o._stopped:
                 await o.stop()

@@ -1,0 +1,121 @@
+"""The mgr daemon: module host with active/standby.
+
+ref: src/mgr/ + src/pybind/mgr/mgr_module.py — a daemon that watches
+cluster state through its MonClient and hosts pluggable modules
+(balancer, pg_autoscaler, prometheus...). Modules get the reference's
+core surface: ``get("osd_map")``-style state access, ``mon_command``,
+and a periodic ``serve`` tick (ref: MgrModule.get / check_mon_command /
+serve). Standby mgrs hold their modules idle until promoted
+(ref: MgrStandby).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.encoding import decode_osdmap
+from ceph_tpu.mon.client import MonClient
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("mgr")
+
+
+class MgrModule:
+    """ref: mgr_module.py MgrModule — subclass and implement tick()."""
+
+    NAME = "module"
+    TICK_INTERVAL = 1.0
+
+    def __init__(self, mgr: "Mgr"):
+        self.mgr = mgr
+
+    async def tick(self) -> None:
+        pass
+
+    # -- the reference's module API surface ---------------------------
+    async def get(self, what: str):
+        """ref: MgrModule.get — structured cluster state."""
+        return await self.mgr.get(what)
+
+    async def mon_command(self, cmd: dict, inbl: bytes = b""):
+        return await self.mgr.monc.command(cmd, inbl)
+
+
+class Mgr:
+    def __init__(self, name: str, monmap, keyring=None,
+                 modules: list[type[MgrModule]] | None = None,
+                 config: dict | None = None):
+        self.name = name
+        self.monc = MonClient(f"mgr.{name}", monmap, keyring=keyring)
+        self.config = config or {}
+        from ceph_tpu.mgr.modules import (
+            BalancerModule, PGAutoscalerModule, PrometheusModule,
+        )
+        self.modules = [cls(self) for cls in (
+            modules if modules is not None else
+            [BalancerModule, PGAutoscalerModule, PrometheusModule])]
+        self.active = False
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+
+    # -- state access -------------------------------------------------
+    async def get(self, what: str):
+        """ref: MgrModule.get('osd_map'|'pg_dump'|'osd_map_crush'...)."""
+        if what == "osd_map":
+            ret, rs, out = await self.monc.command(
+                {"prefix": "osd getmap"})
+            if ret != 0:
+                raise RuntimeError(f"osd getmap failed: {rs}")
+            return decode_osdmap(out)
+        if what == "osd_dump":
+            ret, _, out = await self.monc.command({"prefix": "osd dump"})
+            return json.loads(out) if ret == 0 else {}
+        if what == "pg_dump":
+            ret, _, out = await self.monc.command({"prefix": "pg dump"})
+            return json.loads(out) if ret == 0 else {}
+        if what == "status":
+            ret, _, out = await self.monc.command({"prefix": "status"})
+            return json.loads(out) if ret == 0 else {}
+        raise KeyError(what)
+
+    # -- lifecycle ----------------------------------------------------
+    async def start(self, active: bool = True) -> None:
+        await self.monc.subscribe("osdmap", 0)
+        if active:
+            await self.promote()
+
+    async def promote(self) -> None:
+        """Standby -> active (ref: MgrStandby::handle_mgr_map)."""
+        if self.active:
+            return
+        self.active = True
+        for mod in self.modules:
+            self._tasks.append(
+                asyncio.ensure_future(self._module_loop(mod)))
+        log.dout(1, f"mgr.{self.name} active "
+                    f"({[m.NAME for m in self.modules]})")
+
+    async def _module_loop(self, mod: MgrModule) -> None:
+        try:
+            while not self._stopped and self.active:
+                try:
+                    await mod.tick()
+                except Exception as e:
+                    log.error(f"mgr module {mod.NAME} tick failed: {e}")
+                await asyncio.sleep(
+                    self.config.get(f"mgr_{mod.NAME}_interval",
+                                    mod.TICK_INTERVAL))
+        except asyncio.CancelledError:
+            pass
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self.active = False
+        for t in self._tasks:
+            t.cancel()
+        for mod in self.modules:
+            closer = getattr(mod, "close", None)
+            if closer:
+                await closer()
+        await self.monc.shutdown()

@@ -1,0 +1,200 @@
+"""The stock mgr modules: balancer, pg_autoscaler, prometheus.
+
+ref: src/pybind/mgr/balancer/module.py (upmap mode driving
+OSDMap::calc_pg_upmaps), src/pybind/mgr/pg_autoscaler/module.py
+(pg_num recommendations), src/pybind/mgr/prometheus/module.py
+(the /metrics exporter).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.mgr.daemon import MgrModule
+from ceph_tpu.osd.osdmap import Incremental
+from ceph_tpu.utils.logging import get_logger
+from ceph_tpu.utils.perf_counters import PerfCountersCollection
+
+log = get_logger("mgr")
+
+
+class BalancerModule(MgrModule):
+    """upmap balancer (ref: balancer/module.py Module.optimize +
+    Plan.execute): pull the authoritative map, run calc_pg_upmaps,
+    push each change through `osd pg-upmap-items`."""
+
+    NAME = "balancer"
+    TICK_INTERVAL = 5.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.max_deviation = mgr.config.get("upmap_max_deviation", 1)
+        self.max_optimizations = mgr.config.get(
+            "upmap_max_optimizations", 20)
+        self.last_changes = 0
+
+    async def tick(self) -> None:
+        self.last_changes = await self.optimize()
+
+    async def optimize(self) -> int:
+        osdmap = await self.get("osd_map")
+        if not osdmap.pools:
+            return 0
+        inc = Incremental()
+        changes = osdmap.calc_pg_upmaps(
+            max_deviation=self.max_deviation,
+            max_iterations=self.max_optimizations, inc=inc)
+        if not changes:
+            return 0
+        applied = 0
+        for pg, pairs in inc.new_pg_upmap_items.items():
+            maps: list[int] = []
+            for f, t in pairs:
+                maps += [int(f), int(t)]
+            ret, rs, _ = await self.mon_command(
+                {"prefix": "osd pg-upmap-items", "pgid": str(pg),
+                 "mappings": maps})
+            if ret == 0:
+                applied += 1
+        for pg in inc.old_pg_upmap_items:
+            ret, _, _ = await self.mon_command(
+                {"prefix": "osd rm-pg-upmap-items", "pgid": str(pg)})
+            if ret == 0:
+                applied += 1
+        if applied:
+            log.dout(1, f"balancer applied {applied} upmap changes")
+        return applied
+
+
+class PGAutoscalerModule(MgrModule):
+    """pg_num recommendations (ref: pg_autoscaler/module.py): target
+    ~rate pgs per osd split across pools, rounded to a power of two;
+    grows pg_num via `osd pool set` when under half the target."""
+
+    NAME = "pg_autoscaler"
+    TICK_INTERVAL = 5.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.target_per_osd = mgr.config.get(
+            "mon_target_pg_per_osd", 100)
+        self.max_pg_num = mgr.config.get("autoscaler_max_pg_num", 256)
+
+    def recommend(self, n_osds: int, n_pools: int, size: int) -> int:
+        if not (n_osds and n_pools and size):
+            return 0
+        raw = self.target_per_osd * n_osds / size / n_pools
+        p = 1
+        while p * 2 <= raw:
+            p *= 2
+        return min(max(p, 1), self.max_pg_num)
+
+    async def tick(self) -> None:
+        dump = await self.get("osd_dump")
+        pg_dump = await self.get("pg_dump")
+        pools = dump.get("pools", [])
+        n_osds = sum(1 for o in dump.get("osds", []) if o["in"])
+        # objects per pool from pg stats ("pool.seed" keys)
+        objs_per_pool: dict[int, int] = {}
+        for pgid, st in pg_dump.get("pg_stats", {}).items():
+            pid = int(pgid.split(".")[0])
+            objs_per_pool[pid] = objs_per_pool.get(pid, 0) + \
+                st.get("num_objects", 0)
+        for pool in pools:
+            if objs_per_pool.get(pool["pool"], 0):
+                # PG splitting is not implemented: growing pg_num on a
+                # populated pool would strand objects in their old PGs
+                # (the reference splits PGs on pg_num increase)
+                continue
+            want = self.recommend(n_osds, len(pools), pool["size"])
+            if want and pool["pg_num"] * 2 <= want:
+                log.dout(1, f"autoscaler: pool {pool['name']} pg_num "
+                            f"{pool['pg_num']} -> {want}")
+                await self.mon_command(
+                    {"prefix": "osd pool set", "pool": pool["name"],
+                     "var": "pg_num", "val": str(want)})
+
+
+class PrometheusModule(MgrModule):
+    """/metrics exporter (ref: prometheus/module.py) — a tiny asyncio
+    HTTP endpoint rendering cluster + perf-counter gauges in the
+    exposition format."""
+
+    NAME = "prometheus"
+    TICK_INTERVAL = 2.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+        self._latest = "# no scrape yet\n"
+
+    async def tick(self) -> None:
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._serve_client, "127.0.0.1",
+                self.mgr.config.get("mgr_prometheus_port", 0))
+            self.port = self._server.sockets[0].getsockname()[1]
+            log.dout(1, f"prometheus exporter on :{self.port}")
+        self._latest = await self.render()
+
+    async def render(self) -> str:
+        status = await self.get("status")
+        lines = ["# TYPE ceph_osd_up gauge"]
+        om = status.get("osdmap", {})
+        pg = status.get("pgmap", {})
+        health = {"HEALTH_OK": 0, "HEALTH_WARN": 1,
+                  "HEALTH_ERR": 2}.get(
+            status.get("health", {}).get("status"), -1)
+        lines += [
+            f"ceph_health_status {health}",
+            f"ceph_osd_up {om.get('num_up_osds', 0)}",
+            f"ceph_osd_in {om.get('num_in_osds', 0)}",
+            f"ceph_osd_total {om.get('num_osds', 0)}",
+            f"ceph_osdmap_epoch {om.get('epoch', 0)}",
+            f"ceph_pool_total {om.get('pools', 0)}",
+            f"ceph_pg_total {pg.get('num_pgs', 0)}",
+            f"ceph_pg_degraded {pg.get('degraded_pgs', 0)}",
+            f"ceph_objects_total {pg.get('num_objects', 0)}",
+            f"ceph_bytes_total {pg.get('num_bytes', 0)}",
+        ]
+        for state, n in pg.get("states", {}).items():
+            safe = state.replace("+", "_")
+            lines.append(f'ceph_pg_state{{state="{safe}"}} {n}')
+        # in-process perf counters (ref: prometheus module exporting
+        # daemon perf counters)
+        for name, counters in PerfCountersCollection.instance() \
+                .dump().items():
+            for key, val in counters.items():
+                if isinstance(val, (int, float)):
+                    lines.append(
+                        f'ceph_perf{{daemon="{name}",counter="{key}"}}'
+                        f' {val}')
+        return "\n".join(lines) + "\n"
+
+    async def _serve_client(self, reader, writer) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(),
+                                             timeout=2.0)
+            while True:
+                line = await asyncio.wait_for(reader.readline(),
+                                              timeout=2.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            body = self._latest if b"/metrics" in request else \
+                "ceph_tpu mgr prometheus exporter\n"
+            payload = body.encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(payload)).encode() +
+                b"\r\n\r\n" + payload)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()

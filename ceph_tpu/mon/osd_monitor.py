@@ -235,6 +235,8 @@ class OSDMonitor(PaxosService):
             "osd setcrushmap": self._cmd_setcrushmap,
             "osd map": self._cmd_map,
             "pg dump": self._cmd_pg_dump,
+            "osd pg-upmap-items": self._cmd_pg_upmap_items,
+            "osd rm-pg-upmap-items": self._cmd_rm_pg_upmap_items,
         }.get(prefix)
         if handler is None:
             return -22, f"unknown command {prefix!r}", b""
@@ -512,6 +514,27 @@ class OSDMonitor(PaxosService):
             "up_primary": int(upp[0]),
             "acting": [int(o) for o in acting[0] if o != ITEM_NONE],
             "acting_primary": int(actp[0])}).encode()
+
+    async def _cmd_pg_upmap_items(self, cmd, inbl):
+        """`osd pg-upmap-items <pgid> <from> <to> [...]` — the mgr
+        balancer's write path (ref: OSDMonitor prepare_command
+        osd pg-upmap-items)."""
+        from ceph_tpu.osd.types import pg_t
+        pg = pg_t.parse(cmd["pgid"])
+        maps = [int(x) for x in cmd["mappings"]]
+        pairs = list(zip(maps[0::2], maps[1::2]))
+        inc = Incremental()
+        inc.new_pg_upmap_items[pg] = pairs
+        ok = await self._propose_inc(inc)
+        return (0, f"set {cmd['pgid']} pg_upmap_items", b"") if ok \
+            else (-11, "proposal failed", b"")
+
+    async def _cmd_rm_pg_upmap_items(self, cmd, inbl):
+        from ceph_tpu.osd.types import pg_t
+        inc = Incremental()
+        inc.old_pg_upmap_items.append(pg_t.parse(cmd["pgid"]))
+        ok = await self._propose_inc(inc)
+        return (0, "", b"") if ok else (-11, "proposal failed", b"")
 
     async def _cmd_pg_dump(self, cmd, inbl):
         return 0, "", json.dumps({

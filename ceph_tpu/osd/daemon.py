@@ -27,7 +27,7 @@ from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
     MOSDPGPushReply, MOSDPGQuery, MOSDPing, MOSDRepOp, MOSDRepOpReply,
-    PING, PING_REPLY,
+    MOSDRepScrub, MOSDRepScrubMap, PING, PING_REPLY,
 )
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import pg_t
@@ -47,6 +47,7 @@ class OSD(Dispatcher):
         self.hb_interval = cfg.get("osd_heartbeat_interval", 0.25)
         self.hb_grace = cfg.get("osd_heartbeat_grace", 1.5)
         self.stats_interval = cfg.get("osd_stats_interval", 0.5)
+        self.scrub_interval = cfg.get("osd_scrub_interval", 0.0)
         self.config = cfg
         name = f"osd.{whoami}"
         self.msgr = Messenger(name, keyring=keyring)
@@ -64,6 +65,7 @@ class OSD(Dispatcher):
         self._hb_reported: dict[int, float] = {}
         self._hb_task: asyncio.Task | None = None
         self._stats_task: asyncio.Task | None = None
+        self._scrub_task: asyncio.Task | None = None
         self._stopped = False
         self.up = False
 
@@ -126,11 +128,14 @@ class OSD(Dispatcher):
             await asyncio.sleep(0.05)
         self._hb_task = asyncio.ensure_future(self._hb_loop())
         self._stats_task = asyncio.ensure_future(self._stats_loop())
+        if self.scrub_interval > 0:
+            self._scrub_task = asyncio.ensure_future(self._scrub_loop())
         log.dout(1, f"osd.{self.whoami} booted at {self.msgr.addr}")
 
     async def stop(self) -> None:
         self._stopped = True
-        for task in (self._hb_task, self._stats_task):
+        for task in (self._hb_task, self._stats_task,
+                     self._scrub_task):
             if task:
                 task.cancel()
         for pg in self.pgs.values():
@@ -277,6 +282,19 @@ class OSD(Dispatcher):
             return True
         if isinstance(msg, MOSDPGPushReply):
             return True
+        if isinstance(msg, MOSDRepScrub):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                from ceph_tpu.osd.scrub import build_scrub_map
+                await msg.conn.send_message(MOSDRepScrubMap(
+                    pgid=msg.pgid, tid=msg.tid, from_osd=self.whoami,
+                    scrub_map=build_scrub_map(pg)))
+            return True
+        if isinstance(msg, MOSDRepScrubMap):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None and pg._scrubber is not None:
+                pg.scrubber.handle_map(msg)
+            return True
         return False
 
     # -- heartbeats --------------------------------------------------------
@@ -319,6 +337,20 @@ class OSD(Dispatcher):
                             self.hb_grace:
                         self._hb_reported[o] = now
                         await self._report_failure(o)
+        except asyncio.CancelledError:
+            pass
+
+    async def _scrub_loop(self) -> None:
+        """Round-robin background scrub (ref: OSD::sched_scrub)."""
+        try:
+            while not self._stopped:
+                await asyncio.sleep(self.scrub_interval)
+                for pg in list(self.pgs.values()):
+                    # never scrub mid-recovery: legitimately missing
+                    # objects would read as inconsistencies
+                    if pg.is_primary() and pg.state in ("active",
+                                                        "clean"):
+                        await pg.scrubber.scrub()
         except asyncio.CancelledError:
             pass
 

@@ -184,3 +184,23 @@ class MOSDPGPush(Message):
 class MOSDPGPushReply(Message):
     TYPE = 173
     FIELDS = [("pgid", "str"), ("oid", "str"), ("from_osd", "s32")]
+
+
+@register
+class MOSDRepScrub(Message):
+    """Primary -> replica: send your scrub map for this PG
+    (ref: MOSDRepScrub)."""
+
+    TYPE = 175
+    FIELDS = [("pgid", "str"), ("tid", "u64"), ("epoch", "u32"),
+              ("from_osd", "s32")]
+
+
+@register
+class MOSDRepScrubMap(Message):
+    """Replica's scrub map: oid -> json{size, digest, omap_digest,
+    version} (ref: ScrubMap)."""
+
+    TYPE = 176
+    FIELDS = [("pgid", "str"), ("tid", "u64"), ("from_osd", "s32"),
+              ("scrub_map", "map:str:blob")]

@@ -75,6 +75,9 @@ class PG:
         # reply was lost return the recorded outcome instead of
         # re-executing (ref: pg_log_entry_t reqid dedup)
         self._reqid_results: dict[tuple, tuple] = {}
+        self.scrub_errors = 0
+        self.last_scrub = 0.0
+        self._scrubber = None
         self._ensure_collection()
         self._load_meta()
 
@@ -99,6 +102,13 @@ class PG:
         t.omap_setkeys(self.cid, PGMETA,
                        {"pg_log": self.pg_log.encode()})
         return t
+
+    @property
+    def scrubber(self):
+        if self._scrubber is None:
+            from ceph_tpu.osd.scrub import Scrubber
+            self._scrubber = Scrubber(self)
+        return self._scrubber
 
     def is_primary(self) -> bool:
         return self.primary == self.osd.whoami
@@ -532,4 +542,5 @@ class PG:
         return {"state": state, "num_objects": len(objs),
                 "num_bytes": nbytes,
                 "acting": self.acting, "up": self.up,
-                "last_update": str(self.pg_log.head)}
+                "last_update": str(self.pg_log.head),
+                "scrub_errors": self.scrub_errors}

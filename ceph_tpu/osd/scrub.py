@@ -1,0 +1,181 @@
+"""PG scrub: cross-shard consistency checking.
+
+ref: src/osd/scrubber/* (PgScrubber/ScrubMachine) — the primary
+collects a *scrub map* (per-object size/data-digest/omap-digest/
+version) from itself and every live acting peer, then compares:
+
+- replicated PGs: every field must match byte-for-byte across
+  replicas (ref: be_compare_scrubmaps);
+- EC PGs: shards legitimately differ in bytes, so versions and
+  logical sizes must agree; DEEP scrub additionally regathers the data
+  chunks and re-encodes to verify stored parity shards
+  (ref: ECBackend scrub with hinfo digests).
+
+Inconsistencies land in the PG's stats (scrub_errors) which flow to
+the mon's pgmap -> HEALTH checks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import zlib
+
+from ceph_tpu.os_.objectstore import StoreError
+from ceph_tpu.osd.messages import MOSDRepScrub, MOSDRepScrubMap
+from ceph_tpu.osd.pg import PGMETA
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("osd")
+
+
+def build_scrub_map(pg) -> dict[str, bytes]:
+    """This osd's per-object scrub entries for one PG
+    (ref: PgScrubber::build_scrub_map_chunk)."""
+    store = pg.osd.store
+    out: dict[str, bytes] = {}
+    try:
+        objs = store.list_objects(pg.cid)
+    except StoreError:
+        return out
+    for oid in objs:
+        if oid == PGMETA:
+            continue
+        try:
+            data = store.read(pg.cid, oid)
+            attrs = store.getattrs(pg.cid, oid)
+            omap = store.omap_get(pg.cid, oid)
+        except StoreError:
+            continue
+        entry = {
+            "size": len(data),
+            "digest": zlib.crc32(data),
+            "omap_digest": zlib.crc32(json.dumps(
+                sorted((k, v.hex()) for k, v in omap.items()
+                       if not k.startswith("_"))).encode()),
+            "version": attrs.get("_v", b"").hex(),
+            "logical_size": int.from_bytes(
+                attrs.get("_size", b"\0" * 8), "little"),
+        }
+        out[oid] = json.dumps(entry).encode()
+    return out
+
+
+class Scrubber:
+    """Primary-driven scrub round for one PG."""
+
+    def __init__(self, pg):
+        self.pg = pg
+        self._waiters: dict[int, tuple[set[int], dict,
+                                       asyncio.Future]] = {}
+
+    async def scrub(self, deep: bool = False) -> dict:
+        """Run one scrub; returns {errors: [...], objects: N}
+        (ref: PgScrubber round trip)."""
+        pg = self.pg
+        if not pg.is_primary() or not pg.role_active():
+            return {"errors": ["not primary+active"], "objects": 0}
+        maps: dict[int, dict[str, dict]] = {
+            pg.osd.whoami: _parse(build_scrub_map(pg))}
+        peers = [o for o in pg.live_acting() if o != pg.osd.whoami]
+        if peers:
+            tid = pg.osd.next_tid()
+            fut = asyncio.get_event_loop().create_future()
+            self._waiters[tid] = (set(peers), {}, fut)
+            for o in peers:
+                try:
+                    await pg.osd.send_osd(o, MOSDRepScrub(
+                        pgid=pg.cid, tid=tid, epoch=pg.epoch,
+                        from_osd=pg.osd.whoami))
+                except Exception:
+                    self._waiters[tid][0].discard(o)
+            if not self._waiters[tid][0] and not fut.done():
+                fut.set_result(True)       # all sends failed: no waits
+            try:
+                await asyncio.wait_for(fut, timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+            _, got, _ = self._waiters.pop(tid)
+            maps.update(got)
+        errors = self._compare(maps)
+        if deep and pg.pool.is_erasure():
+            errors += await self._deep_ec_check(maps)
+        pg.scrub_errors = len(errors)
+        pg.last_scrub = asyncio.get_event_loop().time()
+        if errors:
+            log.dout(1, f"pg {pg.pgid} scrub found "
+                        f"{len(errors)} errors: {errors[:3]}")
+        n = len(maps.get(pg.osd.whoami, {}))
+        return {"errors": errors, "objects": n}
+
+    def handle_map(self, m: MOSDRepScrubMap) -> None:
+        ent = self._waiters.get(m.tid)
+        if ent is None:
+            return
+        pending, got, fut = ent
+        got[m.from_osd] = _parse(m.scrub_map)
+        pending.discard(m.from_osd)
+        if not pending and not fut.done():
+            fut.set_result(True)
+
+    def _compare(self, maps: dict[int, dict[str, dict]]) -> list[str]:
+        """ref: be_compare_scrubmaps — the primary is the authority;
+        every peer entry must agree."""
+        pg = self.pg
+        errors: list[str] = []
+        auth = maps.get(pg.osd.whoami, {})
+        ec = pg.pool.is_erasure()
+        all_oids = set()
+        for m in maps.values():
+            all_oids |= set(m)
+        for oid in sorted(all_oids):
+            entries = {o: m[oid] for o, m in maps.items() if oid in m}
+            missing = [o for o in maps if oid not in maps[o]]
+            if missing:
+                errors.append(f"{oid}: missing on osd {missing}")
+                continue
+            base = entries[pg.osd.whoami]
+            for o, e in entries.items():
+                if e["version"] != base["version"]:
+                    errors.append(f"{oid}: version mismatch on osd.{o}")
+                elif not ec and (e["digest"] != base["digest"] or
+                                 e["size"] != base["size"]):
+                    errors.append(f"{oid}: digest mismatch on osd.{o}")
+                elif not ec and e["omap_digest"] != base["omap_digest"]:
+                    errors.append(f"{oid}: omap mismatch on osd.{o}")
+                elif ec and e["logical_size"] != base["logical_size"]:
+                    errors.append(f"{oid}: size mismatch on osd.{o}")
+        return errors
+
+    async def _deep_ec_check(self, maps) -> list[str]:
+        """Deep scrub for EC: regenerate parity from the data shards
+        and compare digests against what the parity shards stored."""
+        import numpy as np
+        pg = self.pg
+        errors: list[str] = []
+        auth = maps.get(pg.osd.whoami, {})
+        for oid, entry in auth.items():
+            try:
+                ver = pg._obj_version(oid)
+                size = entry["logical_size"]
+                count = pg.sinfo.object_stripes(size) or 1
+                data = await pg._gather(oid, 0, count, ver)
+                parity = np.asarray(pg.ec.encode_batch(data))
+            except Exception as e:
+                errors.append(f"{oid}: deep-scrub gather failed ({e})")
+                continue
+            for pos in range(pg.k, pg.k + pg.m):
+                osd_id = pg.acting[pos] if pos < len(pg.acting) else -1
+                if osd_id < 0 or osd_id not in maps or \
+                        oid not in maps[osd_id]:
+                    continue
+                want = zlib.crc32(parity[:, pos - pg.k, :].tobytes())
+                if maps[osd_id][oid]["digest"] != want:
+                    errors.append(
+                        f"{oid}: parity shard {pos} digest mismatch "
+                        f"on osd.{osd_id}")
+        return errors
+
+
+def _parse(raw: dict[str, bytes]) -> dict[str, dict]:
+    return {oid: json.loads(blob) for oid, blob in raw.items()}
