@@ -468,8 +468,9 @@ class OSDMonitor(PaxosService):
         return 0, "", json.dumps(out).encode()
 
     async def _cmd_tree(self, cmd, inbl):
-        from ceph_tpu.crush.compiler import decompile_crushmap
-        return 0, "", decompile_crushmap(self.osdmap.crush).encode()
+        from ceph_tpu.crush.tree_dumper import dump_tree
+        return 0, "", dump_tree(self.osdmap.crush,
+                                osdmap=self.osdmap).encode()
 
     async def _cmd_df(self, cmd, inbl):
         om = self.osdmap

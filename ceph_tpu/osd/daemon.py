@@ -32,6 +32,7 @@ from ceph_tpu.osd.messages import (
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import pg_t
 from ceph_tpu.utils.logging import get_logger
+from ceph_tpu.utils.op_tracker import OpTracker
 
 log = get_logger("osd")
 
@@ -68,6 +69,11 @@ class OSD(Dispatcher):
         self._scrub_task: asyncio.Task | None = None
         self._stopped = False
         self.up = False
+        # ref: OSD op tracking + admin socket
+        self.op_tracker = OpTracker(
+            slow_op_warn_s=cfg.get("osd_op_complaint_time", 30.0))
+        self.asok = None
+        self._asok_dir = cfg.get("admin_socket_dir")
 
     # -- service facade used by PG ----------------------------------------
     def next_tid(self) -> int:
@@ -126,6 +132,30 @@ class OSD(Dispatcher):
             await self.monc.subscribe(
                 "osdmap", (self.osdmap.epoch + 1) if self.osdmap else 0)
             await asyncio.sleep(0.05)
+        if self._asok_dir:
+            from ceph_tpu.utils.admin_socket import AdminSocket
+            self.asok = AdminSocket(
+                f"{self._asok_dir}/osd.{self.whoami}.asok")
+            self.asok.register(
+                "status", lambda: {
+                    "whoami": self.whoami, "up": self.up,
+                    "epoch": self.osdmap.epoch if self.osdmap else 0,
+                    "num_pgs": len(self.pgs),
+                    "pgs": {p: pg.state
+                            for p, pg in self.pgs.items()}},
+                "osd state summary")
+            self.asok.register(
+                "dump_ops_in_flight",
+                self.op_tracker.dump_ops_in_flight,
+                "in-flight client ops")
+            self.asok.register(
+                "dump_historic_ops",
+                self.op_tracker.dump_historic_ops,
+                "recently completed ops")
+            self.asok.register(
+                "config show", lambda: dict(self.config),
+                "daemon configuration")
+            await self.asok.start()
         self._hb_task = asyncio.ensure_future(self._hb_loop())
         self._stats_task = asyncio.ensure_future(self._stats_loop())
         if self.scrub_interval > 0:
@@ -143,6 +173,8 @@ class OSD(Dispatcher):
                 pg._worker.cancel()
             if pg._peering_task:
                 pg._peering_task.cancel()
+        if self.asok:
+            await self.asok.stop()
         await self.msgr.shutdown()
         await self.hb_msgr.shutdown()
 

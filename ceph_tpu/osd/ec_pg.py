@@ -33,7 +33,8 @@ from ceph_tpu.osd.ecutil import StripeInfo
 from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, OSD_OP_DELETE, OSD_OP_GETXATTR,
-    OSD_OP_OMAP_GET, OSD_OP_OMAP_SET, OSD_OP_PGLS, OSD_OP_READ,
+    OSD_OP_OMAP_GET, OSD_OP_OMAP_RM, OSD_OP_OMAP_SET, OSD_OP_PGLS,
+    OSD_OP_READ,
     OSD_OP_SETXATTR, OSD_OP_STAT, OSD_OP_TRUNCATE, OSD_OP_WRITE,
     OSD_OP_WRITEFULL, OSD_OP_ZERO,
 )
@@ -181,6 +182,7 @@ class ECPG(PG):
         new_size: int | None = None
         attrs_delta: dict[str, bytes] = {}
         omap_delta: dict[str, bytes] = {}
+        omap_rm: list[str] = []
         deleted = False
         write_full = None
         for code, off, length, name, data in m.unpack_ops():
@@ -232,11 +234,14 @@ class ECPG(PG):
                 attrs_delta[name] = bytes(data)
             elif code == OSD_OP_OMAP_SET:
                 omap_delta[name] = bytes(data)
+            elif code == OSD_OP_OMAP_RM:
+                omap_rm.append(name)
             else:
                 await self._reply(m, -95, b"", {})
                 return
-        mutated = bool(edits or attrs_delta or omap_delta or deleted or
-                       write_full is not None or new_size is not None)
+        mutated = bool(edits or attrs_delta or omap_delta or omap_rm or
+                       deleted or write_full is not None or
+                       new_size is not None)
         if not mutated:
             await self._reply(m, 0, data_out, extra)
             return
@@ -244,12 +249,18 @@ class ECPG(PG):
             result, rextra = self._reqid_results[reqid]
             await self._reply(m, result, b"", rextra)
             return
-        if deleted and not self.osd.store.exists(self.cid, oid):
+        if (deleted or (omap_rm and not (edits or attrs_delta or
+                                         omap_delta or
+                                         write_full is not None or
+                                         new_size is not None))) and \
+                not self.osd.store.exists(self.cid, oid):
+            # delete / bare omap-rm of a nonexistent object: -ENOENT,
+            # never materialize a ghost object
             await self._reply(m, -2, b"", {})
             return
         result = await self._submit_ec_write(
             oid, edits, write_full, new_size, deleted, attrs_delta,
-            omap_delta)
+            omap_delta, omap_rm)
         extra["version"] = str(self.pg_log.head)
         self._reqid_results[reqid] = (result, extra)
         if len(self._reqid_results) > 2000:
@@ -273,7 +284,8 @@ class ECPG(PG):
 
     # -- the RMW + sub-op write pipeline -----------------------------------
     async def _submit_ec_write(self, oid, edits, write_full, new_size,
-                               deleted, attrs_delta, omap_delta) -> int:
+                               deleted, attrs_delta, omap_delta,
+                               omap_rm=()) -> int:
         live = self.live_acting()
         if len(live) < self.pool.min_size:
             return -11
@@ -346,7 +358,7 @@ class ECPG(PG):
                 first_stripe=first, data=shard.tobytes(),
                 truncate_stripes=trunc_stripes, size=size,
                 remove=False, attrs=attrs_delta, omap=omap_delta,
-                log_entry=entry_blob)
+                omap_rm=list(omap_rm), log_entry=entry_blob)
         committed = await self._fan_out_subops(tid, per_osd)
         if committed < self.k:
             # fewer than k durable shards: the object would be
@@ -366,7 +378,7 @@ class ECPG(PG):
                     tid=tid, epoch=self.epoch, pgid=self.cid, oid=oid,
                     first_stripe=0, data=b"", truncate_stripes=0,
                     size=0, remove=True, attrs={}, omap={},
-                    log_entry=entry.encode())
+                    omap_rm=[], log_entry=entry.encode())
         await self._fan_out_subops(tid, per_osd)
         return 0
 
@@ -422,6 +434,8 @@ class ECPG(PG):
                 t.setattrs(self.cid, m.oid, m.attrs)
             if m.omap:
                 t.omap_setkeys(self.cid, m.oid, m.omap)
+            if m.omap_rm:
+                t.omap_rmkeys(self.cid, m.oid, list(m.omap_rm))
         if not local:
             entry = LogEntry.decode(m.log_entry)
             self.pg_log.append(entry)

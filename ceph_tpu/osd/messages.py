@@ -23,6 +23,7 @@ OSD_OP_SETXATTR = 9
 OSD_OP_OMAP_GET = 10
 OSD_OP_OMAP_SET = 11
 OSD_OP_PGLS = 12           # list objects in pg (rados ls building block)
+OSD_OP_OMAP_RM = 13
 
 # heartbeat ops (ref: MOSDPing::PING / PING_REPLY)
 PING = 1
@@ -107,6 +108,7 @@ class MOSDECSubOpWrite(Message):
               ("size", "u64"),              # logical object size
               ("remove", "bool"),
               ("attrs", "map:str:blob"), ("omap", "map:str:blob"),
+              ("omap_rm", "list:str"),
               ("log_entry", "blob")]
 
 

@@ -34,8 +34,8 @@ from ceph_tpu.osd.messages import (
     MOSDOp, MOSDOpReply, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
     MOSDPGPushReply, MOSDPGQuery, MOSDRepOp, MOSDRepOpReply, OSD_OP_DELETE,
     OSD_OP_GETXATTR, OSD_OP_OMAP_GET, OSD_OP_OMAP_SET, OSD_OP_PGLS,
-    OSD_OP_READ, OSD_OP_SETXATTR, OSD_OP_STAT, OSD_OP_TRUNCATE,
-    OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_ZERO,
+    OSD_OP_OMAP_RM, OSD_OP_READ, OSD_OP_SETXATTR, OSD_OP_STAT,
+    OSD_OP_TRUNCATE, OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_ZERO,
 )
 from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry, PGLog, \
     eversion
@@ -316,13 +316,21 @@ class PG:
         try:
             while True:
                 m = await self.op_queue.get()
-                while not self.role_active():
-                    await asyncio.sleep(0.05)
+                tracked = self.osd.op_tracker.create(
+                    f"osd_op({m.src} {self.cid} {m.oid} "
+                    f"tid={m.tid})")
+                if not self.role_active():
+                    tracked.mark_event("waiting_for_active")
+                    while not self.role_active():
+                        await asyncio.sleep(0.05)
+                tracked.mark_event("started")
                 try:
                     await self._execute(m)
                 except Exception as e:
                     log.error(f"pg {self.pgid} op failed: {e}")
                     await self._reply(m, -5, b"", {})       # -EIO
+                finally:
+                    tracked.finish()
         except asyncio.CancelledError:
             pass
 
@@ -428,6 +436,12 @@ class PG:
             elif code == OSD_OP_OMAP_SET:
                 t.touch(cid, oid)
                 t.omap_setkeys(cid, oid, {name: data})
+                mutated = True
+            elif code == OSD_OP_OMAP_RM:
+                if not store.exists(cid, oid):
+                    await self._reply(m, -2, b"", {})
+                    return
+                t.omap_rmkeys(cid, oid, [name])
                 mutated = True
             else:
                 await self._reply(m, -95, b"", {})   # -EOPNOTSUPP

@@ -15,9 +15,10 @@ from ceph_tpu.mon.client import MonClient
 from ceph_tpu.mon.monitor import MonMap
 from ceph_tpu.msg import Keyring
 from ceph_tpu.osd.messages import (
-    OSD_OP_DELETE, OSD_OP_GETXATTR, OSD_OP_OMAP_GET, OSD_OP_OMAP_SET,
-    OSD_OP_PGLS, OSD_OP_READ, OSD_OP_SETXATTR, OSD_OP_STAT,
-    OSD_OP_TRUNCATE, OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_ZERO,
+    OSD_OP_DELETE, OSD_OP_GETXATTR, OSD_OP_OMAP_GET, OSD_OP_OMAP_RM,
+    OSD_OP_OMAP_SET, OSD_OP_PGLS, OSD_OP_READ, OSD_OP_SETXATTR,
+    OSD_OP_STAT, OSD_OP_TRUNCATE, OSD_OP_WRITE, OSD_OP_WRITEFULL,
+    OSD_OP_ZERO,
 )
 from ceph_tpu.osdc.objecter import Objecter, ObjectOperationError
 
@@ -108,6 +109,9 @@ class IoCtx:
     async def set_omap(self, oid: str, key: str, value: bytes):
         await self._op(oid, [(OSD_OP_OMAP_SET, 0, 0, key,
                               bytes(value))])
+
+    async def rm_omap_key(self, oid: str, key: str):
+        await self._op(oid, [(OSD_OP_OMAP_RM, 0, 0, key, b"")])
 
     # -- reads -------------------------------------------------------------
     async def read(self, oid: str, length: int = 0,

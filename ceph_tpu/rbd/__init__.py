@@ -1,0 +1,165 @@
+"""librbd analog: block images striped over RADOS objects.
+
+ref: src/librbd/ (librbd::RBD / librbd::Image) — an image is a header
+object (``rbd_header.<name>``: size/order/features in omap) plus data
+objects ``rbd_data.<name>.<N>`` of ``2^order`` bytes each; image I/O
+maps byte extents onto those objects exactly like the reference's
+Striper (ref: src/osdc/Striper.cc with stripe_count=1). The API keeps
+the reference's names: RBD.create/list/remove, Image.read/write/
+resize/size/stat.
+
+This is also this framework's libradosstriper seat: large-object
+striping over many RADOS objects, client-side.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ceph_tpu.rados import IoCtx, ObjectOperationError
+
+__all__ = ["RBD", "Image"]
+
+RBD_DIRECTORY = "rbd_directory"
+
+
+def _header(name: str) -> str:
+    return f"rbd_header.{name}"
+
+
+def _data(name: str, idx: int) -> str:
+    return f"rbd_data.{name}.{idx:016x}"
+
+
+class RBD:
+    """ref: librbd::RBD — image management on one pool."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+
+    async def create(self, name: str, size: int,
+                     order: int = 22) -> None:
+        """ref: RBD::create (order = log2 object size, default 4 MiB)."""
+        if not (12 <= order <= 26):
+            raise ValueError("order must be in [12, 26]")
+        existing = await self.list()
+        if name in existing:
+            raise ObjectOperationError(-17, f"image {name} exists")
+        io = self.ioctx
+        await io.set_omap(_header(name), "meta", json.dumps(
+            {"size": size, "order": order}).encode())
+        await io.set_omap(RBD_DIRECTORY, name, b"1")
+
+    async def list(self) -> list[str]:
+        try:
+            return sorted(await self.ioctx.get_omap_vals(RBD_DIRECTORY))
+        except ObjectOperationError:
+            return []
+
+    async def remove(self, name: str) -> None:
+        """ref: RBD::remove — data objects, header, directory entry."""
+        img = await self.open(name)
+        for idx in img._object_range(0, img.size_bytes):
+            try:
+                await self.ioctx.remove(_data(name, idx))
+            except ObjectOperationError:
+                pass
+        await self.ioctx.remove(_header(name))
+        try:
+            await self.ioctx.rm_omap_key(RBD_DIRECTORY, name)
+        except ObjectOperationError:
+            pass
+
+    async def open(self, name: str) -> "Image":
+        io = self.ioctx
+        try:
+            omap = await io.get_omap_vals(_header(name))
+        except ObjectOperationError:
+            raise ObjectOperationError(-2, f"no image {name}") from None
+        if "meta" not in omap:
+            raise ObjectOperationError(-2, f"no image {name}")
+        meta = json.loads(omap["meta"])
+        return Image(io, name, meta["size"], meta["order"])
+
+
+class Image:
+    """ref: librbd::Image — byte-addressed I/O over the data objects."""
+
+    def __init__(self, ioctx: IoCtx, name: str, size: int, order: int):
+        self.ioctx = ioctx
+        self.name = name
+        self.size_bytes = size
+        self.order = order
+        self.obj_size = 1 << order
+
+    def _object_range(self, offset: int, length: int) -> list[int]:
+        if length <= 0:
+            return []
+        first = offset // self.obj_size
+        last = (offset + length - 1) // self.obj_size
+        return list(range(first, last + 1))
+
+    async def size(self) -> int:
+        return self.size_bytes
+
+    async def write(self, offset: int, data: bytes) -> int:
+        """ref: Image::write — extent-split across data objects."""
+        if offset + len(data) > self.size_bytes:
+            raise ObjectOperationError(-27, "write past image size")
+        done = 0
+        while done < len(data):
+            abs_off = offset + done
+            idx = abs_off // self.obj_size
+            within = abs_off % self.obj_size
+            n = min(self.obj_size - within, len(data) - done)
+            await self.ioctx.write(_data(self.name, idx),
+                                   data[done:done + n], offset=within)
+            done += n
+        return done
+
+    async def read(self, offset: int, length: int) -> bytes:
+        """ref: Image::read — absent data objects read as zeros."""
+        length = min(length, max(self.size_bytes - offset, 0))
+        out = bytearray(length)
+        done = 0
+        while done < length:
+            abs_off = offset + done
+            idx = abs_off // self.obj_size
+            within = abs_off % self.obj_size
+            n = min(self.obj_size - within, length - done)
+            try:
+                piece = await self.ioctx.read(
+                    _data(self.name, idx), length=n, offset=within)
+                out[done:done + len(piece)] = piece
+            except ObjectOperationError:
+                pass                       # sparse: zeros
+            done += n
+        return bytes(out)
+
+    async def resize(self, new_size: int) -> None:
+        """ref: Image::resize — shrink drops whole trailing objects."""
+        if new_size < self.size_bytes:
+            for idx in self._object_range(
+                    new_size, self.size_bytes - new_size):
+                if idx * self.obj_size >= new_size:
+                    try:
+                        await self.ioctx.remove(_data(self.name, idx))
+                    except ObjectOperationError:
+                        pass
+                elif new_size % self.obj_size:
+                    try:
+                        await self.ioctx.truncate(
+                            _data(self.name, idx),
+                            new_size % self.obj_size)
+                    except ObjectOperationError:
+                        pass
+        self.size_bytes = new_size
+        await self.ioctx.set_omap(_header(self.name), "meta", json.dumps(
+            {"size": new_size, "order": self.order}).encode())
+
+    async def stat(self) -> dict:
+        """ref: Image::stat (info_t)."""
+        return {"size": self.size_bytes, "order": self.order,
+                "obj_size": self.obj_size,
+                "num_objs": -(-self.size_bytes // self.obj_size),
+                "block_name_prefix": f"rbd_data.{self.name}"}

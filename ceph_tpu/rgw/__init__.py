@@ -1,0 +1,201 @@
+"""RGW-lite: an S3-subset HTTP object gateway over RADOS.
+
+ref: src/rgw/ (RGWRados + the beast frontend + RGWOp hierarchy) —
+rebuilt small: an asyncio HTTP frontend translating the core S3
+operations onto one backing pool. Buckets are omap *bucket index*
+objects (ref: RGW bucket index shards); object payloads live in
+``<bucket>/<key>`` RADOS objects. XML response shapes follow S3's
+ListAllMyBucketsResult / ListBucketResult so s3-style clients parse
+them.
+
+Supported: PUT/DELETE bucket, GET / (list buckets), PUT/GET/HEAD/
+DELETE object, GET bucket (list objects). Not built: multipart,
+ACLs/auth signatures, versioning, multisite replication.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from urllib.parse import unquote
+from xml.sax.saxutils import escape
+
+from ceph_tpu.rados import IoCtx, ObjectOperationError
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("rgw")
+
+BUCKETS_ROOT = ".rgw.buckets"          # omap: bucket name -> b"1"
+
+
+def _index(bucket: str) -> str:
+    return f".bucket.{bucket}"
+
+
+def _obj(bucket: str, key: str) -> str:
+    return f"{bucket}/{key}"
+
+
+class RGWGateway:
+    """ref: RGWHTTPFrontend + RGWOp dispatch."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._serve, host,
+                                                  port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.dout(1, f"rgw listening on :{self.port}")
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+
+    # -- http plumbing -----------------------------------------------------
+    async def _serve(self, reader, writer) -> None:
+        try:
+            req = await asyncio.wait_for(reader.readline(), timeout=10)
+            if not req:
+                return
+            method, path, _ = req.decode().split(" ", 2)
+            headers: dict[str, str] = {}
+            while True:
+                line = await asyncio.wait_for(reader.readline(),
+                                              timeout=10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            n = int(headers.get("content-length", 0))
+            if n:
+                body = await asyncio.wait_for(reader.readexactly(n),
+                                              timeout=30)
+            status, ctype, payload = await self._dispatch(
+                method.upper(), unquote(path.split("?", 1)[0]), body)
+            writer.write(
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + payload)
+            await writer.drain()
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionError, OSError, ValueError) as e:
+            log.dout(5, f"rgw client error: {e}")
+        finally:
+            writer.close()
+
+    # -- op dispatch (ref: RGWOp subclasses) --------------------------------
+    async def _dispatch(self, method: str, path: str,
+                        body: bytes) -> tuple[str, str, bytes]:
+        parts = [p for p in path.split("/") if p]
+        try:
+            if not parts:
+                if method == "GET":
+                    return await self._list_buckets()
+                return "405 Method Not Allowed", "text/plain", b""
+            bucket = parts[0]
+            key = "/".join(parts[1:])
+            if not key:
+                if method == "PUT":
+                    return await self._create_bucket(bucket)
+                if method == "DELETE":
+                    return await self._delete_bucket(bucket)
+                if method == "GET":
+                    return await self._list_objects(bucket)
+                return "405 Method Not Allowed", "text/plain", b""
+            if method == "PUT":
+                return await self._put_object(bucket, key, body)
+            if method == "GET":
+                return await self._get_object(bucket, key)
+            if method == "HEAD":
+                return await self._get_object(bucket, key, head=True)
+            if method == "DELETE":
+                return await self._delete_object(bucket, key)
+            return "405 Method Not Allowed", "text/plain", b""
+        except ObjectOperationError as e:
+            if e.errno == -2:
+                return "404 Not Found", "application/xml", \
+                    b"<Error><Code>NoSuchKey</Code></Error>"
+            return "500 Internal Server Error", "text/plain", \
+                str(e).encode()
+
+    async def _bucket_exists(self, bucket: str) -> bool:
+        try:
+            omap = await self.ioctx.get_omap_vals(BUCKETS_ROOT)
+        except ObjectOperationError:
+            return False
+        return bucket in omap
+
+    async def _list_buckets(self):
+        try:
+            omap = await self.ioctx.get_omap_vals(BUCKETS_ROOT)
+        except ObjectOperationError:
+            omap = {}
+        items = "".join(
+            f"<Bucket><Name>{escape(b)}</Name></Bucket>"
+            for b in sorted(omap))
+        xml = (f'<?xml version="1.0"?><ListAllMyBucketsResult>'
+               f"<Buckets>{items}</Buckets>"
+               f"</ListAllMyBucketsResult>")
+        return "200 OK", "application/xml", xml.encode()
+
+    async def _create_bucket(self, bucket: str):
+        await self.ioctx.set_omap(BUCKETS_ROOT, bucket, b"1")
+        await self.ioctx.set_omap(_index(bucket), "_created", b"1")
+        return "200 OK", "application/xml", b""
+
+    async def _delete_bucket(self, bucket: str):
+        if not await self._bucket_exists(bucket):
+            return "404 Not Found", "application/xml", \
+                b"<Error><Code>NoSuchBucket</Code></Error>"
+        idx = await self.ioctx.get_omap_vals(_index(bucket))
+        if any(k.startswith("k:") for k in idx):
+            return "409 Conflict", "application/xml", \
+                b"<Error><Code>BucketNotEmpty</Code></Error>"
+        await self.ioctx.remove(_index(bucket))
+        await self.ioctx.rm_omap_key(BUCKETS_ROOT, bucket)
+        return "204 No Content", "application/xml", b""
+
+    async def _list_objects(self, bucket: str):
+        if not await self._bucket_exists(bucket):
+            return "404 Not Found", "application/xml", \
+                b"<Error><Code>NoSuchBucket</Code></Error>"
+        idx = await self.ioctx.get_omap_vals(_index(bucket))
+        items = "".join(
+            f"<Contents><Key>{escape(k[2:])}</Key>"
+            f"<Size>{int.from_bytes(v, 'little')}</Size></Contents>"
+            for k, v in sorted(idx.items())
+            if k.startswith("k:"))
+        xml = (f'<?xml version="1.0"?><ListBucketResult>'
+               f"<Name>{escape(bucket)}</Name>{items}"
+               f"</ListBucketResult>")
+        return "200 OK", "application/xml", xml.encode()
+
+    async def _put_object(self, bucket: str, key: str, body: bytes):
+        if not await self._bucket_exists(bucket):
+            return "404 Not Found", "application/xml", \
+                b"<Error><Code>NoSuchBucket</Code></Error>"
+        await self.ioctx.write_full(_obj(bucket, key), body)
+        # "k:" prefix keeps user keys out of the index meta namespace
+        await self.ioctx.set_omap(_index(bucket), f"k:{key}",
+                                  len(body).to_bytes(8, "little"))
+        return "200 OK", "application/xml", b""
+
+    async def _get_object(self, bucket: str, key: str,
+                          head: bool = False):
+        data = await self.ioctx.read(_obj(bucket, key))
+        return "200 OK", "application/octet-stream", \
+            b"" if head else data
+
+    async def _delete_object(self, bucket: str, key: str):
+        await self.ioctx.remove(_obj(bucket, key))
+        try:
+            await self.ioctx.rm_omap_key(_index(bucket), f"k:{key}")
+        except ObjectOperationError:
+            pass
+        return "204 No Content", "application/xml", b""

@@ -1,0 +1,81 @@
+"""TrackedOp / OpTracker: per-op event timelines.
+
+ref: src/common/TrackedOp.{h,cc} — every client op gets a tracked
+record with timestamped lifecycle events; in-flight ops and a bounded
+history are dumpable via the admin socket (``dump_ops_in_flight`` /
+``dump_historic_ops``), and ops older than the warn threshold are
+counted as slow (ref: OpTracker::check_ops_in_flight).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+
+class TrackedOp:
+    def __init__(self, tracker: "OpTracker", desc: str):
+        self._tracker = tracker
+        self.desc = desc
+        self.start = time.time()
+        self.events: list[tuple[float, str]] = [(self.start, "queued")]
+        self.done = False
+
+    def mark_event(self, name: str) -> None:
+        self.events.append((time.time(), name))
+
+    def finish(self) -> None:
+        if not self.done:
+            self.done = True
+            self.mark_event("done")
+            self._tracker._finish(self)
+
+    @property
+    def duration(self) -> float:
+        end = self.events[-1][0] if self.done else time.time()
+        return end - self.start
+
+    def dump(self) -> dict:
+        return {
+            "description": self.desc,
+            "initiated_at": self.start,
+            "age": round(self.duration, 6),
+            "events": [{"time": round(t - self.start, 6), "event": e}
+                       for t, e in self.events],
+        }
+
+
+class OpTracker:
+    """ref: OpTracker — per-daemon registry."""
+
+    def __init__(self, history_size: int = 20,
+                 slow_op_warn_s: float = 30.0):
+        self.inflight: dict[int, TrackedOp] = {}
+        self.history: deque[TrackedOp] = deque(maxlen=history_size)
+        self.slow_op_warn_s = slow_op_warn_s
+        self._seq = 0
+
+    def create(self, desc: str) -> TrackedOp:
+        self._seq += 1
+        op = TrackedOp(self, desc)
+        op._seq = self._seq
+        self.inflight[self._seq] = op
+        return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        self.inflight.pop(getattr(op, "_seq", -1), None)
+        self.history.append(op)
+
+    def dump_ops_in_flight(self) -> dict:
+        """ref: admin socket dump_ops_in_flight."""
+        return {"num_ops": len(self.inflight),
+                "ops": [op.dump() for op in self.inflight.values()]}
+
+    def dump_historic_ops(self) -> dict:
+        """ref: admin socket dump_historic_ops (slowest-last order)."""
+        return {"num_ops": len(self.history),
+                "ops": [op.dump() for op in self.history]}
+
+    def slow_ops(self) -> list[TrackedOp]:
+        return [op for op in self.inflight.values()
+                if op.duration > self.slow_op_warn_s]

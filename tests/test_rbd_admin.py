@@ -1,0 +1,123 @@
+"""librbd-lite images + admin socket + op tracking.
+
+ref test models: src/test/librbd (image I/O semantics) and the
+`ceph daemon` admin-socket workunits.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.rados import ObjectOperationError
+from ceph_tpu.rbd import RBD
+from ceph_tpu.utils.admin_socket import daemon_command
+from ceph_tpu.utils.op_tracker import OpTracker
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_rbd_image_lifecycle():
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("rbd", pg_num=8, size=3)
+            await c.wait_for_clean(timeout=90)
+            io = await c.client.open_ioctx("rbd")
+            rbd = RBD(io)
+            # 256 KiB image with 64 KiB objects -> 4 data objects
+            await rbd.create("disk0", 256 << 10, order=16)
+            assert await rbd.list() == ["disk0"]
+            with pytest.raises(ObjectOperationError):
+                await rbd.create("disk0", 1 << 20)
+            img = await rbd.open("disk0")
+            info = await img.stat()
+            assert info["obj_size"] == 64 << 10
+            assert info["num_objs"] == 4
+            # write spanning two data objects
+            span = os.urandom(100_000)
+            await img.write(30_000, span)
+            assert await img.read(30_000, len(span)) == span
+            # sparse read: untouched region is zeros
+            assert await img.read(200_000, 100) == b"\x00" * 100
+            # the data objects exist with the striper's names
+            names = await io.list_objects()
+            assert "rbd_data.disk0.0000000000000000" in names
+            assert "rbd_data.disk0.0000000000000001" in names
+            # writes past the image size are rejected
+            with pytest.raises(ObjectOperationError):
+                await img.write(260_000, b"x" * 10_000)
+            # shrink: trailing objects go away
+            await img.resize(64 << 10)
+            img2 = await rbd.open("disk0")
+            assert await img2.size() == 64 << 10
+            names = await io.list_objects()
+            assert "rbd_data.disk0.0000000000000001" not in names
+            # data inside the surviving object is intact
+            assert await img2.read(30_000, 1000) == span[:1000]
+            await rbd.remove("disk0")
+            assert await rbd.list() == []
+            assert not [n for n in await io.list_objects()
+                        if n.startswith("rbd_data.disk0")]
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_admin_socket_and_op_tracking(tmp_path):
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=2,
+            config={"admin_socket_dir": str(tmp_path)}).start()
+        try:
+            await c.client.pool_create("p", pg_num=4, size=2)
+            await c.wait_for_clean(timeout=90)
+            io = await c.client.open_ioctx("p")
+            for i in range(5):
+                await io.write_full(f"o{i}", b"x" * 128)
+            sock = str(tmp_path / "osd.0.asok")
+            # ceph daemon osd.0 status
+            st = await daemon_command(sock, "status")
+            assert st["whoami"] == 0 and st["up"] is True
+            assert st["num_pgs"] > 0
+            # perf dump returns the process-wide counters
+            perf = await daemon_command(sock, "perf dump")
+            assert isinstance(perf, dict)
+            # historic ops recorded the writes this osd served
+            hist = await daemon_command(sock, "dump_historic_ops")
+            total_hist = hist["num_ops"]
+            other = await daemon_command(
+                str(tmp_path / "osd.1.asok"), "dump_historic_ops")
+            assert total_hist + other["num_ops"] >= 5
+            if hist["ops"]:
+                op = hist["ops"][0]
+                assert "osd_op(" in op["description"]
+                assert any(e["event"] == "done" for e in op["events"])
+            # unknown command errors cleanly
+            bad = await daemon_command(sock, "no-such-cmd")
+            assert "error" in bad
+            helpmap = await daemon_command(sock, "help")
+            assert "dump_ops_in_flight" in helpmap
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_op_tracker_unit():
+    t = OpTracker(history_size=2, slow_op_warn_s=0.0)
+    a = t.create("op-a")
+    a.mark_event("started")
+    assert t.dump_ops_in_flight()["num_ops"] == 1
+    assert t.slow_ops() == [a]           # warn threshold 0
+    a.finish()
+    assert t.dump_ops_in_flight()["num_ops"] == 0
+    assert t.dump_historic_ops()["num_ops"] == 1
+    b, c_ = t.create("b"), t.create("c")
+    b.finish()
+    c_.finish()
+    hist = t.dump_historic_ops()
+    assert hist["num_ops"] == 2          # bounded history
+    assert [o["description"] for o in hist["ops"]] == ["b", "c"]

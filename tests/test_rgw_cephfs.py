@@ -1,0 +1,158 @@
+"""RGW-lite S3 gateway + libcephfs-lite over a live cluster.
+
+ref test models: s3-tests subset (bucket/object lifecycle over raw
+HTTP) and src/test/libcephfs (namespace semantics).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cephfs import CephFSLite, FSError
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.rados import ObjectOperationError
+from ceph_tpu.rgw import RGWGateway
+
+
+async def _warm(io) -> None:
+    """One write before timing-sensitive asserts: the first op pays the
+    CRUSH-mapper jit compile on a loaded 1-core host."""
+    for _ in range(30):
+        try:
+            await io.write_full("_warm", b"x")
+            return
+        except ObjectOperationError:
+            await asyncio.sleep(1)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _http(port: int, method: str, path: str,
+                body: bytes = b"") -> tuple[int, bytes]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    try:
+        writer.write(
+            f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        await writer.drain()
+        # generous: the first op in a fresh process may sit behind a
+        # CRUSH-mapper jit compile on a loaded 1-core host
+        status_line = await asyncio.wait_for(reader.readline(),
+                                             timeout=60)
+        status = int(status_line.split()[1])
+        clen = 0
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if line.lower().startswith(b"content-length"):
+                clen = int(line.split(b":")[1])
+        payload = await reader.readexactly(clen) if clen else b""
+        return status, payload
+    finally:
+        writer.close()
+
+
+def test_rgw_s3_lifecycle():
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("rgw", pg_num=8, size=3)
+            await c.wait_for_clean(timeout=90)
+            io = await c.client.open_ioctx("rgw")
+            await _warm(io)
+            gw = RGWGateway(io)
+            port = await gw.start()
+            # bucket lifecycle
+            st, _ = await _http(port, "PUT", "/photos")
+            assert st == 200
+            st, xml = await _http(port, "GET", "/")
+            assert st == 200 and b"<Name>photos</Name>" in xml
+            # object lifecycle
+            st, _ = await _http(port, "PUT", "/photos/cat.jpg",
+                                b"\xff\xd8meow")
+            assert st == 200
+            st, data = await _http(port, "GET", "/photos/cat.jpg")
+            assert st == 200 and data == b"\xff\xd8meow"
+            st, _ = await _http(port, "HEAD", "/photos/cat.jpg")
+            assert st == 200
+            st, xml = await _http(port, "GET", "/photos")
+            assert b"<Key>cat.jpg</Key>" in xml
+            assert b"<Size>6</Size>" in xml
+            # nested keys
+            st, _ = await _http(port, "PUT", "/photos/a/b.txt", b"hi")
+            assert st == 200
+            st, data = await _http(port, "GET", "/photos/a/b.txt")
+            assert data == b"hi"
+            # errors: missing key / bucket, non-empty delete
+            st, _ = await _http(port, "GET", "/photos/nope")
+            assert st == 404
+            st, _ = await _http(port, "PUT", "/nobucket/x", b"1")
+            assert st == 404
+            st, _ = await _http(port, "DELETE", "/photos")
+            assert st == 409                      # BucketNotEmpty
+            st, _ = await _http(port, "DELETE", "/photos/cat.jpg")
+            assert st == 204
+            st, _ = await _http(port, "DELETE", "/photos/a/b.txt")
+            assert st == 204
+            st, _ = await _http(port, "DELETE", "/photos")
+            assert st == 204
+            st, xml = await _http(port, "GET", "/")
+            assert b"photos" not in xml
+            await gw.stop()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_cephfs_namespace():
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("fs", pg_num=8, size=3)
+            await c.wait_for_clean(timeout=90)
+            io = await c.client.open_ioctx("fs")
+            fs = await CephFSLite(io).mount()
+            await fs.mkdir("/home")
+            await fs.mkdir("/home/user")
+            await fs.write_file("/home/user/notes.txt", b"hello fs")
+            await fs.write_file("/readme", b"root file")
+            assert await fs.ls("/") == ["home", "readme"]
+            assert await fs.ls("/home") == ["user"]
+            assert await fs.ls("/home/user") == ["notes.txt"]
+            assert await fs.read_file("/home/user/notes.txt") == \
+                b"hello fs"
+            st = await fs.stat("/home/user/notes.txt")
+            assert st == {"path": "/home/user/notes.txt",
+                          "type": "file", "size": 8}
+            assert (await fs.stat("/home"))["type"] == "dir"
+            # offset write grows the file
+            await fs.write_file("/home/user/notes.txt", b"!", offset=8)
+            assert (await fs.stat("/home/user/notes.txt"))["size"] == 9
+            # rename across directories
+            await fs.rename("/home/user/notes.txt", "/notes-moved")
+            assert "notes-moved" in await fs.ls("/")
+            assert await fs.ls("/home/user") == []
+            assert await fs.read_file("/notes-moved") == b"hello fs!"
+            # error semantics
+            with pytest.raises(FSError):
+                await fs.mkdir("/home")               # EEXIST
+            with pytest.raises(FSError):
+                await fs.rmdir("/home")               # ENOTEMPTY
+            with pytest.raises(FSError):
+                await fs.read_file("/home")           # EISDIR
+            with pytest.raises(FSError):
+                await fs.ls("/ghost")                 # ENOENT
+            with pytest.raises(FSError):
+                await fs.unlink("/home")              # EISDIR
+            # cleanup path: rmdir after emptying
+            await fs.rmdir("/home/user")
+            await fs.rmdir("/home")
+            await fs.unlink("/readme")
+            await fs.unlink("/notes-moved")
+            assert await fs.ls("/") == []
+        finally:
+            await c.stop()
+    run(go())

@@ -1,0 +1,61 @@
+"""Live thrashing: randomized OSD kill/revive under client workload.
+
+ref test model: qa/tasks/ceph_manager.py Thrasher + the
+rados/thrash-erasure-code suites — while a client keeps writing,
+OSDs are killed and revived in rounds; after the storm the cluster
+must return to clean with every acknowledged write readable.
+"""
+
+import asyncio
+import random
+
+from ceph_tpu.cluster.vstart import Cluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_thrash_replicated_pool():
+    async def go():
+        rng = random.Random(42)
+        c = await Cluster(
+            n_mons=1, n_osds=4,
+            config={"mon_osd_down_out_interval": 600.0}).start()
+        try:
+            await c.client.pool_create("t", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            acked: dict[str, bytes] = {}
+            seq = 0
+
+            async def write_some(n: int) -> None:
+                nonlocal seq
+                for _ in range(n):
+                    oid = f"obj{seq % 30}"
+                    data = bytes([seq % 256]) * rng.randint(1, 2048)
+                    await io.write_full(oid, data)
+                    acked[oid] = data          # acked => must survive
+                    seq += 1
+
+            await write_some(10)
+            for round_no in range(2):
+                victim = rng.randrange(4)
+                await c.kill_osd(victim)
+                await c.wait_for_osd_down(victim, timeout=25)
+                # acked writes stay readable; new writes land degraded
+                for oid, data in list(acked.items())[:5]:
+                    assert await io.read(oid) == data
+                await write_some(8)
+                await c.revive_osd(victim)
+                await c.wait_for_clean(timeout=120)
+                await write_some(5)
+            # final verification: every acknowledged write intact
+            for oid, data in acked.items():
+                assert await io.read(oid) == data, oid
+            status = await c.client.status()
+            assert status["osdmap"]["num_up_osds"] == 4
+        finally:
+            await c.stop()
+    run(go())
