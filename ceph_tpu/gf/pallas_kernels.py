@@ -1,0 +1,80 @@
+"""Pallas TPU kernel for the GF(2^8) bit-plane encode.
+
+The XLA `bitmatmul` path (gf.ops.gf_matmul_bitplanes) materializes the
+(8k, L) int8 bit-plane expansion in HBM — 8x the payload in traffic —
+before the MXU contraction, which caps encode throughput far below the
+payload roofline. This kernel fuses unpack -> int8 matmul -> mod-2 ->
+pack inside one VMEM tile, so HBM sees only the payload in
+(read k + write m chunks ≈ 1 + m/k bytes moved per byte encoded).
+
+ref: the role of ISA-L's ec_encode_data AVX512 kernels
+(src/erasure-code/isa); the bit-plane formulation is SURVEY.md §7
+step 1's MXU mapping.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except ImportError:                                   # pragma: no cover
+    HAVE_PALLAS = False
+
+# Lane-tile bytes per grid step. 8k int8 bit-planes of a TILE_L block
+# plus the int32 accumulator must fit VMEM comfortably:
+# 64 * TILE_L (bits) + 24 * 4 * TILE_L (acc) ≈ 160 * TILE_L.
+# TILE_L = 64 KiB -> ~10 MiB VMEM working set on a 128 MiB-VMEM v5e.
+TILE_L = 1 << 16
+
+
+def _encode_kernel(bm_ref, data_ref, out_ref):
+    data = data_ref[...]                              # (k, TILE_L) uint8
+    k = data.shape[0]
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((data[:, None, :] >> shifts[None, :, None]) & jnp.uint8(1))
+    bits = bits.reshape(8 * k, data.shape[1]).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        bm_ref[...], bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)             # (8m, TILE_L)
+    m8 = acc.shape[0]
+    b = (acc & 1).astype(jnp.uint8).reshape(m8 // 8, 8, -1)
+    weights = (jnp.uint8(1) << shifts)
+    out_ref[...] = jnp.sum(b * weights[None, :, None], axis=1,
+                           dtype=jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gf_matmul_bitplanes_pallas(bitmatrix: jax.Array, data: jax.Array,
+                               interpret: bool = False) -> jax.Array:
+    """(8m, 8k) bitmatrix x (k, L) uint8 -> (m, L) uint8 parity.
+
+    L must be a multiple of TILE_L for the tiled fast path; callers
+    with smaller/unaligned L fall back to the XLA kernel upstream."""
+    m8, k8 = bitmatrix.shape
+    k, L = data.shape
+    assert k8 == 8 * k, (bitmatrix.shape, data.shape)
+    m = m8 // 8
+    grid = (L // TILE_L,)
+    return pl.pallas_call(
+        _encode_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m8, k8), lambda i: (0, 0)),
+            pl.BlockSpec((k, TILE_L), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, TILE_L), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((m, L), jnp.uint8),
+        interpret=interpret,
+    )(bitmatrix, data)
+
+
+def pallas_ok(L: int) -> bool:
+    """Fast-path eligibility for this lane length."""
+    return HAVE_PALLAS and L % TILE_L == 0
