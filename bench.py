@@ -29,7 +29,11 @@ BASELINE_GIBS = 7.5  # ISA-L RS k=8,m=3 single-core (BASELINE.md external row)
 def ec_metrics() -> tuple[dict, dict]:
     from ceph_tpu.bench.ec_benchmark import ErasureCodeBench, parse_args
 
-    backend = os.environ.get("CEPH_TPU_BENCH_BACKEND", "bitmatmul")
+    # Round 3: "auto" resolves to the fused pallas kernel on TPU —
+    # tested byte-exact vs the XLA path (tests/test_gf.py
+    # TestPallasKernel) and measured ~1.4x bitmatmul on v5e — and to
+    # bitmatmul elsewhere (pallas would only interpret on CPU).
+    backend = os.environ.get("CEPH_TPU_BENCH_BACKEND", "auto")
     common = [
         "--plugin", "jax", "--size", str(4 << 20),
         "--iterations", "1024",

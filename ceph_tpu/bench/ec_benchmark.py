@@ -92,7 +92,7 @@ def _readback(x) -> None:
 # Working-set multiple of the input bytes each backend materializes in HBM
 # (bit-planes at 8x + int32 accumulator rows for bitmatmul; the (m, k, L)
 # nibble-product intermediate for lut — measured from XLA OOM dumps).
-_HBM_MULTIPLE = {"bitmatmul": 16, "lut": 72}
+_HBM_MULTIPLE = {"bitmatmul": 16, "lut": 72, "pallas": 3}
 
 
 def _auto_batch(object_size: int, iterations: int, backend: str,

@@ -144,15 +144,7 @@ def encode_stripes(bitmatrix: jax.Array, lo: jax.Array, hi: jax.Array,
     """
     b, k, C = data.shape
     flat = jnp.transpose(data, (1, 0, 2)).reshape(k, b * C)
-    if backend == "pallas":
-        from ceph_tpu.gf import pallas_kernels as pk
-        if pk.pallas_ok(b * C):
-            out = pk.gf_matmul_bitplanes_pallas(
-                bitmatrix, flat,
-                interpret=jax.default_backend() == "cpu")
-        else:                       # unaligned tail: XLA fallback
-            out = gf_matmul_bitplanes(bitmatrix, flat)
-    elif backend == "bitmatmul":
+    if backend == "bitmatmul":
         out = gf_matmul_bitplanes(bitmatrix, flat)
     elif backend == "lut":
         out = gf_matmul_lut(lo, hi, flat)
