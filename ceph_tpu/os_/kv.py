@@ -197,6 +197,15 @@ class WALDB(MemDB):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.path, self.SNAPSHOT))
+        # The rename must be durable BEFORE the WAL is truncated: on
+        # power loss an un-fsynced rename can be lost while the
+        # truncation survives, dropping every transaction since the
+        # previous snapshot. fsync the directory entry first.
+        dfd = os.open(self.path, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._wal.close()
         self._wal = open(os.path.join(self.path, self.WAL), "wb")
 

@@ -247,11 +247,72 @@ class MemStore(ObjectStore):
         return o
 
     def queue_transaction(self, t: Transaction) -> None:
-        # validate-then-apply would need shadow state; like the
-        # reference, a malformed transaction asserts (StoreError) and
-        # the caller treats the whole txn as failed
+        # All-or-nothing: validate every op against simulated existence
+        # state BEFORE mutating, so a bad op cannot leave memory
+        # half-applied while the caller treats the txn as failed
+        # (ref: ObjectStore::Transaction atomicity contract).
+        self._validate(t.ops)
         for op in t.ops:
             self._apply_op(op)
+
+    # ops whose object lookup auto-creates (mirrors _apply_op)
+    _CREATES = frozenset((OP_TOUCH, OP_WRITE, OP_ZERO, OP_TRUNCATE,
+                          OP_SETATTRS, OP_OMAP_SETKEYS))
+    # ops that raise when the object is missing
+    _NEEDS_OBJ = frozenset((OP_RMATTR, OP_OMAP_RMKEYS, OP_OMAP_CLEAR))
+
+    def _validate(self, ops) -> None:
+        """Dry-run existence simulation of _apply_op: raises the same
+        StoreErrors it would, without touching live state."""
+        colls: dict[str, bool] = {}
+        objs: dict[tuple[str, str], bool] = {}
+        # cids whose contents were dropped by a simulated RMCOLL: object
+        # existence under them is decided by the simulation alone, never
+        # by live state (an RMCOLL+MKCOLL pair leaves the coll EMPTY).
+        reset: set[str] = set()
+
+        def cexists(cid: str) -> bool:
+            if cid not in colls:
+                colls[cid] = cid in self.colls
+            return colls[cid]
+
+        def oexists(cid: str, oid: str) -> bool:
+            key = (cid, oid)
+            if key not in objs:
+                if cid in reset:
+                    objs[key] = False
+                else:
+                    coll = self.colls.get(cid)
+                    objs[key] = coll is not None and oid in coll
+            return objs[key]
+
+        for op in ops:
+            code = op[0]
+            if code == OP_MKCOLL:
+                colls[op[1]] = True
+                continue
+            if code == OP_RMCOLL:
+                colls[op[1]] = False
+                reset.add(op[1])
+                for key in [k for k in objs if k[0] == op[1]]:
+                    del objs[key]
+                continue
+            cid, oid = op[1], op[2]
+            if not cexists(cid):
+                raise StoreError(f"no collection {cid}")
+            if code in self._CREATES:
+                objs[(cid, oid)] = True
+            elif code == OP_CLONE:
+                if not oexists(cid, oid):
+                    raise StoreError(f"no object {cid}/{oid}")
+                objs[(cid, op[3])] = True
+            elif code in self._NEEDS_OBJ:
+                if not oexists(cid, oid):
+                    raise StoreError(f"no object {cid}/{oid}")
+            elif code == OP_REMOVE:
+                objs[(cid, oid)] = False
+            else:
+                raise StoreError(f"unknown op {code}")
 
     def _apply_op(self, op: tuple) -> None:
         code = op[0]
@@ -347,6 +408,9 @@ class WALStore(MemStore):
     def __init__(self, path: str, compact_threshold: int = 64 << 20):
         super().__init__()
         self.db = WALDB(path, compact_threshold=compact_threshold)
+        # (cid, oid) whose kv record checksum has been verified since
+        # its last write — lets ranged reads verify once per version.
+        self._verified: set[tuple[str, str]] = set()
         self._load()
 
     @staticmethod
@@ -404,20 +468,30 @@ class WALStore(MemStore):
         for cid, oid in sorted(touched):
             coll = self.colls.get(cid)
             o = coll.get(oid) if coll is not None else None
+            self._verified.discard((cid, oid))
             if o is None:
                 kt.rmkey("O", self._okey(cid, oid))
             else:
                 kt.set("O", self._okey(cid, oid), self._encode_obj(o))
+        for cid in removed_coll_objs:
+            self._verified = {k for k in self._verified if k[0] != cid}
         self.db.submit_transaction(kt)
 
     def read(self, cid, oid, offset=0, length=None):
         data = super().read(cid, oid, offset, length)
-        if offset == 0 and length is None:
+        # Verify the stored record checksum on EVERY read path, ranged
+        # included — but only once per object version: re-decoding the
+        # whole record per 4 KiB ranged read would be O(object) each
+        # time. The verified set is invalidated on every write to the
+        # object (queue_transaction) and repopulated lazily here.
+        key = (cid, oid)
+        if key not in self._verified:
             rec = self.db.get("O", self._okey(cid, oid))
             if rec is not None:
                 _, ok = self._decode_obj(rec)
                 if not ok:
                     raise ChecksumError(f"{cid}/{oid} checksum mismatch")
+            self._verified.add(key)
         return data
 
     def fsck(self) -> list[str]:

@@ -67,7 +67,8 @@ class ECPG(PG):
         self.m = self.ec.get_coding_chunk_count()
         self.sinfo = StripeInfo(
             self.k, int(prof.get("stripe_unit", 4096)))
-        self._subop_waiters: dict[int, tuple[set[int], asyncio.Future]] = {}
+        self._subop_waiters: dict[
+            int, tuple[set[int], asyncio.Future, set[int]]] = {}
         self._subread_waiters: dict[int, asyncio.Future] = {}
 
     # -- shard helpers -----------------------------------------------------
@@ -393,12 +394,13 @@ class ECPG(PG):
         remote = []
         for osd_id, msg in per_osd.items():
             if osd_id == self.osd.whoami:
-                self._apply_sub_write(msg, local=True)
-                committed += 1
+                if self._apply_sub_write(msg, local=True) == 0:
+                    committed += 1
             else:
                 pending.add(osd_id)
                 remote.append((osd_id, msg))
-        self._subop_waiters[tid] = (pending, waiter)
+        failed: set[int] = set()
+        self._subop_waiters[tid] = (pending, waiter, failed)
         sent = set()
         for osd_id, msg in remote:
             try:
@@ -411,8 +413,12 @@ class ECPG(PG):
                 await asyncio.wait_for(waiter, timeout=5.0)
             except asyncio.TimeoutError:
                 log.dout(1, f"pg {self.pgid} ec sub-op {tid} timed out")
-        remaining, _ = self._subop_waiters.pop(tid, (set(), None))
-        committed += len(sent - remaining)
+        remaining, _, failed = self._subop_waiters.pop(
+            tid, (set(), None, set()))
+        # A shard that replied with a non-zero result did NOT durably
+        # apply — it must not count toward the >=k durability check, or
+        # the client could be acked with fewer than k live shards.
+        committed += len((sent - remaining) - failed)
         return committed
 
     def _meta_txn_store(self) -> None:
@@ -420,7 +426,7 @@ class ECPG(PG):
 
     # -- sub-op handling (shard side) --------------------------------------
     def _apply_sub_write(self, m: MOSDECSubOpWrite,
-                         local: bool = False) -> None:
+                         local: bool = False) -> int:
         t = Transaction()
         C = self.sinfo.chunk_size
         if m.remove:
@@ -447,14 +453,16 @@ class ECPG(PG):
             self.osd.store.queue_transaction(t)
         except StoreError as e:
             log.error(f"pg {self.pgid} ec sub-write failed: {e}")
+            return -5                                   # -EIO
+        return 0
 
     def handle_ec_sub_write(self, m: MOSDECSubOpWrite) -> None:
-        self._apply_sub_write(m)
+        result = self._apply_sub_write(m)
 
         async def _ack():
             try:
                 await m.conn.send_message(MOSDECSubOpWriteReply(
-                    tid=m.tid, result=0, pgid=self.cid,
+                    tid=m.tid, result=result, pgid=self.cid,
                     from_osd=self.osd.whoami))
             except Exception:
                 pass
@@ -464,7 +472,9 @@ class ECPG(PG):
         ent = self._subop_waiters.get(m.tid)
         if ent is None:
             return
-        pending, fut = ent
+        pending, fut, failed = ent
+        if m.result != 0:
+            failed.add(m.from_osd)
         pending.discard(m.from_osd)
         if not pending and not fut.done():
             fut.set_result(True)

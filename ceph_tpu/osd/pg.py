@@ -449,20 +449,30 @@ class PG:
         if not mutated:
             await self._reply(m, 0, data_out, extra)
             return
-        result = await self._submit_write(oid, t, deleted)
+        result, applied = await self._submit_write(oid, t, deleted)
         extra["version"] = str(self.pg_log.head)
-        self._reqid_results[reqid] = (result, extra)
+        if applied:
+            # The op is in the pg log: once the PG is active in any
+            # later interval, log-based recovery has made it durable on
+            # the whole acting set, so a RESEND must see success rather
+            # than a re-execution (ref: PrimaryLogPG::already_complete).
+            # A repop-timeout -EAGAIN is therefore recorded as 0 for
+            # dedup while the CURRENT attempt still reports -EAGAIN.
+            self._reqid_results[reqid] = (0 if result == -11 else result,
+                                          extra)
         if len(self._reqid_results) > 2000:      # bounded (log-trim analog)
             for k in list(self._reqid_results)[:1000]:
                 self._reqid_results.pop(k, None)
         await self._reply(m, result, data_out, extra)
 
     async def _submit_write(self, oid: str, t: Transaction,
-                            deleted: bool) -> int:
+                            deleted: bool) -> tuple[int, bool]:
         """The replication pipeline (ref: ReplicatedBackend::
-        submit_transaction + issue_repop)."""
+        submit_transaction + issue_repop). Returns (result, applied):
+        ``applied`` is True iff the op landed in the local store+log
+        (it may still report -EAGAIN when replicas never confirmed)."""
         if len(self.live_acting()) < self.pool.min_size:
-            return -11                                  # -EAGAIN
+            return -11, False                           # -EAGAIN
         self.last_user_version += 1
         version = eversion(self.epoch, self.last_user_version)
         entry = self.pg_log.add(
@@ -486,7 +496,7 @@ class PG:
         except StoreError as e:
             log.error(f"pg {self.pgid} local commit failed: {e}")
             self._repop_waiters.pop(tid, None)
-            return -5
+            return -5, False
         for o in replicas:
             await self.osd.send_osd(o, MOSDRepOp(
                 tid=tid, epoch=self.epoch, pgid=self.cid,
@@ -495,12 +505,17 @@ class PG:
             try:
                 await asyncio.wait_for(waiter, timeout=5.0)
             except asyncio.TimeoutError:
-                # a replica died mid-write: the new interval will
-                # re-peer; the write is durable on the survivors
+                # A replica never committed: the client MUST NOT see
+                # success, or a subsequent primary failure could lose an
+                # acknowledged write (ref: ReplicatedBackend's
+                # all-replica-commit-before-ack contract). -EAGAIN makes
+                # the objecter resend once the map moves and the PG
+                # re-peers.
                 log.dout(1, f"pg {self.pgid} repop {tid} timed out")
+                return -11, True                        # -EAGAIN
             finally:
                 self._repop_waiters.pop(tid, None)
-        return 0
+        return 0, True
 
     def handle_rep_op(self, m: MOSDRepOp) -> None:
         """Replica applies the shipped transaction (ref:

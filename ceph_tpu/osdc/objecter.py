@@ -71,6 +71,12 @@ class Objecter(Dispatcher):
         Returns (result, data, extra_dict)."""
         deadline = asyncio.get_event_loop().time() + timeout
         attempt = 0
+        # One tid for the whole logical op: resends must carry the SAME
+        # reqid so the PG's dedup (pg.py _reqid_results) recognizes a
+        # retry of an already-applied op instead of re-executing it
+        # (ref: Objecter keeps op->tid across resends; osd_reqid_t).
+        self._tid += 1
+        tid = self._tid
         while True:
             if asyncio.get_event_loop().time() > deadline:
                 raise ObjectOperationError(-110, f"op on {oid} timed out")
@@ -86,8 +92,6 @@ class Objecter(Dispatcher):
                 await self._refresh_map(osdmap)
                 continue
             host, port, _hb = osdmap.osd_addrs[primary]
-            self._tid += 1
-            tid = self._tid
             fut = asyncio.get_event_loop().create_future()
             self._waiters[tid] = fut
             try:

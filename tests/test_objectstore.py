@@ -197,3 +197,60 @@ def test_walstore_rmcoll_removes_objects(tmp_path):
     assert st2.list_collections() == []
     assert list(st2.db.get_iterator("O")) == []
     st2.umount()
+
+
+def test_transaction_all_or_nothing(tmp_path):
+    """A txn that fails mid-way must leave live state untouched
+    (ADVICE r2: memory diverged from kv until restart)."""
+    for st in (MemStore(), WALStore(str(tmp_path / "w"))):
+        st.queue_transaction(
+            Transaction().create_collection("1.0").write(
+                "1.0", "a", 0, b"before"))
+        bad = Transaction().write("1.0", "a", 0, b"after")
+        from ceph_tpu.os_.objectstore import OP_RMATTR
+        bad.ops.append((OP_RMATTR, "1.0", "missing", "x"))  # will raise
+        with pytest.raises(StoreError):
+            st.queue_transaction(bad)
+        assert st.read("1.0", "a") == b"before"   # first op NOT applied
+        # later ops in the txn can satisfy earlier requirements
+        ok = Transaction().touch("1.0", "b")
+        ok.omap_setkeys("1.0", "b", {"k": b"v"})
+        st.queue_transaction(ok)
+        assert st.omap_get("1.0", "b") == {"k": b"v"}
+
+
+def test_walstore_ranged_read_checks_crc(tmp_path):
+    """Ranged reads must verify the record checksum too (ADVICE r2)."""
+    path = str(tmp_path / "w")
+    st = WALStore(path)
+    st.queue_transaction(
+        Transaction().create_collection("1.0").write(
+            "1.0", "a", 0, b"payload-payload-payload"))
+    key = WALStore._okey("1.0", "a")
+    rec = bytearray(st.db.get("O", key))
+    rec[10] ^= 0xFF
+    st.db.submit_transaction(
+        st.db.get_transaction().set("O", key, bytes(rec)))
+    st.umount()
+    st2 = WALStore(path)
+    with pytest.raises(ChecksumError):
+        st2.read("1.0", "a", 2, 4)                # ranged, not full
+    st2.umount()
+
+
+def test_rmcoll_recreate_validates_against_simulated_state(tmp_path):
+    """RMCOLL+MKCOLL in one txn leaves the collection EMPTY: a later op
+    on a previously-existing object must fail validation up front (not
+    mid-apply, which would destroy the collection on a failed txn)."""
+    for st in (MemStore(), WALStore(str(tmp_path / "w"))):
+        st.queue_transaction(
+            Transaction().create_collection("1.0").write(
+                "1.0", "a", 0, b"keep me"))
+        bad = Transaction()
+        bad.remove_collection("1.0")
+        bad.create_collection("1.0")
+        bad.omap_clear("1.0", "a")          # 'a' gone after reset
+        with pytest.raises(StoreError):
+            st.queue_transaction(bad)
+        # nothing applied: object survives
+        assert st.read("1.0", "a") == b"keep me"
