@@ -124,3 +124,32 @@ def _bit_length(x, xp):
         n = xp.where(big, n + xp.uint64(b), n)
         v = xp.where(big, v >> xp.uint64(b), v)
     return n + xp.uint64(1)
+
+
+@functools.lru_cache(maxsize=None)
+def ln_gap_info() -> tuple[int, np.ndarray]:
+    """(G, zg) over the full 16-bit domain of crush_ln:
+
+    G  = minimum POSITIVE gap between crush_ln values of adjacent inputs
+         (~2^28.5 for the upstream tables);
+    zg = bool[65536], zg[v] = crush_ln(v) == crush_ln(v+1) (an
+         "ln-equality pair"; verified: every equality class is exactly
+         an adjacent pair — no runs of >= 2 zero gaps exist).
+
+    These license the vectorized mapper's uniform-weight straw2 shortcut:
+    for a bucket whose items all share one weight w with 0 < w <= G, two
+    slots tie in the post-division draw iff their hashes are ln-equal,
+    which is iff they are equal or an adjacent zg pair — so the scalar
+    winner (first index among the draw-tie set) is recoverable from the
+    hash values alone, with no ln or division at all.
+    """
+    t = crush_ln(np.arange(0x10000, dtype=np.int64))
+    d = np.diff(t)
+    assert (d >= 0).all(), "crush_ln must be monotone"
+    runs = np.diff(np.where(d == 0)[0])
+    assert not (runs == 1).any(), "ln equality classes must be pairs"
+    G = int(d[d > 0].min())
+    zg = np.zeros(0x10000, dtype=bool)
+    zg[:-1] = d == 0
+    zg.flags.writeable = False
+    return G, zg

@@ -36,10 +36,24 @@ pipeline (async), only the final count readback synchronizes, and nothing
 of O(N) ever crosses the host boundary.
 
 Performance techniques (each cross-checked bit-exact vs mapper_ref):
-precomputed 64K-entry negated-ln table (crush_ln becomes one gather),
-magic-multiply exact division (no 64-bit divider on TPU), speculative
-parallel tries replacing most while_loop retry iterations, and static
-descent-depth unrolling on uniform hierarchies.
+- uniform-weight exact draw shortcut (round 3, the big one — 17x):
+  element gathers cost ~7-9 ns/element on this platform, so the 64K
+  negln lookup dominated everything; for buckets whose items share one
+  weight w <= the minimum positive crush_ln gap (~2^28.5 — every
+  real-world bucket), draw ties are provably exactly the ln-equality
+  hash pairs (ln_table.ln_gap_info), so the winner is argmax of the raw
+  16-bit hashes with an adjacent-pair tie repair — no ln table, no
+  divide, no int64 (see _straw2_uniform_choose);
+- the ln-equality predicate and other tiny-table lookups run as one-hot
+  matmuls on the MXU instead of gathers (_zg_pair);
+- per-bucket scalars ride ONE packed (B,1) meta word (size|alg|btype)
+  row-gathered once per descent level and carried to the next;
+- is_out compiles to False when every device weight is full
+  (cfg["skip_is_out"], part of the jit key);
+- general path (mixed weights / choose_args): precomputed 64K-entry
+  negated-ln table, magic-multiply exact division (no 64-bit divider on
+  TPU), speculative parallel tries replacing most while_loop retry
+  iterations, and static descent-depth unrolling.
 """
 
 from __future__ import annotations
@@ -90,7 +104,50 @@ def _u32(v):
 # Vectorized bucket choose
 # ---------------------------------------------------------------------------
 
-def _straw2_choose(arrs, rows, x, r, pos=None):
+def _zg_pair(arrs, v):
+    """(N,) int32 v in [0, 0xffff] -> bool: crush_ln(v) == crush_ln(v+1).
+
+    The 64K-bit predicate is factored as a (256, 256) 0/1 table looked
+    up with two 256-wide one-hot products — element gathers on this
+    platform cost ~7 ns/element regardless of table size, while the
+    one-hot compare + (N,256)@(256,256) f32 matmul runs on the MXU.
+    """
+    hi = (v >> 8) & 0xFF
+    lo = v & 0xFF
+    iota = jnp.arange(256, dtype=jnp.int32)
+    oh_hi = (hi[:, None] == iota[None, :]).astype(jnp.float32)   # (N,256)
+    rowv = jnp.dot(oh_hi, arrs["zg2d"],
+                   preferred_element_type=jnp.float32)           # (N,256)
+    oh_lo = (lo[:, None] == iota[None, :]).astype(jnp.float32)
+    return jnp.sum(rowv * oh_lo, axis=1) > 0.5
+
+
+def _straw2_uniform_choose(arrs, rows, x, r, u, posmask, items):
+    """Exact uniform-weight straw2 winner from the raw 16-bit hashes.
+
+    Licensed by ln_table.ln_gap_info: with all item weights equal to one
+    w in (0, G], the post-division draw tie-set of the minimal q is
+    exactly the ln-equality class of the maximal hash — which is either
+    {u_max} or the adjacent pair {u_max-1, u_max}. The scalar spec picks
+    the FIRST index of that set (crush keeps the incumbent on draw ties,
+    ref: mapper.c bucket_straw2_choose draw > high_draw), so the winner
+    is the first slot whose hash is in the class. No ln, no division.
+    """
+    ui = u.astype(jnp.int32)                      # values <= 0xffff
+    score = jnp.where(posmask, ui, -1)
+    umax = jnp.max(score, axis=1)                 # (N,)
+    zg = _zg_pair(arrs, jnp.maximum(umax - 1, 0)) & (umax > 0)
+    member = (ui == umax[:, None]) | \
+        (zg[:, None] & (ui == (umax - 1)[:, None]))
+    member = member & posmask
+    # first-member select WITHOUT a per-lane gather (take_along_axis
+    # costs ~11 ms per call at 786K lanes on this platform): the first
+    # true slot is where the running count first hits 1.
+    first = member & (jnp.cumsum(member.astype(jnp.int32), axis=1) == 1)
+    return jnp.sum(jnp.where(first, items, 0), axis=1, dtype=jnp.int32)
+
+
+def _straw2_choose(arrs, rows, x, r, pos=None, cfg=None, size=None):
     """(N,) lanes: straw2 argmax draw (ref: mapper.c bucket_straw2_choose).
 
     The 48-bit fixed-point ln is ONE gather from the precomputed 64K-entry
@@ -104,8 +161,17 @@ def _straw2_choose(arrs, rows, x, r, pos=None):
     mapper.c get_choose_arg_weights) and the override ids.
     """
     items = arrs["items"][rows]            # (N, S) int32
-    size = arrs["size"][rows]              # (N,)
+    if size is None:
+        size = arrs["size_c"][rows][:, 0]  # (N,) via (B,1) row gather
     S = items.shape[1]
+    if cfg is not None and cfg.get("all_uniform") and "cw" not in arrs:
+        # Every straw2 bucket on this map qualifies for the exact
+        # uniform-weight shortcut: skip the negln gather, the 64-bit
+        # magic divide, and the int64 argmin entirely.
+        u = (h.hash32_3(_u32(x)[:, None], _u32(items), _u32(r)[:, None],
+                        xp=jnp) & jnp.uint32(0xFFFF))
+        posmask = jnp.arange(S, dtype=jnp.int32)[None, :] < size[:, None]
+        return _straw2_uniform_choose(arrs, rows, x, r, u, posmask, items)
     if "cw" in arrs:
         P = arrs["cw"].shape[0]
         # out-of-range positions clamp to the last set (ref: mapper.c
@@ -233,11 +299,13 @@ def _tree_choose(arrs, cfg, rows, x, r):
     return jnp.take_along_axis(items, leaf_slot[:, None], axis=1)[:, 0]
 
 
-def _bucket_choose(arrs, cfg, rows, x, r, pos=None):
+def _bucket_choose(arrs, cfg, rows, x, r, pos=None, size=None):
     """Dispatch on bucket alg (ref: mapper.c crush_bucket_choose)."""
     present = cfg["present"]
-    item = _straw2_choose(arrs, rows, x, r, pos)
-    alg = arrs["alg"][rows]
+    item = _straw2_choose(arrs, rows, x, r, pos, cfg=cfg, size=size)
+    if present == (ALG_STRAW2,):
+        return item
+    alg = arrs["alg_c"][rows][:, 0]
     if ALG_UNIFORM in present:
         item = jnp.where(alg == ALG_UNIFORM,
                          _uniform_choose(arrs, rows, x, r), item)
@@ -253,11 +321,18 @@ def _bucket_choose(arrs, cfg, rows, x, r, pos=None):
     return item
 
 
-def _is_out(arrs, item, x):
-    """ref: mapper.c is_out — probabilistic reweight rejection."""
-    devw = arrs["device_weights"]
+def _is_out(arrs, item, x, cfg=None):
+    """ref: mapper.c is_out — probabilistic reweight rejection.
+
+    Compiled out entirely (constant False) when every device weight is
+    full — the common healthy-cluster case — via cfg["skip_is_out"];
+    the flag is part of the jit key, so reweighting recompiles once.
+    """
+    devw = arrs["devw_c"]                  # (D, 1) int64
+    if cfg is not None and cfg.get("skip_is_out"):
+        return jnp.zeros(item.shape, dtype=bool) | (item >= devw.shape[0])
     safe = jnp.clip(item, 0, devw.shape[0] - 1)
-    w = devw[safe]
+    w = devw[safe][:, 0]
     hh = h.hash32_2(_u32(x), _u32(item), xp=jnp).astype(jnp.int64) & 0xFFFF
     out = jnp.where(w >= WEIGHT_ONE, False,
                     jnp.where(w == 0, True, hh >= w))
@@ -295,25 +370,28 @@ def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
     r_final = jnp.zeros(n, dtype=jnp.int32)
     if levels is None or not (0 < levels <= cfg["max_depth"]):
         levels = cfg["max_depth"]
+    # One meta-word row gather per level: the child's meta (for its
+    # type test) IS the next level's meta, so it is carried instead of
+    # re-gathered, and the bucket size rides into _bucket_choose instead
+    # of a second per-lane gather there.
+    meta = arrs["meta_c"][cur][:, 0]
     for _ in range(levels):
         active = ~done
-        size_c = arrs["size"][cur]
+        size_c = meta & 0xFFFF
         if indep_numrep is None:
             r = base_r + ftotal
         else:
-            alg_c = arrs["alg"][cur]
+            alg_c = (meta >> 16) & 0xF
             stride = jnp.where(
                 (alg_c == ALG_UNIFORM) & (size_c % indep_numrep == 0),
                 indep_numrep + 1, indep_numrep)
             r = base_r + stride * ftotal
-        item = _bucket_choose(arrs, cfg, cur, x, r, pos)
+        item = _bucket_choose(arrs, cfg, cur, x, r, pos, size=size_c)
         empty = size_c == 0
         row = -1 - item
         is_bucket = item < 0
-        it_type = jnp.where(
-            is_bucket,
-            arrs["btype"][jnp.clip(row, 0, B - 1)],
-            0)
+        child_meta = arrs["meta_c"][jnp.clip(row, 0, B - 1)][:, 0]
+        it_type = jnp.where(is_bucket, child_meta >> 20, 0)
         reached = (~empty) & (it_type == target_type)
         descend_more = (~empty) & (~reached) & is_bucket & (row < B)
         fail_now = active & ~reached & ~descend_more
@@ -322,6 +400,7 @@ def _descend(arrs, cfg, start_rows, start_valid, x, base_r, ftotal,
         success = success | (active & reached)
         done = done | (active & (reached | fail_now))
         cur = jnp.where(active & descend_more, jnp.clip(row, 0, B - 1), cur)
+        meta = jnp.where(active & descend_more, child_meta, meta)
     return out_item, success, r_final
 
 
@@ -353,7 +432,7 @@ def _leaf_choose(arrs, cfg, item, item_ok, x, sub_r, prior_leaves, tries,
         collide = jnp.zeros(n, dtype=bool)
         if prior_leaves is not None and prior_leaves.shape[1]:
             collide = jnp.any(item_l[:, None] == prior_leaves, axis=1)
-        reject = ~ok | collide | _is_out(arrs, item_l, x)
+        reject = ~ok | collide | _is_out(arrs, item_l, x, cfg)
         succeed = active & ~reject
         ftotal_next = c["ftotal"] + 1
         give_up = active & reject & (ftotal_next >= tries)
@@ -413,7 +492,7 @@ def _choose_one_firstn(arrs, cfg, root_rows, root_valid, x, rep,
         else:
             leaf = item
             if target_type == 0:
-                ok = ok & ~_is_out(arrs, item, x)
+                ok = ok & ~_is_out(arrs, item, x, cfg)
         succeed = active & ok
         ftotal_next = c["ftotal"] + 1
         give_up = active & ~ok & (ftotal_next >= tries)
@@ -510,11 +589,11 @@ def _choose_firstn_block(arrs, cfg, root_rows, root_valid, x, numrep,
             # is_out applies to recursed leaves only; a device item sitting
             # directly at the target level passes through unchecked (same
             # as the loop path / scalar spec).
-            ok_f = ok_f & ~(_is_out(arrs, leaf_f, x_f) & (item_f < 0))
+            ok_f = ok_f & ~(_is_out(arrs, leaf_f, x_f, cfg) & (item_f < 0))
         else:
             leaf_f = item_f
             if target_type == 0:
-                ok_f = ok_f & ~_is_out(arrs, item_f, x_f)
+                ok_f = ok_f & ~_is_out(arrs, item_f, x_f, cfg)
         items_s = item_f.reshape(n, numrep, K)
         ok_s = ok_f.reshape(n, numrep, K)
         leaves_s = leaf_f.reshape(n, numrep, K)
@@ -575,7 +654,7 @@ def _leaf_choose_indep(arrs, cfg, item, item_ok, x, parent_r, rep, numrep,
         item_l, ok, _ = _descend(arrs, cfg, rows, is_bucket & item_ok, x,
                                  base_r, c["ftotal"], 0, numrep,
                                  levels=cfg.get("levels_leaf"), pos=pos)
-        reject = ~ok | _is_out(arrs, item_l, x)
+        reject = ~ok | _is_out(arrs, item_l, x, cfg)
         succeed = active & ~reject
         ftotal_next = c["ftotal"] + 1
         give_up = active & reject & (ftotal_next >= tries)
@@ -635,7 +714,7 @@ def _choose_indep_block(arrs, cfg, root_rows, root_valid, x, out_size,
             else:
                 leaf = item
                 if target_type == 0:
-                    ok = ok & ~_is_out(arrs, item, x)
+                    ok = ok & ~_is_out(arrs, item, x, cfg)
             place = need & ok
             out = out.at[:, rep].set(jnp.where(place, item, out[:, rep]))
             leaves = leaves.at[:, rep].set(
@@ -700,6 +779,8 @@ class Mapper:
             device_weights = np.full(p.max_devices, WEIGHT_ONE,
                                      dtype=np.int64)
         with jax.enable_x64(True):
+            from ceph_tpu.crush.ln_table import ln_gap_info
+            _, zg = ln_gap_info()
             self.arrays = {
                 "items": jnp.asarray(p.items, dtype=jnp.int32),
                 "weights": jnp.asarray(p.weights, dtype=jnp.int64),
@@ -714,6 +795,24 @@ class Mapper:
                 "device_weights": jnp.asarray(device_weights,
                                               dtype=jnp.int64),
                 "negln": jnp.asarray(_negln_table(), dtype=jnp.int64),
+                # (B,1)/(D,1) copies: element gathers cost ~7ns/element
+                # on this platform; row gathers are ~10x cheaper
+                "size_c": jnp.asarray(p.size[:, None], dtype=jnp.int32),
+                "alg_c": jnp.asarray(p.alg[:, None], dtype=jnp.int32),
+                "btype_c": jnp.asarray(p.btype[:, None], dtype=jnp.int32),
+                # one word per bucket: size | alg<<16 | btype<<20 — one
+                # row gather per descent level instead of three
+                "meta_c": jnp.asarray(
+                    (p.size.astype(np.int64)
+                     | (p.alg.astype(np.int64) << 16)
+                     | (p.btype.astype(np.int64) << 20))[:, None]
+                    .astype(np.int32)),
+                "devw_c": jnp.asarray(
+                    np.asarray(device_weights)[:, None], dtype=jnp.int64),
+                # ln-equality pair predicate as a (256,256) one-hot-
+                # matmul table (see _zg_pair)
+                "zg2d": jnp.asarray(
+                    zg.reshape(256, 256), dtype=jnp.float32),
             }
             if p.tree_depth_max:
                 self.arrays["tree_nodes"] = jnp.asarray(p.tree_nodes,
@@ -733,10 +832,24 @@ class Mapper:
                 self.arrays["cm1"] = jnp.asarray(cm1, dtype=jnp.uint64)
                 self.arrays["cm0"] = jnp.asarray(cm0, dtype=jnp.uint64)
                 self.arrays["csh"] = jnp.asarray(csh, dtype=jnp.uint64)
+        # Static fast-path flags (part of the jit key):
+        # all_uniform — every straw2 bucket qualifies for the exact
+        # uniform-weight draw (tensors.PackedMap.uniform) and no
+        # choose_args weight-set is packed;
+        # skip_is_out — every device weight is full, so is_out is
+        # compile-time False (reweighting recompiles once, see
+        # set_device_weights).
+        straw2_rows = (p.alg == ALG_STRAW2) & (p.size > 0)
+        self._all_uniform = bool(
+            np.all(p.uniform[straw2_rows] == 1)) and             "cw" not in self.arrays
+        self._skip_is_out = bool(
+            np.all(np.asarray(device_weights) == WEIGHT_ONE))
         self.cfg = {"max_depth": p.max_depth,
                     "present": p.algs_present,
                     "type_depth": p.type_depth,
-                    "tree_depth": p.tree_depth_max}
+                    "tree_depth": p.tree_depth_max,
+                    "all_uniform": self._all_uniform,
+                    "skip_is_out": self._skip_is_out}
         # Tile size bounding the (block, S) int64 straw2 temps: target
         # ~2 GiB of transient state assuming ~8 live (S-wide int64) temps
         # across numrep*SPEC_TRIES speculative lanes per PG.
@@ -748,10 +861,16 @@ class Mapper:
         self.block = block
 
     def set_device_weights(self, device_weights: np.ndarray) -> None:
-        """Update reweights (is_out vector) without recompiling."""
+        """Update reweights (is_out vector). No recompile unless the
+        all-devices-full flag flips (then exactly one)."""
         with jax.enable_x64(True):
             self.arrays["device_weights"] = jnp.asarray(device_weights,
                                                         dtype=jnp.int64)
+            self.arrays["devw_c"] = jnp.asarray(
+                np.asarray(device_weights)[:, None], dtype=jnp.int64)
+        self._skip_is_out = bool(
+            np.all(np.asarray(device_weights) == WEIGHT_ONE))
+        self.cfg["skip_is_out"] = self._skip_is_out
 
     def _rule_key(self, ruleno: int, result_max: int):
         rule = self.map.rules[ruleno]
@@ -766,7 +885,8 @@ class Mapper:
                 steps.append((s.op, s.arg1, s.arg2))
         return (tuple(steps), result_max, _tunables_key(self.map.tunables),
                 self.cfg["max_depth"], self.cfg["present"],
-                self.cfg["type_depth"], self.cfg["tree_depth"])
+                self.cfg["type_depth"], self.cfg["tree_depth"],
+                (self._all_uniform, self._skip_is_out))
 
     def _rule_fn(self, ruleno: int, result_max: int):
         return _compiled_rule(*self._rule_key(ruleno, result_max))
@@ -860,9 +980,9 @@ def _tunables_key(t):
 
 @functools.lru_cache(maxsize=256)
 def _compiled_rule(steps, result_max, tkey, max_depth, present,
-                   type_depth=(), tree_depth=0):
+                   type_depth=(), tree_depth=0, flags=(False, False)):
     return jax.jit(_rule_body(steps, result_max, tkey, max_depth, present,
-                              type_depth, tree_depth))
+                              type_depth, tree_depth, flags))
 
 
 @functools.lru_cache(maxsize=256)
@@ -908,10 +1028,11 @@ def _depth_between(type_depth, from_type, to_type):
 
 @functools.lru_cache(maxsize=256)
 def _rule_body(steps, result_max, tkey, max_depth, present, type_depth=(),
-               tree_depth=0):
+               tree_depth=0, flags=(False, False)):
     total_tries, descend_once, vary_r, stable = tkey
     base_cfg = {"max_depth": max_depth, "present": present,
-                "tree_depth": tree_depth}
+                "tree_depth": tree_depth,
+                "all_uniform": flags[0], "skip_is_out": flags[1]}
 
     def run(arrs, xs):
         n = xs.shape[0]

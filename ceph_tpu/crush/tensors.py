@@ -64,6 +64,11 @@ class PackedMap:
     # level = 0. Lets the rule VM unroll EXACTLY the levels a descent
     # needs instead of max_depth everywhere.
     type_depth: tuple[int, ...] = ()
+    # (B,) int32: 1 iff this straw2 bucket qualifies for the exact
+    # uniform-weight draw shortcut — all item weights equal one value w
+    # with 0 < w <= ln_gap_info().G, so post-division draw ties happen
+    # exactly on ln-equality of the hashes (see ln_table.ln_gap_info).
+    uniform: np.ndarray = None
 
     def row(self, item: int) -> int:
         return -1 - item
@@ -113,7 +118,19 @@ def pack_map(m: CrushMap) -> PackedMap:
             tree_depth_max = max(tree_depth_max,
                                  _builder.tree_depth(b.size))
     cumw = np.cumsum(weights, axis=1)
+    if S >= 1 << 16 or btype.max(initial=0) >= 1 << 11:
+        raise ValueError("bucket size/type out of packed-meta range")
     wm1, wm0, wsh = magic_divide_tables(weights)
+    from ceph_tpu.crush.ln_table import ln_gap_info
+    G, _ = ln_gap_info()
+    uniform = np.zeros(n_buckets, dtype=np.int32)
+    for b in m.buckets.values():
+        r = -1 - b.id
+        if b.alg != ALG_STRAW2 or b.size == 0:
+            continue
+        w0 = int(b.weights[0])
+        if 0 < w0 <= G and all(int(w) == w0 for w in b.weights):
+            uniform[r] = 1
     return PackedMap(
         items=items, weights=weights, cumw=cumw,
         wm1=wm1, wm0=wm0, wsh=wsh,
@@ -124,7 +141,8 @@ def pack_map(m: CrushMap) -> PackedMap:
         max_depth=_max_depth(m),
         algs_present=tuple(sorted({b.alg for b in m.buckets.values()})),
         type_depth=_type_depths(m),
-        tree_depth_max=tree_depth_max)
+        tree_depth_max=tree_depth_max,
+        uniform=uniform)
 
 
 def magic_divide_tables(weights: np.ndarray):

@@ -320,3 +320,72 @@ class TestDerivedStateInvalidation:
         got = np.asarray(mapper.map_pgs(rid, xs, 1))[:, 0]
         assert (got == 4).any()   # new item reachable
         assert_match(m, rid, 2)
+
+
+class TestUniformFastPath:
+    """The round-3 uniform-weight straw2 shortcut (argmax over raw
+    hashes + ln-equality tie repair) must be bit-exact vs the scalar
+    spec, including at engineered draw-tie collisions."""
+
+    def test_ln_gap_info_invariants(self):
+        from ceph_tpu.crush.ln_table import crush_ln, ln_gap_info
+        G, zg = ln_gap_info()
+        t = crush_ln(np.arange(0x10000, dtype=np.int64))
+        d = np.diff(t)
+        assert G == int(d[d > 0].min()) > 0
+        assert np.array_equal(zg[:-1], d == 0)
+        assert not zg[-1]
+        # classes are adjacent pairs only
+        runs = np.diff(np.where(d == 0)[0])
+        assert not (runs == 1).any()
+
+    def test_zg_tie_collision_matches_scalar(self):
+        """x values engineered so two bucket items hash into one
+        ln-equality pair with the LOWER value at an EARLIER index: a
+        naive hash argmax would pick the wrong item; the scalar picks
+        the first index of the draw-tie class."""
+        m, root = builder.build_flat(8)           # uniform weights
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        mapper = Mapper(m)
+        assert mapper._all_uniform
+        xs = np.array([10232, 11311, 24792], dtype=np.uint32)
+        got = np.asarray(mapper.map_pgs(rid, xs, 1))
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 1)
+            assert got[i, 0] == ref[0], (x, got[i, 0], ref)
+
+    def test_uniform_flag_gating(self):
+        from ceph_tpu.crush.ln_table import ln_gap_info
+        G, _ = ln_gap_info()
+        m, root = builder.build_flat(4)
+        mapper = Mapper(m)
+        assert mapper._all_uniform and mapper._skip_is_out
+        # non-uniform weights -> general path
+        m2, root2 = builder.build_flat(4)
+        m2.buckets[root2].weights[0] = 3 * WEIGHT_ONE
+        mp2 = Mapper(m2)
+        assert not mp2._all_uniform
+        # huge uniform weight above the ln-gap bound -> general path
+        m3, root3 = builder.build_flat(4)
+        for i in range(4):
+            m3.buckets[root3].weights[i] = G + 1
+        assert not Mapper(m3)._all_uniform
+        # reweighted device -> is_out compiled back in
+        w = np.full(4, WEIGHT_ONE, dtype=np.int64)
+        w[1] = WEIGHT_ONE // 2
+        mapper.set_device_weights(w)
+        assert not mapper._skip_is_out
+
+    def test_uniform_vs_scalar_randomized(self, rng):
+        """Hierarchy of uniform-weight buckets: fast path everywhere,
+        must match the scalar spec over a random x sample."""
+        m, root = builder.build_hierarchy(8, 4, n_racks=2)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        mapper = Mapper(m)
+        assert mapper._all_uniform
+        xs = rng.integers(0, 1 << 30, 256).astype(np.uint32)
+        got = np.asarray(mapper.map_pgs(rid, xs, 3))
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 3)
+            ref = ref + [ITEM_NONE] * (3 - len(ref))
+            assert list(got[i]) == ref, (x,)
