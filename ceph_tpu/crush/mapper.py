@@ -59,6 +59,7 @@ Performance techniques (each cross-checked bit-exact vs mapper_ref):
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -850,6 +851,23 @@ class Mapper:
                     "tree_depth": p.tree_depth_max,
                     "all_uniform": self._all_uniform,
                     "skip_is_out": self._skip_is_out}
+        # Fused Pallas kernel (round 4): the whole rule in one VMEM
+        # program for eligible (straw2/uniform/firstn) maps — see
+        # pallas_mapper. "auto" = on when the default backend is TPU;
+        # "interpret" runs the kernel through the Pallas interpreter on
+        # CPU (tests); "0" disables.
+        mode = os.environ.get("CEPH_TPU_CRUSH_KERNEL", "auto")
+        self._kernel_mode = None
+        if not self._scalar_reason:
+            from ceph_tpu.crush import pallas_mapper as _pm
+            if mode == "interpret":
+                self._kernel_mode = "interpret"
+            elif mode in ("1", "auto") and _pm.HAVE_PALLAS and \
+                    jax.default_backend() == "tpu":
+                self._kernel_mode = "tpu"
+        self._kernel_plans: dict[int, object] = {}
+        self._kernel_bodies: dict[tuple, object] = {}
+        self._kernel_fns: dict[tuple, object] = {}
         # Tile size bounding the (block, S) int64 straw2 temps: target
         # ~2 GiB of transient state assuming ~8 live (S-wide int64) temps
         # across numrep*SPEC_TRIES speculative lanes per PG.
@@ -871,6 +889,106 @@ class Mapper:
         self._skip_is_out = bool(
             np.all(np.asarray(device_weights) == WEIGHT_ONE))
         self.cfg["skip_is_out"] = self._skip_is_out
+        # kernel plans embed the non-full-device list: rebuild lazily
+        self._kernel_plans.clear()
+        self._kernel_bodies.clear()
+        self._kernel_fns.clear()
+
+    # -- fused Pallas kernel path (round 4) --------------------------------
+    def _kernel_plan(self, ruleno: int):
+        if ruleno not in self._kernel_plans:
+            from ceph_tpu.crush import pallas_mapper as _pm
+            self._kernel_plans[ruleno] = _pm.build_plan(
+                self.map, self.packed, ruleno,
+                np.asarray(self.arrays["device_weights"]),
+                self.choose_args_key)
+        return self._kernel_plans[ruleno]
+
+    def _kernel_body(self, ruleno: int, result_max: int):
+        """fn_body(arrs, xs) -> (N, result_max), backed by the fused
+        kernel with a masked XLA fallback for flagged lanes, or None
+        when this rule is ineligible (the XLA path stands)."""
+        if self._kernel_mode is None:
+            return None
+        key = (ruleno, result_max)
+        if key in self._kernel_bodies:
+            return self._kernel_bodies[key]
+        from ceph_tpu.crush import pallas_mapper as _pm
+        plan = self._kernel_plan(ruleno)
+        body = None
+        if plan is not None:
+            numrep = plan.numrep_arg if plan.numrep_arg > 0 \
+                else plan.numrep_arg + result_max
+            numrep = min(numrep, result_max)
+            if numrep >= 1:
+                body = self._make_kernel_body(plan, ruleno, result_max,
+                                              numrep)
+        self._kernel_bodies[key] = body
+        return body
+
+    def _make_kernel_body(self, plan, ruleno: int, result_max: int,
+                          numrep: int):
+        from ceph_tpu.crush import pallas_mapper as _pm
+        interpret = self._kernel_mode == "interpret"
+        rule = self.map.rules[ruleno]
+        root = next(s.arg1 for s in rule.steps if s.op == OP_TAKE)
+        root_type = self.map.buckets[root].type
+        t = self.map.tunables
+        tries = t.choose_total_tries
+        recurse_tries = 1 if t.chooseleaf_descend_once else tries
+        cfg = dict(self.cfg)
+        cfg["levels_main"] = _depth_between(
+            self.cfg["type_depth"], root_type, plan.target_type)
+        cfg["levels_leaf"] = (_depth_between(
+            self.cfg["type_depth"], plan.target_type, 0)
+            if plan.recurse else None)
+        root_row = -1 - root
+        lanes = _pm.LANES
+
+        def fn_body(arrs, xs):
+            n = xs.shape[0]
+            pad = -n % lanes
+            xs_k = jnp.pad(xs, (0, pad)) if pad else xs
+            leaves, bad = _pm._run_kernel(
+                plan, xs_k.astype(jnp.int32), numrep,
+                interpret=interpret)
+            leaves, bad = leaves[:n], bad[:n]
+
+            # masked XLA fallback for flagged lanes (candidate-table
+            # exhaustion, P ~ 1e-8/lane): the loop path recomputes the
+            # whole lane bit-exactly. Under lax.cond it costs ONE
+            # scalar reduction + branch when no lane is flagged — the
+            # descents themselves (which are as expensive as the whole
+            # XLA path) never execute in the common case.
+            def _run_fallback(op):
+                arrs_, bad_, xs_, leaves_ = op
+                rows = jnp.full(n, root_row, dtype=jnp.int32)
+                fb = jnp.full((n, numrep), ITEM_NONE, dtype=jnp.int32)
+                fb_lv = jnp.full((n, numrep), ITEM_NONE,
+                                 dtype=jnp.int32)
+                for rep in range(numrep):
+                    item, leaf, ok = _choose_one_firstn(
+                        arrs_, cfg, rows, bad_, xs_, rep,
+                        fb[:, :rep], fb_lv[:, :rep], plan.target_type,
+                        plan.recurse, tries, recurse_tries,
+                        plan.vary_r)
+                    fb = fb.at[:, rep].set(
+                        jnp.where(ok, item, ITEM_NONE))
+                    fb_lv = fb_lv.at[:, rep].set(
+                        jnp.where(ok, leaf, ITEM_NONE))
+                chosen = fb_lv if plan.recurse else fb
+                return jnp.where(bad_[:, None], _compact(chosen),
+                                 leaves_)
+
+            w = jax.lax.cond(jnp.any(bad), _run_fallback,
+                             lambda op: op[3], (arrs, bad, xs, leaves))
+            if w.shape[1] < result_max:
+                padc = jnp.full((n, result_max - w.shape[1]), ITEM_NONE,
+                                dtype=jnp.int32)
+                w = jnp.concatenate([w, padc], axis=1)
+            return w[:, :result_max]
+
+        return fn_body
 
     def _rule_key(self, ruleno: int, result_max: int):
         rule = self.map.rules[ruleno]
@@ -910,23 +1028,49 @@ class Mapper:
             out[i, :len(got[:result_max])] = got[:result_max]
         return out
 
+    def effective_block(self, ruleno: int, result_max: int) -> int:
+        """The chunk width sweep/map_pgs will actually use for this
+        rule (kernel-path rules take wider blocks) — benches must
+        quantize their two-size slope on this, not on self.block."""
+        if self._scalar_reason:
+            return self.block
+        return self._block_for(
+            self._kernel_body(ruleno, result_max) is not None)
+
+    def _block_for(self, kernel: bool) -> int:
+        """Chunk width. The fused kernel's working set is VMEM-resident
+        per LANES-wide grid cell (no (N, S) straw2 temps), so it takes
+        much wider blocks — fewer dispatches, which matters on this
+        platform's remote-TPU tunnel where each dispatch pays RPC
+        latency."""
+        return max(self.block, 1 << 21) if kernel else self.block
+
     def map_pgs(self, ruleno: int, xs, result_max: int) -> jax.Array:
         """Vectorized crush_do_rule over xs -> (N, result_max) device ids
-        (ITEM_NONE fills failures/indep holes). Tiled into self.block-lane
+        (ITEM_NONE fills failures/indep holes). Tiled into block-lane
         chunks so straw2 temps stay bounded at any N."""
         if self._scalar_reason:
             return self._scalar_map(ruleno, xs, result_max)
-        fn = self._rule_fn(ruleno, result_max)
+        kb = self._kernel_body(ruleno, result_max)
+        if kb is not None:
+            key = (ruleno, result_max)
+            fn = self._kernel_fns.get(key)
+            if fn is None:
+                fn = jax.jit(kb)
+                self._kernel_fns[key] = fn
+        else:
+            fn = self._rule_fn(ruleno, result_max)
+        block = self._block_for(kb is not None)
         with jax.enable_x64(True):
             xs = jnp.asarray(xs, dtype=jnp.uint32)
             n = xs.shape[0]
-            if n <= self.block:
+            if n <= block:
                 return fn(self.arrays, xs)
             pieces = []
-            for start in range(0, n, self.block):
-                piece = xs[start:start + self.block]
-                if piece.shape[0] < self.block:  # pad the tail block so the
-                    pad = self.block - piece.shape[0]  # jit cache stays at
+            for start in range(0, n, block):
+                piece = xs[start:start + block]
+                if piece.shape[0] < block:       # pad the tail block so the
+                    pad = block - piece.shape[0]       # jit cache stays at
                     piece = jnp.pad(piece, (0, pad))   # one entry per shape
                     pieces.append(fn(self.arrays, piece)[:-pad])
                 else:
@@ -956,10 +1100,11 @@ class Mapper:
             bad = int((live.sum(axis=1) < result_max).sum()) \
                 if self.rule_is_firstn(ruleno) else 0
             return np.asarray(counts, dtype=np.int64), np.int64(bad)
-        fn_body = _rule_body(*self._rule_key(ruleno, result_max))
+        kb = self._kernel_body(ruleno, result_max)
+        fn_body = kb or _rule_body(*self._rule_key(ruleno, result_max))
         firstn = self.rule_is_firstn(ruleno)
         nd = device_counts_size or self.packed.max_devices
-        block = self.block
+        block = self._block_for(kb is not None)
         nblocks = -(-n // block)
 
         step_fn = _compiled_sweep(fn_body, firstn, nd, block, result_max)

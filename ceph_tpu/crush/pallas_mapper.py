@@ -1,0 +1,553 @@
+"""Fused Pallas TPU kernel for the CRUSH hot path.
+
+Round 3 left CRUSH at 1.3M mappings/s single-chip: the XLA pipeline
+pays HBM round-trips between every op of the hash->draw->argmax chain
+and re-gathers bucket rows at every descent level. This kernel fuses
+the ENTIRE rule execution — rjenkins hashing, the uniform-weight exact
+straw2 draw with its ln-equality tie repair, bucket descent, chooseleaf
+recursion, reweight rejection, and replica-slot resolution — into one
+VMEM-resident Pallas program over PG-id lanes (ref: the role of
+src/crush/mapper.c crush_do_rule + bucket_straw2_choose; SURVEY.md §3.2
+hot loop, §7 step 4).
+
+The enabling observation (new in round 4): with chooseleaf_stable=1 and
+no choose_args, the descent for replica slot ``rep`` at retry ``ftotal``
+depends ONLY on r = rep + ftotal (the `pos` argument matters only to
+choose_args weight-sets, which gate the kernel off). So instead of the
+XLA path's numrep x SPEC_TRIES speculative descents (which recompute
+r=1,2 twice), the kernel computes ONE descent per candidate r in
+[0, numrep + SPEC_EXTRA) and resolves all slots by scanning that shared
+candidate table elementwise:
+
+    slot s takes the first candidate r >= s that succeeded and does not
+    collide with an earlier slot's item/leaf — exactly the scalar
+    loop's sequence, because a candidate consumed by slot s' < s
+    re-collides on its own item for slot s and is skipped.
+
+Lanes where any slot exhausts all candidates (P ~ (collision rate)^
+(SPEC_EXTRA+1) ~ 1e-8 on healthy maps) are flagged and recomputed
+bit-exactly by the caller's masked XLA fallback — the while_loop costs
+nothing when no lane is flagged.
+
+Per-descent-level bucket row data (item ids for hashing, child row
+indices, row size) is fetched with one-hot f32 MXU matmuls instead of
+gathers (measured round 3: element gathers cost ~7-9ns each on this
+platform; a (65, P)@(P, N) f32 matmul is ~0.1ns/lane). The ln-equality
+tie predicate zg (ln_table.ln_gap_info) runs as an f32 MXU matmul over
+its (256, 256) factorization. rjenkins runs in int32 with logical
+shifts (Mosaic has no uint32 printf-exact guarantees; int32 two's-
+complement add/sub/xor/shl wrap identically to C uint32, and
+shift_right_logical supplies the unsigned right shift).
+
+Eligibility (build_plan returns None otherwise; the caller keeps the
+XLA path):
+- modern tunables (chooseleaf_stable=1, no legacy local retries),
+- rule shape TAKE root / CHOOSE[LEAF]_FIRSTN / EMIT,
+- every bucket reachable from the root is straw2, non-empty, and
+  uniform-weight (PackedMap.uniform — every real-world bucket),
+- uniform hierarchy depth (all root->target->device paths equal),
+- no choose_args weight-set selected,
+- at most MAX_REWEIGHT non-full devices (is_out then runs as a
+  compare-against-list; beyond that the XLA path's full devw table is
+  the right tool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except ImportError:                                   # pragma: no cover
+    HAVE_PALLAS = False
+
+from ceph_tpu.crush.types import (
+    ALG_STRAW2, ITEM_NONE,
+    OP_CHOOSELEAF_FIRSTN, OP_CHOOSE_FIRSTN, OP_EMIT, OP_NOOP, OP_TAKE,
+    CrushMap, WEIGHT_ONE,
+)
+
+CRUSH_HASH_SEED = 1315423911
+
+# perf triage only (results become WRONG): comma list of kernel stages
+# to stub out, e.g. "nozg,nofetch,nohash" — used to attribute kernel
+# time between the zg tie matmul, the one-hot table fetch, and the
+# rjenkins hashing on real hardware. Never set in production.
+import os as _os
+_ABLATE = set(filter(None, _os.environ.get(
+    "CEPH_TPU_KERNEL_ABLATE", "").split(",")))
+SPEC_EXTRA = 2      # candidates beyond numrep; slot s scans
+                    # numrep - s + SPEC_EXTRA candidates before the lane
+                    # falls back (P(fallback) ~ collision^(SPEC_EXTRA+1))
+MAX_REWEIGHT = 128  # largest non-full-device list the kernel carries
+LANES = int(_os.environ.get("CEPH_TPU_KERNEL_LANES", "1024"))
+                    # PG lanes per grid cell (VMEM: ~4 MiB peak at the
+                    # canonical map's 640-row host level)
+
+
+# ---------------------------------------------------------------------------
+# Plan: map -> per-level stratified tables
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True, eq=False)   # eq=False: identity
+class KernelPlan:                               # hash -> usable as a
+    """Host-built per-descent-level tables + static rule facts.
+
+    The plan is a static jit argument compared BY IDENTITY — the Mapper
+    builds it once per map and reuses the object, so each map compiles
+    once.
+
+    levels[l] is a (2*(2*S_l + 1), P_l) f32 table, transposed for the
+    (rows, P) @ (P, N) MXU fetch: logical rows [0,S) item ids, [S,2S)
+    next-level row index (device id at the last level), row 2S the
+    bucket size — each logical value v stored as TWO byte planes
+    lo=(v+32768)&0xFF (rows [0,R)) and hi=(v+32768)>>8 (rows [R,2R)),
+    both in [0,256) and hence EXACT in one bf16 MXU pass (DEFAULT
+    precision; HIGHEST's 6 passes made this fetch the kernel's
+    dominant cost — measured 6x on the canonical map's 640-host
+    level). build_plan declines maps with |value| >= 32768.
+    """
+
+    levels: tuple          # tuple of np.ndarray (f32)
+    sizes: tuple           # (S_l, P_l) pairs, static
+    l_main: int            # levels from root to the target type
+    l_leaf: int            # levels from target type to devices
+    numrep_arg: int        # rule's arg1 (0 = fill result_max)
+    recurse: bool          # chooseleaf?
+    vary_r: int
+    tries: int
+    target_type: int
+    rw_ids: np.ndarray     # (K,) int32 non-full device ids (maybe empty)
+    rw_w: np.ndarray       # (K,) int32 their 16.16 reweights
+    zg2dT: np.ndarray      # (256, 256) f32 {0,1}, [lo, hi] ln-equality
+
+
+def build_plan(m: CrushMap, packed, ruleno: int,
+               device_weights: np.ndarray | None = None,
+               choose_args_key=None) -> KernelPlan | None:
+    """Stratify the map for one rule, or None if ineligible."""
+    t = m.tunables
+    if t.chooseleaf_stable != 1 or t.choose_local_tries or \
+            t.choose_local_fallback_tries:
+        return None
+    if choose_args_key is not None and choose_args_key in m.choose_args:
+        return None
+    rule = m.rules.get(ruleno) if isinstance(m.rules, dict) \
+        else (m.rules[ruleno] if ruleno < len(m.rules) else None)
+    if rule is None:
+        return None
+    steps = [s for s in rule.steps if s.op != OP_NOOP]
+    if len(steps) != 3 or steps[0].op != OP_TAKE or \
+            steps[2].op != OP_EMIT:
+        return None
+    choose = steps[1]
+    if choose.op not in (OP_CHOOSELEAF_FIRSTN, OP_CHOOSE_FIRSTN):
+        return None
+    recurse = choose.op == OP_CHOOSELEAF_FIRSTN
+    target_type = choose.arg2
+    if recurse and target_type == 0:
+        return None
+    root = steps[0].arg1
+    if root >= 0 or root not in m.buckets:
+        return None
+    # BFS strata: level l = all buckets at depth l from the root; the
+    # kernel requires every level to be "pure" (all buckets, or all
+    # devices at the end) and the target type to sit at one depth.
+    strata: list[list[int]] = [[root]]
+    l_main = None
+    while True:
+        cur = strata[-1]
+        for bid in cur:
+            b = m.buckets[bid]
+            if b.alg != ALG_STRAW2 or b.size == 0:
+                return None
+            if packed.uniform[-1 - bid] != 1:
+                return None
+        types = {m.buckets[bid].type for bid in cur}
+        if len(strata) - 1 > 0 or True:
+            if types == {target_type}:
+                if l_main is not None:
+                    return None
+                l_main = len(strata) - 1
+            elif target_type in types:
+                return None                     # mixed target level
+        children: list[int] = []
+        seen = set()
+        kinds = set()
+        for bid in cur:
+            for it in m.buckets[bid].items:
+                kinds.add(it >= 0)
+                if it < 0 and it not in seen:
+                    if it not in m.buckets:
+                        return None
+                    seen.add(it)
+                    children.append(it)
+        if len(kinds) > 1:
+            return None                         # devices mixed w/ buckets
+        if kinds == {True}:                     # next level is devices
+            break
+        if len(strata) > 12:
+            return None
+        strata.append(children)
+    if l_main is None:
+        # CHOOSE_FIRSTN type 0 straight to devices: target level is the
+        # device level
+        if not recurse and target_type == 0:
+            l_main = len(strata)
+        else:
+            return None
+    l_total = len(strata)                       # levels of bucket choice
+    l_leaf = l_total - l_main
+    if recurse and l_leaf < 1:
+        return None
+    if not recurse and l_leaf != 0:
+        return None
+    # reweight eligibility
+    max_dev = -1
+    for bid in strata[-1]:
+        for it in m.buckets[bid].items:
+            max_dev = max(max_dev, it)
+    if device_weights is None:
+        rw_ids = np.zeros(0, dtype=np.int32)
+        rw_w = np.zeros(0, dtype=np.int32)
+    else:
+        dw = np.asarray(device_weights)
+        if max_dev >= dw.shape[0]:
+            return None                         # out-of-range device ids
+        nonfull = np.nonzero(dw[:max_dev + 1] != WEIGHT_ONE)[0]
+        if nonfull.shape[0] > MAX_REWEIGHT:
+            return None
+        rw_ids = nonfull.astype(np.int32)
+        rw_w = dw[nonfull].astype(np.int32)
+    # per-level tables
+    row_index = [{bid: i for i, bid in enumerate(lvl)} for lvl in strata]
+    levels = []
+    sizes = []
+    for li, lvl in enumerate(strata):
+        S = max(m.buckets[bid].size for bid in lvl)
+        P = len(lvl)
+        tbl = np.zeros((2 * S + 1, P), dtype=np.int64)
+        for p, bid in enumerate(lvl):
+            b = m.buckets[bid]
+            tbl[:b.size, p] = b.items
+            if li + 1 < l_total:
+                tbl[S:S + b.size, p] = [row_index[li + 1][it]
+                                        for it in b.items]
+            else:
+                tbl[S:S + b.size, p] = b.items   # device ids
+            tbl[2 * S, p] = b.size
+        if tbl.min() < -32768 or tbl.max() >= 32768:
+            return None      # byte-plane split covers [-32768, 32768)
+        biased = tbl + 32768                     # [0, 65536)
+        # (measured: 8-aligning the sections/lanes for relayout-free
+        # slices was 8% SLOWER and crashed Mosaic on 1-wide blocks —
+        # the simple layout wins; see BASELINE.md kernel-cost table)
+        split = np.concatenate([biased & 0xFF, biased >> 8],
+                               axis=0).astype(np.float32)
+        levels.append(split)
+        sizes.append((S, P))
+    from ceph_tpu.crush.ln_table import ln_gap_info
+    _, zg = ln_gap_info()
+    # f32, not int8: Mosaic cannot lower int32->int8 casts (the
+    # bool one-hot would recurse through _convert_helper); the table
+    # holds only {0,1} so f32 is exact. Only hi bytes >= 128 ever have
+    # an equality pair (min zg index is 33023 = 0x80FF: iexpon-15
+    # territory, where crush_ln's gaps shrink below 1), so the hi
+    # one-hot needs 128 rows, halving the per-choose matmul.
+    zg2 = zg.reshape(256, 256)                      # [hi, lo]
+    assert not zg2[:128].any(), "zg pairs must all have hi >= 128"
+    zg2dT = np.ascontiguousarray(
+        zg2[128:].T).astype(np.float32)             # (256 lo, 128 hi)
+    return KernelPlan(
+        levels=tuple(levels), sizes=tuple(sizes),
+        l_main=l_main, l_leaf=l_leaf,
+        numrep_arg=choose.arg1, recurse=recurse,
+        vary_r=t.chooseleaf_vary_r, tries=t.choose_total_tries,
+        target_type=target_type, rw_ids=rw_ids, rw_w=rw_w,
+        zg2dT=zg2dT)
+
+
+# ---------------------------------------------------------------------------
+# In-kernel primitives
+# ---------------------------------------------------------------------------
+
+def _srl(v, n):
+    return jax.lax.shift_right_logical(v, jnp.int32(n))
+
+
+def _mix(a, b, c):
+    """crush_hashmix in int32 (bit-identical to C uint32: add/sub/xor/
+    shl wrap two's-complement; right shifts are explicit logical)."""
+    a = (a - b) - c
+    a = a ^ _srl(c, 13)
+    b = (b - c) - a
+    b = b ^ (a << 8)
+    c = (c - a) - b
+    c = c ^ _srl(b, 13)
+    a = (a - b) - c
+    a = a ^ _srl(c, 12)
+    b = (b - c) - a
+    b = b ^ (a << 16)
+    c = (c - a) - b
+    c = c ^ _srl(b, 5)
+    a = (a - b) - c
+    a = a ^ _srl(c, 3)
+    b = (b - c) - a
+    b = b ^ (a << 10)
+    c = (c - a) - b
+    c = c ^ _srl(b, 15)
+    return a, b, c
+
+
+def _hash3(a, b, c):
+    """crush_hash32_rjenkins1_3 (ref: src/crush/hash.c)."""
+    h = jnp.int32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+    x = jnp.int32(231232)
+    y = jnp.int32(1232)
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+def _hash2(a, b):
+    h = jnp.int32(CRUSH_HASH_SEED) ^ a ^ b
+    x = jnp.int32(231232)
+    y = jnp.int32(1232)
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+def _zg_flag(zg_ref, umax):
+    """(1, N) int32 in {0,1}: crush_ln(umax-1) == crush_ln(umax)?
+
+    The tie between draw umax and umax-1 exists iff they are an
+    ln-equality pair (ln_gap_info); factored (256, 256) int8 table,
+    fetched with an int8 MXU matmul + sublane select."""
+    if "nozg" in _ABLATE:                            # pragma: no cover
+        return jnp.zeros_like(umax)
+    vm1 = jnp.maximum(umax - 1, 0)
+    hi = (_srl(vm1, 8) & 0xFF) - 128     # zg rows cover hi in [128,256)
+    lo = vm1 & 0xFF
+    iota = jax.lax.broadcasted_iota(jnp.int32, (256, umax.shape[1]), 0)
+    hiota = jax.lax.broadcasted_iota(jnp.int32, (128, umax.shape[1]), 0)
+    oh_hi = (hiota == hi).astype(jnp.float32)        # (128, N); hi < 0
+    # (no pair possible) matches no row -> flag 0 with no extra select.
+    # DEFAULT precision: one bf16 MXU pass is EXACT here — both
+    # operands are {0,1} (bf16-representable) and accumulation is f32;
+    # this is the kernel's hot matmul (one per choose), so the 6-pass
+    # HIGHEST the id-fetch needs would cost 6x for nothing.
+    rowv = jax.lax.dot_general(
+        zg_ref[...], oh_hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)                              # (256lo, N) {0,1}
+    sel = (iota == lo).astype(jnp.int32)
+    # dtype=int32: under enable_x64 jnp.sum would promote to an int64
+    # accumulator (numpy rules) — Mosaic has no int64, and the int64->
+    # int32 cast recurses forever in its _convert_helper; an explicit
+    # accumulator dtype never creates the int64 in the first place
+    flag = jnp.sum(rowv * sel, axis=0, keepdims=True, dtype=jnp.int32)
+    # scalar literals in jnp.where must be explicit int32: under
+    # enable_x64 a Python int traces as an i64[] constant whose
+    # i64->i32 convert Mosaic cannot lower (recurses in
+    # _convert_helper)
+    return jnp.where(umax > 0, flag, jnp.int32(0))
+
+
+def _choose_level(zg_ref, x_row, ids, rows_next, size, r):
+    """One straw2 uniform-weight choose over (S, N) candidate slots.
+
+    ids/rows_next: (S, N) int32; size: (1, N) int32 live-slot count;
+    r: (1, N) or scalar int32. Returns (win_id, win_next) each (1, N).
+    Winner = first slot among the ln-equality class of the max 16-bit
+    hash (ref: mapper.c bucket_straw2_choose keeps the incumbent on
+    draw ties -> first index wins; ln_table.ln_gap_info licenses the
+    hash-only formulation for uniform weights)."""
+    S, N = ids.shape
+    xb = jnp.broadcast_to(x_row, (S, N))
+    rb = jnp.broadcast_to(jnp.asarray(r, jnp.int32), (S, N)) \
+        if not hasattr(r, "shape") or r.shape != (S, N) \
+        else r
+    if "nohash" in _ABLATE:                          # pragma: no cover
+        u = (xb ^ ids ^ rb) & 0xFFFF
+    else:
+        u = _hash3(xb, ids, rb) & 0xFFFF             # (S, N)
+    slot = jax.lax.broadcasted_iota(jnp.int32, (S, N), 0)
+    valid = slot < size                              # (S, N)
+    um = jnp.where(valid, u, jnp.int32(-1))   # int32: see _zg_flag
+    umax = jnp.max(um, axis=0, keepdims=True)        # (1, N)
+    thresh = umax - _zg_flag(zg_ref, umax)
+    member = valid & (um >= thresh)
+    kk = jnp.where(member, slot, jnp.int32(S))
+    kmin = jnp.min(kk, axis=0, keepdims=True)        # first member slot
+    sel = (slot == kmin).astype(jnp.int32)
+    # dtype=int32: see _zg_flag — the x64 sum promotion must neither
+    # leak int64 into the reweight branch's _hash2 nor emit an
+    # int64->int32 cast (unlowerable on Mosaic)
+    win_id = jnp.sum(sel * ids, axis=0, keepdims=True,
+                     dtype=jnp.int32)
+    win_next = jnp.sum(sel * rows_next, axis=0, keepdims=True,
+                       dtype=jnp.int32)
+    return win_id, win_next
+
+
+def _fetch_level(tbl_ref, S, P, row, n):
+    """Row tables for per-lane rows via a one-hot bf16 MXU matmul.
+
+    The table stores each value as two byte planes (build_plan), both
+    in [0,256) and so EXACT under DEFAULT precision's single bf16 pass
+    — this fetch was the kernel's dominant cost at HIGHEST (6 passes;
+    doubling the rows costs nothing here because row counts sit far
+    below the MXU's 128-row tile).
+
+    Returns ids (S, N) int32, next_rows (S, N) int32, size (1, N)."""
+    R = 2 * S + 1
+    if P == 1 or "nofetch" in _ABLATE:
+        col = tbl_ref[...][:, 0:1]                   # (2R, 1)
+        planes = jnp.broadcast_to(col, (2 * R, n))
+    else:
+        iota = jax.lax.broadcasted_iota(jnp.int32, (P, n), 0)
+        onehot = (iota == row).astype(jnp.float32)   # (P, N)
+        planes = jax.lax.dot_general(
+            tbl_ref[...], onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (2R, N)
+    # recombine: hi*256 + lo <= 65535 is exact in f32; debias after
+    full = (planes[R:2 * R, :] * jnp.float32(256.0) +
+            planes[0:R, :]).astype(jnp.int32) - jnp.int32(32768)
+    ids = full[0:S, :]
+    nxt = full[S:2 * S, :]
+    size = full[2 * S:2 * S + 1, :]
+    return ids, nxt, size
+
+
+# ---------------------------------------------------------------------------
+# The kernel
+# ---------------------------------------------------------------------------
+
+def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
+    l_total = plan.l_main + plan.l_leaf
+    S_list = [s for s, _ in plan.sizes]
+    P_list = [p for _, p in plan.sizes]
+    K = plan.rw_ids.shape[0]
+
+    def kernel(*refs):
+        xs_ref = refs[0]
+        tbl_refs = refs[1:1 + l_total]
+        zg_ref = refs[1 + l_total]
+        out_ref = refs[2 + l_total]
+        bad_ref = refs[3 + l_total]
+        x = xs_ref[...]                              # (1, N) int32
+        n = x.shape[1]
+        items_c = []
+        leaves_c = []
+        ok_c = []
+        for r in range(n_cand):
+            row = jnp.zeros((1, n), dtype=jnp.int32)
+            item = None
+            # main descent at r; leaf descent at sub_r (descend_once)
+            sub_r = (r >> (plan.vary_r - 1)) if plan.vary_r else 0
+            for li in range(l_total):
+                ids, nxt, size = _fetch_level(
+                    tbl_refs[li], S_list[li], P_list[li], row, n)
+                rr = r if li < plan.l_main else sub_r
+                win_id, win_next = _choose_level(
+                    zg_ref, x, ids, nxt, size, jnp.int32(rr))
+                if li == plan.l_main - 1:
+                    item = win_id                    # target-type bucket
+                row = win_next
+            leaf = row                               # device id (1, N)
+            if item is None:                         # choose-to-device
+                item = leaf
+            ok = jnp.ones((1, n), dtype=jnp.bool_)
+            if not skip_rw and K:
+                hh = _hash2(x, leaf) & 0xFFFF
+                w = jnp.full((1, n), WEIGHT_ONE, dtype=jnp.int32)
+                for k in range(K):                   # K <= MAX_REWEIGHT
+                    w = jnp.where(leaf == jnp.int32(plan.rw_ids[k]),
+                                  jnp.int32(plan.rw_w[k]), w)
+                out = (w < WEIGHT_ONE) & ((w == 0) | (hh >= w))
+                ok = ok & ~out
+            items_c.append(item)
+            leaves_c.append(leaf)
+            ok_c.append(ok)
+        # slot resolution: scan the shared candidate table
+        bad = jnp.zeros((1, n), dtype=jnp.bool_)
+        chosen_i = []
+        chosen_l = []
+        for s in range(numrep):
+            found = jnp.zeros((1, n), dtype=jnp.bool_)
+            it_s = jnp.full((1, n), ITEM_NONE, dtype=jnp.int32)
+            lf_s = jnp.full((1, n), ITEM_NONE, dtype=jnp.int32)
+            for c in range(s, n_cand):
+                coll = jnp.zeros((1, n), dtype=jnp.bool_)
+                for pi, pl_ in zip(chosen_i, chosen_l):
+                    coll = coll | (items_c[c] == pi) | (leaves_c[c] == pl_)
+                good = ok_c[c] & ~coll & ~found
+                it_s = jnp.where(good, items_c[c], it_s)
+                lf_s = jnp.where(good, leaves_c[c], lf_s)
+                found = found | good
+            chosen_i.append(it_s)
+            chosen_l.append(lf_s)
+            bad = bad | ~found
+        out_ref[...] = jnp.concatenate(chosen_l, axis=0)
+        bad_ref[...] = bad.astype(jnp.int32)
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("plan", "numrep", "interpret"))
+def _run_kernel(plan: KernelPlan, xs: jax.Array, numrep: int,
+                interpret: bool = False):
+    """xs (N,) int32 -> (leaves (N, numrep) int32, bad (N,) bool).
+
+    N must be a multiple of LANES."""
+    n = xs.shape[0]
+    assert n % LANES == 0, n
+    n_cand = numrep + SPEC_EXTRA
+    l_total = plan.l_main + plan.l_leaf
+    skip_rw = plan.rw_ids.shape[0] == 0
+    kernel = _make_kernel(plan, numrep, n_cand, skip_rw)
+    grid = (n // LANES,)
+    # index maps return jnp.int32(0), not the literal 0: under the
+    # caller's enable_x64 the literal traces as i64 and Mosaic cannot
+    # legalize the index map's (i64, i32) func.return
+    zero = lambda i: (jnp.int32(0), jnp.int32(0))
+    in_specs = [pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i))]
+    operands = [xs.reshape(1, n)]
+    for li, tbl in enumerate(plan.levels):
+        R, P = tbl.shape
+        in_specs.append(pl.BlockSpec((R, P), zero))
+        operands.append(jnp.asarray(tbl))
+    in_specs.append(pl.BlockSpec((256, 128), zero))
+    operands.append(jnp.asarray(plan.zg2dT))
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    leaves, bad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((numrep, LANES),
+                                lambda i: (jnp.int32(0), i)),
+                   pl.BlockSpec((1, LANES),
+                                lambda i: (jnp.int32(0), i))],
+        out_shape=[jax.ShapeDtypeStruct((numrep, n), jnp.int32),
+                   jax.ShapeDtypeStruct((1, n), jnp.int32)],
+        interpret=interpret,
+        **params,
+    )(*operands)
+    return leaves.T, bad[0].astype(bool)

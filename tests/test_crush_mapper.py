@@ -154,6 +154,20 @@ class TestJaxMatchesScalar:
         assert_match(m, rid, 3,
                      weights=[0x10000, 0x8000, 0x10000, 0x10000, 0, 0x4000])
 
+    def test_out_of_range_device_rejected_both_paths(self):
+        """A device id beyond the reweight vector is out (ref: mapper.c
+        is_out item >= weight_max) — and BOTH compiled variants
+        (skip_is_out True/False) must agree with the scalar spec, so a
+        reweight flip cannot change placement of out-of-range ids
+        (ADVICE r3 low #3)."""
+        m, root = builder.build_flat(8)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        # 5-entry reweight vector: devices 5..7 are out-of-range
+        full = [0x10000] * 5                    # skip_is_out compiles True
+        assert_match(m, rid, 3, weights=full)
+        mixed = [0x10000, 0x8000, 0x10000, 0x10000, 0x10000]  # general path
+        assert_match(m, rid, 3, weights=mixed)
+
     def test_zero_weight_subtree(self):
         m, root = builder.build_hierarchy(
             4, 3, osd_weights=[0, 0, 0] + [WEIGHT_ONE] * 9)

@@ -1,0 +1,211 @@
+"""Fused Pallas CRUSH kernel: bit-exactness vs the scalar spec and the
+XLA path (interpret mode on CPU; the same program runs compiled on TPU).
+
+The kernel must agree with mapper_ref on every eligible map — including
+engineered draw-tie collisions (the ln-equality repair), reweighted
+devices (the compare-list is_out), and collision-heavy small maps where
+replica slots contend (the shared candidate table + fallback flagging).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("CEPH_TPU_CRUSH_KERNEL", "interpret")
+
+import jax.numpy as jnp
+
+from ceph_tpu.crush import builder, mapper_ref
+from ceph_tpu.crush import pallas_mapper as pm
+from ceph_tpu.crush.mapper import Mapper
+from ceph_tpu.crush.tensors import pack_map
+from ceph_tpu.crush.types import ITEM_NONE, WEIGHT_ONE
+
+N_X = 192
+
+
+def _assert_kernel_matches_ref(m, rid, numrep, weights=None, xs=None):
+    mapper = Mapper(m, np.asarray(weights, dtype=np.int64)
+                    if weights is not None else None)
+    assert mapper._kernel_mode == "interpret"
+    assert mapper._kernel_body(rid, numrep) is not None, \
+        "map unexpectedly ineligible for the kernel"
+    xs = xs if xs is not None else np.arange(N_X, dtype=np.uint32)
+    got = np.asarray(mapper.map_pgs(rid, xs, numrep))
+    wl = list(weights) if weights is not None else None
+    for i, x in enumerate(xs):
+        ref = mapper_ref.do_rule(m, rid, int(x), numrep, weight=wl)
+        ref = ref + [ITEM_NONE] * (numrep - len(ref))
+        assert list(got[i]) == ref, (int(x), list(got[i]), ref)
+
+
+def _hier(n_hosts, per_host, n_racks=None):
+    m, root = builder.build_hierarchy(
+        n_hosts, per_host,
+        n_racks=n_racks if n_racks else max(1, n_hosts // 4))
+    rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+    return m, rid
+
+
+class TestEligibility:
+    def test_canonical_map_eligible(self):
+        m, rid = _hier(16, 4)
+        p = pack_map(m)
+        assert pm.build_plan(m, p, rid, None) is not None
+
+    def test_mixed_weights_ineligible(self):
+        m, root = builder.build_flat(
+            8, weights=[WEIGHT_ONE] * 7 + [2 * WEIGHT_ONE])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        assert pm.build_plan(m, pack_map(m), rid, None) is None
+
+    def test_choose_args_ineligible(self):
+        m, rid = _hier(8, 2)
+        m.choose_args[0] = {}
+        assert pm.build_plan(m, pack_map(m), rid, None,
+                             choose_args_key=0) is None
+
+    def test_many_reweights_ineligible(self):
+        m, rid = _hier(40, 4)                           # 160 devices
+        dw = np.full(160, WEIGHT_ONE, dtype=np.int64)
+        dw[:pm.MAX_REWEIGHT + 1] = WEIGHT_ONE // 2
+        assert pm.build_plan(m, pack_map(m), rid, dw) is None
+
+    def test_short_weight_vector_ineligible(self):
+        """Device ids beyond the reweight vector would dodge the
+        compare-list is_out: the kernel must decline."""
+        m, rid = _hier(4, 4)
+        dw = np.full(8, WEIGHT_ONE, dtype=np.int64)     # ids go to 15
+        assert pm.build_plan(m, pack_map(m), rid, dw) is None
+
+    def test_xla_fallback_when_ineligible(self):
+        """Ineligible maps silently keep the XLA path through Mapper."""
+        m, root = builder.build_flat(
+            6, weights=[WEIGHT_ONE] * 5 + [WEIGHT_ONE * 3])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        mapper = Mapper(m)
+        assert mapper._kernel_body(rid, 3) is None
+        out = np.asarray(mapper.map_pgs(
+            0, np.arange(32, dtype=np.uint32), 3))
+        for i in range(32):
+            ref = mapper_ref.do_rule(m, rid, i, 3)
+            assert list(out[i]) == ref + [ITEM_NONE] * (3 - len(ref))
+
+
+class TestBitExact:
+    def test_three_level_chooseleaf(self):
+        m, rid = _hier(16, 4)
+        _assert_kernel_matches_ref(m, rid, 3)
+
+    def test_flat_choose_firstn_osd(self):
+        m, root = builder.build_flat(12)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        _assert_kernel_matches_ref(m, rid, 3)
+
+    def test_numrep_variants(self):
+        m, rid = _hier(16, 4)
+        for numrep in (1, 2, 4, 5):
+            _assert_kernel_matches_ref(
+                m, rid, numrep, xs=np.arange(64, dtype=np.uint32))
+
+    def test_collision_heavy_small_map(self):
+        """numrep == n_hosts: every lane contends for every host, the
+        candidate scan + fallback must reproduce the scalar walk."""
+        m, rid = _hier(4, 2)
+        _assert_kernel_matches_ref(m, rid, 4)
+        m2, rid2 = _hier(3, 3, n_racks=1)
+        _assert_kernel_matches_ref(m2, rid2, 3)
+
+    def test_reweighted_devices(self):
+        m, rid = _hier(8, 4)
+        w = np.full(32, WEIGHT_ONE, dtype=np.int64)
+        w[3] = 0                       # fully out
+        w[17] = WEIGHT_ONE // 2        # probabilistic
+        w[18] = WEIGHT_ONE // 7
+        _assert_kernel_matches_ref(m, rid, 3, weights=w)
+
+    def test_reweight_update_rebuilds_plan(self):
+        m, rid = _hier(8, 4)
+        mapper = Mapper(m)
+        xs = np.arange(64, dtype=np.uint32)
+        base = np.asarray(mapper.map_pgs(rid, xs, 3))
+        w = np.full(32, WEIGHT_ONE, dtype=np.int64)
+        w[5] = 0
+        mapper.set_device_weights(w)
+        out = np.asarray(mapper.map_pgs(rid, xs, 3))
+        assert not np.array_equal(base, out)
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 3,
+                                     weight=list(w))
+            assert list(out[i]) == ref + [ITEM_NONE] * (3 - len(ref))
+
+    def test_engineered_draw_ties(self):
+        """Scan wide x ranges on a small bucket so ln-equality adjacent
+        pairs (zg) actually occur among the drawn hashes; the winner
+        must match the spec's first-index tie rule everywhere."""
+        m, root = builder.build_flat(16)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        # big uniform stride to diversify hash space coverage
+        xs = (np.arange(256, dtype=np.uint32) * 2654435761) & 0x7FFFFFFF
+        _assert_kernel_matches_ref(m, rid, 3, xs=xs.astype(np.uint32))
+
+    def test_sweep_counts_match_xla(self):
+        m, rid = _hier(16, 4)
+        mk = Mapper(m, block=1 << 14)
+        os.environ["CEPH_TPU_CRUSH_KERNEL"] = "0"
+        try:
+            mx = Mapper(m, block=1 << 14)
+        finally:
+            os.environ["CEPH_TPU_CRUSH_KERNEL"] = "interpret"
+        assert mk._kernel_mode == "interpret" and mx._kernel_mode is None
+        ck, bk = mk.sweep(rid, 0, 3000, 3)
+        cx, bx = mx.sweep(rid, 0, 3000, 3)
+        assert np.array_equal(np.asarray(ck), np.asarray(cx))
+        assert int(bk) == int(bx)
+
+
+class TestKernelInternals:
+    def test_hash_bit_exact(self):
+        from ceph_tpu.crush import hash as H
+        rng = np.random.default_rng(0)
+        a = rng.integers(0, 1 << 32, 256, dtype=np.uint32)
+        b = rng.integers(0, 1 << 32, 256, dtype=np.uint32)
+        c = rng.integers(0, 1 << 32, 256, dtype=np.uint32)
+        want = H.hash32_3(a, b, c).astype(np.int64)
+        got = np.asarray(pm._hash3(
+            jnp.asarray(a.astype(np.int32)).reshape(2, -1),
+            jnp.asarray(b.astype(np.int32)).reshape(2, -1),
+            jnp.asarray(c.astype(np.int32)).reshape(2, -1))
+        ).reshape(-1).astype(np.uint32).astype(np.int64)
+        assert np.array_equal(want, got)
+        want2 = H.hash32_2(a, b).astype(np.int64)
+        got2 = np.asarray(pm._hash2(
+            jnp.asarray(a.astype(np.int32)).reshape(2, -1),
+            jnp.asarray(b.astype(np.int32)).reshape(2, -1))
+        ).reshape(-1).astype(np.uint32).astype(np.int64)
+        assert np.array_equal(want2, got2)
+
+    def test_zg_flag_table(self):
+        from ceph_tpu.crush.ln_table import ln_gap_info
+        _, zg = ln_gap_info()
+        m, rid = _hier(4, 2)
+        plan = pm.build_plan(m, pack_map(m), rid, None)
+        idx = np.where(zg)[0][:8].astype(np.int32)
+
+        class _R:
+            def __init__(self, a):
+                self.a = a
+
+            def __getitem__(self, k):
+                return self.a
+
+        zgt = jnp.asarray(plan.zg2dT)
+        for v in idx:
+            f = np.asarray(pm._zg_flag(
+                _R(zgt), jnp.full((1, 8), int(v) + 1, jnp.int32)))
+            assert f[0, 0] == 1, v
+            f2 = np.asarray(pm._zg_flag(
+                _R(zgt), jnp.full((1, 8), int(v), jnp.int32)))
+            # zg[v-1] is almost never also set (pairs are isolated)
+            assert f2[0, 0] == int(zg[v - 1]), v
