@@ -101,11 +101,12 @@ class PGAutoscalerModule(MgrModule):
             objs_per_pool[pid] = objs_per_pool.get(pid, 0) + \
                 st.get("num_objects", 0)
         for pool in pools:
-            if objs_per_pool.get(pool["pool"], 0):
-                # PG splitting is not implemented: growing pg_num on a
-                # populated pool would strand objects in their old PGs
-                # (the reference splits PGs on pg_num increase)
-                continue
+            # pg splitting (round 4): OSDs split populated PGs locally
+            # on a pg_num increase, so populated pools grow too. Two
+            # phases like the reference: raise pg_num (split in place —
+            # pgp_num stays, placement unchanged), then once the
+            # cluster is clean raise pgp_num to migrate the children
+            # (ref: pg_autoscaler module + OSDMonitor pgp_num ramp).
             want = self.recommend(n_osds, len(pools), pool["size"])
             if want and pool["pg_num"] * 2 <= want:
                 log.dout(1, f"autoscaler: pool {pool['name']} pg_num "
@@ -113,6 +114,23 @@ class PGAutoscalerModule(MgrModule):
                 await self.mon_command(
                     {"prefix": "osd pool set", "pool": pool["name"],
                      "var": "pg_num", "val": str(want)})
+            elif pool.get("pgp_num", pool["pg_num"]) < pool["pg_num"] \
+                    and self._all_clean(pg_dump):
+                log.dout(1, f"autoscaler: pool {pool['name']} pgp_num "
+                            f"-> {pool['pg_num']}")
+                await self.mon_command(
+                    {"prefix": "osd pool set", "pool": pool["name"],
+                     "var": "pgp_num", "val": str(pool["pg_num"])})
+
+    @staticmethod
+    def _all_clean(pg_dump) -> bool:
+        """Exact clean states only: 'active+undersized+degraded' must
+        NOT license the pgp_num ramp (migrating split children while
+        degraded would stack recovery on recovery)."""
+        stats = pg_dump.get("pg_stats", {})
+        return bool(stats) and all(
+            st.get("state", "") in ("clean", "replica")
+            for st in stats.values())
 
 
 class PrometheusModule(MgrModule):

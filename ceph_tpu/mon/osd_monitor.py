@@ -220,6 +220,8 @@ class OSDMonitor(PaxosService):
             "osd pool rm": self._cmd_pool_rm,
             "osd pool set": self._cmd_pool_set,
             "osd pool ls": self._cmd_pool_ls,
+            "osd pool selfmanaged-snap-create": self._cmd_snap_create,
+            "osd pool selfmanaged-snap-remove": self._cmd_snap_remove,
             "osd erasure-code-profile set": self._cmd_ecp_set,
             "osd erasure-code-profile get": self._cmd_ecp_get,
             "osd erasure-code-profile ls": self._cmd_ecp_ls,
@@ -356,10 +358,12 @@ class OSDMonitor(PaxosService):
             return -11, "proposal failed", b""
         return 0, f"pool '{name}' removed", b""
 
-    async def _cmd_pool_set(self, cmd, inbl):
-        name, var, val = cmd["pool"], cmd["var"], cmd["val"]
-        if var not in ("size", "min_size", "pg_num", "pgp_num"):
-            return -22, f"unknown pool var {var!r}", b""
+    async def _cmd_snap_create(self, cmd, inbl):
+        """Allocate a self-managed snap id: bump the pool's snap_seq
+        (ref: OSDMonitor::prepare_pool_op SELFMANAGED_SNAP_CREATE —
+        pg_pool_t::add_unmanaged_snap)."""
+        name = cmd["pool"]
+        got: dict = {}
 
         def build(om):
             pool = next((p for p in om.pools.values()
@@ -368,12 +372,80 @@ class OSDMonitor(PaxosService):
                 return None
             import copy
             newpool = copy.deepcopy(pool)
-            setattr(newpool, var, int(val))
+            sid = int(newpool.extra.get("snap_seq", 0)) + 1
+            newpool.extra["snap_seq"] = sid
+            got["snapid"] = sid
+            inc = Incremental()
+            inc.new_pools[pool.id] = newpool
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if not ok or "snapid" not in got:
+            if not any(p.name == name
+                       for p in self.osdmap.pools.values()):
+                return -2, f"pool '{name}' does not exist", b""
+            return -11, "proposal failed", b""   # transient: retryable
+        return 0, "", json.dumps({"snapid": got["snapid"]}).encode()
+
+    async def _cmd_snap_remove(self, cmd, inbl):
+        """Record a self-managed snap as deleted (clone trimming is
+        client-driven via OSD_OP_SNAPTRIM)."""
+        name, sid = cmd["pool"], int(cmd["snapid"])
+
+        def build(om):
+            pool = next((p for p in om.pools.values()
+                         if p.name == name), None)
+            if pool is None:
+                return None
+            import copy
+            newpool = copy.deepcopy(pool)
+            removed = set(newpool.extra.get("removed_snaps", []))
+            removed.add(sid)
+            newpool.extra["removed_snaps"] = sorted(removed)
             inc = Incremental()
             inc.new_pools[pool.id] = newpool
             return inc, None
         ok, _ = await self._propose_change(build)
         if not ok:
+            if not any(p.name == name
+                       for p in self.osdmap.pools.values()):
+                return -2, f"pool '{name}' does not exist", b""
+            return -11, "proposal failed", b""   # transient: retryable
+        return 0, f"removed snap {sid}", b""
+
+    async def _cmd_pool_set(self, cmd, inbl):
+        name, var, val = cmd["pool"], cmd["var"], cmd["val"]
+        if var not in ("size", "min_size", "pg_num", "pgp_num"):
+            return -22, f"unknown pool var {var!r}", b""
+        rejected: dict = {}
+
+        def build(om):
+            # guards run INSIDE build against the authoritative map a
+            # proposal would actually apply to — prechecking against
+            # self.osdmap races concurrent pool-set commands and could
+            # land a forbidden pg_num decrease (merge)
+            # (ref: OSDMonitor::prepare_command_pool_set checks)
+            pool = next((p for p in om.pools.values()
+                         if p.name == name), None)
+            if pool is None:
+                return None
+            if var == "pg_num" and int(val) < pool.pg_num:
+                rejected["msg"] = "pg_num decrease (merge) not supported"
+                return None
+            if var == "pgp_num" and int(val) > pool.pg_num:
+                rejected["msg"] = "pgp_num cannot exceed pg_num"
+                return None
+            import copy
+            newpool = copy.deepcopy(pool)
+            setattr(newpool, var, int(val))
+            if var == "pg_num" and newpool.pgp_num > newpool.pg_num:
+                newpool.pgp_num = newpool.pg_num
+            inc = Incremental()
+            inc.new_pools[pool.id] = newpool
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            if "msg" in rejected:
+                return -22, rejected["msg"], b""
             if not any(p.name == name
                        for p in self.osdmap.pools.values()):
                 return -2, f"pool '{name}' does not exist", b""
@@ -459,6 +531,7 @@ class OSDMonitor(PaxosService):
             "pools": [{"pool": p.id, "name": p.name,
                        "type": p.type, "size": p.size,
                        "min_size": p.min_size, "pg_num": p.pg_num,
+                       "pgp_num": p.pgp_num,
                        "crush_rule": p.crush_rule,
                        "erasure_code_profile": p.erasure_code_profile}
                       for p in om.pools.values()],

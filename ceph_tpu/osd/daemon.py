@@ -194,6 +194,20 @@ class OSD(Dispatcher):
         for pg in self.pgs.values():
             by_pool.setdefault(pg.pool.id, []).append(pg)
         for pool in osdmap.pools.values():
+            # pg splitting (ref: OSD::consume_map split tracking): a
+            # grown pg_num re-folds object names; every existing local
+            # PG moves its re-folded objects into child collections
+            # BEFORE the new child PGs instantiate and peer below.
+            # Besides the in-memory pg_num transition, run the
+            # (idempotent, store-derived) split once per PG instance:
+            # an OSD that BOOTS after the increase builds its PGs from
+            # the new map and would otherwise never observe a delta,
+            # stranding re-folded objects in the parent collection.
+            for pg in by_pool.get(pool.id, []):
+                if pool.pg_num > pg.pool.pg_num or \
+                        not getattr(pg, "_split_checked", False):
+                    pg.split_objects(osdmap, pool)
+                    pg._split_checked = True
             seeds = np.arange(pool.pg_num, dtype=np.uint32)
             up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
                 pool.id, seeds)
@@ -248,8 +262,18 @@ class OSD(Dispatcher):
                 # wrong target: client's map is stale; it will resend
                 from ceph_tpu.osd.messages import MOSDOpReply
                 await msg.conn.send_message(MOSDOpReply(
-                    tid=msg.tid, result=-11, epoch=self.osdmap.epoch
+                    tid=msg.tid, attempt=getattr(msg, "attempt", 0),
+                    result=-11, epoch=self.osdmap.epoch
                     if self.osdmap else 0, data=b"", extra=""))
+                return True
+            from ceph_tpu.osd.messages import OSD_OP_NOTIFY_ACK
+            if msg.op_codes and all(c == OSD_OP_NOTIFY_ACK
+                                    for c in msg.op_codes):
+                # acks complete a notify the op worker may itself be
+                # awaiting — bypass the serialized queue. ONLY pure
+                # ack bundles: a mixed bundle with mutating ops must
+                # keep the per-PG serialization the queue provides.
+                await pg._execute(msg)
                 return True
             await pg.queue_op(msg)
             return True
@@ -307,12 +331,16 @@ class OSD(Dispatcher):
             return True
         if isinstance(msg, MOSDPGPush):
             pg = self._pg_for(msg.pgid, create=True)
-            if pg is not None:
-                pg.apply_push(msg)
+            if pg is not None and pg.apply_push(msg):
+                # ack ONLY on durable apply: the primary counts acked
+                # pushes as recovered (durability promotion gate)
                 await self.send_osd(msg.from_osd, MOSDPGPushReply(
                     pgid=msg.pgid, oid=msg.oid, from_osd=self.whoami))
             return True
         if isinstance(msg, MOSDPGPushReply):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                pg.handle_push_reply(msg)
             return True
         if isinstance(msg, MOSDRepScrub):
             pg = self._pg_for(msg.pgid)

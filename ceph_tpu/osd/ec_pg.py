@@ -263,7 +263,15 @@ class ECPG(PG):
             oid, edits, write_full, new_size, deleted, attrs_delta,
             omap_delta, omap_rm)
         extra["version"] = str(self.pg_log.head)
-        self._reqid_results[reqid] = (result, extra)
+        if result != -11:
+            # -11 (-EAGAIN) here means the min_size gate rejected the op
+            # BEFORE anything was applied: recording it would make every
+            # future resend of this reqid replay -EAGAIN forever, even
+            # after the PG heals (r4 review finding). Re-execution is
+            # safe — nothing was logged. A -5 (< k shards committed) IS
+            # recorded: the entry is in the pg log, so a replay would
+            # double-log; the dup honestly reports the partial failure.
+            self._reqid_results[reqid] = (result, extra)
         if len(self._reqid_results) > 2000:
             for k in list(self._reqid_results)[:1000]:
                 self._reqid_results.pop(k, None)
@@ -571,6 +579,7 @@ class ECPG(PG):
         if any(self.peer_missing.values()):
             self.state = "recovering"
         from ceph_tpu.osd.messages import MOSDPGPush
+        sends: list = []
         for o, missing in list(self.peer_missing.items()):
             if not self.osd.osd_is_up(o):
                 continue
@@ -606,12 +615,15 @@ class ECPG(PG):
                             attrs={"_v": _vblob(ver),
                                    "_size": size.to_bytes(8, "little")},
                             omap=omap, from_osd=self.osd.whoami)
-                    await self.osd.send_osd(o, push)
                 except Exception as e:
                     log.dout(1, f"pg {self.pgid} ec push {oid}->{o} "
-                                f"failed: {e}")
+                                f"build failed: {e}")
                     continue
-                missing.pop(oid, None)
+                sends.append((o, oid, push))
+        # a shard only counts as recovered once ACKED — the gate is
+        # shared with the replicated path (PG._send_gated_pushes)
+        if await self._send_gated_pushes(sends):
+            return
         if not any(self.peer_missing.values()) and \
                 self.state in ("active", "recovering"):
             self.state = "clean" if \

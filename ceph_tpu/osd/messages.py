@@ -24,6 +24,11 @@ OSD_OP_OMAP_GET = 10
 OSD_OP_OMAP_SET = 11
 OSD_OP_PGLS = 12           # list objects in pg (rados ls building block)
 OSD_OP_OMAP_RM = 13
+OSD_OP_WATCH = 14          # register a watcher (cookie in `offset`)
+OSD_OP_UNWATCH = 15
+OSD_OP_NOTIFY = 16         # fan payload out to watchers, await acks
+OSD_OP_NOTIFY_ACK = 17     # watcher -> primary (notify_id in `offset`)
+OSD_OP_SNAPTRIM = 18       # drop a snap id from the object's clones
 
 # heartbeat ops (ref: MOSDPing::PING / PING_REPLY)
 PING = 1
@@ -40,11 +45,15 @@ class MOSDOp(Message):
 
     TYPE = 160
     FIELDS = [
-        ("tid", "u64"), ("epoch", "u32"),
+        ("tid", "u64"), ("attempt", "u32"), ("epoch", "u32"),
         ("pool", "s64"), ("seed", "u32"), ("oid", "str"),
         ("op_codes", "list:u32"), ("op_offs", "list:u64"),
         ("op_lens", "list:u64"), ("op_names", "list:str"),
         ("op_datas", "list:blob"),
+        # self-managed snap context (ref: SnapContext in MOSDOp):
+        # writes carry (snap_seq, snaps) for clone-on-write; reads
+        # carry snap_id (0 = head)
+        ("snap_seq", "u64"), ("snaps", "list:u64"), ("snap_id", "u64"),
     ]
 
     def unpack_ops(self):
@@ -53,19 +62,30 @@ class MOSDOp(Message):
 
 
 def make_osd_op(tid: int, epoch: int, pool: int, seed: int, oid: str,
-                ops: list[tuple]) -> MOSDOp:
-    """ops: (code, offset, length, name, data) tuples."""
+                ops: list[tuple], attempt: int = 0,
+                snapc: tuple | None = None, snap_id: int = 0) -> MOSDOp:
+    """ops: (code, offset, length, name, data) tuples.
+
+    ``attempt`` distinguishes objecter resends of one logical op (same
+    tid): the OSD echoes it so a late reply from a timed-out earlier
+    attempt cannot resolve a newer attempt's waiter with a stale read
+    (ref: MOSDOp::get_retry_attempt). ``snapc`` = (seq, [snap ids])
+    write snap context; ``snap_id`` = read-at-snap (0 = head)."""
+    seq, snaps = snapc if snapc else (0, [])
     return MOSDOp(
-        tid=tid, epoch=epoch, pool=pool, seed=seed, oid=oid,
+        tid=tid, attempt=attempt, epoch=epoch, pool=pool, seed=seed,
+        oid=oid,
         op_codes=[o[0] for o in ops], op_offs=[o[1] for o in ops],
         op_lens=[o[2] for o in ops], op_names=[o[3] for o in ops],
-        op_datas=[o[4] for o in ops])
+        op_datas=[o[4] for o in ops],
+        snap_seq=seq, snaps=list(snaps), snap_id=snap_id)
 
 
 @register
 class MOSDOpReply(Message):
     TYPE = 161
-    FIELDS = [("tid", "u64"), ("result", "s32"), ("epoch", "u32"),
+    FIELDS = [("tid", "u64"), ("attempt", "u32"), ("result", "s32"),
+              ("epoch", "u32"),
               ("data", "blob"), ("extra", "str")]   # extra: json
 
 
@@ -76,7 +96,11 @@ class MOSDRepOp(Message):
 
     TYPE = 162
     FIELDS = [("tid", "u64"), ("epoch", "u32"), ("pgid", "str"),
-              ("txn", "blob"), ("log_entry", "blob")]
+              ("txn", "blob"), ("log_entry", "blob"),
+              # snap-clone entries committed by the same txn (kept
+              # separate from log_entry for compatibility with the
+              # single-entry fast path)
+              ("extra_log", "list:blob")]
 
 
 @register
@@ -84,6 +108,17 @@ class MOSDRepOpReply(Message):
     TYPE = 163
     FIELDS = [("tid", "u64"), ("result", "s32"), ("pgid", "str"),
               ("from_osd", "s32")]
+
+
+@register
+class MWatchNotify(Message):
+    """Primary -> watching client: a notify fired on a watched object
+    (ref: src/messages/MWatchNotify.h). The client acks with an
+    OSD_OP_NOTIFY_ACK op so the notifier can collect completions."""
+
+    TYPE = 177
+    FIELDS = [("oid", "str"), ("pgid", "str"), ("notify_id", "u64"),
+              ("cookie", "u64"), ("payload", "blob")]
 
 
 @register

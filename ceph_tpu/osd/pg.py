@@ -32,10 +32,14 @@ import json
 from ceph_tpu.os_.objectstore import StoreError, Transaction
 from ceph_tpu.osd.messages import (
     MOSDOp, MOSDOpReply, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
-    MOSDPGPushReply, MOSDPGQuery, MOSDRepOp, MOSDRepOpReply, OSD_OP_DELETE,
-    OSD_OP_GETXATTR, OSD_OP_OMAP_GET, OSD_OP_OMAP_SET, OSD_OP_PGLS,
-    OSD_OP_OMAP_RM, OSD_OP_READ, OSD_OP_SETXATTR, OSD_OP_STAT,
-    OSD_OP_TRUNCATE, OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_ZERO,
+    MOSDPGPushReply, MOSDPGQuery, MOSDRepOp, MOSDRepOpReply,
+    MWatchNotify, OSD_OP_DELETE,
+    OSD_OP_GETXATTR, OSD_OP_NOTIFY, OSD_OP_NOTIFY_ACK, OSD_OP_OMAP_GET,
+    OSD_OP_OMAP_SET, OSD_OP_PGLS,
+    OSD_OP_OMAP_RM, OSD_OP_READ, OSD_OP_SETXATTR, OSD_OP_SNAPTRIM,
+    OSD_OP_STAT,
+    OSD_OP_TRUNCATE, OSD_OP_UNWATCH, OSD_OP_WATCH, OSD_OP_WRITE,
+    OSD_OP_WRITEFULL, OSD_OP_ZERO,
 )
 from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry, PGLog, \
     eversion
@@ -45,6 +49,24 @@ from ceph_tpu.utils.logging import get_logger
 log = get_logger("osd")
 
 PGMETA = "_pgmeta_"
+
+# snapshot clone objects live beside their head in the same PG under a
+# reserved prefix (ref: the SnapSet clone list; upstream names clones
+# hobject(oid, snapid) — here the snapid rides in the name)
+CLONE_PREFIX = "_snapclone."
+
+
+def clone_name(oid: str, clone_id: int) -> str:
+    return f"{CLONE_PREFIX}{clone_id}.{oid}"
+
+
+def clone_head(name: str) -> str | None:
+    """The head oid a clone object belongs to, or None for non-clones."""
+    if not name.startswith(CLONE_PREFIX):
+        return None
+    rest = name[len(CLONE_PREFIX):]
+    parts = rest.split(".", 1)
+    return parts[1] if len(parts) == 2 else None
 
 
 class PG:
@@ -69,12 +91,33 @@ class PG:
         # op pipeline
         self.op_queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
-        self._repop_waiters: dict[int, tuple[set[int], asyncio.Future]] = {}
+        # tid -> [pending_replica_set, future, reqid, timed_out]: one
+        # record per in-flight repop. ``timed_out`` marks repops whose
+        # client already got -EAGAIN; a late completing reply (or a
+        # re-peer + completed recovery) promotes the recorded dedup
+        # result to success so resends stop seeing -EAGAIN.
+        self._repop_waiters: dict[int, list] = {}
         self._push_waiters: dict[str, asyncio.Future] = {}
+        # (peer_osd, oid) -> future completed by MOSDPGPushReply: the
+        # primary's recovery only counts ACKED pushes as recovered
+        self._push_ack_waiters: dict[tuple[int, str],
+                                     asyncio.Future] = {}
         # (client, tid) -> (result, extra): replays of mutating ops whose
         # reply was lost return the recorded outcome instead of
         # re-executing (ref: pg_log_entry_t reqid dedup)
         self._reqid_results: dict[tuple, tuple] = {}
+        # watch/notify (ref: PrimaryLogPG watchers_): oid ->
+        # {(client, cookie): conn}. In-memory on the primary; clients
+        # re-watch after a primary change (the reference persists watch
+        # state in the object info — documented simplification).
+        self._watchers: dict[str, dict[tuple, object]] = {}
+        self._notify_waiters: dict[int, list] = {}   # id -> [pending, fut, acks]
+        # head oid -> [(clone_id, covered_snaps)], lazily built from the
+        # store and INVALIDATED whenever clone state changes (COW, trim,
+        # recovery push, split). Keeps the hot snapc-write path O(1) —
+        # without it every snap-context write scanned the whole PG
+        # collection (r4 review finding).
+        self._clone_idx: dict[str, list] | None = None
         self.scrub_errors = 0
         self.last_scrub = 0.0
         self._scrubber = None
@@ -227,6 +270,227 @@ class PG:
                 set(self.peer_logs) >= set(peers):
             self._info_waiter.set_result(True)
 
+    # -- self-managed snapshots (ref: PrimaryLogPG make_writeable /
+    # SnapSet; clones are first-class objects in the same PG) ------------
+    def _clone_list(self, oid: str) -> list[tuple[int, list[int]]]:
+        """[(clone_id, covered_snap_ids)] ascending, from the clone
+        objects' _clsnaps xattrs (served from the lazy per-PG index)."""
+        if self._clone_idx is None:
+            store = self.osd.store
+            idx: dict[str, list] = {}
+            prefix = CLONE_PREFIX
+            try:
+                names = store.list_objects(self.cid)
+            except StoreError:
+                names = []
+            for name in names:
+                head = clone_head(name)
+                if head is None:
+                    continue
+                cid_ = int(name[len(prefix):].split(".", 1)[0])
+                try:
+                    blob = store.getattrs(self.cid, name).get("_clsnaps")
+                except StoreError:
+                    continue
+                covered = json.loads(blob) if blob else []
+                idx.setdefault(head, []).append((cid_, covered))
+            for lst in idx.values():
+                lst.sort()
+            self._clone_idx = idx
+        return self._clone_idx.get(oid, [])
+
+    def _resolve_snap_read(self, oid: str, snap_id: int) -> str | None:
+        """Object name serving a read AT snap_id, or None (-ENOENT):
+        the clone covering the snap, else the head if the object
+        existed unmodified since (and was not created after the snap)
+        (ref: PrimaryLogPG::find_object_context snapid resolution)."""
+        for cid_, covered in self._clone_list(oid):
+            if snap_id in covered:
+                return clone_name(oid, cid_)
+        store = self.osd.store
+        if not store.exists(self.cid, oid):
+            return None
+        try:
+            pre = store.getattrs(self.cid, oid).get("_pre")
+        except StoreError:
+            return None
+        if pre and snap_id in json.loads(pre):
+            return None                 # created after this snap
+        return oid
+
+    def _maybe_cow(self, t: Transaction, oid: str, snap_seq: int,
+                   snaps: list[int]) -> str | None:
+        """Clone-on-write: preserve the head state for every live snap
+        not yet covered by a clone, as part of the SAME transaction as
+        the incoming mutation (ref: make_writeable). Returns the clone
+        name when one was made (caller logs it so recovery tracks it)."""
+        store = self.osd.store
+        live = [s for s in snaps if s <= snap_seq]
+        if not store.exists(self.cid, oid):
+            return None     # born-after marking happens post-mutation
+        covered: set[int] = set()
+        for _, csnaps in self._clone_list(oid):
+            covered |= set(csnaps)
+        try:
+            pre = store.getattrs(self.cid, oid).get("_pre")
+            if pre:
+                covered |= set(json.loads(pre))
+        except StoreError:
+            pass
+        new_snaps = sorted(s for s in live if s not in covered)
+        if not new_snaps:
+            return None
+        clone = clone_name(oid, snap_seq)
+        if store.exists(self.cid, clone):
+            # a clone for this snap id already exists (e.g. a stale
+            # client snapc still names a snap whose clone was since
+            # trimmed down): NEVER overwrite it — that would replace
+            # data preserved for OTHER snaps with the current head
+            # (silent snapshot corruption, r4 review finding)
+            return None
+        data = store.read(self.cid, oid)
+        attrs = dict(store.getattrs(self.cid, oid))
+        omap = store.omap_get(self.cid, oid)
+        t.touch(self.cid, clone)
+        if data:
+            t.write(self.cid, clone, 0, data)
+        attrs["_clsnaps"] = json.dumps(new_snaps).encode()
+        attrs.pop("_pre", None)
+        t.setattrs(self.cid, clone, attrs)
+        if omap:
+            t.omap_setkeys(self.cid, clone, omap)
+        self._clone_idx = None          # clone set changes when t lands
+        return clone
+
+    def _snaptrim(self, t: Transaction, oid: str, snap_id: int) -> list:
+        """Drop snap_id from the object's clones; clones covering no
+        remaining snap are removed (ref: the snap trimmer /
+        PrimaryLogPG::trim_object). Returns touched clone names."""
+        touched = []
+        for cid_, covered in self._clone_list(oid):
+            if snap_id not in covered:
+                continue
+            covered = [s for s in covered if s != snap_id]
+            name = clone_name(oid, cid_)
+            if covered:
+                t.setattrs(self.cid, name,
+                           {"_clsnaps": json.dumps(covered).encode()})
+            else:
+                t.remove(self.cid, name)
+            touched.append(name)
+        if touched:
+            self._clone_idx = None
+        return touched
+
+    # -- watch/notify ------------------------------------------------------
+    async def _do_notify(self, m, oid: str, timeout_ms: int,
+                         payload: bytes) -> None:
+        """Fan a notify out to every watcher and gather acks (ref:
+        PrimaryLogPG::do_osd_op NOTIFY + watch_info_t). Runs as its own
+        task so the op worker is not head-of-line blocked; NOTIFY_ACK
+        ops bypass the worker queue (daemon routes them directly)."""
+        notify_id = self.osd.next_tid()
+        watchers = dict(self._watchers.get(oid, {}))
+        # every watcher is pending BEFORE any send: an ack that races
+        # in while later sends still await must neither be dropped nor
+        # complete the future early (NOTIFY_ACK bypasses the op queue,
+        # so it can arrive mid-loop)
+        pending = set(watchers.keys())
+        fut = asyncio.get_event_loop().create_future()
+        acks: list = []
+        self._notify_waiters[notify_id] = [pending, fut, acks]
+        for (client, cookie), conn in list(watchers.items()):
+            try:
+                await conn.send_message(MWatchNotify(
+                    oid=oid, pgid=self.cid, notify_id=notify_id,
+                    cookie=cookie, payload=payload))
+            except Exception:
+                # dead watcher: drop the registration (the reference
+                # ages watchers out via the watch timeout)
+                self._watchers.get(oid, {}).pop((client, cookie), None)
+                pending.discard((client, cookie))
+        if pending:
+            await asyncio.wait([fut],
+                               timeout=(timeout_ms or 2000) / 1000.0)
+        self._notify_waiters.pop(notify_id, None)
+        await self._reply(m, 0, b"", {
+            "notify_id": notify_id,
+            "acks": sorted(str(k) for k in acks),
+            "timeouts": sorted(str(k) for k in pending - set(acks))})
+
+    def handle_notify_ack(self, client: str, notify_id: int,
+                          cookie: int) -> None:
+        ent = self._notify_waiters.get(notify_id)
+        if ent is None:
+            return
+        pending, fut, acks = ent
+        key = (client, cookie)
+        if key in pending:
+            acks.append(key)
+            pending.discard(key)
+        if not pending and not fut.done():
+            fut.set_result(True)
+
+    # -- pg splitting ------------------------------------------------------
+    def split_objects(self, osdmap, new_pool) -> int:
+        """pg_num grew: move every local object whose name now folds to
+        a CHILD pg seed into that child's collection (ref: PG::
+        split_into + pg_t::is_split — ceph_stable_mod guarantees a
+        child's placement equals the parent's while pgp_num is
+        unchanged, so the split is a local collection move; a later
+        pgp_num bump migrates whole child PGs through normal peering).
+
+        Runs on every replica identically (deterministic name fold), so
+        post-split logs and stores stay consistent across the acting
+        set. Idempotent: re-running moves nothing. Returns the number
+        of objects moved."""
+        self._clone_idx = None          # clones move with their heads
+        import numpy as np
+        from ceph_tpu.osd.types import ObjectLocator, pg_t as _pg_t
+        store = self.osd.store
+        if self.cid not in store.list_collections():
+            return 0
+        moved = 0
+        loc = ObjectLocator(pool=self.pool.id)
+        for oid in list(store.list_objects(self.cid)):
+            if oid == PGMETA:
+                continue
+            # snap clones fold by their HEAD's name (they must stay in
+            # the head's PG)
+            raw = osdmap.object_locator_to_pg(clone_head(oid) or oid,
+                                              loc)
+            # fold the raw hash by the NEW pg_num (the objecter's
+            # _calc_target fold — ceph_stable_mod)
+            seed = int(new_pool.raw_pg_to_pg(
+                np.asarray([raw.seed]), xp=np)[0])
+            if seed == self.pgid.seed:
+                continue
+            child_cid = str(_pg_t(self.pool.id, seed))
+            t = Transaction()
+            if child_cid not in store.list_collections():
+                t.create_collection(child_cid)
+                t.touch(child_cid, PGMETA)
+            try:
+                data = store.read(self.cid, oid)
+                attrs = store.getattrs(self.cid, oid)
+                omap = store.omap_get(self.cid, oid)
+            except StoreError:
+                continue
+            t.touch(child_cid, oid)
+            if data:
+                t.write(child_cid, oid, 0, data)
+            if attrs:
+                t.setattrs(child_cid, oid, attrs)
+            if omap:
+                t.omap_setkeys(child_cid, oid, omap)
+            t.remove(self.cid, oid)
+            store.queue_transaction(t)
+            moved += 1
+        if moved:
+            log.dout(1, f"pg {self.pgid} split: moved {moved} objects "
+                        f"(pg_num -> {new_pool.pg_num})")
+        return moved
+
     # -- recovery ----------------------------------------------------------
     async def _pull(self, from_osd: int, oid: str) -> None:
         """Primary pulls an object it is missing (ref: RecoveryOp pull)."""
@@ -268,7 +532,12 @@ class PG:
             data=data, attrs=attrs, omap=omap,
             from_osd=self.osd.whoami)
 
-    def apply_push(self, m: MOSDPGPush) -> None:
+    def apply_push(self, m: MOSDPGPush) -> bool:
+        """Apply a recovery push. Returns True iff the object durably
+        landed — the caller must only ack on success, because the
+        primary counts an ACKED push as 'recovered' for the durability
+        promotion (_promote_pending_eagain)."""
+        self._clone_idx = None          # pushes can create/replace clones
         t = Transaction()
         if m.exists:
             t.remove(self.cid, m.oid)
@@ -283,10 +552,58 @@ class PG:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
             log.error(f"pg {self.pgid} push apply failed: {e}")
+            return False
         self.my_missing.pop(m.oid, None)
         fut = self._push_waiters.get(m.oid)
         if fut and not fut.done():
             fut.set_result(True)
+        return True
+
+    def handle_push_reply(self, m: MOSDPGPushReply) -> None:
+        fut = self._push_ack_waiters.get((m.from_osd, m.oid))
+        if fut and not fut.done():
+            fut.set_result(True)
+
+    async def _send_gated_pushes(self, sends) -> bool:
+        """Send recovery pushes and gate 'recovered' on the peer's ACK
+        (MOSDPGPushReply): counting at send time would let
+        _promote_pending_eagain flip an -EAGAIN'd write to success
+        while a live acting replica still lacks it. Shared by the
+        replicated and EC recovery paths (they differ only in how the
+        push message is built).
+
+        sends: [(peer_osd, oid, MOSDPGPush)]. Retires acked oids from
+        peer_missing; returns True (and schedules a retry) when a LIVE
+        peer's push went unacked — a down peer is left to the next map
+        change."""
+        acks: list[tuple[int, str, asyncio.Future]] = []
+        for o, oid, push in sends:
+            fut = asyncio.get_event_loop().create_future()
+            self._push_ack_waiters[(o, oid)] = fut
+            try:
+                await self.osd.send_osd(o, push)
+            except Exception as e:
+                log.dout(1, f"pg {self.pgid} push {oid}->{o} "
+                            f"failed: {e}")
+                self._push_ack_waiters.pop((o, oid), None)
+                continue
+            acks.append((o, oid, fut))
+        if acks:
+            await asyncio.wait([f for _, _, f in acks], timeout=5.0)
+        incomplete = False
+        for o, oid, fut in acks:
+            self._push_ack_waiters.pop((o, oid), None)
+            if fut.done():
+                self.peer_missing.get(o, {}).pop(oid, None)
+            elif self.osd.osd_is_up(o):
+                incomplete = True
+        if incomplete:
+            log.dout(1, f"pg {self.pgid} recovery pushes unacked; "
+                        "retrying")
+            loop = asyncio.get_event_loop()
+            loop.call_later(1.0, lambda: asyncio.ensure_future(
+                self._recover()))
+        return incomplete
 
     async def _recover(self) -> None:
         """Push every peer's missing objects (ref: run_recovery_op)."""
@@ -294,19 +611,16 @@ class PG:
             return
         self.state = "recovering" if any(self.peer_missing.values()) \
             else self.state
-        for o, missing in list(self.peer_missing.items()):
-            for oid in list(missing):
-                try:
-                    await self.osd.send_osd(o, self.make_push(oid))
-                except Exception as e:
-                    log.dout(1, f"pg {self.pgid} push {oid}->{o} "
-                                f"failed: {e}")
-                    continue
-                missing.pop(oid, None)
+        sends = [(o, oid, self.make_push(oid))
+                 for o, missing in list(self.peer_missing.items())
+                 for oid in list(missing)]
+        if await self._send_gated_pushes(sends):
+            return
         if not any(self.peer_missing.values()) and \
                 self.state in ("active", "recovering"):
             self.state = "clean" if \
                 len(self.live_acting()) >= self.pool.size else "active"
+            self._promote_pending_eagain()
 
     # -- op execution ------------------------------------------------------
     async def queue_op(self, m: MOSDOp) -> None:
@@ -340,7 +654,8 @@ class PG:
             return
         try:
             await m.conn.send_message(MOSDOpReply(
-                tid=m.tid, result=result, epoch=self.epoch, data=data,
+                tid=m.tid, attempt=getattr(m, "attempt", 0),
+                result=result, epoch=self.epoch, data=data,
                 extra=json.dumps(extra) if extra else ""))
         except Exception:
             pass                          # client resends via objecter
@@ -355,13 +670,21 @@ class PG:
         reqid = (m.src, getattr(m.conn, "peer_session", 0), m.tid)
         mutating = {OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_TRUNCATE,
                     OSD_OP_ZERO, OSD_OP_DELETE, OSD_OP_SETXATTR,
-                    OSD_OP_OMAP_SET}
+                    OSD_OP_OMAP_SET, OSD_OP_SNAPTRIM}
         if any(c in mutating for c in m.op_codes) and \
                 reqid in self._reqid_results:
             # resend of an applied-but-unacked mutation: return the
             # recorded outcome, never re-execute (a DELETE replay would
             # spuriously return -ENOENT; a write would duplicate log
             # entries). ref: PrimaryLogPG::already_complete (reqids)
+            # A recorded -EAGAIN means the op is applied locally but NOT
+            # yet known durable: the dup keeps seeing -EAGAIN (the
+            # objecter backs off and resends) until the late
+            # MOSDRepOpReply or a re-peer + completed recovery promotes
+            # the record to success (ref: PrimaryLogPG::already_complete
+            # only short-circuits dups of committed repops). Replying
+            # immediately — rather than parking the dup on the repop
+            # future — keeps the serialized op worker free.
             result, extra = self._reqid_results[reqid]
             await self._reply(m, result, b"", extra)
             return
@@ -373,23 +696,49 @@ class PG:
         t = Transaction()
         mutated = False
         deleted = False
+        cow_clones: list[str] = []
+        snap_seq = getattr(m, "snap_seq", 0)
+        snapc = list(getattr(m, "snaps", []) or [])
+        snap_id = getattr(m, "snap_id", 0)
+        # snap reads resolve once to the serving object (clone or head)
+        read_oid = oid
+        if snap_id:
+            resolved = self._resolve_snap_read(oid, snap_id)
+            if resolved is None:
+                await self._reply(m, -2, b"", {})           # -ENOENT
+                return
+            read_oid = resolved
+        born_after: list[int] = []
+        if snap_seq and any(c in mutating for c in m.op_codes):
+            # clone-on-write rides in the SAME transaction as the
+            # mutation (atomic on every replica); the clone gets its own
+            # log entry below so log-based recovery tracks it
+            clone = self._maybe_cow(t, oid, snap_seq, snapc)
+            if clone:
+                cow_clones.append(clone)
+            elif not store.exists(cid, oid):
+                # the object is being born after these snaps existed:
+                # mark it (APPENDED after the mutation ops — a WRITEFULL
+                # remove would wipe an earlier xattr) so snap reads at
+                # them say -ENOENT
+                born_after = sorted(s for s in snapc if s <= snap_seq)
         for code, off, length, name, data in m.unpack_ops():
             if code == OSD_OP_READ:
                 try:
                     data_out = store.read(
-                        cid, oid, off, length if length else None)
+                        cid, read_oid, off, length if length else None)
                 except StoreError:
                     await self._reply(m, -2, b"", {})       # -ENOENT
                     return
             elif code == OSD_OP_STAT:
                 try:
-                    extra["size"] = store.stat(cid, oid)
+                    extra["size"] = store.stat(cid, read_oid)
                 except StoreError:
                     await self._reply(m, -2, b"", {})
                     return
             elif code == OSD_OP_GETXATTR:
                 try:
-                    attrs = store.getattrs(cid, oid)
+                    attrs = store.getattrs(cid, read_oid)
                 except StoreError:
                     await self._reply(m, -2, b"", {})
                     return
@@ -399,7 +748,7 @@ class PG:
                 data_out = attrs[name]
             elif code == OSD_OP_OMAP_GET:
                 try:
-                    omap = store.omap_get(cid, oid)
+                    omap = store.omap_get(cid, read_oid)
                 except StoreError:
                     await self._reply(m, -2, b"", {})
                     return
@@ -407,8 +756,23 @@ class PG:
                                  if not k.startswith("_")}
             elif code == OSD_OP_PGLS:
                 objs = [o for o in store.list_objects(cid)
-                        if o != PGMETA]
+                        if o != PGMETA and clone_head(o) is None]
                 extra["objects"] = objs
+            elif code == OSD_OP_WATCH:
+                self._watchers.setdefault(oid, {})[(m.src, off)] = m.conn
+            elif code == OSD_OP_UNWATCH:
+                self._watchers.get(oid, {}).pop((m.src, off), None)
+            elif code == OSD_OP_NOTIFY:
+                asyncio.ensure_future(
+                    self._do_notify(m, oid, off, data))
+                return                      # replies when acks are in
+            elif code == OSD_OP_NOTIFY_ACK:
+                self.handle_notify_ack(m.src, off, length)
+            elif code == OSD_OP_SNAPTRIM:
+                touched = self._snaptrim(t, oid, off)
+                if touched:
+                    mutated = True
+                    cow_clones.extend(touched)
             elif code == OSD_OP_WRITE:
                 t.write(cid, oid, off, data)
                 mutated = True
@@ -449,34 +813,71 @@ class PG:
         if not mutated:
             await self._reply(m, 0, data_out, extra)
             return
-        result, applied = await self._submit_write(oid, t, deleted)
+        if born_after and not deleted:
+            t.setattrs(cid, oid,
+                       {"_pre": json.dumps(born_after).encode()})
+        result, applied, waiter = await self._submit_write(
+            oid, t, deleted, reqid, extra_oids=cow_clones)
+        if result == -11 and waiter is not None and waiter.done():
+            # the last reply landed between the timeout firing and this
+            # task resuming: the repop IS fully committed — without this
+            # check the -11 would be recorded with the waiter already
+            # popped, and nothing could ever promote it
+            result = 0
         extra["version"] = str(self.pg_log.head)
         if applied:
-            # The op is in the pg log: once the PG is active in any
-            # later interval, log-based recovery has made it durable on
-            # the whole acting set, so a RESEND must see success rather
-            # than a re-execution (ref: PrimaryLogPG::already_complete).
-            # A repop-timeout -EAGAIN is therefore recorded as 0 for
-            # dedup while the CURRENT attempt still reports -EAGAIN.
-            self._reqid_results[reqid] = (0 if result == -11 else result,
-                                          extra)
+            # The op is in the pg log, so a RESEND must never re-execute
+            # (a DELETE replay would return -ENOENT; a write would
+            # duplicate log entries) — but a repop-timeout -EAGAIN is
+            # recorded AS -EAGAIN: dups keep seeing -EAGAIN until the
+            # repop commits on every live acting replica (late reply) or
+            # a re-peer + recovery has made the log durable on the new
+            # acting set (_promote_pending_eagain). Recording 0 here
+            # immediately (round 3) let a dup be acked with fewer than
+            # min_size durable copies (ADVICE.md round 3, medium).
+            self._reqid_results[reqid] = (result, extra)
         if len(self._reqid_results) > 2000:      # bounded (log-trim analog)
+            kept_eagain = 0
             for k in list(self._reqid_results)[:1000]:
+                if self._reqid_results.get(k, (0,))[0] == -11 and \
+                        kept_eagain < 500:
+                    # keep -EAGAIN entries awaiting promotion — but
+                    # only a bounded number: a wedged replica would
+                    # otherwise grow the table by one per timed-out
+                    # write forever. Beyond the cap the oldest are
+                    # evicted like any trimmed reqid: a later dup
+                    # re-executes, which is the reference's semantics
+                    # once a reqid ages out of the pg log's dup window.
+                    kept_eagain += 1
+                    continue
                 self._reqid_results.pop(k, None)
         await self._reply(m, result, data_out, extra)
 
-    async def _submit_write(self, oid: str, t: Transaction,
-                            deleted: bool) -> tuple[int, bool]:
+    async def _submit_write(self, oid: str, t: Transaction, deleted: bool,
+                            reqid: tuple,
+                            extra_oids: list[str] | None = None) -> tuple:
         """The replication pipeline (ref: ReplicatedBackend::
-        submit_transaction + issue_repop). Returns (result, applied):
-        ``applied`` is True iff the op landed in the local store+log
-        (it may still report -EAGAIN when replicas never confirmed)."""
+        submit_transaction + issue_repop). Returns (result, applied,
+        waiter): ``applied`` is True iff the op landed in the local
+        store+log (it may still report -EAGAIN when replicas never
+        confirmed — the repop record stays registered, marked
+        timed_out, so a late reply can complete it and promote the
+        dedup result)."""
         if len(self.live_acting()) < self.pool.min_size:
-            return -11, False                           # -EAGAIN
+            return -11, False, None                     # -EAGAIN
         self.last_user_version += 1
         version = eversion(self.epoch, self.last_user_version)
         entry = self.pg_log.add(
             version, oid, OP_DELETE if deleted else OP_MODIFY)
+        # snap clones created/trimmed in this txn get their own log
+        # entries so peering's missing computation recovers them too —
+        # shipped to replicas alongside the head entry
+        extra_entries = []
+        for clone_oid in (extra_oids or []):
+            self.last_user_version += 1
+            extra_entries.append(self.pg_log.add(
+                eversion(self.epoch, self.last_user_version),
+                clone_oid, OP_MODIFY))
         self.pg_log.trim()
         if not deleted:
             t.setattrs(self.cid, oid, {"_v":
@@ -490,36 +891,59 @@ class PG:
         waiter = None
         if replicas:
             waiter = asyncio.get_event_loop().create_future()
-            self._repop_waiters[tid] = (set(replicas), waiter)
+            self._repop_waiters[tid] = [set(replicas), waiter, reqid,
+                                        False]
         try:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
             log.error(f"pg {self.pgid} local commit failed: {e}")
             self._repop_waiters.pop(tid, None)
-            return -5, False
+            return -5, False, waiter
         for o in replicas:
             await self.osd.send_osd(o, MOSDRepOp(
                 tid=tid, epoch=self.epoch, pgid=self.cid,
-                txn=txn_blob, log_entry=entry.encode()))
+                txn=txn_blob, log_entry=entry.encode(),
+                extra_log=[e.encode() for e in extra_entries]))
         if waiter is not None:
-            try:
-                await asyncio.wait_for(waiter, timeout=5.0)
-            except asyncio.TimeoutError:
-                # A replica never committed: the client MUST NOT see
+            # asyncio.wait (NOT wait_for): wait_for CANCELS the future
+            # on timeout, which would make it impossible for a late
+            # MOSDRepOpReply to ever complete the repop — and dups of
+            # the -EAGAIN'd op would stay -EAGAIN until re-peer even
+            # though every replica committed.
+            done, _ = await asyncio.wait(
+                [waiter],
+                timeout=self.osd.config.get("osd_repop_timeout", 5.0))
+            if not done:
+                # A replica never confirmed: the client MUST NOT see
                 # success, or a subsequent primary failure could lose an
                 # acknowledged write (ref: ReplicatedBackend's
                 # all-replica-commit-before-ack contract). -EAGAIN makes
                 # the objecter resend once the map moves and the PG
-                # re-peers.
+                # re-peers. The record stays in _repop_waiters, marked
+                # timed_out: a late reply promotes the recorded dedup
+                # result to success (handle_rep_reply).
+                ent = self._repop_waiters.get(tid)
+                if ent is not None:
+                    ent[3] = True
+                # bound the timed-out backlog: under a wedged-but-up
+                # replica every write parks a record here; beyond the
+                # cap the oldest are dropped (their dup entries age out
+                # of _reqid_results the same way — reference semantics
+                # once a reqid leaves the pg log's dup window)
+                stale = [t_ for t_, e_ in self._repop_waiters.items()
+                         if e_[3]]
+                for t_ in stale[:-500]:
+                    self._repop_waiters.pop(t_, None)
                 log.dout(1, f"pg {self.pgid} repop {tid} timed out")
-                return -11, True                        # -EAGAIN
-            finally:
-                self._repop_waiters.pop(tid, None)
-        return 0, True
+                return -11, True, waiter                # -EAGAIN
+            self._repop_waiters.pop(tid, None)
+        return 0, True, waiter
 
     def handle_rep_op(self, m: MOSDRepOp) -> None:
         """Replica applies the shipped transaction (ref:
         ReplicatedBackend::do_repop)."""
+        self._clone_idx = None      # the txn may create/trim clones; a
+        # later re-promotion to primary must not serve a stale index
         entry = LogEntry.decode(m.log_entry)
         t = Transaction.decode(m.txn)
         try:
@@ -528,6 +952,11 @@ class PG:
             log.error(f"pg {self.pgid} repop apply failed: {e}")
             return
         self.pg_log.append(entry)
+        for blob in getattr(m, "extra_log", None) or []:
+            e2 = LogEntry.decode(blob)
+            self.pg_log.append(e2)
+            self.last_user_version = max(self.last_user_version,
+                                         e2.version.v)
         self.pg_log.trim()
         self.last_user_version = max(self.last_user_version,
                                      entry.version.v)
@@ -547,10 +976,49 @@ class PG:
         ent = self._repop_waiters.get(m.tid)
         if ent is None:
             return
-        pending, fut = ent
+        pending, fut, reqid, timed_out = ent
         pending.discard(m.from_osd)
-        if not pending and not fut.done():
-            fut.set_result(True)
+        if not pending:
+            if not fut.done():
+                fut.set_result(True)
+            self._repop_waiters.pop(m.tid, None)
+            if timed_out:
+                # Late completion of a timed-out repop: every live
+                # acting replica has now committed, so dups of the
+                # -EAGAIN'd op may see success. (If the client task has
+                # not recorded the -11 yet, its waiter.done() check in
+                # _execute sees the completion instead.)
+                self._promote(reqid)
+
+    def _promote(self, reqid: tuple) -> None:
+        res = self._reqid_results.get(reqid)
+        if res and res[0] == -11:
+            self._reqid_results[reqid] = (0, res[1])
+
+    def _promote_pending_eagain(self) -> None:
+        """A re-peer + acked recovery has made every pg-log entry
+        durable on the (new) live acting set — writes whose repop timed
+        out in an earlier interval are now recoverable from any acting
+        member, so their dedup results flip from -EAGAIN to success
+        (the 'log-based recovery has made it durable' argument, gated
+        on recovery pushes actually being ACKED, not merely sent).
+        Only timed-out records are touched: in-flight repops of the
+        current interval keep their waiters. A record whose
+        never-replied replica is STILL live in the current acting set
+        must NOT promote — recovery completing for older objects says
+        nothing about this write, which was logged after peering and so
+        was never in peer_missing (r4 review finding: promoting it
+        would ack a write a live acting replica lacks)."""
+        for tid, ent in list(self._repop_waiters.items()):
+            if not ent[3]:                # not timed out: still in flight
+                continue
+            if any(r in self.acting and self.osd.osd_is_up(r)
+                   for r in ent[0]):
+                continue                  # wedged live replica: keep -EAGAIN
+            self._repop_waiters.pop(tid, None)
+            self._promote(ent[2])
+            if not ent[1].done():
+                ent[1].set_result(True)
 
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:

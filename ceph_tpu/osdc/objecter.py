@@ -36,11 +36,18 @@ class Objecter(Dispatcher):
         self.msgr = monc.msgr
         self.msgr.add_dispatcher(self)
         self._tid = 0
-        self._waiters: dict[int, asyncio.Future] = {}
+        # keyed on (tid, attempt): the tid is the LOGICAL op id (stable
+        # across resends for OSD-side dedup), but a late reply from a
+        # timed-out earlier attempt must not resolve a newer attempt's
+        # waiter — for reads that would surface a result captured before
+        # the retry's map refresh (ref: Objecter op->attempts /
+        # MOSDOp::get_retry_attempt).
+        self._waiters: dict[tuple[int, int], asyncio.Future] = {}
 
     async def ms_dispatch(self, msg) -> bool:
         if isinstance(msg, MOSDOpReply):
-            fut = self._waiters.pop(msg.tid, None)
+            fut = self._waiters.pop(
+                (msg.tid, getattr(msg, "attempt", 0)), None)
             if fut and not fut.done():
                 fut.set_result(msg)
             return True
@@ -65,9 +72,12 @@ class Objecter(Dispatcher):
         raise ObjectOperationError(-2, f"no pool {name!r}")
 
     async def op_submit(self, pool_id: int, oid: str, ops: list[tuple],
-                        timeout: float = 20.0, seed: int | None = None):
+                        timeout: float = 20.0, seed: int | None = None,
+                        snapc: tuple | None = None, snap_id: int = 0):
         """Send one op bundle; retries across map changes.
         ``seed`` overrides name hashing for PG-targeted ops (pgls).
+        ``snapc``/``snap_id``: self-managed snap write context / read
+        snap (ref: Objecter::Op snapc+snapid).
         Returns (result, data, extra_dict)."""
         deadline = asyncio.get_event_loop().time() + timeout
         attempt = 0
@@ -93,11 +103,12 @@ class Objecter(Dispatcher):
                 continue
             host, port, _hb = osdmap.osd_addrs[primary]
             fut = asyncio.get_event_loop().create_future()
-            self._waiters[tid] = fut
+            self._waiters[(tid, attempt)] = fut
             try:
                 await self.msgr.send_message(
                     make_osd_op(tid, osdmap.epoch, pool_id, pg_seed,
-                                oid, ops),
+                                oid, ops, attempt=attempt,
+                                snapc=snapc, snap_id=snap_id),
                     EntityAddr(host, port), f"osd.{primary}")
                 reply = await asyncio.wait_for(
                     fut, timeout=min(5.0 + attempt,
@@ -105,7 +116,7 @@ class Objecter(Dispatcher):
                                      asyncio.get_event_loop().time()))
             except (asyncio.TimeoutError, ConnectionError, OSError,
                     ConnectionError_):
-                self._waiters.pop(tid, None)
+                self._waiters.pop((tid, attempt), None)
                 attempt += 1
                 await self._refresh_map(osdmap)
                 continue

@@ -11,18 +11,49 @@ from __future__ import annotations
 
 import json
 
+import itertools
+
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.mon.monitor import MonMap
-from ceph_tpu.msg import Keyring
+from ceph_tpu.msg import Dispatcher, Keyring
 from ceph_tpu.osd.messages import (
-    OSD_OP_DELETE, OSD_OP_GETXATTR, OSD_OP_OMAP_GET, OSD_OP_OMAP_RM,
+    MWatchNotify,
+    OSD_OP_DELETE, OSD_OP_GETXATTR, OSD_OP_NOTIFY, OSD_OP_NOTIFY_ACK,
+    OSD_OP_OMAP_GET, OSD_OP_OMAP_RM,
     OSD_OP_OMAP_SET, OSD_OP_PGLS, OSD_OP_READ, OSD_OP_SETXATTR,
-    OSD_OP_STAT, OSD_OP_TRUNCATE, OSD_OP_WRITE, OSD_OP_WRITEFULL,
+    OSD_OP_SNAPTRIM, OSD_OP_STAT, OSD_OP_TRUNCATE, OSD_OP_UNWATCH,
+    OSD_OP_WATCH, OSD_OP_WRITE, OSD_OP_WRITEFULL,
     OSD_OP_ZERO,
 )
 from ceph_tpu.osdc.objecter import Objecter, ObjectOperationError
 
 __all__ = ["Rados", "IoCtx", "ObjectOperationError"]
+
+
+class _WatchDispatcher(Dispatcher):
+    """Delivers MWatchNotify to registered callbacks and auto-acks
+    (ref: librados watch callback + notify_ack)."""
+
+    def __init__(self, rados: "Rados"):
+        self.rados = rados
+
+    async def ms_dispatch(self, msg) -> bool:
+        if not isinstance(msg, MWatchNotify):
+            return False
+        ent = self.rados._watches.get(msg.cookie)
+        if ent is not None:
+            ioctx, oid, cb = ent
+            try:
+                res = cb(msg.notify_id, msg.payload)
+                if hasattr(res, "__await__"):
+                    await res
+            except Exception:
+                pass
+            # ack so the notifier's collection completes
+            import asyncio
+            asyncio.ensure_future(ioctx._op(oid, [
+                (OSD_OP_NOTIFY_ACK, msg.notify_id, msg.cookie, "", b"")]))
+        return True
 
 
 class Rados:
@@ -32,6 +63,10 @@ class Rados:
                  keyring: Keyring | None = None):
         self.monc = MonClient(name, monmap, keyring=keyring)
         self.objecter = Objecter(self.monc)
+        # cookie -> (ioctx, oid, callback)
+        self._watches: dict[int, tuple] = {}
+        self._cookie_gen = itertools.count(1)
+        self.monc.msgr.add_dispatcher(_WatchDispatcher(self))
 
     async def connect(self) -> None:
         await self.monc.subscribe("osdmap", 0)
@@ -76,31 +111,116 @@ class IoCtx:
         self.rados = rados
         self.pool_id = pool_id
         self.pool_name = pool_name
+        # self-managed snap state (ref: IoCtx::selfmanaged_snap_set_
+        # write_ctx / snap_set_read)
+        self.snapc: tuple[int, list[int]] = (0, [])
+        self.read_snap: int = 0
 
-    async def _op(self, oid: str, ops, timeout: float = 20.0):
+    def set_snap_context(self, seq: int, snaps: list[int]) -> None:
+        """Write snap context: seq = newest snap id, snaps = all live
+        snap ids (newest first, like the reference)."""
+        self.snapc = (seq, list(snaps))
+
+    def snap_set_read(self, snap_id: int) -> None:
+        """Subsequent reads serve the object state AT this snap
+        (0 = head)."""
+        self.read_snap = snap_id
+
+    # ops that serve object STATE and therefore honor read_snap; any
+    # other op (mutations, watch/unwatch/notify, notify-ack) must go to
+    # the head regardless of snap_set_read — librados applies the read
+    # snap to reads only (ref: IoCtx::snap_set_read)
+    _SNAP_READ_OPS = frozenset((
+        OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR, OSD_OP_OMAP_GET))
+
+    async def _op(self, oid: str, ops, timeout: float = 20.0,
+                  snapc: tuple | None = None, snap_id: int | None = None):
+        if snapc is None:
+            snapc = self.snapc if self.snapc[0] else None
+        if snap_id is None:
+            snap_id = self.read_snap if ops and all(
+                o[0] in self._SNAP_READ_OPS for o in ops) else 0
         res, data, extra = await self.rados.objecter.op_submit(
-            self.pool_id, oid, ops, timeout=timeout)
+            self.pool_id, oid, ops, timeout=timeout,
+            snapc=snapc, snap_id=snap_id)
         if res < 0:
             raise ObjectOperationError(res, f"{oid}")
         return data, extra
 
+    # -- self-managed snapshots -------------------------------------------
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a new snap id from the pool (ref: librados
+        selfmanaged_snap_create -> OSDMonitor pool snap_seq)."""
+        ret, rs, out = await self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap-create",
+             "pool": self.pool_name})
+        if ret != 0:
+            raise ObjectOperationError(ret, rs)
+        return json.loads(out)["snapid"]
+
+    async def selfmanaged_snap_remove(self, snap_id: int) -> None:
+        ret, rs, _ = await self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap-remove",
+             "pool": self.pool_name, "snapid": snap_id})
+        if ret != 0:
+            raise ObjectOperationError(ret, rs)
+
+    async def snap_trim(self, oid: str, snap_id: int) -> None:
+        """Drop one snap from one object's clones (the snap trimmer's
+        unit of work, client-driven here)."""
+        await self._op(oid, [(OSD_OP_SNAPTRIM, snap_id, 0, "", b"")])
+
+    # -- watch/notify ------------------------------------------------------
+    async def watch(self, oid: str, callback) -> int:
+        """Register callback(notify_id, payload) for notifies on oid;
+        returns the watch cookie (ref: IoCtx::watch2)."""
+        cookie = next(self.rados._cookie_gen)
+        self.rados._watches[cookie] = (self, oid, callback)
+        try:
+            await self._op(oid, [(OSD_OP_WATCH, cookie, 0, "", b"")])
+        except BaseException:
+            self.rados._watches.pop(cookie, None)   # no leak on failure
+            raise
+        return cookie
+
+    async def unwatch(self, oid: str, cookie: int) -> None:
+        self.rados._watches.pop(cookie, None)
+        await self._op(oid, [(OSD_OP_UNWATCH, cookie, 0, "", b"")])
+
+    async def notify(self, oid: str, payload: bytes = b"",
+                     timeout_ms: int = 2000) -> dict:
+        """Send payload to every watcher, await their acks (ref:
+        IoCtx::notify2). Returns {'acks': [...], 'timeouts': [...]}."""
+        _, extra = await self._op(
+            oid, [(OSD_OP_NOTIFY, timeout_ms, 0, "", bytes(payload))],
+            timeout=max(20.0, timeout_ms / 1000 + 5))
+        return extra
+
     # -- writes ------------------------------------------------------------
-    async def write(self, oid: str, data: bytes, offset: int = 0):
+    async def write(self, oid: str, data: bytes, offset: int = 0,
+                    timeout: float = 20.0, snapc: tuple | None = None):
         await self._op(oid, [(OSD_OP_WRITE, offset, len(data), "",
-                              bytes(data))])
+                              bytes(data))], timeout=timeout, snapc=snapc)
 
-    async def write_full(self, oid: str, data: bytes):
+    async def write_full(self, oid: str, data: bytes,
+                         timeout: float = 20.0,
+                         snapc: tuple | None = None):
         await self._op(oid, [(OSD_OP_WRITEFULL, 0, len(data), "",
-                              bytes(data))])
+                              bytes(data))], timeout=timeout, snapc=snapc)
 
-    async def truncate(self, oid: str, size: int):
-        await self._op(oid, [(OSD_OP_TRUNCATE, size, 0, "", b"")])
+    async def truncate(self, oid: str, size: int,
+                       snapc: tuple | None = None):
+        await self._op(oid, [(OSD_OP_TRUNCATE, size, 0, "", b"")],
+                       snapc=snapc)
 
-    async def zero(self, oid: str, offset: int, length: int):
-        await self._op(oid, [(OSD_OP_ZERO, offset, length, "", b"")])
+    async def zero(self, oid: str, offset: int, length: int,
+                   snapc: tuple | None = None):
+        await self._op(oid, [(OSD_OP_ZERO, offset, length, "", b"")],
+                       snapc=snapc)
 
-    async def remove(self, oid: str):
-        await self._op(oid, [(OSD_OP_DELETE, 0, 0, "", b"")])
+    async def remove(self, oid: str, snapc: tuple | None = None):
+        await self._op(oid, [(OSD_OP_DELETE, 0, 0, "", b"")],
+                       snapc=snapc)
 
     async def setxattr(self, oid: str, name: str, value: bytes):
         await self._op(oid, [(OSD_OP_SETXATTR, 0, 0, name,
@@ -115,13 +235,15 @@ class IoCtx:
 
     # -- reads -------------------------------------------------------------
     async def read(self, oid: str, length: int = 0,
-                   offset: int = 0) -> bytes:
+                   offset: int = 0, snap_id: int | None = None) -> bytes:
         data, _ = await self._op(
-            oid, [(OSD_OP_READ, offset, length, "", b"")])
+            oid, [(OSD_OP_READ, offset, length, "", b"")],
+            snap_id=snap_id)
         return data
 
-    async def stat(self, oid: str) -> int:
-        _, extra = await self._op(oid, [(OSD_OP_STAT, 0, 0, "", b"")])
+    async def stat(self, oid: str, snap_id: int | None = None) -> int:
+        _, extra = await self._op(oid, [(OSD_OP_STAT, 0, 0, "", b"")],
+                                  snap_id=snap_id)
         return extra["size"]
 
     async def getxattr(self, oid: str, name: str) -> bytes:

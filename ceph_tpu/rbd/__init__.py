@@ -5,8 +5,17 @@ object (``rbd_header.<name>``: size/order/features in omap) plus data
 objects ``rbd_data.<name>.<N>`` of ``2^order`` bytes each; image I/O
 maps byte extents onto those objects exactly like the reference's
 Striper (ref: src/osdc/Striper.cc with stripe_count=1). The API keeps
-the reference's names: RBD.create/list/remove, Image.read/write/
-resize/size/stat.
+the reference's names: RBD.create/list/remove/clone, Image.read/write/
+resize/size/stat/snap_*.
+
+Snapshots (round 4) ride the RADOS self-managed snap machinery
+(ref: librbd snapshots are selfmanaged snaps + the image snapc):
+snap_create allocates a pool snap id and records it in the header;
+writes carry the image snap context so the OSD clones-on-write; reads
+of an Image opened at a snapshot pass the snap id down. Clones are
+copy-on-write children referencing a PROTECTED parent snapshot with
+client-side fallthrough reads and copy-up on first write, like the
+reference's layering (ref: src/librbd/io/CopyupRequest).
 
 This is also this framework's libradosstriper seat: large-object
 striping over many RADOS objects, client-side.
@@ -57,8 +66,11 @@ class RBD:
             return []
 
     async def remove(self, name: str) -> None:
-        """ref: RBD::remove — data objects, header, directory entry."""
+        """ref: RBD::remove — data objects, header, directory entry.
+        Refuses while snapshots exist (like the reference)."""
         img = await self.open(name)
+        if img.snaps:
+            raise ObjectOperationError(-39, "image has snapshots")
         for idx in img._object_range(0, img.size_bytes):
             try:
                 await self.ioctx.remove(_data(name, idx))
@@ -69,8 +81,24 @@ class RBD:
             await self.ioctx.rm_omap_key(RBD_DIRECTORY, name)
         except ObjectOperationError:
             pass
+        # a removed clone must drop off its parent's children list, or
+        # the parent snap can never be unprotected/removed (ref:
+        # librbd::image::RemoveRequest child detach)
+        parent_ref = img.meta.get("parent")
+        if parent_ref:
+            try:
+                parent = await self.open(parent_ref["image"])
+                kids = parent.meta.get("children", [])
+                kept = [c for c in kids if c[0] != name]
+                if kept != kids:
+                    parent.meta["children"] = kept
+                    await parent._save_meta()
+            except ObjectOperationError:
+                pass                    # parent already gone
 
-    async def open(self, name: str) -> "Image":
+    async def open(self, name: str, snapshot: str | None = None) -> "Image":
+        """ref: RBD::open / Image::snap_set — ``snapshot`` opens a
+        read-only view at that snap."""
         io = self.ioctx
         try:
             omap = await io.get_omap_vals(_header(name))
@@ -79,18 +107,160 @@ class RBD:
         if "meta" not in omap:
             raise ObjectOperationError(-2, f"no image {name}")
         meta = json.loads(omap["meta"])
-        return Image(io, name, meta["size"], meta["order"])
+        img = Image(io, name, meta["size"], meta["order"], meta=meta,
+                    rbd=self)
+        if snapshot is not None:
+            if snapshot not in img.snaps:
+                raise ObjectOperationError(-2, f"no snap {snapshot}")
+            img.snap_name = snapshot
+            img.snap_id = img.snaps[snapshot]["id"]
+            img.size_bytes = img.snaps[snapshot]["size"]
+        return img
+
+    async def clone(self, parent_name: str, snap_name: str,
+                    child_name: str) -> None:
+        """Copy-on-write child of a PROTECTED parent snapshot
+        (ref: RBD::clone; parent must be protected first)."""
+        parent = await self.open(parent_name)
+        snap = parent.snaps.get(snap_name)
+        if snap is None:
+            raise ObjectOperationError(-2, f"no snap {snap_name}")
+        if snap_name not in parent.meta.get("protected", []):
+            raise ObjectOperationError(-22,
+                                       f"snap {snap_name} not protected")
+        existing = await self.list()
+        if child_name in existing:
+            raise ObjectOperationError(-17, f"image {child_name} exists")
+        meta = {"size": snap["size"], "order": parent.order,
+                "parent": {"image": parent_name, "snap": snap_name,
+                           "snap_id": snap["id"]}}
+        await self.ioctx.set_omap(_header(child_name), "meta",
+                                  json.dumps(meta).encode())
+        await self.ioctx.set_omap(RBD_DIRECTORY, child_name, b"1")
+        # record the child on the parent so protected snaps with
+        # children refuse removal (ref: rbd_children tracking)
+        children = parent.meta.setdefault("children", [])
+        if [child_name, snap_name] not in children:
+            children.append([child_name, snap_name])
+            await parent._save_meta()
 
 
 class Image:
     """ref: librbd::Image — byte-addressed I/O over the data objects."""
 
-    def __init__(self, ioctx: IoCtx, name: str, size: int, order: int):
+    def __init__(self, ioctx: IoCtx, name: str, size: int, order: int,
+                 meta: dict | None = None, rbd: "RBD | None" = None):
         self.ioctx = ioctx
         self.name = name
         self.size_bytes = size
         self.order = order
         self.obj_size = 1 << order
+        self.meta = meta if meta is not None else {"size": size,
+                                                   "order": order}
+        self.rbd = rbd
+        # snaps: name -> {"id": snapid, "size": size_at_snap}
+        self.snaps: dict[str, dict] = self.meta.get("snaps", {})
+        self.snap_name: str | None = None    # opened-at-snap view
+        self.snap_id = 0
+        self.parent = self.meta.get("parent")
+
+    # -- snapshot plumbing -------------------------------------------------
+    def _snapc(self) -> tuple | None:
+        """The image's write snap context: (newest id, all ids desc)
+        (ref: librbd ImageCtx::snapc)."""
+        ids = sorted((s["id"] for s in self.snaps.values()),
+                     reverse=True)
+        return (ids[0], ids) if ids else None
+
+    async def _save_meta(self) -> None:
+        self.meta["size"] = self.size_bytes
+        self.meta["order"] = self.order
+        self.meta["snaps"] = self.snaps
+        await self.ioctx.set_omap(_header(self.name), "meta",
+                                  json.dumps(self.meta).encode())
+
+    def _assert_writable(self) -> None:
+        if self.snap_name is not None:
+            raise ObjectOperationError(-30, "snapshot view is read-only")
+
+    async def snap_create(self, snap_name: str) -> int:
+        """ref: Image::snap_create — allocate a self-managed snap id,
+        record it; subsequent writes clone-on-write at the OSD."""
+        self._assert_writable()
+        if snap_name in self.snaps:
+            raise ObjectOperationError(-17, f"snap {snap_name} exists")
+        sid = await self.ioctx.selfmanaged_snap_create()
+        self.snaps[snap_name] = {"id": sid, "size": self.size_bytes}
+        await self._save_meta()
+        return sid
+
+    async def snap_list(self) -> list[dict]:
+        return [{"name": n, "id": s["id"], "size": s["size"]}
+                for n, s in sorted(self.snaps.items(),
+                                   key=lambda kv: kv[1]["id"])]
+
+    async def snap_protect(self, snap_name: str) -> None:
+        if snap_name not in self.snaps:
+            raise ObjectOperationError(-2, f"no snap {snap_name}")
+        prot = self.meta.setdefault("protected", [])
+        if snap_name not in prot:
+            prot.append(snap_name)
+            await self._save_meta()
+
+    async def snap_unprotect(self, snap_name: str) -> None:
+        children = [c for c in self.meta.get("children", [])
+                    if c[1] == snap_name]
+        if children:
+            raise ObjectOperationError(-16, "snap has clone children")
+        prot = self.meta.setdefault("protected", [])
+        if snap_name in prot:
+            prot.remove(snap_name)
+            await self._save_meta()
+
+    async def snap_remove(self, snap_name: str) -> None:
+        """ref: Image::snap_remove — trims the snap from every data
+        object's clones, then drops it from the header and pool."""
+        snap = self.snaps.get(snap_name)
+        if snap is None:
+            raise ObjectOperationError(-2, f"no snap {snap_name}")
+        if snap_name in self.meta.get("protected", []):
+            raise ObjectOperationError(-16, f"snap {snap_name} protected")
+        top = max(self.size_bytes, snap["size"])
+        for idx in self._object_range(0, top):
+            try:
+                await self.ioctx.snap_trim(_data(self.name, idx),
+                                           snap["id"])
+            except ObjectOperationError:
+                pass
+        await self.ioctx.selfmanaged_snap_remove(snap["id"])
+        self.snaps.pop(snap_name, None)
+        await self._save_meta()
+
+    async def snap_rollback(self, snap_name: str) -> None:
+        """ref: Image::snap_rollback — per-object restore of the snap
+        state (itself snapc-protected, so newer snaps still see the
+        pre-rollback data)."""
+        self._assert_writable()
+        snap = self.snaps.get(snap_name)
+        if snap is None:
+            raise ObjectOperationError(-2, f"no snap {snap_name}")
+        sid = snap["id"]
+        snapc = self._snapc()
+        top = max(self.size_bytes, snap["size"])
+        for idx in self._object_range(0, top):
+            oid = _data(self.name, idx)
+            try:
+                old = await self.ioctx.read(oid, snap_id=sid)
+            except ObjectOperationError:
+                # object absent at snap time: drop the head too
+                try:
+                    await self.ioctx.remove(oid, snapc=snapc)
+                except ObjectOperationError:
+                    pass
+                continue
+            await self.ioctx.write_full(oid, old, snapc=snapc)
+        self.size_bytes = snap["size"]
+        await self._save_meta()
 
     def _object_range(self, offset: int, length: int) -> list[int]:
         if length <= 0:
@@ -102,23 +272,58 @@ class Image:
     async def size(self) -> int:
         return self.size_bytes
 
+    async def _parent_image(self) -> "Image":
+        if getattr(self, "_parent_img", None) is None:
+            self._parent_img = await self.rbd.open(
+                self.parent["image"], snapshot=self.parent["snap"])
+        return self._parent_img
+
+    async def _copyup(self, idx: int) -> None:
+        """First write to a cloned object: materialize the parent
+        snap's content in the child first (ref: io/CopyupRequest)."""
+        oid = _data(self.name, idx)
+        try:
+            await self.ioctx.stat(oid)
+            return                          # child object exists
+        except ObjectOperationError as e:
+            if e.errno != -2:
+                # a timeout/transport error is NOT "absent": assuming
+                # so would overwrite newer child data with the parent
+                # snapshot's content (r4 review finding)
+                raise
+        parent = await self._parent_image()
+        off = idx * self.obj_size
+        if off >= parent.size_bytes:
+            return
+        data = await parent.read(off, self.obj_size)
+        if data.rstrip(b"\x00"):
+            await self.ioctx.write_full(oid, data, snapc=self._snapc())
+
     async def write(self, offset: int, data: bytes) -> int:
-        """ref: Image::write — extent-split across data objects."""
+        """ref: Image::write — extent-split across data objects; the
+        image snapc rides every object write (clone-on-write for
+        snapshots); clone children copy-up before the first write."""
+        self._assert_writable()
         if offset + len(data) > self.size_bytes:
             raise ObjectOperationError(-27, "write past image size")
+        snapc = self._snapc()
         done = 0
         while done < len(data):
             abs_off = offset + done
             idx = abs_off // self.obj_size
             within = abs_off % self.obj_size
             n = min(self.obj_size - within, len(data) - done)
+            if self.parent is not None:
+                await self._copyup(idx)
             await self.ioctx.write(_data(self.name, idx),
-                                   data[done:done + n], offset=within)
+                                   data[done:done + n], offset=within,
+                                   snapc=snapc)
             done += n
         return done
 
     async def read(self, offset: int, length: int) -> bytes:
-        """ref: Image::read — absent data objects read as zeros."""
+        """ref: Image::read — absent data objects read as zeros; clone
+        children fall through to the parent snapshot (layering)."""
         length = min(length, max(self.size_bytes - offset, 0))
         out = bytearray(length)
         done = 0
@@ -129,33 +334,44 @@ class Image:
             n = min(self.obj_size - within, length - done)
             try:
                 piece = await self.ioctx.read(
-                    _data(self.name, idx), length=n, offset=within)
+                    _data(self.name, idx), length=n, offset=within,
+                    snap_id=self.snap_id)
                 out[done:done + len(piece)] = piece
-            except ObjectOperationError:
-                pass                       # sparse: zeros
+            except ObjectOperationError as e:
+                if e.errno != -2:
+                    raise   # timeout/transport error != sparse object
+                if self.parent is not None:
+                    parent = await self._parent_image()
+                    if abs_off < parent.size_bytes:
+                        piece = await parent.read(abs_off, n)
+                        out[done:done + len(piece)] = piece
+                # else sparse: zeros
             done += n
         return bytes(out)
 
     async def resize(self, new_size: int) -> None:
-        """ref: Image::resize — shrink drops whole trailing objects."""
+        """ref: Image::resize — shrink drops whole trailing objects
+        (snapc-protected, so snapshots keep the dropped data)."""
+        self._assert_writable()
+        snapc = self._snapc()
         if new_size < self.size_bytes:
             for idx in self._object_range(
                     new_size, self.size_bytes - new_size):
                 if idx * self.obj_size >= new_size:
                     try:
-                        await self.ioctx.remove(_data(self.name, idx))
+                        await self.ioctx.remove(_data(self.name, idx),
+                                                snapc=snapc)
                     except ObjectOperationError:
                         pass
                 elif new_size % self.obj_size:
                     try:
                         await self.ioctx.truncate(
                             _data(self.name, idx),
-                            new_size % self.obj_size)
+                            new_size % self.obj_size, snapc=snapc)
                     except ObjectOperationError:
                         pass
         self.size_bytes = new_size
-        await self.ioctx.set_omap(_header(self.name), "meta", json.dumps(
-            {"size": new_size, "order": self.order}).encode())
+        await self._save_meta()
 
     async def stat(self) -> dict:
         """ref: Image::stat (info_t)."""
