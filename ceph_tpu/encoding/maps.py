@@ -180,7 +180,7 @@ def encode_osdmap(m) -> bytes:
     monitor store value)."""
     e = Encoder()
     e.u32(OSDMAP_MAGIC)
-    with e.start(2):
+    with e.start(3):                    # v3: + up_thru
         e.u32(m.epoch)
         e.blob(encode_crush_map(m.crush))
         e.u32(m.max_osd)
@@ -197,6 +197,8 @@ def encode_osdmap(m) -> bytes:
               lambda e, v: e.list(
                   v, lambda e, pr: e.s32(pr[0]).s32(pr[1])))
         e.map(m.osd_addrs, lambda e, k: e.s32(k), _enc_addr)   # v2
+        e.map(m.up_thru, lambda e, k: e.s32(k),
+              lambda e, v: e.u32(v))                           # v3
     return e.tobytes()
 
 
@@ -205,7 +207,7 @@ def decode_osdmap(data: bytes):
     d = Decoder(data)
     if d.u32() != OSDMAP_MAGIC:
         raise EncodingError("bad osdmap magic")
-    with d.start(2) as _v:
+    with d.start(3) as _v:
         epoch = d.u32()
         crush = decode_crush_map(d.blob())
         max_osd = d.u32()
@@ -223,6 +225,8 @@ def decode_osdmap(data: bytes):
             dec_pg_t, lambda d: d.list(lambda d: (d.s32(), d.s32())))
         if _v >= 2:
             m.osd_addrs = d.map(lambda d: d.s32(), _dec_addr)
+        if _v >= 3:
+            m.up_thru = d.map(lambda d: d.s32(), lambda d: d.u32())
     return m
 
 
@@ -230,7 +234,7 @@ def encode_incremental(inc) -> bytes:
     """ref: OSDMap::Incremental::encode — the delta the monitor commits
     per epoch and OSDs apply on subscription."""
     e = Encoder()
-    with e.start(2):
+    with e.start(3):                    # v3: + new_up_thru
         e.u32(inc.epoch)
         e.optional(inc.new_max_osd, lambda e, v: e.u32(v))
         e.map(inc.new_pools, lambda e, k: e.s64(k), _enc_pool)
@@ -256,6 +260,8 @@ def encode_incremental(inc) -> bytes:
         e.map(inc.new_addrs, lambda e, k: e.s32(k), _enc_addr)    # v2
         e.map(inc.new_state, lambda e, k: e.s32(k),
               lambda e, v: e.s32(v))                              # v2
+        e.map(inc.new_up_thru, lambda e, k: e.s32(k),
+              lambda e, v: e.u32(v))                              # v3
     return e.tobytes()
 
 
@@ -263,7 +269,7 @@ def decode_incremental(data: bytes):
     from ceph_tpu.osd.osdmap import Incremental
     d = Decoder(data)
     inc = Incremental()
-    with d.start(2) as _v:
+    with d.start(3) as _v:
         inc.epoch = d.u32()
         inc.new_max_osd = d.optional(lambda d: d.u32())
         inc.new_pools = d.map(lambda d: d.s64(), _dec_pool)
@@ -286,4 +292,7 @@ def decode_incremental(data: bytes):
         if _v >= 2:
             inc.new_addrs = d.map(lambda d: d.s32(), _dec_addr)
             inc.new_state = d.map(lambda d: d.s32(), lambda d: d.s32())
+        if _v >= 3:
+            inc.new_up_thru = d.map(lambda d: d.s32(),
+                                    lambda d: d.u32())
     return inc

@@ -17,8 +17,8 @@ from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
     MMonCommand, MMonCommandAck, MMonElection, MMonGetOSDMap, MMonMap,
-    MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDBoot, MOSDFailure,
-    MOSDMap, MPGStats,
+    MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
+    MOSDFailure, MOSDMap, MPGStats,
 )
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.store import MonitorDBStore
@@ -226,7 +226,7 @@ class Monitor(Dispatcher):
         if isinstance(msg, MMonGetOSDMap):
             await self._send_osdmaps(msg.conn, msg.start_epoch)
             return True
-        if isinstance(msg, (MOSDBoot, MOSDFailure, MPGStats)):
+        if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure, MPGStats)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:

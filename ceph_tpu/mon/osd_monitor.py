@@ -22,7 +22,8 @@ from ceph_tpu.encoding import (
     decode_crush_map, decode_osdmap, encode_crush_map, encode_incremental,
     encode_osdmap,
 )
-from ceph_tpu.mon.messages import MOSDBoot, MOSDFailure, MPGStats
+from ceph_tpu.mon.messages import (MOSDAlive, MOSDBoot, MOSDFailure,
+                                   MPGStats)
 from ceph_tpu.mon.service import PaxosService
 from ceph_tpu.osd.osdmap import (
     STATE_EXISTS, STATE_UP, Incremental, OSDMap,
@@ -127,8 +128,34 @@ class OSDMonitor(PaxosService):
             await self._handle_boot(msg)
         elif isinstance(msg, MOSDFailure):
             await self._handle_failure(msg)
+        elif isinstance(msg, MOSDAlive):
+            await self._handle_alive(msg)
         elif isinstance(msg, MPGStats):
             self._handle_pg_stats(msg)
+
+    async def _handle_alive(self, m: MOSDAlive) -> None:
+        """up_thru grant (ref: OSDMonitor::prepare_alive): a primary
+        asks to be recorded 'up through' its interval-start epoch
+        before activating; peering later uses the grant to decide
+        whether a past interval MAY have gone active (no grant = the
+        interval's primary never activated = no acked writes to lose)."""
+        om = self.osdmap
+        if om is None or m.osd < 0 or m.osd >= om.max_osd or \
+                not bool(om.is_up(np.asarray(m.osd))):
+            return
+
+        def build(cur):
+            # the duplicate-grant test runs UNDER the proposal lock:
+            # primaries re-send MOSDAlive every 0.3s until the granted
+            # map reaches them, and a pre-lock check would commit one
+            # redundant paxos round + map publish per retry
+            if cur.up_thru.get(m.osd, 0) >= m.epoch:
+                return None
+            inc = Incremental()
+            inc.new_up_thru[m.osd] = m.epoch
+            return inc, None
+        await self._propose_change(build)
+        log.dout(10, f"osd.{m.osd} up_thru -> {m.epoch}")
 
     async def _handle_boot(self, m: MOSDBoot) -> None:
         """ref: OSDMonitor::prepare_boot — mark up, publish addrs,

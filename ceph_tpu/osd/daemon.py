@@ -27,7 +27,7 @@ from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDOp, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
     MOSDPGPushReply, MOSDPGQuery, MOSDPing, MOSDRepOp, MOSDRepOpReply,
-    MOSDRepScrub, MOSDRepScrubMap, PING, PING_REPLY,
+    MOSDRepScrub, MOSDRepScrubMap, MPGCleanNotice, PING, PING_REPLY,
 )
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import pg_t
@@ -341,6 +341,11 @@ class OSD(Dispatcher):
             pg = self._pg_for(msg.pgid)
             if pg is not None:
                 pg.handle_push_reply(msg)
+            return True
+        if isinstance(msg, MPGCleanNotice):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                pg.handle_clean_notice(msg)
             return True
         if isinstance(msg, MOSDRepScrub):
             pg = self._pg_for(msg.pgid)

@@ -626,8 +626,10 @@ class ECPG(PG):
             return
         if not any(self.peer_missing.values()) and \
                 self.state in ("active", "recovering"):
-            self.state = "clean" if \
-                len(self.live_acting()) >= self.pool.size else "active"
+            if len(self.live_acting()) >= self.pool.size:
+                self._mark_clean()
+            else:
+                self.state = "active"
 
     # -- stats --------------------------------------------------------------
     def stats(self) -> dict:

@@ -241,3 +241,15 @@ class MOSDRepScrubMap(Message):
     TYPE = 176
     FIELDS = [("pgid", "str"), ("tid", "u64"), ("from_osd", "s32"),
               ("scrub_map", "map:str:blob")]
+
+
+@register
+class MPGCleanNotice(Message):
+    """Primary -> every OSD that hosted the PG since its last clean:
+    the PG is clean at ``epoch``, so past intervals up to it are
+    subsumed — trim them (the stray/replica half of last_epoch_clean;
+    ref: the purge_strays/pg-notify machinery's role). Best-effort: a
+    missed notice leaves the conservative blocking behavior."""
+
+    TYPE = 178
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32")]

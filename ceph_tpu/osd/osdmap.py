@@ -77,6 +77,10 @@ class Incremental:
     # absolute state overrides (ref: Incremental::new_state xor — here
     # absolute values; used by `osd new` to create EXISTS+down slots)
     new_state: dict[int, int] = field(default_factory=dict)
+    # ref: Incremental::new_up_thru — the mon grants 'osd X was up
+    # through epoch E' when a primary asks before activating; peering
+    # uses it to decide whether a past interval may have gone active
+    new_up_thru: dict[int, int] = field(default_factory=dict)
 
 
 class OSDMap:
@@ -98,6 +102,9 @@ class OSDMap:
         self.pg_upmap_items: dict[pg_t, list] = {}
         # osd -> (host, port, hb_port); ref: OSDMap osd_addrs
         self.osd_addrs: dict[int, tuple] = {}
+        # osd -> highest epoch the mon has granted 'alive through'
+        # (ref: osd_info_t::up_thru); peering's maybe-went-active test
+        self.up_thru: dict[int, int] = {}
         self._mappers: dict[int | None, Mapper] = {}
 
     # -- state predicates (array-capable) ---------------------------------
@@ -244,6 +251,7 @@ class OSDMap:
         for pg in inc.old_pg_upmap_items:
             self.pg_upmap_items.pop(pg, None)
         self.osd_addrs.update(inc.new_addrs)
+        self.up_thru.update(inc.new_up_thru)
         for mp in self._mappers.values():
             mp.set_device_weights(self._device_weights())
         self.epoch += 1

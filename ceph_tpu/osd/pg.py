@@ -82,12 +82,24 @@ class PG:
         self.up: list[int] = []
         self.primary = -1
         self.last_user_version = 0
+        # PastIntervals (ref: osd_types PastIntervals + PeeringState::
+        # build_prior): every acting set this PG has had since it was
+        # last clean, [[first_epoch, last_epoch, [acting...]], ...].
+        # Peering must hear from at least one member of EACH past
+        # interval before activating — the current acting set's logs
+        # alone cannot prove no other interval acknowledged writes
+        # (e.g. acting flipped A->B->A: B took writes while A was out).
+        # Persisted in the pg meta object; trimmed at last_epoch_clean.
+        self.past_intervals: list[list] = []
+        self.interval_start = 0           # epoch current acting set began
+        self.last_epoch_clean = 0
         # peering scratch
         self.peer_logs: dict[int, PGLog] = {}
         self.peer_missing: dict[int, dict[str, LogEntry]] = {}
         self.my_missing: dict[str, LogEntry] = {}
         self._peering_task: asyncio.Task | None = None
         self._info_waiter: asyncio.Future | None = None
+        self._expected_infos: set[int] = set()
         # op pipeline
         self.op_queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
@@ -140,10 +152,21 @@ class PG:
         if blob:
             self.pg_log = PGLog.decode(blob)
             self.last_user_version = self.pg_log.head.v
+        pblob = omap.get("peering")
+        if pblob:
+            meta = json.loads(pblob)
+            self.past_intervals = meta.get("past_intervals", [])
+            self.interval_start = meta.get("interval_start", 0)
+            self.last_epoch_clean = meta.get("last_epoch_clean", 0)
 
     def _meta_txn(self, t: Transaction) -> Transaction:
-        t.omap_setkeys(self.cid, PGMETA,
-                       {"pg_log": self.pg_log.encode()})
+        t.omap_setkeys(self.cid, PGMETA, {
+            "pg_log": self.pg_log.encode(),
+            "peering": json.dumps({
+                "past_intervals": self.past_intervals,
+                "interval_start": self.interval_start,
+                "last_epoch_clean": self.last_epoch_clean,
+            }).encode()})
         return t
 
     @property
@@ -163,8 +186,29 @@ class PG:
     def advance(self, up: list[int], acting: list[int], primary: int,
                 epoch: int) -> None:
         """ref: PeeringState::advance_map — a changed acting set starts
-        a new interval; the primary re-peers."""
+        a new interval; the primary re-peers. The closing interval is
+        recorded in past_intervals (every member of it may hold writes
+        this PG acknowledged — see _peer_inner's prior coverage)."""
         changed = (acting != self.acting or primary != self.primary)
+        if changed:
+            old = [o for o in self.acting if o >= 0]
+            if old and epoch > self.interval_start:
+                self.past_intervals.append(
+                    [self.interval_start, epoch - 1, old,
+                     self.primary])
+                self.past_intervals = [
+                    iv for iv in self.past_intervals
+                    if iv[1] >= self.last_epoch_clean]
+            self.interval_start = epoch
+            try:        # survive restarts: intervals gate activation
+                self.osd.store.queue_transaction(
+                    self._meta_txn(Transaction()))
+            except StoreError as e:
+                # degraded to in-memory-only intervals until the next
+                # successful meta write (every log append retries it) —
+                # loud, because a crash before then re-opens the
+                # pre-PastIntervals activation hole
+                log.error(f"pg {self.pgid} interval persist failed: {e}")
         self.up = up
         self.acting = acting
         self.primary = primary
@@ -206,10 +250,37 @@ class PG:
         if len(self.live_acting()) < self.pool.min_size:
             self.state = "peering"        # undersized: wait for map
             return
-        if peers:
+        # prior set (ref: PeeringState::build_prior): members of every
+        # past interval since last clean that MAY have gone active —
+        # any of them may hold writes acknowledged while the current
+        # acting set was out. An interval whose primary never received
+        # an up_thru grant >= its first epoch never activated (the
+        # grant precedes activation below), so it cannot hold acked
+        # writes and is excluded — without this test, every transient
+        # one-epoch acting set whose members later die would block the
+        # PG forever. Reachable prior strays are queried alongside the
+        # acting peers; their logs compete in find_best_info below.
+        om = self.osd.osdmap
+        active_ivs = []
+        for iv in self.past_intervals:
+            prim = iv[3] if len(iv) > 3 else \
+                (iv[2][0] if iv[2] else -1)
+            if om is not None and prim >= 0 and \
+                    om.up_thru.get(prim, 0) < iv[0]:
+                continue                  # never activated
+            active_ivs.append(iv)
+        prior = set()
+        for iv in active_ivs:
+            prior.update(iv[2])
+        prior -= set(self.acting)
+        prior.discard(self.osd.whoami)
+        strays = [o for o in sorted(prior) if self.osd.osd_is_up(o)]
+        query = peers + strays
+        if query:
             fut = asyncio.get_event_loop().create_future()
             self._info_waiter = fut
-            for o in peers:
+            self._expected_infos = set(query)
+            for o in query:
                 await self.osd.send_osd(o, MOSDPGQuery(
                     pgid=self.cid, epoch=interval_epoch,
                     from_osd=self.osd.whoami))
@@ -219,13 +290,40 @@ class PG:
                 pass
             finally:
                 self._info_waiter = None
-            if set(self.peer_logs) < set(peers):
+            if set(self.peer_logs) < set(query):
                 # a peer didn't answer; retry soon (map may be stale)
                 self.state = "peering"
                 self.osd.request_repeer(self, delay=0.5)
                 return
         if self.epoch != interval_epoch:
             return                        # superseded interval
+        # interval coverage gate: activation requires having heard
+        # from >=1 member of EACH past interval — an interval whose
+        # every member is down blocks peering (upstream: 'down' /
+        # 'incomplete'; recovery needs those OSDs back or an operator
+        # decision, never silent activation that may discard their
+        # acknowledged writes).
+        heard = set(self.peer_logs) | {self.osd.whoami}
+        for iv in active_ivs:
+            _f, _l, members = iv[0], iv[1], iv[2]
+            if not (set(members) & heard):
+                log.dout(1, f"pg {self.pgid} down: no member of past "
+                            f"interval [{_f},{_l}] {members} reachable")
+                self.state = "peering"
+                self.osd.request_repeer(self, delay=1.0)
+                return
+        # up_thru grant (ref: OSDMonitor::prepare_alive / PeeringState
+        # need_up_thru): BEFORE activating, this interval must be
+        # recorded in the map — that is what lets FUTURE peers apply
+        # the maybe-went-active test above to THIS interval.
+        if om is not None and \
+                om.up_thru.get(self.osd.whoami, 0) < self.interval_start:
+            from ceph_tpu.mon.messages import MOSDAlive
+            await self.osd.monc.send_report(MOSDAlive(
+                osd=self.osd.whoami, epoch=self.interval_start))
+            self.state = "peering"    # retry once the grant's map lands
+            self.osd.request_repeer(self, delay=0.3)
+            return
         # authoritative log: max head (ref: find_best_info)
         best_osd = self.osd.whoami
         best = self.pg_log
@@ -247,10 +345,12 @@ class PG:
                 return
         self.last_user_version = max(self.last_user_version,
                                      self.pg_log.head.v)
-        # per-peer missing sets (ref: GetMissing)
+        # per-peer missing sets (ref: GetMissing) — acting peers only:
+        # prior strays answered queries but take no recovery pushes
+        # (they leave the set at the next clean interval)
         self.peer_missing = {
             o: plog.missing_vs(self.pg_log)
-            for o, plog in self.peer_logs.items()}
+            for o, plog in self.peer_logs.items() if o in self.acting}
         self.state = "active"
         if self._worker is None:
             self._worker = asyncio.ensure_future(self._op_worker())
@@ -265,9 +365,10 @@ class PG:
 
     def handle_pg_info(self, m: MOSDPGInfo) -> None:
         self.peer_logs[m.from_osd] = PGLog.decode(m.log)
-        peers = [o for o in self.live_acting() if o != self.osd.whoami]
+        expected = self._expected_infos or set(
+            o for o in self.live_acting() if o != self.osd.whoami)
         if self._info_waiter and not self._info_waiter.done() and \
-                set(self.peer_logs) >= set(peers):
+                set(self.peer_logs) >= expected:
             self._info_waiter.set_result(True)
 
     # -- self-managed snapshots (ref: PrimaryLogPG make_writeable /
@@ -618,9 +719,51 @@ class PG:
             return
         if not any(self.peer_missing.values()) and \
                 self.state in ("active", "recovering"):
-            self.state = "clean" if \
-                len(self.live_acting()) >= self.pool.size else "active"
+            if len(self.live_acting()) >= self.pool.size:
+                self._mark_clean()
+            else:
+                self.state = "active"
             self._promote_pending_eagain()
+
+    def _mark_clean(self) -> None:
+        """Every acting replica has every object at full size: past
+        intervals are subsumed by the current one (ref: last_epoch_clean
+        gating PastIntervals trimming). Every OSD that hosted the PG
+        since the previous clean is told, so replica/stray instances
+        trim their own copies too — otherwise a later promotion of one
+        of them would block forever on intervals this clean made
+        irrelevant (r4 review finding)."""
+        notify = set(self.acting)
+        for iv in self.past_intervals:
+            notify.update(iv[2])
+        notify.discard(self.osd.whoami)
+        self.state = "clean"
+        self.last_epoch_clean = self.epoch
+        self.past_intervals = []
+        try:
+            self.osd.store.queue_transaction(
+                self._meta_txn(Transaction()))
+        except StoreError as e:
+            log.error(f"pg {self.pgid} clean meta persist failed: {e}")
+        from ceph_tpu.osd.messages import MPGCleanNotice
+        for o in notify:
+            if o >= 0 and self.osd.osd_is_up(o):
+                asyncio.ensure_future(self.osd.send_osd(
+                    o, MPGCleanNotice(pgid=self.cid, epoch=self.epoch,
+                                      from_osd=self.osd.whoami)))
+
+    def handle_clean_notice(self, m) -> None:
+        """Replica/stray half of _mark_clean's trimming."""
+        if m.epoch <= self.last_epoch_clean:
+            return
+        self.last_epoch_clean = m.epoch
+        self.past_intervals = [iv for iv in self.past_intervals
+                               if iv[1] >= m.epoch]
+        try:
+            self.osd.store.queue_transaction(
+                self._meta_txn(Transaction()))
+        except StoreError as e:
+            log.error(f"pg {self.pgid} clean-notice persist failed: {e}")
 
     # -- op execution ------------------------------------------------------
     async def queue_op(self, m: MOSDOp) -> None:

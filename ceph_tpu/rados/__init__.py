@@ -235,10 +235,11 @@ class IoCtx:
 
     # -- reads -------------------------------------------------------------
     async def read(self, oid: str, length: int = 0,
-                   offset: int = 0, snap_id: int | None = None) -> bytes:
+                   offset: int = 0, snap_id: int | None = None,
+                   timeout: float = 20.0) -> bytes:
         data, _ = await self._op(
             oid, [(OSD_OP_READ, offset, length, "", b"")],
-            snap_id=snap_id)
+            snap_id=snap_id, timeout=timeout)
         return data
 
     async def stat(self, oid: str, snap_id: int | None = None) -> int:

@@ -29,7 +29,9 @@ async def _ec_cluster(n_osds=4, k=2, m=1):
         {"prefix": "osd pool create", "pool": "ecpool", "pg_num": 4,
          "pool_type": "erasure", "erasure_code_profile": "kprof"})
     assert ret == 0, rs
-    await c.wait_for_clean(timeout=120)
+    # 240: this wait flakes under whole-suite CPU contention on the
+    # 1-core CI host (observed at 120 with peering's up_thru round trip)
+    await c.wait_for_clean(timeout=240)
     io = await c.client.open_ioctx("ecpool")
     return c, io
 

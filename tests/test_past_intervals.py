@@ -1,0 +1,138 @@
+"""PastIntervals: peering must consult PRIOR acting sets, not just the
+current one.
+
+ref test model: the reference's PastIntervals/build_prior machinery
+(osd_types PastIntervals, PeeringState::build_prior) is what proves no
+acknowledged write is lost across overlapping acting-set changes — the
+canonical failure being acting A -> B -> A, where B acknowledged writes
+while A's members were absent. Without it, A's members peer among
+themselves, elect a stale log, and silently discard B's writes. These
+tests steer acting sets deterministically with pg-upmap-items (the
+balancer's own mechanism) so the A->B->A flip is exact, not thrashed.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.rados import ObjectOperationError
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _cluster():
+    c = await Cluster(n_mons=1, n_osds=4,
+                      config={"mon_osd_down_out_interval": 2.0}).start()
+    # one PG so the acting set is a single steerable pair; min_size=1
+    # so interval B can acknowledge writes on its own
+    await c.client.pool_create("p", pg_num=1, size=2, min_size=1)
+    await c.wait_for_clean(timeout=120)
+    io = await c.client.open_ioctx("p")
+    return c, io
+
+
+def _acting(c, pool_id):
+    for o in c.osds:
+        if o._stopped:
+            continue
+        pg = o.pgs.get(f"{pool_id}.0")
+        if pg is not None and pg.is_primary():
+            return list(pg.acting)
+    return []
+
+
+async def _upmap_to(c, pool_id, pairs):
+    maps = [str(x) for pair in pairs for x in pair]
+    ret, rs, _ = await c.client.mon_command(
+        {"prefix": "osd pg-upmap-items", "pgid": f"{pool_id}.0",
+         "mappings": maps})
+    assert ret == 0, rs
+
+
+async def _rm_upmap(c, pool_id):
+    ret, rs, _ = await c.client.mon_command(
+        {"prefix": "osd rm-pg-upmap-items", "pgid": f"{pool_id}.0"})
+    assert ret == 0, rs
+
+
+async def _wait_acting(c, pool_id, want, timeout=60.0):
+    """The upmap change must PROPAGATE before wait_for_clean means
+    anything — the PG is still 'clean' under the old acting set."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while set(_acting(c, pool_id)) != set(want):
+        assert asyncio.get_event_loop().time() < deadline, \
+            (_acting(c, pool_id), want)
+        await asyncio.sleep(0.1)
+
+
+def test_acting_flip_does_not_lose_acked_writes():
+    """A -> B -> A via upmap: a write acknowledged in interval B must
+    survive the flip back to A. Fails on the single-interval model:
+    A's members peer among themselves, elect the stale pre-B log, and
+    serve the old data."""
+    async def go():
+        c, io = await _cluster()
+        try:
+            await io.write_full("obj", b"v1-interval-A")
+            a = _acting(c, io.pool_id)
+            assert len(a) == 2, a
+            b = [o.whoami for o in c.osds if o.whoami not in a][:2]
+            # interval B: remap both acting members
+            await _upmap_to(c, io.pool_id, list(zip(a, b)))
+            await _wait_acting(c, io.pool_id, b)
+            await c.wait_for_clean(timeout=120)
+            await io.write_full("obj", b"v2-interval-B")
+            # back to A (the raw CRUSH mapping)
+            await _rm_upmap(c, io.pool_id)
+            await _wait_acting(c, io.pool_id, a)
+            await c.wait_for_clean(timeout=120)
+            assert await io.read("obj") == b"v2-interval-B", \
+                "write acknowledged in interval B was lost on A->B->A"
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_down_past_interval_blocks_activation():
+    """If EVERY member of a past interval is down, the PG must block
+    peering (upstream 'down'/'incomplete') instead of activating with a
+    possibly-stale log — and must activate with the newer data once one
+    of them returns."""
+    async def go():
+        c, io = await _cluster()
+        try:
+            await io.write_full("obj", b"v1-interval-A")
+            a = _acting(c, io.pool_id)
+            b = [o.whoami for o in c.osds if o.whoami not in a][:2]
+            await _upmap_to(c, io.pool_id, list(zip(a, b)))
+            await _wait_acting(c, io.pool_id, b)
+            await c.wait_for_clean(timeout=120)
+            await io.write_full("obj", b"v2-interval-B")
+            # kill BOTH of interval B's members; acting falls back to A
+            for osd_id in b:
+                await c.kill_osd(osd_id)
+            for osd_id in b:
+                await c.wait_for_osd_down(osd_id, timeout=30)
+            await _rm_upmap(c, io.pool_id)
+            # A must NOT activate: its only logs predate interval B
+            await asyncio.sleep(2.0)
+            pg_states = [o.pgs[f"{io.pool_id}.0"].state
+                         for o in c.osds
+                         if not o._stopped and
+                         f"{io.pool_id}.0" in o.pgs and
+                         o.pgs[f"{io.pool_id}.0"].is_primary()]
+            assert all(s == "peering" for s in pg_states), pg_states
+            with pytest.raises(ObjectOperationError):
+                await io.read("obj", timeout=2.0)
+            # the LAST-alive prior member returns (it covers both the
+            # [b0,b1] interval and any transient singleton interval of
+            # its own): peering completes with B's log
+            await c.revive_osd(b[1])
+            await c.wait_for_clean(timeout=120)
+            assert await io.read("obj") == b"v2-interval-B"
+        finally:
+            await c.stop()
+    run(go())
