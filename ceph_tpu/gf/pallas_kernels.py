@@ -7,27 +7,53 @@ payload roofline. These kernels fuse unpack -> int8 matmul -> mod-2 ->
 pack inside one VMEM tile, so HBM sees only the payload
 (read k + write m chunks ≈ 1 + m/k bytes moved per byte encoded).
 
-Design notes (measured on a v5e, round 3):
+Design notes (round 4, all measured on a v5e with the interleaved
+median-of-paired-slopes protocol; round-3 numbers in parentheses):
 
-- The VPU bit-unpack, not the MXU matmul, is the bottleneck, so the
-  kernel avoids every Mosaic relayout it can:
-  * unpack is a `concatenate([data]*8)` (sublane copy, no interleave)
-    with a per-row shift from a broadcasted iota — NOT a
-    (k, 8, T) -> (8k, T) reshape, which lowers to an expensive bit
-    interleaving relayout. The coding bitmatrix columns are permuted
-    host-side to the matching bit-major order (see make_plan).
-  * the mod-2 + byte-pack epilogue runs on the MXU as a second small
-    matmul against constant weight matrices (1<<b), instead of a VPU
-    multiply-reduce over a reshaped (m, 8, T) view.
-- Together these took the measured rate from ~55 GiB/s (XLA bitmatmul,
-  transpose included) to ~80-95 GiB/s at k=8,m=3 on 256 MiB steps.
+- **Mod-2 absorb — the `& 1` before the matmul is unnecessary.** The
+  MXU only needs operands CONGRUENT to the bit mod 2: feeding the
+  whole shifted byte `(data >> b)` wrapped to int8 keeps parity intact
+  (the int8 wrap changes the value by a multiple of 256 — even; the
+  int32 accumulator is exact at |acc| <= 8k * 128; the epilogue's
+  `acc & 1` kills all junk). One full VPU pass gone.
+- **Per-plane constant shifts** replace round 3's
+  `concatenate([data]*8)` + broadcasted-iota variable shift: 8 (or 16,
+  see below) immediate-shift ops on (k, T) int32, each cast straight
+  to int8 — no (8k, T) int32 intermediate, no iota. (Shifting in the
+  int8/uint8 domain does not lower in Mosaic — measured, compile
+  error — so the shifts stay in native 32-bit lanes.)
+- **Block-diagonal r=2 contraction.** The k=8 coding matmul is
+  (24, 64) — it uses 9% of the 128x128 systolic array and streams one
+  column per cycle anyway. Splitting the tile into two lane-halves and
+  stacking their planes gives a (48, 128) @ (128, T/2) product: the
+  full contraction depth at half the column count. Applied whenever
+  2*8k <= 128.
+- **Aligned pack rows.** The mod-2 + byte-pack epilogue is one bf16
+  MXU matmul (weights 2^b <= 128 and pbits {0,1} are bf16-exact; the
+  f32 accumulator is exact <= 255). The two half-results ride rows
+  [0, m) and [8, 8+m) of a 16-row output so both final stores are
+  sublane-tile-aligned — Mosaic crashes on an int8 lane-concat whose
+  operand carries a vpad sublane offset (measured: the naive
+  (3, h)+(3, h) concat), and rejoining the int32 acc halves instead
+  costs a 3 MiB VMEM copy per tile (~0.35 ms/step at the bench shape).
+- Stage attribution at the bench shape (64 x 8 x 512 KiB, 2.4 ms/step
+  full): unpack shifts ~0.76 ms, main matmul ~0.66 ms, epilogue
+  ~0.35 ms, HBM floor 0.43 ms — the stages mostly serialize, so the
+  formulation is VPU/MXU-issue-bound, not bandwidth-bound. int4
+  operands compile but run SLOWER (extra `& 1` + casts outweigh the
+  MXU rate); int32 operands don't lower.
+- Net: ~103 GiB/s encode at k=8,m=3 on 256 MiB steps (round 3:
+  ~79 GiB/s same protocol; round-3's published 88 was a luckier
+  platform window — see BASELINE.md).
 - The batched entry point takes (B, k, C) stripes directly with a
-  (B, C/TILE) grid so callers never pay the (B,k,C) -> (k, B*C)
-  transpose the XLA path needs.
+  (B, C/tile) grid so callers never pay the (B,k,C) -> (k, B*C)
+  transpose the XLA path needs. Both grid dims are `parallel`
+  (independent output tiles).
 
-The plan (permuted bitmatrix + pack weights) is built eagerly on the
-host (make_plan) because the permutation needs concrete values; the
-jitted entry then treats the plan arrays as ordinary operands.
+The plan (permuted bitmatrix + block-diag operand + pack weights) is
+built eagerly on the host (make_plan) because the permutation needs
+concrete values; the jitted entry then treats the plan arrays as
+ordinary operands.
 
 ref: the role of ISA-L's ec_encode_data AVX512 kernels
 (src/erasure-code/isa); the bit-plane formulation is SURVEY.md §7
@@ -51,17 +77,33 @@ try:
 except ImportError:                                   # pragma: no cover
     HAVE_PALLAS = False
 
-# Lane-tile bytes per grid step. Working set per step is
-# ~(k + 8k*4 + 8k + m*4 + m) * TILE_L bytes; 32 KiB keeps it ~10 MiB at
-# k=8 — small enough to double-buffer comfortably in a 128 MiB VMEM.
-# Measured: 32 KiB beats both 16 KiB and 64 KiB tiles on v5e.
+# Minimum lane-tile bytes per grid step (and the alignment callers must
+# provide). encode_batch_planned picks the largest tile in
+# [TILE_L, TILE_MAX] that divides C and keeps the VMEM working set in
+# budget — measured on v5e: 128 KiB tiles beat 32 KiB by ~5% and
+# 512 KiB exceeds the 16 MiB scoped-VMEM limit at k=8.
 TILE_L = 1 << 15
+TILE_MAX = 1 << 17
+# k * tile cap keeping the scoped-VMEM allocation under the compiler's
+# 16 MiB limit (k=8 at 128 KiB tiles measured as the edge's safe side).
+_KTILE_CAP = 1 << 20
 
 
 class EncodePlan(NamedTuple):
     bm_bitmajor: jax.Array   # (8m, 8k) int8, cols permuted to b*k+i
-    pack_lo: jax.Array       # (m, 8m) int8, weights 1..64
-    pack_hi: jax.Array       # (m, 8m) int8, bit-7 selector
+    bm_op: jax.Array         # (r*8m, r*8k) int8 block-diag MXU operand
+    packw: jax.Array         # (r*OFF, r*8m) bf16 aligned pack weights
+
+
+def _pick_tile(k: int, C: int) -> int:
+    t = TILE_MAX
+    while t > TILE_L:
+        if C % t == 0 and k * t <= _KTILE_CAP:
+            return t
+        t //= 2
+    # TILE_L is the floor regardless of k: pallas_ok() gates on it and
+    # the pre-cap code ran every k at this tile size
+    return TILE_L if C % TILE_L == 0 else 0
 
 
 def make_plan(bitmatrix: np.ndarray) -> EncodePlan:
@@ -73,35 +115,53 @@ def make_plan(bitmatrix: np.ndarray) -> EncodePlan:
     bm_bitmajor = np.zeros_like(bm)
     for b in range(8):
         bm_bitmajor[:, b * k:(b + 1) * k] = bm[:, b::8]
-    # Byte pack as matmul: out[j] = sum_b (1<<b) * paritybit[8j+b].
-    # int8 weights cap at 64, so bit 7 rides a second 0/1 matrix.
-    lo = np.zeros((m, m8), dtype=np.int8)
-    hi = np.zeros((m, m8), dtype=np.int8)
-    for j in range(m):
-        for b in range(7):
-            lo[j, 8 * j + b] = 1 << b
-        hi[j, 8 * j + 7] = 1
-    return EncodePlan(jnp.asarray(bm_bitmajor), jnp.asarray(lo),
-                      jnp.asarray(hi))
+    r = 2 if 2 * k8 <= 128 else 1
+    bm_op = np.zeros((r * m8, r * k8), dtype=np.int8)
+    for j in range(r):
+        bm_op[j * m8:(j + 1) * m8, j * k8:(j + 1) * k8] = bm_bitmajor
+    # Byte pack as one bf16 matmul: out[j] = sum_b (1<<b) * paritybit
+    # [8j+b]; per lane-half j its m output rows start at j*OFF so every
+    # final store slice is 8-sublane aligned.
+    off = 8 * ((m + 7) // 8)
+    pw = np.zeros((r * off, r * m8), dtype=np.float32)
+    for j in range(r):
+        for jj in range(m):
+            for b in range(8):
+                pw[j * off + jj, j * m8 + 8 * jj + b] = float(1 << b)
+    return EncodePlan(jnp.asarray(bm_bitmajor),
+                      jnp.asarray(bm_op),
+                      jnp.asarray(pw).astype(jnp.bfloat16))
 
 
-def _kernel(bm_ref, lo_ref, hi_ref, data_ref, out_ref):
-    data = data_ref[0].astype(jnp.int32)              # (k, T)
-    k, T = data.shape
-    big = jnp.concatenate([data] * 8, axis=0)         # (8k, T) bit-major
-    shifts = jax.lax.broadcasted_iota(jnp.int32, (8 * k, T), 0) // k
-    bits = ((big >> shifts) & 1).astype(jnp.int8)
-    acc = jax.lax.dot_general(
-        bm_ref[...], bits, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)             # (8m, T)
-    pbits = (acc & 1).astype(jnp.int8)
-    lo = jax.lax.dot_general(
-        lo_ref[...], pbits, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)             # (m, T)
-    hi = jax.lax.dot_general(
-        hi_ref[...], pbits, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    out_ref[0] = (lo + (hi << 7)).astype(jnp.uint8)
+def _make_kernel(k: int, m: int, r: int, off: int):
+    def kernel(bm_ref, pw_ref, data_ref, out_ref):
+        data = data_ref[0].astype(jnp.int32)          # (k, T)
+        T = data.shape[1]
+        h = T // r
+        if r == 2:
+            halves = (data[:, :h], data[:, h:])
+        else:
+            halves = (data,)
+        # constant-shift planes, no & 1 (mod-2 absorb: the int8 wrap of
+        # data>>b differs from bit b by an even number; acc & 1 below
+        # recovers the parity exactly — |acc| <= 8k*128 is int32-exact)
+        planes = [(d >> b).astype(jnp.int8)
+                  for d in halves for b in range(8)]
+        bits = jnp.concatenate(planes, axis=0)        # (r*8k, h) int8
+        acc = jax.lax.dot_general(
+            bm_ref[...], bits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)         # (r*8m, h)
+        pbits = (acc & 1).astype(jnp.bfloat16)
+        out = jax.lax.dot_general(
+            pw_ref[...], pbits, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (r*off, h)
+        outi = out.astype(jnp.int32).astype(jnp.uint8)
+        if r == 2:
+            out_ref[0, :, 0:h] = outi[0:m]
+            out_ref[0, :, h:2 * h] = outi[off:off + m]
+        else:
+            out_ref[0] = outi[0:m]
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -114,30 +174,31 @@ def encode_batch_planned(plan: EncodePlan, data: jax.Array,
     m8, k8 = plan.bm_bitmajor.shape
     B, k, C = data.shape
     assert k8 == 8 * k, (plan.bm_bitmajor.shape, data.shape)
-    assert C % TILE_L == 0, f"C={C} not a multiple of TILE_L={TILE_L}"
     m = m8 // 8
-    grid = (B, C // TILE_L)
+    r = plan.bm_op.shape[1] // k8
+    off = plan.packw.shape[0] // r
+    tile = _pick_tile(k, C)
+    assert tile, f"C={C} not a multiple of TILE_L={TILE_L}"
+    grid = (B, C // tile)
     params = {}
     if not interpret:
-        # Stripes are independent: declaring the batch grid dim parallel
-        # lets Mosaic overlap/pipeline across stripes (measured ~2.5x vs
-        # sequential semantics on the bench's (64, 16) grid).
+        # Output tiles are fully independent: both grid dims parallel
+        # lets Mosaic overlap/pipeline across stripes and lane tiles.
         params["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"))
+            dimension_semantics=("parallel", "parallel"))
     return pl.pallas_call(
-        _kernel,
+        _make_kernel(k, m, r, off),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((m8, k8), lambda b, i: (0, 0)),
-            pl.BlockSpec((m, m8), lambda b, i: (0, 0)),
-            pl.BlockSpec((m, m8), lambda b, i: (0, 0)),
-            pl.BlockSpec((1, k, TILE_L), lambda b, i: (b, 0, i)),
+            pl.BlockSpec(plan.bm_op.shape, lambda b, i: (0, 0)),
+            pl.BlockSpec(plan.packw.shape, lambda b, i: (0, 0)),
+            pl.BlockSpec((1, k, tile), lambda b, i: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, m, TILE_L), lambda b, i: (b, 0, i)),
+        out_specs=pl.BlockSpec((1, m, tile), lambda b, i: (b, 0, i)),
         out_shape=jax.ShapeDtypeStruct((B, m, C), jnp.uint8),
         interpret=interpret,
         **params,
-    )(*plan, data)
+    )(plan.bm_op, plan.packw, data)
 
 
 def gf_encode_batch_pallas(bitmatrix, data: jax.Array,
