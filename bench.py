@@ -37,25 +37,23 @@ def ec_metrics() -> tuple[dict, dict, dict]:
     backend = os.environ.get("CEPH_TPU_BENCH_BACKEND", "auto")
     common = [
         "--plugin", "jax", "--size", str(4 << 20),
-        "--iterations", "1024",
         "--parameter", "k=8", "--parameter", "m=3",
         "--parameter", f"backend={backend}",
         "--parameter", "technique=reed_sol_van",
     ]
     enc = ErasureCodeBench(parse_args(
-        common + ["--workload", "encode",
+        common + ["--iterations", "1024", "--workload", "encode",
                   "--slope-steps", "16", "96"])).run()
     dec = ErasureCodeBench(parse_args(
-        common + ["--workload", "decode", "--erasures", "2",
-                  "--slope-steps", "16", "96"])).run()
+        common + ["--iterations", "1024", "--workload", "decode",
+                  "--erasures", "2", "--slope-steps", "16", "96"])).run()
     # Streamed row (SURVEY §7: report resident AND streamed): H2D inside
     # the loop. Small steps — on this sandbox H2D rides the axon network
     # tunnel (~6 MB/s measured), so the row documents the honest
     # host-transfer-bound rate of THIS platform, not a PCIe number.
-    stream_args = [a for a in common if a not in ("--iterations", "1024")]
     stream = ErasureCodeBench(parse_args(
-        stream_args + ["--iterations", "8", "--batch", "8",
-                       "--workload", "encode", "--stream"])).run()
+        common + ["--iterations", "8", "--batch", "8",
+                  "--workload", "encode", "--stream"])).run()
     return enc, dec, stream
 
 

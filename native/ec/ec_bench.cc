@@ -22,6 +22,7 @@ int main(int argc, char** argv) {
   std::string profile;
   size_t size = 1 << 20;
   int iterations = 1, erasures = 1;
+  bool verify = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* {
@@ -33,6 +34,7 @@ int main(int argc, char** argv) {
     else if (a == "--size" || a == "-s") size = std::stoul(next());
     else if (a == "--iterations" || a == "-i") iterations = std::stoi(next());
     else if (a == "--erasures" || a == "-e") erasures = std::stoi(next());
+    else if (a == "--verify") verify = true;
     else if (a == "--parameter" || a == "-P") {
       if (!profile.empty()) profile += " ";
       profile += next();
@@ -63,6 +65,25 @@ int main(int argc, char** argv) {
   std::mt19937 rng(0);
   for (auto& b : data) b = static_cast<uint8_t>(rng());
 
+  // Erase the first `erasures` chunks; assemble the k survivor chunks
+  // (data then parity order) into `in` — shared by the decode workload
+  // and --verify so the two paths can never disagree on layout.
+  auto make_decode_set = [&](std::vector<int>& want, std::vector<int>& avail,
+                             std::vector<uint8_t>& in) {
+    want.clear();
+    avail.clear();
+    for (int i = 0; i < erasures; ++i) want.push_back(i);
+    for (int i = erasures; i < k + m && (int)avail.size() < k; ++i)
+      avail.push_back(i);
+    in.assign(static_cast<size_t>(k) * chunk, 0);
+    for (int i = 0; i < k; ++i) {
+      const uint8_t* src = avail[i] < k
+          ? data.data() + static_cast<size_t>(avail[i]) * chunk
+          : parity.data() + static_cast<size_t>(avail[i] - k) * chunk;
+      std::memcpy(in.data() + static_cast<size_t>(i) * chunk, src, chunk);
+    }
+  };
+
   double elapsed = 0;
   if (workload == "encode") {
     auto t0 = std::chrono::steady_clock::now();
@@ -73,17 +94,9 @@ int main(int argc, char** argv) {
                   .count();
   } else {
     vt->encode(be, data.data(), parity.data(), chunk);
-    std::vector<uint8_t> all(static_cast<size_t>(k + m) * chunk);
-    std::memcpy(all.data(), data.data(), data.size());
-    std::memcpy(all.data() + data.size(), parity.data(), parity.size());
     std::vector<int> want, avail;
-    for (int i = 0; i < erasures; ++i) want.push_back(i);
-    for (int i = erasures; i < k + m && (int)avail.size() < k; ++i)
-      avail.push_back(i);
-    std::vector<uint8_t> in(static_cast<size_t>(k) * chunk);
-    for (int i = 0; i < k; ++i)
-      std::memcpy(in.data() + static_cast<size_t>(i) * chunk,
-                  all.data() + static_cast<size_t>(avail[i]) * chunk, chunk);
+    std::vector<uint8_t> in;
+    make_decode_set(want, avail, in);
     std::vector<uint8_t> out(static_cast<size_t>(want.size()) * chunk);
     auto t0 = std::chrono::steady_clock::now();
     for (int it = 0; it < iterations; ++it)
@@ -93,6 +106,29 @@ int main(int argc, char** argv) {
     elapsed = std::chrono::duration<double>(
                   std::chrono::steady_clock::now() - t0)
                   .count();
+  }
+  if (verify) {
+    // Erase the first `erasures` data chunks, decode through the
+    // plugin, memcmp against the originals — a plugin-level roundtrip
+    // check usable from the shell (the jax shim's smoke test).
+    vt->encode(be, data.data(), parity.data(), chunk);
+    std::vector<int> want, avail;
+    std::vector<uint8_t> in;
+    make_decode_set(want, avail, in);
+    std::vector<uint8_t> out(static_cast<size_t>(want.size()) * chunk);
+    int rc = vt->decode(be, avail.data(), k, want.data(),
+                        static_cast<int>(want.size()), in.data(),
+                        out.data(), chunk);
+    bool ok = rc == 0;
+    for (size_t i = 0; ok && i < want.size(); ++i)
+      ok = std::memcmp(out.data() + i * chunk,
+                       data.data() + static_cast<size_t>(want[i]) * chunk,
+                       chunk) == 0;
+    std::fprintf(stderr, "verify: %s\n", ok ? "ok" : "FAIL");
+    if (!ok) {
+      vt->destroy(be);
+      return 3;
+    }
   }
   double total = static_cast<double>(iterations) * k * chunk;
   // reference output format: seconds <tab> MB/s
