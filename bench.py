@@ -77,6 +77,35 @@ def crush_metric() -> dict:
     return res
 
 
+def balancer_metric() -> dict:
+    """Balancer convergence at scale (VERDICT r3 ask #10): wall time of
+    calc_pg_upmaps on a canonical-scale map, plus the Mapper lifecycle
+    counter DELTAS for the run — pack/compile traffic at 10k OSDs is a
+    recorded number now, not a guess."""
+    from ceph_tpu.bench import osdmaptool
+    from ceph_tpu.crush.mapper import PERF
+
+    n_osds = int(os.environ.get("CEPH_TPU_BENCH_BAL_OSDS", "10240"))
+    pgs = int(os.environ.get("CEPH_TPU_BENCH_BAL_PGS", "16384"))
+    iters = int(os.environ.get("CEPH_TPU_BENCH_BAL_ITERS", "40"))
+    t0 = time.perf_counter()
+    m = osdmaptool.create_simple(n_osds, pgs, 3, erasure=False)
+    build_s = time.perf_counter() - t0
+    before = PERF.dump()
+    t0 = time.perf_counter()
+    changes = m.calc_pg_upmaps(max_deviation=5, max_iterations=iters)
+    bal_s = time.perf_counter() - t0
+    after = PERF.dump()
+    counters = {k: round(after[k] - before[k], 4)
+                for k in after if isinstance(after[k], (int, float))}
+    return {"n_osds": n_osds, "pg_num": pgs, "max_iterations": iters,
+            "upmap_changes": changes,
+            "build_seconds": round(build_s, 3),
+            "balance_seconds": round(bal_s, 3),
+            "seconds_per_iteration": round(bal_s / max(iters, 1), 4),
+            "mapper_counter_deltas": counters}
+
+
 def main() -> None:
     enc, dec, stream = ec_metrics()
     detail = {
@@ -116,6 +145,10 @@ def main() -> None:
             detail["crush_error"] = traceback.format_exc(limit=3)
             if attempt == 1:
                 time.sleep(90)
+    try:
+        detail["balancer"] = balancer_metric()
+    except Exception:
+        detail["balancer_error"] = traceback.format_exc(limit=3)
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),

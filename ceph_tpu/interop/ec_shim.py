@@ -26,6 +26,12 @@ import os
 
 
 def _pin_platform() -> None:
+    # Only pin when WE are the embedded interpreter (plugin_jax_shim.cc
+    # sets the marker just before importing this module, and only when
+    # it called Py_Initialize itself). A host Python process that loads
+    # the shim in-process keeps its own platform choice.
+    if os.environ.get("CEPH_TPU_EMBEDDED_SHIM") != "1":
+        return
     import jax
     try:
         jax.config.update(

@@ -120,10 +120,14 @@ int main(int argc, char** argv) {
                         static_cast<int>(want.size()), in.data(),
                         out.data(), chunk);
     bool ok = rc == 0;
-    for (size_t i = 0; ok && i < want.size(); ++i)
-      ok = std::memcmp(out.data() + i * chunk,
-                       data.data() + static_cast<size_t>(want[i]) * chunk,
-                       chunk) == 0;
+    for (size_t i = 0; ok && i < want.size(); ++i) {
+      // want ids >= k are parity chunks (reachable when erasures > k,
+      // i.e. m > k geometries) — compare against the right buffer.
+      const uint8_t* expect = want[i] < k
+          ? data.data() + static_cast<size_t>(want[i]) * chunk
+          : parity.data() + static_cast<size_t>(want[i] - k) * chunk;
+      ok = std::memcmp(out.data() + i * chunk, expect, chunk) == 0;
+    }
     std::fprintf(stderr, "verify: %s\n", ok ? "ok" : "FAIL");
     if (!ok) {
       vt->destroy(be);

@@ -31,11 +31,13 @@ struct JaxBackend {
 };
 
 PyObject* g_mod = nullptr;  // ceph_tpu.interop.ec_shim, kept for life
+bool g_we_initialized = false;  // did WE start the interpreter?
 
 bool ensure_interp() {
   if (Py_IsInitialized()) return true;
   Py_InitializeEx(0);
   if (!Py_IsInitialized()) return false;
+  g_we_initialized = true;
   // Release the GIL the init left us holding so every entry point can
   // use the uniform PyGILState_Ensure/Release pairing.
   PyEval_SaveThread();
@@ -76,7 +78,12 @@ PyObject* shim_module() {
     esc += c;
   }
   std::string boot =
-      "import os, site, sys\n"
+      "import os, site, sys\n" +
+      // The platform pin in ec_shim must only fire for an interpreter
+      // WE embedded, never for a host Python that loaded us in-process.
+      std::string(g_we_initialized
+                      ? "os.environ['CEPH_TPU_EMBEDDED_SHIM'] = '1'\n"
+                      : "") +
       "sys.path.insert(0, os.path.abspath(" +
       std::string("\"") + esc + "\"))\n" +
       "venv = os.environ.get('VIRTUAL_ENV')\n"

@@ -34,6 +34,38 @@ GEOMETRIES = [(2, 2, "reed_sol_van"), (4, 2, "reed_sol_van"),
               (6, 3, "cauchy_orig"), (10, 4, "reed_sol_van")]
 
 
+class VT(ctypes.Structure):
+    """The native ec_plugin_vtable_t (native/ec/plugin.h) — single
+    definition shared by every dlopen-driven test."""
+    _fields_ = [
+        ("create", ctypes.CFUNCTYPE(ctypes.c_void_p, ctypes.c_char_p)),
+        ("destroy", ctypes.CFUNCTYPE(None, ctypes.c_void_p)),
+        ("k_of", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
+        ("m_of", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
+        ("encode", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t)),
+        ("decode", ctypes.CFUNCTYPE(
+            ctypes.c_int, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t)),
+    ]
+
+
+def load_registry():
+    """ctypes handle to libec_registry.so with the factory prototype."""
+    from ceph_tpu.interop.native import native_build_dir
+    build = native_build_dir()
+    lib = ctypes.CDLL(str(build / "libec_registry.so"),
+                      mode=ctypes.RTLD_GLOBAL)
+    lib.ec_registry_factory.restype = ctypes.c_void_p
+    lib.ec_registry_factory.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p)]
+    return lib, build
+
+
 class TestNativeOracle:
     @pytest.mark.parametrize("k,m,tech", GEOMETRIES)
     def test_coding_matrix_matches_python(self, k, m, tech):
@@ -77,41 +109,13 @@ class TestDlopenRegistry:
     """The __erasure_code_init dlopen flow, driven exactly as an external
     C consumer would (ref: ErasureCodePluginRegistry::load)."""
 
-    def _registry(self):
-        from ceph_tpu.interop.native import native_build_dir
-        build = native_build_dir()
-        lib = ctypes.CDLL(str(build / "libec_registry.so"),
-                          mode=ctypes.RTLD_GLOBAL)
-        lib.ec_registry_factory.restype = ctypes.c_void_p
-        lib.ec_registry_factory.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_void_p)]
-        return lib, build
-
     def test_dlopen_factory_and_encode(self):
-        lib, build = self._registry()
+        lib, build = load_registry()
         vt_ptr = ctypes.c_void_p()
         be = lib.ec_registry_factory(b"rsvan", str(build).encode(),
                                      b"k=4 m=2", ctypes.byref(vt_ptr))
         assert be, "factory returned null"
         assert vt_ptr.value
-
-        class VT(ctypes.Structure):
-            _fields_ = [
-                ("create", ctypes.CFUNCTYPE(ctypes.c_void_p,
-                                            ctypes.c_char_p)),
-                ("destroy", ctypes.CFUNCTYPE(None, ctypes.c_void_p)),
-                ("k_of", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
-                ("m_of", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
-                ("encode", ctypes.CFUNCTYPE(
-                    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
-                    ctypes.c_char_p, ctypes.c_size_t)),
-                ("decode", ctypes.CFUNCTYPE(
-                    ctypes.c_int, ctypes.c_void_p,
-                    ctypes.POINTER(ctypes.c_int), ctypes.c_int,
-                    ctypes.POINTER(ctypes.c_int), ctypes.c_int,
-                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t)),
-            ]
 
         vt = ctypes.cast(vt_ptr, ctypes.POINTER(VT)).contents
         assert vt.k_of(be) == 4 and vt.m_of(be) == 2
@@ -126,7 +130,7 @@ class TestDlopenRegistry:
         vt.destroy(be)
 
     def test_unknown_plugin_fails(self):
-        lib, build = self._registry()
+        lib, build = load_registry()
         vt_ptr = ctypes.c_void_p()
         be = lib.ec_registry_factory(b"nosuch", str(build).encode(),
                                      b"k=4 m=2", ctypes.byref(vt_ptr))
@@ -176,35 +180,13 @@ class TestJaxReverseShim:
         C function pointers, and compare bytes against the in-process
         Python plugin — an actual cross-boundary byte check, not just a
         self-roundtrip."""
-        build = self._build()
-        lib = ctypes.CDLL(str(build / "libec_registry.so"),
-                          mode=ctypes.RTLD_GLOBAL)
-        lib.ec_registry_factory.restype = ctypes.c_void_p
-        lib.ec_registry_factory.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_void_p)]
+        self._build()
+        lib, build = load_registry()
         vt_ptr = ctypes.c_void_p()
         be = lib.ec_registry_factory(b"jax", str(build).encode(),
                                      b"k=4 m=2 technique=reed_sol_van",
                                      ctypes.byref(vt_ptr))
         assert be and vt_ptr.value, "jax shim factory failed"
-
-        class VT(ctypes.Structure):
-            _fields_ = [
-                ("create", ctypes.CFUNCTYPE(ctypes.c_void_p,
-                                            ctypes.c_char_p)),
-                ("destroy", ctypes.CFUNCTYPE(None, ctypes.c_void_p)),
-                ("k_of", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
-                ("m_of", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
-                ("encode", ctypes.CFUNCTYPE(
-                    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
-                    ctypes.c_char_p, ctypes.c_size_t)),
-                ("decode", ctypes.CFUNCTYPE(
-                    ctypes.c_int, ctypes.c_void_p,
-                    ctypes.POINTER(ctypes.c_int), ctypes.c_int,
-                    ctypes.POINTER(ctypes.c_int), ctypes.c_int,
-                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t)),
-            ]
 
         vt = ctypes.cast(vt_ptr, ctypes.POINTER(VT)).contents
         assert vt.k_of(be) == 4 and vt.m_of(be) == 2
