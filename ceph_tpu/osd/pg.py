@@ -895,8 +895,12 @@ class PG:
                 except StoreError:
                     await self._reply(m, -2, b"", {})
                     return
+                # name = optional key-prefix filter (ref: the role of
+                # omap_get_vals' start_after/filter_prefix) — callers
+                # with large omaps fetch only the range they need
                 extra["omap"] = {k: v.hex() for k, v in omap.items()
-                                 if not k.startswith("_")}
+                                 if not k.startswith("_")
+                                 and (not name or k.startswith(name))}
             elif code == OSD_OP_PGLS:
                 objs = [o for o in store.list_objects(cid)
                         if o != PGMETA and clone_head(o) is None]

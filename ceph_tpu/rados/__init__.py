@@ -252,9 +252,13 @@ class IoCtx:
             oid, [(OSD_OP_GETXATTR, 0, 0, name, b"")])
         return data
 
-    async def get_omap_vals(self, oid: str) -> dict[str, bytes]:
+    async def get_omap_vals(self, oid: str,
+                            prefix: str = "") -> dict[str, bytes]:
+        """All omap pairs, or only keys starting with ``prefix`` (the
+        filter runs OSD-side — large omaps don't cross the wire;
+        ref: the role of omap_get_vals' filter_prefix)."""
         _, extra = await self._op(
-            oid, [(OSD_OP_OMAP_GET, 0, 0, "", b"")])
+            oid, [(OSD_OP_OMAP_GET, 0, 0, prefix, b"")])
         return {k: bytes.fromhex(v)
                 for k, v in extra.get("omap", {}).items()}
 
