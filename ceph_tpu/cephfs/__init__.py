@@ -127,6 +127,21 @@ class CephFSLite:
                                              "size": size})
         return len(data)
 
+    async def set_size(self, path: str, size: int) -> None:
+        """Update a file dentry's size without touching data — the MDS
+        setattr path after a cap-holding client's direct data write
+        (ref: Client::_setattr CEPH_SETATTR_SIZE without truncate)."""
+        path = _norm(path)
+        parent, name = posixpath.split(path)
+        entries = await self._dir_entries(parent)
+        ent = entries.get(name)
+        if ent is None:
+            raise FSError(-2, f"no such entry {path}")
+        if ent["type"] != "file":
+            raise FSError(-21, f"{path} is a directory")
+        ent["size"] = int(size)
+        await self._add_entry(parent, name, ent)
+
     async def read_file(self, path: str, length: int = 0,
                         offset: int = 0) -> bytes:
         ent = await self._lookup(path)

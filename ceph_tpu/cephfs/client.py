@@ -1,0 +1,224 @@
+"""CephFS client: metadata through the MDS, data direct to RADOS.
+
+ref: src/client/Client.{h,cc} (the libcephfs backend) — every
+namespace operation is an MClientRequest round-trip to the MDS; file
+reads/writes go straight to the data objects, gated by the file
+capabilities the MDS granted at open. A revoke arriving from the MDS
+invalidates the handle (writers have nothing to flush — writes here
+are write-through) and is acked immediately; the next I/O through
+that handle re-opens to reacquire a cap, which blocks until the
+conflicting holder is done — giving one-writer-or-many-readers
+semantics across clients.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.cephfs import FSError, _norm
+from ceph_tpu.cephfs.mds import (
+    CAP_FR, CAP_FW, CAP_OP_ACK, CAP_OP_RELEASE, CAP_OP_REVOKE,
+    MClientCaps, MClientReply, MClientRequest, MClientSession,
+    SESSION_CLOSE, SESSION_OPEN,
+)
+from ceph_tpu.msg import Dispatcher, Messenger
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("cephfs.client")
+
+
+class FileHandle:
+    """An open file + the cap that licenses its I/O."""
+
+    def __init__(self, client: "CephFSClient", path: str, oid: str,
+                 mode: int, cap_seq: int, size: int):
+        self.client = client
+        self.path = path
+        self.oid = oid
+        self.mode = mode
+        self.cap_seq = cap_seq
+        self.size = size
+        self.valid = True
+
+    async def _ensure(self) -> None:
+        if not self.valid:
+            fresh = await self.client.open_file(
+                self.path, "w" if self.mode == CAP_FW else "r")
+            self.__dict__.update(fresh.__dict__)
+            # this handle now IS the reacquired cap; drop the twin so
+            # _handles doesn't accumulate orphans (its registration
+            # transfers to self)
+            hs = self.client._handles.get(self.path, [])
+            if fresh in hs:
+                hs.remove(fresh)
+            if self not in hs:
+                hs.append(self)
+
+    async def read(self, length: int = 0, offset: int = 0) -> bytes:
+        await self._ensure()
+        want = length or max(self.size - offset, 0)
+        if want <= 0:
+            return b""
+        return await self.client.ioctx.read(self.oid, length=want,
+                                            offset=offset)
+
+    async def write(self, data: bytes, offset: int = 0) -> int:
+        await self._ensure()
+        if self.mode != CAP_FW:
+            raise FSError(-9, "handle not open for write")  # -EBADF
+        if offset:
+            await self.client.ioctx.write(self.oid, data, offset=offset)
+            self.size = max(self.size, offset + len(data))
+        else:
+            await self.client.ioctx.write_full(self.oid, data)
+            self.size = len(data)
+        # dentry size rides a setattr through the MDS (metadata is
+        # always MDS-authoritative)
+        await self.client._request("setattr", self.path,
+                                   flags=self.size)
+        return len(data)
+
+    async def close(self) -> None:
+        hs = self.client._handles.get(self.path, [])
+        if self in hs:
+            hs.remove(self)
+        if not hs:
+            self.client._handles.pop(self.path, None)
+        if self.valid:
+            self.valid = False
+            await self.client._send_caps(CAP_OP_RELEASE, self.path,
+                                         self.mode, self.cap_seq)
+
+
+class CephFSClient(Dispatcher):
+    """ref: libcephfs.h surface, MDS-backed."""
+
+    _next_id = 0
+
+    def __init__(self, ioctx, mds_addr,
+                 messenger: Messenger | None = None):
+        CephFSClient._next_id += 1
+        self.ioctx = ioctx
+        self.mds_addr = mds_addr
+        self.msgr = messenger or Messenger(
+            f"client.fs{CephFSClient._next_id}")
+        self.msgr.add_dispatcher(self)
+        self._tid = 0
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._session_fut: asyncio.Future | None = None
+        self._handles: dict[str, list[FileHandle]] = {}
+
+    # -- session -----------------------------------------------------------
+    async def mount(self) -> "CephFSClient":
+        self._session_fut = asyncio.get_event_loop().create_future()
+        await self.msgr.send_message(
+            MClientSession(op=SESSION_OPEN, cseq=0), self.mds_addr,
+            "mds")
+        await asyncio.wait_for(self._session_fut, timeout=10)
+        return self
+
+    async def unmount(self) -> None:
+        for hs in list(self._handles.values()):   # close() mutates the
+            for h in list(hs):                    # dict and the lists
+                await h.close()
+        self._session_fut = asyncio.get_event_loop().create_future()
+        await self.msgr.send_message(
+            MClientSession(op=SESSION_CLOSE, cseq=0), self.mds_addr,
+            "mds")
+        await asyncio.wait_for(self._session_fut, timeout=10)
+        await self.msgr.shutdown()
+
+    # -- dispatch ----------------------------------------------------------
+    async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MClientReply):
+            fut = self._waiters.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+            return True
+        if isinstance(msg, MClientSession):
+            if self._session_fut and not self._session_fut.done():
+                self._session_fut.set_result(msg.op)
+            return True
+        if isinstance(msg, MClientCaps):
+            if msg.op == CAP_OP_REVOKE:
+                # write-through clients have nothing dirty to flush:
+                # invalidate handles on this path and ack at once
+                for h in self._handles.get(msg.path, []):
+                    h.valid = False
+                await msg.conn.send_message(MClientCaps(
+                    op=CAP_OP_ACK, path=msg.path, mode=msg.mode,
+                    cseq=msg.cseq))
+            return True
+        return False
+
+    async def _send_caps(self, op: int, path: str, mode: int,
+                         seq: int) -> None:
+        await self.msgr.send_message(
+            MClientCaps(op=op, path=path, mode=mode, cseq=seq),
+            self.mds_addr, "mds")
+
+    # -- requests ----------------------------------------------------------
+    async def _request(self, op: str, path: str, path2: str = "",
+                       flags: int = 0) -> MClientReply:
+        self._tid += 1
+        tid = self._tid
+        fut = asyncio.get_event_loop().create_future()
+        self._waiters[tid] = fut
+        await self.msgr.send_message(
+            MClientRequest(tid=tid, op=op, path=path, path2=path2,
+                           flags=flags), self.mds_addr, "mds")
+        reply = await asyncio.wait_for(fut, timeout=40)
+        if reply.result < 0:
+            raise FSError(int(reply.result),
+                          reply.payload.decode(errors="replace"))
+        return reply
+
+    # -- namespace (ref: libcephfs.h) --------------------------------------
+    async def mkdir(self, path: str) -> None:
+        await self._request("mkdir", path)
+
+    async def rmdir(self, path: str) -> None:
+        await self._request("rmdir", path)
+
+    async def ls(self, path: str = "/") -> list[str]:
+        r = await self._request("readdir", path)
+        return json.loads(r.payload)
+
+    async def stat(self, path: str) -> dict:
+        r = await self._request("stat", path)
+        return json.loads(r.payload)
+
+    async def unlink(self, path: str) -> None:
+        await self._request("unlink", path)
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self._request("rename", src, path2=dst)
+
+    # -- files (cap-gated) -------------------------------------------------
+    async def open_file(self, path: str, mode: str = "r") -> FileHandle:
+        """'r' wants shared-read; 'w' wants exclusive-write (creating
+        the file if absent). Blocks while conflicting caps are being
+        revoked from other clients."""
+        path = _norm(path)        # cap/revoke bookkeeping is keyed on
+        want = CAP_FW if mode == "w" else CAP_FR   # the normalized path
+        r = await self._request("open", path, flags=want)
+        info = json.loads(r.payload)
+        h = FileHandle(self, path, info["oid"], int(r.cap_mode),
+                       int(r.cap_seq), int(info["size"]))
+        self._handles.setdefault(h.path, []).append(h)
+        return h
+
+    async def read_file(self, path: str) -> bytes:
+        h = await self.open_file(path, "r")
+        try:
+            return await h.read()
+        finally:
+            await h.close()
+
+    async def write_file(self, path: str, data: bytes) -> int:
+        h = await self.open_file(path, "w")
+        try:
+            return await h.write(data)
+        finally:
+            await h.close()

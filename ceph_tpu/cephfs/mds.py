@@ -1,0 +1,340 @@
+"""The MDS daemon: metadata authority + client capabilities.
+
+ref: src/mds/ (MDSDaemon, Server::handle_client_request, Locker's cap
+machinery, MDLog/EUpdate journaling) + src/messages/MClientRequest.h /
+MClientReply.h / MClientCaps.h — rebuilt small on this framework's
+messenger. The division of labor is the reference's:
+
+- ALL namespace mutations flow through the MDS, which journals each
+  one to a metadata-pool journal object before applying it to the
+  dirfrag omap objects (the same on-disk model ``CephFSLite`` uses —
+  an MDS restart replays uncommitted journal events idempotently, the
+  EUpdate/MDLog pattern in miniature).
+- File DATA I/O never touches the MDS: clients read/write the
+  ``.file<path>`` RADOS objects directly — but only while holding a
+  file capability granted by the MDS.
+
+Capabilities (ref: Locker, simplified to the file caps that matter at
+this scope): ``CAP_FR`` is shared-read, ``CAP_FW`` is exclusive-write.
+A conflicting open triggers revoke messages to the current holders;
+the grant is withheld until every holder acks (writers flush before
+acking), which is exactly the reference's revoke/ack dance. Sessions
+(ref: MClientSession) gate everything; closing a session drops its
+caps and wakes any waiter blocked on them.
+
+Not rebuilt: dynamic subtree partitioning/multi-MDS, client cap
+leases/timeouts, the full inode lock matrix.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.cephfs import CephFSLite, FSError, _fileobj, _norm
+from ceph_tpu.msg import Dispatcher, Messenger
+from ceph_tpu.msg.message import Message, register
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("mds")
+
+SESSION_OPEN = 1
+SESSION_CLOSE = 2
+
+CAP_FR = 1          # shared read
+CAP_FW = 2          # exclusive write
+
+CAP_OP_GRANT = 1    # mds -> client (unsolicited would go here; unused)
+CAP_OP_REVOKE = 2   # mds -> client: stop using this cap, then ack
+CAP_OP_ACK = 3      # client -> mds: revoke done (writers flushed)
+CAP_OP_RELEASE = 4  # client -> mds: voluntary drop (file close)
+
+JOURNAL_OID = ".mds_journal"
+
+
+@register
+class MClientSession(Message):
+    """ref: MClientSession (REQUEST_OPEN/REQUEST_CLOSE + ack)."""
+    TYPE = 220
+    FIELDS = [("op", "u32"), ("cseq", "u64")]
+
+
+@register
+class MClientRequest(Message):
+    """ref: MClientRequest — one metadata op. ``op`` is the lowercase
+    op name (mkdir/rmdir/readdir/stat/create/unlink/rename/open/
+    setattr); path2 = rename target; flags = cap mode for open,
+    size for setattr."""
+    TYPE = 221
+    FIELDS = [("tid", "u64"), ("op", "str"), ("path", "str"),
+              ("path2", "str"), ("flags", "u64")]
+
+
+@register
+class MClientReply(Message):
+    """ref: MClientReply. result <= 0 errno; payload = op-specific
+    JSON; cap_mode/cap_seq set for open replies."""
+    TYPE = 222
+    FIELDS = [("tid", "u64"), ("result", "s64"), ("payload", "blob"),
+              ("cap_mode", "u32"), ("cap_seq", "u64")]
+
+
+@register
+class MClientCaps(Message):
+    """ref: MClientCaps — both directions (op disambiguates)."""
+    TYPE = 223
+    FIELDS = [("op", "u32"), ("path", "str"), ("mode", "u32"),
+              ("cseq", "u64")]
+
+
+class MDSDaemon(Dispatcher):
+    """Single-rank MDS over one metadata/data pool ioctx."""
+
+    def __init__(self, ioctx, name: str = "a",
+                 messenger: Messenger | None = None):
+        self.fs = CephFSLite(ioctx)
+        self.ioctx = ioctx
+        self.msgr = messenger or Messenger(f"mds.{name}")
+        self.msgr.add_dispatcher(self)
+        self.sessions: dict[str, object] = {}       # client -> conn
+        # path -> {client: [mode, refcount]}; invariant: at most one
+        # CAP_FW holder, never FW alongside another client's FR. A
+        # same-client re-open bumps the refcount and can only upgrade
+        # the mode (FW absorbs FR); releases drop the entry at zero.
+        self.caps: dict[str, dict[str, list]] = {}
+        self._cap_seq = 0
+        # (path, client, seq) -> future resolved by the holder's ack
+        self._revoke_waiters: dict[tuple, asyncio.Future] = {}
+        # serializes the revoke+grant decision per path: without it two
+        # concurrent conflicting opens both see the pre-revoke holder
+        # table and both grant themselves exclusivity
+        self._open_locks: dict[str, asyncio.Lock] = {}
+        self._journal_seq = 0
+        self.addr = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        await self._replay_journal()
+        self.addr = await self.msgr.bind(host, port)
+        log.dout(1, f"mds up at {self.addr}")
+        return self.addr
+
+    async def stop(self) -> None:
+        await self.msgr.shutdown()
+
+    # -- journaling (ref: MDLog + EUpdate, segments of one) ---------------
+    async def _journal(self, event: dict) -> int:
+        """Append-then-apply: the event lands durably in the journal
+        omap before the dirfrag mutation happens; _commit trims it
+        after. Replay applies any event still present (idempotent ops,
+        same outcome)."""
+        self._journal_seq += 1
+        seq = self._journal_seq
+        await self.ioctx.set_omap(JOURNAL_OID, f"{seq:016d}",
+                                  json.dumps(event).encode())
+        return seq
+
+    async def _commit(self, seq: int) -> None:
+        await self.ioctx.rm_omap_key(JOURNAL_OID, f"{seq:016d}")
+
+    async def _journaled_apply(self, ev: dict) -> None:
+        """journal -> apply -> trim. The entry is trimmed on FAILURE
+        too: an op the client was told failed must not linger and
+        replay 'successfully' after conditions change (only a crash
+        between append and apply leaves an entry for replay)."""
+        seq = await self._journal(ev)
+        try:
+            await self._apply(ev)
+        finally:
+            await self._commit(seq)
+
+    async def _replay_journal(self) -> None:
+        from ceph_tpu.rados import ObjectOperationError
+        try:
+            entries = await self.ioctx.get_omap_vals(JOURNAL_OID)
+        except ObjectOperationError:
+            return
+        for k in sorted(entries):
+            ev = json.loads(entries[k])
+            log.dout(1, f"mds journal replay: {ev}")
+            try:
+                await self._apply(ev)
+            except FSError as e:
+                # idempotent replay: EEXIST/ENOENT mean the mutation
+                # already landed before the crash
+                log.dout(5, f"replay skip ({e.errno}): {ev}")
+            await self.ioctx.rm_omap_key(JOURNAL_OID, k)
+            self._journal_seq = max(self._journal_seq, int(k))
+
+    async def _apply(self, ev: dict) -> None:
+        op = ev["op"]
+        if op == "mkdir":
+            await self.fs.mkdir(ev["path"])
+        elif op == "rmdir":
+            await self.fs.rmdir(ev["path"])
+        elif op == "create":
+            # must stay idempotent AND non-destructive: a stale create
+            # replayed after the file gained data must not truncate it
+            try:
+                await self.fs.stat(ev["path"])
+            except FSError:
+                await self.fs.write_file(ev["path"], b"")
+        elif op == "unlink":
+            await self.fs.unlink(ev["path"])
+        elif op == "rename":
+            await self.fs.rename(ev["path"], ev["path2"])
+        elif op == "setattr":
+            await self.fs.set_size(ev["path"], ev["size"])
+        else:                                        # pragma: no cover
+            raise ValueError(f"unknown journal op {op}")
+
+    # -- dispatch ----------------------------------------------------------
+    async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MClientSession):
+            await self._handle_session(msg)
+            return True
+        if isinstance(msg, MClientRequest):
+            await self._handle_request(msg)
+            return True
+        if isinstance(msg, MClientCaps):
+            await self._handle_caps(msg)
+            return True
+        return False
+
+    async def _handle_session(self, m: MClientSession) -> None:
+        if m.op == SESSION_OPEN:
+            self.sessions[m.src] = m.conn
+        else:
+            self.sessions.pop(m.src, None)
+            self._drop_client_caps(m.src)
+        await m.conn.send_message(MClientSession(op=m.op, cseq=m.cseq))
+
+    def _drop_client_caps(self, client: str) -> None:
+        for path in list(self.caps):
+            if self.caps[path].pop(client, None) is not None:
+                if not self.caps[path]:
+                    del self.caps[path]
+        # a dead client can't ack: resolve its pending revokes
+        for (path, holder, seq), fut in list(self._revoke_waiters.items()):
+            if holder == client and not fut.done():
+                fut.set_result(None)
+
+    async def _handle_caps(self, m: MClientCaps) -> None:
+        if m.op == CAP_OP_ACK:
+            fut = self._revoke_waiters.pop((m.path, m.src, m.cseq), None)
+            if fut and not fut.done():
+                fut.set_result(None)
+            holders = self.caps.get(m.path, {})
+            holders.pop(m.src, None)
+            if not holders:
+                self.caps.pop(m.path, None)
+        elif m.op == CAP_OP_RELEASE:
+            holders = self.caps.get(m.path, {})
+            ent = holders.get(m.src)
+            if ent is not None:
+                ent[1] -= 1               # one handle closed; the cap
+                if ent[1] <= 0:           # survives while others remain
+                    holders.pop(m.src, None)
+            if not holders:
+                self.caps.pop(m.path, None)
+
+    async def _revoke_conflicting(self, path: str, client: str,
+                                  want: int) -> None:
+        """Send revokes to every holder whose cap conflicts with
+        ``want`` and wait for their acks (ref: Locker::revoke_client_
+        caps + the grant-after-ack ordering)."""
+        holders = self.caps.get(path, {})
+        waits = []
+        keys = []
+        for holder, (mode, _cnt) in list(holders.items()):
+            if holder == client:
+                continue
+            conflict = want == CAP_FW or mode == CAP_FW
+            if not conflict:
+                continue
+            self._cap_seq += 1
+            seq = self._cap_seq
+            fut = asyncio.get_event_loop().create_future()
+            self._revoke_waiters[(path, holder, seq)] = fut
+            keys.append((path, holder, seq))
+            conn = self.sessions.get(holder)
+            if conn is None:
+                fut.set_result(None)
+                holders.pop(holder, None)
+            else:
+                await conn.send_message(MClientCaps(
+                    op=CAP_OP_REVOKE, path=path, mode=mode, cseq=seq))
+            waits.append(fut)
+        if waits:
+            try:
+                await asyncio.wait_for(asyncio.gather(*waits),
+                                       timeout=30)
+            finally:
+                # a holder that never acks must not leak its waiter
+                for key in keys:
+                    self._revoke_waiters.pop(key, None)
+
+    async def _handle_request(self, m: MClientRequest) -> None:
+        if m.src not in self.sessions:
+            await m.conn.send_message(MClientReply(
+                tid=m.tid, result=-1, payload=b"no session",
+                cap_mode=0, cap_seq=0))
+            return
+        m.path = _norm(m.path)          # caps/journal key consistently
+        if m.path2:
+            m.path2 = _norm(m.path2)
+        result, payload, cap_mode, cap_seq = 0, b"", 0, 0
+        try:
+            if m.op in ("mkdir", "rmdir", "create", "unlink"):
+                await self._journaled_apply({"op": m.op, "path": m.path})
+            elif m.op == "rename":
+                await self._journaled_apply(
+                    {"op": "rename", "path": m.path, "path2": m.path2})
+            elif m.op == "setattr":
+                await self._journaled_apply(
+                    {"op": "setattr", "path": m.path,
+                     "size": int(m.flags)})
+            elif m.op == "readdir":
+                payload = json.dumps(await self.fs.ls(m.path)).encode()
+            elif m.op == "stat":
+                payload = json.dumps(await self.fs.stat(m.path)).encode()
+            elif m.op == "open":
+                want = int(m.flags)
+                st = None
+                try:
+                    st = await self.fs.stat(m.path)
+                except FSError:
+                    if want != CAP_FW:
+                        raise
+                if st is not None and st["type"] != "file":
+                    raise FSError(-21, "EISDIR")
+                if st is None:                       # create on open-w
+                    await self._journaled_apply(
+                        {"op": "create", "path": m.path})
+                # revoke + grant under the per-path lock: two
+                # concurrent conflicting opens must decide sequentially
+                # or both can believe they hold exclusivity
+                lock = self._open_locks.setdefault(m.path,
+                                                   asyncio.Lock())
+                async with lock:
+                    await self._revoke_conflicting(m.path, m.src, want)
+                    self._cap_seq += 1
+                    cap_seq = self._cap_seq
+                    ent = self.caps.setdefault(m.path, {}) \
+                        .setdefault(m.src, [0, 0])
+                    ent[0] = max(ent[0], want)   # FW absorbs FR
+                    ent[1] += 1
+                    cap_mode = ent[0]
+                payload = json.dumps(
+                    {"size": 0 if st is None else st["size"],
+                     "oid": _fileobj(m.path)}).encode()
+            else:
+                result = -22                          # -EINVAL
+        except FSError as e:
+            result = e.errno
+            payload = str(e).encode()
+        except asyncio.TimeoutError:
+            result = -110                             # -ETIMEDOUT
+            payload = b"cap revoke timed out"
+        await m.conn.send_message(MClientReply(
+            tid=m.tid, result=result, payload=payload,
+            cap_mode=cap_mode, cap_seq=cap_seq))
