@@ -67,16 +67,27 @@ class FileHandle:
         await self._ensure()
         if self.mode != CAP_FW:
             raise FSError(-9, "handle not open for write")  # -EBADF
-        if offset:
-            await self.client.ioctx.write(self.oid, data, offset=offset)
-            self.size = max(self.size, offset + len(data))
-        else:
-            await self.client.ioctx.write_full(self.oid, data)
-            self.size = len(data)
-        # dentry size rides a setattr through the MDS (metadata is
-        # always MDS-authoritative)
-        await self.client._request("setattr", self.path,
-                                   flags=self.size)
+        # in-flight accounting: a revoke arriving mid-write must not be
+        # acked until the data write AND its setattr have landed (the
+        # "writers flush before acking" half of the cap contract)
+        self.client._inflight[self.path] = \
+            self.client._inflight.get(self.path, 0) + 1
+        try:
+            if offset:
+                await self.client.ioctx.write(self.oid, data,
+                                              offset=offset)
+                self.size = max(self.size, offset + len(data))
+            else:
+                await self.client.ioctx.write_full(self.oid, data)
+                self.size = len(data)
+            # dentry size rides a setattr through the MDS (metadata is
+            # always MDS-authoritative)
+            await self.client._request("setattr", self.path,
+                                       flags=self.size)
+        finally:
+            self.client._inflight[self.path] -= 1
+            if self.client._inflight[self.path] <= 0:
+                self.client._inflight.pop(self.path, None)
         return len(data)
 
     async def close(self) -> None:
@@ -108,6 +119,7 @@ class CephFSClient(Dispatcher):
         self._waiters: dict[int, asyncio.Future] = {}
         self._session_fut: asyncio.Future | None = None
         self._handles: dict[str, list[FileHandle]] = {}
+        self._inflight: dict[str, int] = {}     # path -> writes in flight
 
     # -- session -----------------------------------------------------------
     async def mount(self) -> "CephFSClient":
@@ -142,15 +154,21 @@ class CephFSClient(Dispatcher):
             return True
         if isinstance(msg, MClientCaps):
             if msg.op == CAP_OP_REVOKE:
-                # write-through clients have nothing dirty to flush:
-                # invalidate handles on this path and ack at once
-                for h in self._handles.get(msg.path, []):
-                    h.valid = False
-                await msg.conn.send_message(MClientCaps(
-                    op=CAP_OP_ACK, path=msg.path, mode=msg.mode,
-                    cseq=msg.cseq))
+                # handled in a task: the ack must wait for in-flight
+                # writes, whose setattr REPLIES arrive on this very
+                # connection — blocking the reader here would deadlock
+                asyncio.ensure_future(self._handle_revoke(msg))
             return True
         return False
+
+    async def _handle_revoke(self, msg) -> None:
+        for h in self._handles.get(msg.path, []):
+            h.valid = False         # future I/O must reacquire first
+        while self._inflight.get(msg.path, 0) > 0:
+            await asyncio.sleep(0.01)   # writers flush before the ack
+        await msg.conn.send_message(MClientCaps(
+            op=CAP_OP_ACK, path=msg.path, mode=msg.mode,
+            cseq=msg.cseq))
 
     async def _send_caps(self, op: int, path: str, mode: int,
                          seq: int) -> None:
@@ -204,7 +222,10 @@ class CephFSClient(Dispatcher):
         want = CAP_FW if mode == "w" else CAP_FR   # the normalized path
         r = await self._request("open", path, flags=want)
         info = json.loads(r.payload)
-        h = FileHandle(self, path, info["oid"], int(r.cap_mode),
+        # the handle keeps the REQUESTED mode, not the granted one: a
+        # reader whose client happens to hold FW must neither pass the
+        # write check nor reacquire exclusivity after a revoke
+        h = FileHandle(self, path, info["oid"], want,
                        int(r.cap_seq), int(info["size"]))
         self._handles.setdefault(h.path, []).append(h)
         return h

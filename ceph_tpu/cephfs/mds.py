@@ -107,12 +107,17 @@ class MDSDaemon(Dispatcher):
         self._revoke_waiters: dict[tuple, asyncio.Future] = {}
         # serializes the revoke+grant decision per path: without it two
         # concurrent conflicting opens both see the pre-revoke holder
-        # table and both grant themselves exclusivity
+        # table and both grant themselves exclusivity. User-counted so
+        # entries drop when the last opener leaves (no per-path leak).
         self._open_locks: dict[str, asyncio.Lock] = {}
+        self._open_lock_users: dict[str, int] = {}
         self._journal_seq = 0
         self.addr = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        # root dirfrag first (idempotent): journal replay on a fresh
+        # pool needs it, and every request would ENOENT without it
+        await self.fs.mount()
         await self._replay_journal()
         self.addr = await self.msgr.bind(host, port)
         log.dout(1, f"mds up at {self.addr}")
@@ -315,15 +320,30 @@ class MDSDaemon(Dispatcher):
                 # or both can believe they hold exclusivity
                 lock = self._open_locks.setdefault(m.path,
                                                    asyncio.Lock())
-                async with lock:
-                    await self._revoke_conflicting(m.path, m.src, want)
-                    self._cap_seq += 1
-                    cap_seq = self._cap_seq
-                    ent = self.caps.setdefault(m.path, {}) \
-                        .setdefault(m.src, [0, 0])
-                    ent[0] = max(ent[0], want)   # FW absorbs FR
-                    ent[1] += 1
-                    cap_mode = ent[0]
+                self._open_lock_users[m.path] = \
+                    self._open_lock_users.get(m.path, 0) + 1
+                try:
+                    async with lock:
+                        await self._revoke_conflicting(m.path, m.src,
+                                                       want)
+                        self._cap_seq += 1
+                        cap_seq = self._cap_seq
+                        ent = self.caps.setdefault(m.path, {}) \
+                            .setdefault(m.src, [0, 0])
+                        ent[0] = max(ent[0], want)   # FW absorbs FR
+                        ent[1] += 1
+                        cap_mode = ent[0]
+                        # re-stat AFTER the revoke wait: a writer's
+                        # setattr may have landed while we blocked
+                        try:
+                            st = await self.fs.stat(m.path)
+                        except FSError:
+                            st = None
+                finally:
+                    self._open_lock_users[m.path] -= 1
+                    if self._open_lock_users[m.path] <= 0:
+                        self._open_lock_users.pop(m.path, None)
+                        self._open_locks.pop(m.path, None)
                 payload = json.dumps(
                     {"size": 0 if st is None else st["size"],
                      "oid": _fileobj(m.path)}).encode()
