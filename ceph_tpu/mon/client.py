@@ -36,6 +36,12 @@ class MonClient(Dispatcher):
         self._tid = 0
         self._command_waiters: dict[int, asyncio.Future] = {}
         self._cur_rank = self.monmap.ranks()[0]
+        # subscriptions live on the mon session that registered them:
+        # after hunting to another mon they must be re-wanted there or
+        # map publishes stop forever (ref: MonClient::_reopen_session
+        # + renew_subs — the round-4 deep-thrash leader-kill stall)
+        self._subs: dict[str, int] = {}
+        self._sub_rank: int | None = None
         self.osdmap = None
         self._osdmap_waiters: list[asyncio.Future] = []
         self.map_callbacks: list = []          # async fn(osdmap)
@@ -116,6 +122,7 @@ class MonClient(Dispatcher):
                         self._cur_rank = leader
                 await asyncio.sleep(0.05)
                 continue
+            await self._renew_subs_if_moved()
             return ret, rs, outbl
         return -110, f"command timed out ({last_err})", b""   # -ETIMEDOUT
 
@@ -131,6 +138,7 @@ class MonClient(Dispatcher):
                     msg, self.monmap.addr_of_rank(rank),
                     f"mon.{self.monmap.name_of_rank(rank)}"),
                     timeout=2.0)
+                await self._renew_subs_if_moved()
                 return True
             except (asyncio.TimeoutError, ConnectionError, OSError,
                     AuthError, ConnectionError_):
@@ -141,11 +149,40 @@ class MonClient(Dispatcher):
     # -- maps --------------------------------------------------------------
     async def subscribe(self, what: str = "osdmap",
                         start: int = 0) -> None:
-        """ref: MonClient::sub_want + renew_subs."""
-        await self.msgr.send_message(
-            MMonSubscribe(what={what: str(start)}),
-            self.monmap.addr_of_rank(self._cur_rank),
-            f"mon.{self.monmap.name_of_rank(self._cur_rank)}")
+        """ref: MonClient::sub_want + renew_subs. Hunts like
+        send_report: a dead current mon must rotate, not raise — every
+        caller (incl. the objecter's map-refresh retry loop) treats
+        subscription as fire-and-forget."""
+        self._subs[what] = start
+        ranks = self.monmap.ranks()
+        for _ in range(len(ranks)):
+            rank = self._cur_rank
+            try:
+                await asyncio.wait_for(self.msgr.send_message(
+                    MMonSubscribe(what={what: str(start)}),
+                    self.monmap.addr_of_rank(rank),
+                    f"mon.{self.monmap.name_of_rank(rank)}"),
+                    timeout=2.0)
+                self._sub_rank = rank
+                return
+            except (asyncio.TimeoutError, ConnectionError, OSError,
+                    AuthError, ConnectionError_):
+                self._cur_rank = ranks[(ranks.index(rank) + 1)
+                                       % len(ranks)]
+        self._sub_rank = None
+
+    async def _renew_subs_if_moved(self) -> None:
+        """Re-register subscriptions after mon hunting moved the
+        session away from the rank that holds them. _sub_rank is None
+        when a previous registration failed everywhere — that means
+        RENEW (nobody holds our subs), not skip."""
+        if not self._subs or self._sub_rank == self._cur_rank:
+            return
+        for what in list(self._subs):
+            start = self._subs[what]
+            if what == "osdmap" and self.osdmap is not None:
+                start = self.osdmap.epoch + 1
+            await self.subscribe(what, start)   # hunts internally
 
     async def wait_for_osdmap(self, min_epoch: int = 1,
                               timeout: float = 10.0):

@@ -194,20 +194,6 @@ class OSD(Dispatcher):
         for pg in self.pgs.values():
             by_pool.setdefault(pg.pool.id, []).append(pg)
         for pool in osdmap.pools.values():
-            # pg splitting (ref: OSD::consume_map split tracking): a
-            # grown pg_num re-folds object names; every existing local
-            # PG moves its re-folded objects into child collections
-            # BEFORE the new child PGs instantiate and peer below.
-            # Besides the in-memory pg_num transition, run the
-            # (idempotent, store-derived) split once per PG instance:
-            # an OSD that BOOTS after the increase builds its PGs from
-            # the new map and would otherwise never observe a delta,
-            # stranding re-folded objects in the parent collection.
-            for pg in by_pool.get(pool.id, []):
-                if pool.pg_num > pg.pool.pg_num or \
-                        not getattr(pg, "_split_checked", False):
-                    pg.split_objects(osdmap, pool)
-                    pg._split_checked = True
             seeds = np.arange(pool.pg_num, dtype=np.uint32)
             up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
                 pool.id, seeds)
@@ -221,6 +207,34 @@ class OSD(Dispatcher):
                 if str(pgid) not in self.pgs:
                     pg = self.pgs[str(pgid)] = cls(self, pool, pgid)
                     by_pool.setdefault(pool.id, []).append(pg)
+            # pg splitting (ref: OSD::consume_map split tracking): a
+            # grown pg_num re-folds object names; every local PG moves
+            # its re-folded objects AND log entries into the child
+            # BEFORE anything peers at the new map. Runs AFTER child
+            # instantiation so split_objects can update the children's
+            # in-memory logs (a child instance constructed above loaded
+            # its pre-split — possibly empty — persisted log). Besides
+            # the in-memory pg_num transition, the (idempotent,
+            # store-derived) split runs once per PG instance: an OSD
+            # that BOOTS after the increase builds its PGs from the new
+            # map and would otherwise never observe a delta, stranding
+            # re-folded objects in the parent collection.
+            for pg in list(by_pool.get(pool.id, [])):
+                if pool.pg_num > pg.pool.pg_num or \
+                        not getattr(pg, "_split_checked", False):
+                    touched = pg.split_objects(osdmap, pool)
+                    pg._split_checked = True
+                    # a batched pg_num+pgp_num consume can move a child
+                    # away before it ever instantiates here: create the
+                    # instance for any child we hold data for, so it
+                    # becomes a STRAY that announces itself to the new
+                    # primary instead of silently stranding the data
+                    for child_cid in touched:
+                        if child_cid not in self.pgs:
+                            cseed = int(child_cid.split(".")[1], 16)
+                            cpg = self.pgs[child_cid] = cls(
+                                self, pool, pg_t(pool.id, cseed))
+                            by_pool[pool.id].append(cpg)
             for pg in by_pool.get(pool.id, []):
                 row = pg.pgid.seed
                 pg.pool = pool
@@ -320,7 +334,11 @@ class OSD(Dispatcher):
                 pg.handle_pg_query(msg)
             return True
         if isinstance(msg, MOSDPGInfo):
-            pg = self._pg_for(msg.pgid)
+            # create=True: an unsolicited stray NOTIFY may beat this
+            # primary's own consume_map to the PG — dropping it loses
+            # the only pointer to the data's old location
+            pg = self._pg_for(msg.pgid, create=bool(
+                getattr(msg, "notify", 0)))
             if pg is not None:
                 pg.handle_pg_info(msg)
             return True

@@ -56,6 +56,15 @@ def _vparse(b: bytes | None) -> eversion:
                     int.from_bytes(b[4:12], "little"))
 
 
+class UnreadableNow(Exception):
+    """The object exists but fewer than k fresh shards are reachable
+    RIGHT NOW (a revived shard still recovering plus a down shard, mid-
+    peering churn, ...). Transient by construction: recovery or the next
+    map refills the shard set, so the op must be retried, never failed
+    with a terminal errno (ref: PrimaryLogPG::wait_for_unreadable_object
+    — upstream parks the op on the recovery queue)."""
+
+
 class ECPG(PG):
     def __init__(self, osd, pool, pgid):
         super().__init__(osd, pool, pgid)
@@ -155,10 +164,14 @@ class ECPG(PG):
         if want <= set(avail):
             return np.stack([avail[c] for c in range(self.k)], axis=1)
         # degraded: decode missing data chunks from what we have
-        need = self.ec.minimum_to_decode(want, list(avail))
-        if not set(need) <= set(avail):
-            raise StoreError(
-                f"{oid}: cannot decode (have {sorted(avail)})")
+        try:
+            need = self.ec.minimum_to_decode(want, list(avail))
+        except ValueError:
+            need = None
+        if need is None or not set(need) <= set(avail):
+            raise UnreadableNow(
+                f"{oid}: {len(avail)} fresh shards < k={self.k} "
+                f"(have {sorted(avail)})")
         use = sorted(need)
         stacked = np.stack([avail[c] for c in use], axis=1)
         missing = sorted(want - set(avail))
@@ -176,6 +189,13 @@ class ECPG(PG):
         reqid = (m.src, getattr(m.conn, "peer_session", 0), m.tid)
         store = self.osd.store
         oid = m.oid
+        if oid in self.my_missing:
+            # this primary's own shard of the object is still being
+            # recovered: the op must neither see -ENOENT nor mutate
+            # around the missing state (ref: PrimaryLogPG::
+            # wait_for_unreadable_object); the objecter retries -EAGAIN
+            await self._reply(m, -11, b"", {})
+            return
         data_out = b""
         extra: dict = {}
         # edits: (offset, bytes) merges; specials for truncate/delete
@@ -190,6 +210,10 @@ class ECPG(PG):
             if code == OSD_OP_READ:
                 try:
                     data_out = await self._read_range(oid, off, length)
+                except UnreadableNow as e:
+                    log.dout(5, f"pg {self.pgid} read parks: {e}")
+                    await self._reply(m, -11, b"", {})  # retry later
+                    return
                 except StoreError:
                     await self._reply(m, -2, b"", {})
                     return
@@ -299,6 +323,34 @@ class ECPG(PG):
         if len(live) < self.pool.min_size:
             return -11
         exists, _, old_version, old_size = self._local_shard_state(oid)
+        old = None
+        if not deleted and write_full is None:
+            size = old_size if exists else 0
+            hi = max([off + len(b) for off, b in edits], default=0)
+            size = max(size, hi)
+            if new_size is not None:
+                size = new_size
+            span_lo = min([off for off, _ in edits], default=0)
+            span_hi = max(hi, size if new_size is not None else 0)
+            if new_size is not None and exists:
+                span_lo = 0 if not edits else min(span_lo, new_size)
+                span_hi = max(span_hi, old_size)
+            first, count = self.sinfo.stripe_range(
+                span_lo, max(span_hi - span_lo, 1))
+            # RMW: read the touched stripes' old contents BEFORE the
+            # log append — a transiently unreadable object (fewer than
+            # k fresh shards mid-recovery) must EAGAIN with no side
+            # effects, not log an entry it then cannot apply
+            if exists:
+                try:
+                    old = await self._gather(oid, first, count,
+                                             old_version)
+                except UnreadableNow as e:
+                    log.dout(5, f"pg {self.pgid} rmw parks: {e}")
+                    return -11
+            else:
+                old = np.zeros((count, self.k, self.sinfo.chunk_size),
+                               dtype=np.uint8)
         self.last_user_version += 1
         version = eversion(self.epoch, self.last_user_version)
         entry = self.pg_log.add(
@@ -316,24 +368,6 @@ class ECPG(PG):
             buf[:size] = np.frombuffer(logical, dtype=np.uint8)
             trunc_stripes = count
         else:
-            size = old_size if exists else 0
-            hi = max([off + len(b) for off, b in edits], default=0)
-            size = max(size, hi)
-            if new_size is not None:
-                size = new_size
-            span_lo = min([off for off, _ in edits], default=0)
-            span_hi = max(hi, size if new_size is not None else 0)
-            if new_size is not None and exists:
-                span_lo = 0 if not edits else min(span_lo, new_size)
-                span_hi = max(span_hi, old_size)
-            first, count = self.sinfo.stripe_range(
-                span_lo, max(span_hi - span_lo, 1))
-            # RMW: read the touched stripes' old contents
-            if exists:
-                old = await self._gather(oid, first, count, old_version)
-            else:
-                old = np.zeros((count, self.k, self.sinfo.chunk_size),
-                               dtype=np.uint8)
             buf = old.reshape(-1).copy()
             W = self.sinfo.stripe_width
             base = first * W

@@ -187,11 +187,16 @@ class MOSDPGQuery(Message):
 @register
 class MOSDPGInfo(Message):
     """Peer's view: last_update + full log blob (ref: MOSDPGInfo/
-    MOSDPGLog merged — logs here are small enough to ship whole)."""
+    MOSDPGLog merged — logs here are small enough to ship whole).
+    ``notify=1`` marks an UNSOLICITED stray announcement (ref:
+    MOSDPGNotify): a map change moved the PG off this OSD, and the new
+    primary — possibly a fresh instance with no history — must learn
+    this stray exists before activating empty. ``intervals`` ships the
+    sender's past_intervals (JSON) for the primary's coverage gate."""
 
     TYPE = 171
     FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32"),
-              ("log", "blob")]
+              ("log", "blob"), ("notify", "u8"), ("intervals", "str")]
 
 
 @register

@@ -100,6 +100,11 @@ class PG:
         self._peering_task: asyncio.Task | None = None
         self._info_waiter: asyncio.Future | None = None
         self._expected_infos: set[int] = set()
+        # OSDs that announced data for this PG (MOSDPGNotify model):
+        # their identity survives the per-round peer_logs rebuild, so
+        # every peering round re-queries them even if their one
+        # announcement raced a wipe
+        self._notifiers: set[int] = set()
         # op pipeline
         self.op_queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
@@ -227,6 +232,22 @@ class PG:
             if self._worker:
                 self._worker.cancel()
                 self._worker = None
+            if self.state == "stray" and primary >= 0 \
+                    and primary != self.osd.whoami:
+                # announce ourselves to the new primary (ref:
+                # MOSDPGNotify): a pgp_num change (pg splitting's
+                # migration phase) can hand the PG to OSDs that hold
+                # none of its data — without this notify a FRESH
+                # primary instance has no way to learn the data's old
+                # location and would activate empty. Re-sent on EVERY
+                # map advance while stray: a one-shot notify can land
+                # mid-peering (peer_logs is rebuilt there) and be lost.
+                asyncio.ensure_future(self.osd.send_osd(
+                    primary, MOSDPGInfo(
+                        pgid=self.cid, epoch=epoch,
+                        from_osd=self.osd.whoami,
+                        log=self.pg_log.encode(), notify=1,
+                        intervals=json.dumps(self.past_intervals))))
 
     def live_acting(self) -> list[int]:
         return [o for o in self.acting
@@ -272,6 +293,7 @@ class PG:
         prior = set()
         for iv in active_ivs:
             prior.update(iv[2])
+        prior |= self._notifiers     # announced data holders (notify)
         prior -= set(self.acting)
         prior.discard(self.osd.whoami)
         strays = [o for o in sorted(prior) if self.osd.osd_is_up(o)]
@@ -331,12 +353,37 @@ class PG:
             if plog.head > best.head:
                 best, best_osd = plog, o
         if best_osd != self.osd.whoami:
-            self.my_missing = self.pg_log.merge(best)
+            # merge may ADD to my_missing; leftovers from an earlier
+            # interval whose pulls failed must stay until recovered —
+            # our log may now BE the best (merged last round) while the
+            # object bytes still aren't here
+            self.my_missing.update(self.pg_log.merge(best))
             t = self._meta_txn(Transaction())
             self.osd.store.queue_transaction(t)
-            # pull objects the primary itself lacks
+        if self.my_missing:
+            # pull objects the primary itself lacks. Source selection
+            # matters: a peer whose log never saw the object would stay
+            # silent (handle_pg_pull), so prefer one whose log carries
+            # the exact entry we need; the merged-from peer qualifies.
+            peer_newest = {o: plog.newest_per_object()
+                           for o, plog in self.peer_logs.items()}
             for oid, entry in list(self.my_missing.items()):
-                await self._pull(best_osd, oid)
+                src = -1
+                if best_osd != self.osd.whoami:
+                    src = best_osd
+                else:
+                    for o, newest in peer_newest.items():
+                        ne = newest.get(oid)
+                        if ne is not None and \
+                                ne.version == entry.version and \
+                                self.osd.osd_is_up(o):
+                            src = o
+                            break
+                    if src < 0:
+                        src = next((o for o in self.live_acting()
+                                    if o != self.osd.whoami), -1)
+                if src >= 0:
+                    await self._pull(src, oid)
             if self.my_missing:
                 # do NOT activate with stale objects: a client read
                 # would serve pre-outage data. Retry the interval.
@@ -361,10 +408,38 @@ class PG:
     def handle_pg_query(self, m: MOSDPGQuery) -> None:
         asyncio.ensure_future(self.osd.send_osd(m.from_osd, MOSDPGInfo(
             pgid=self.cid, epoch=self.epoch, from_osd=self.osd.whoami,
-            log=self.pg_log.encode())))
+            log=self.pg_log.encode(), notify=0, intervals="")))
 
     def handle_pg_info(self, m: MOSDPGInfo) -> None:
-        self.peer_logs[m.from_osd] = PGLog.decode(m.log)
+        plog = PGLog.decode(m.log)
+        self.peer_logs[m.from_osd] = plog
+        if m.notify:
+            # unsolicited stray announcement (ref: MOSDPGNotify): merge
+            # its interval history so the coverage gate knows this OSD,
+            # and if it knows writes we don't (a pgp_num change moved
+            # the PG here before any data followed), re-peer — its log
+            # now competes in find_best_info and recovery pulls from it
+            self._notifiers.add(m.from_osd)
+            if m.intervals:
+                try:
+                    have = {json.dumps(iv) for iv in self.past_intervals}
+                    for iv in json.loads(m.intervals):
+                        # prune like advance() does: an interval that
+                        # closed before our last clean epoch is already
+                        # covered — merging it verbatim could wedge the
+                        # coverage gate on long-dead OSDs
+                        if json.dumps(iv) not in have and \
+                                len(iv) >= 2 and \
+                                iv[1] >= self.last_epoch_clean:
+                            self.past_intervals.append(iv)
+                except (ValueError, TypeError):
+                    pass
+            if self.is_primary() and plog.head > self.pg_log.head and \
+                    self.state in ("active", "recovering", "clean"):
+                log.dout(1, f"pg {self.pgid} stray osd.{m.from_osd} "
+                            f"knows newer writes; re-peering")
+                self.state = "peering"
+                self.osd.request_repeer(self, delay=0.1)
         expected = self._expected_infos or set(
             o for o in self.live_acting() if o != self.osd.whoami)
         if self._info_waiter and not self._info_waiter.done() and \
@@ -533,7 +608,7 @@ class PG:
             fut.set_result(True)
 
     # -- pg splitting ------------------------------------------------------
-    def split_objects(self, osdmap, new_pool) -> int:
+    def split_objects(self, osdmap, new_pool) -> set:
         """pg_num grew: move every local object whose name now folds to
         a CHILD pg seed into that child's collection (ref: PG::
         split_into + pg_t::is_split — ceph_stable_mod guarantees a
@@ -543,15 +618,20 @@ class PG:
 
         Runs on every replica identically (deterministic name fold), so
         post-split logs and stores stay consistent across the acting
-        set. Idempotent: re-running moves nothing. Returns the number
-        of objects moved."""
+        set. Idempotent: re-running moves nothing. Returns the child
+        cids that received objects or log entries — the caller must
+        ensure those children have local PG instances even when this
+        OSD is not in their latest acting set (a batched pg_num +
+        pgp_num map consume can move a child away in the same pass;
+        without an instance there is no stray to announce the data)."""
         self._clone_idx = None          # clones move with their heads
         import numpy as np
         from ceph_tpu.osd.types import ObjectLocator, pg_t as _pg_t
         store = self.osd.store
         if self.cid not in store.list_collections():
-            return 0
+            return set()
         moved = 0
+        touched: set[str] = set()
         loc = ObjectLocator(pool=self.pool.id)
         for oid in list(store.list_objects(self.cid)):
             if oid == PGMETA:
@@ -587,10 +667,66 @@ class PG:
             t.remove(self.cid, oid)
             store.queue_transaction(t)
             moved += 1
-        if moved:
-            log.dout(1, f"pg {self.pgid} split: moved {moved} objects "
-                        f"(pg_num -> {new_pool.pg_num})")
-        return moved
+            touched.add(child_cid)
+        # Split the PG LOG with the objects (ref: PGLog::split_into).
+        # Store moves alone are NOT enough: a replica that missed the
+        # writes (down during them) has the hole in neither child store
+        # nor child log — every peer's child log would be empty, the
+        # logs compare equal, and the acked object is never recovered
+        # (objects vanished under the round-4 deep thrash's pg_num
+        # growth mid-recovery). Moving the entries lets the child's
+        # peering see exactly the divergence the parent's log recorded.
+        child_logs: dict[str, PGLog] = {}
+        keep: list[LogEntry] = []
+        for entry in self.pg_log.entries:
+            raw = osdmap.object_locator_to_pg(
+                clone_head(entry.oid) or entry.oid, loc)
+            seed = int(new_pool.raw_pg_to_pg(
+                np.asarray([raw.seed]), xp=np)[0])
+            if seed == self.pgid.seed:
+                keep.append(entry)
+                continue
+            child_cid = str(_pg_t(self.pool.id, seed))
+            clog = child_logs.get(child_cid)
+            if clog is None:
+                clog = PGLog()
+                try:
+                    blob = store.omap_get(child_cid, PGMETA).get(
+                        "pg_log")
+                    if blob:
+                        clog = PGLog.decode(blob)
+                except StoreError:
+                    pass
+                child_logs[child_cid] = clog
+            clog.append(entry)
+        if child_logs:
+            self.pg_log.entries = keep
+            for child_cid, clog in child_logs.items():
+                clog.entries.sort(key=lambda en: (en.version.epoch,
+                                                  en.version.v))
+                if clog.entries:
+                    clog.head = clog.entries[-1].version
+                t = Transaction()
+                if child_cid not in store.list_collections():
+                    t.create_collection(child_cid)
+                    t.touch(child_cid, PGMETA)
+                t.omap_setkeys(child_cid, PGMETA,
+                               {"pg_log": clog.encode()})
+                store.queue_transaction(t)
+                # an already-instantiated child loaded its pre-split
+                # persisted log; hand it the split result in memory too
+                child_pg = self.osd.pgs.get(child_cid)
+                if child_pg is not None:
+                    child_pg.pg_log = clog
+                    child_pg.last_user_version = max(
+                        child_pg.last_user_version, clog.head.v)
+            store.queue_transaction(self._meta_txn(Transaction()))
+        touched.update(child_logs)
+        if moved or child_logs:
+            log.dout(1, f"pg {self.pgid} split: moved {moved} objects, "
+                        f"{sum(len(c.entries) for c in child_logs.values())} "
+                        f"log entries (pg_num -> {new_pool.pg_num})")
+        return touched
 
     # -- recovery ----------------------------------------------------------
     async def _pull(self, from_osd: int, oid: str) -> None:
@@ -608,6 +744,17 @@ class PG:
             self._push_waiters.pop(oid, None)
 
     def handle_pg_pull(self, m: MOSDPGPull) -> None:
+        # only answer exists=False when OUR LOG says the object was
+        # deleted — a peer that merely never had the object (stale log,
+        # mid-split, mid-recovery itself) must stay silent, or the
+        # puller would "recover" the absence as an authoritative delete
+        # and drop an acked object (round-4 deep thrash, obj35)
+        if not self.osd.store.exists(self.cid, m.oid):
+            newest = self.pg_log.newest_per_object().get(m.oid)
+            if newest is None or newest.op != OP_DELETE:
+                log.dout(1, f"pg {self.pgid} pull of {m.oid}: absent "
+                            f"here with no delete entry; not answering")
+                return
         asyncio.ensure_future(
             self.osd.send_osd(m.from_osd, self.make_push(m.oid)))
 
@@ -811,6 +958,14 @@ class PG:
         # reqid = (entity, messenger incarnation, tid) — distinct client
         # processes sharing a name must not collide
         reqid = (m.src, getattr(m.conn, "peer_session", 0), m.tid)
+        if m.oid in self.my_missing:
+            # a just-promoted/revived primary may not yet hold this
+            # object: serving now would return -ENOENT for an existing
+            # object (or mutate around missing state). Park via -EAGAIN
+            # until recovery lands it (ref: PrimaryLogPG::
+            # wait_for_unreadable_object).
+            await self._reply(m, -11, b"", {})
+            return
         mutating = {OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_TRUNCATE,
                     OSD_OP_ZERO, OSD_OP_DELETE, OSD_OP_SETXATTR,
                     OSD_OP_OMAP_SET, OSD_OP_SNAPTRIM}
