@@ -231,9 +231,8 @@ class OSD(Dispatcher):
                     # primary instead of silently stranding the data
                     for child_cid in touched:
                         if child_cid not in self.pgs:
-                            cseed = int(child_cid.split(".")[1], 16)
                             cpg = self.pgs[child_cid] = cls(
-                                self, pool, pg_t(pool.id, cseed))
+                                self, pool, pg_t.parse(child_cid))
                             by_pool[pool.id].append(cpg)
             for pg in by_pool.get(pool.id, []):
                 row = pg.pgid.seed

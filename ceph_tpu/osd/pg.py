@@ -312,8 +312,11 @@ class PG:
                 pass
             finally:
                 self._info_waiter = None
-            if set(self.peer_logs) < set(query):
-                # a peer didn't answer; retry soon (map may be stale)
+            if not set(query) <= set(self.peer_logs):
+                # a QUERIED peer didn't answer; retry soon (map may be
+                # stale). Subset test, not proper-subset: an
+                # unsolicited notify landing in peer_logs mid-wait must
+                # not mask a queried peer's silence.
                 self.state = "peering"
                 self.osd.request_repeer(self, delay=0.5)
                 return
@@ -368,22 +371,29 @@ class PG:
             peer_newest = {o: plog.newest_per_object()
                            for o, plog in self.peer_logs.items()}
             for oid, entry in list(self.my_missing.items()):
-                src = -1
+                # candidate sources in preference order; ROTATE through
+                # them — a single fixed source whose log has the entry
+                # but whose store lacks the bytes (its own pulls failed
+                # earlier) stays silent, and retrying only it would
+                # livelock while another peer holds the object
+                cands: list[int] = []
                 if best_osd != self.osd.whoami:
-                    src = best_osd
-                else:
-                    for o, newest in peer_newest.items():
-                        ne = newest.get(oid)
-                        if ne is not None and \
-                                ne.version == entry.version and \
-                                self.osd.osd_is_up(o):
-                            src = o
-                            break
-                    if src < 0:
-                        src = next((o for o in self.live_acting()
-                                    if o != self.osd.whoami), -1)
-                if src >= 0:
+                    cands.append(best_osd)
+                for o, newest in peer_newest.items():
+                    ne = newest.get(oid)
+                    if ne is not None and ne.version == entry.version:
+                        cands.append(o)
+                cands.extend(o for o in self.live_acting())
+                seen: set[int] = set()
+                for src in cands:
+                    if src in seen or src < 0 or \
+                            src == self.osd.whoami or \
+                            not self.osd.osd_is_up(src):
+                        continue
+                    seen.add(src)
                     await self._pull(src, oid)
+                    if oid not in self.my_missing:
+                        break
             if self.my_missing:
                 # do NOT activate with stale objects: a client read
                 # would serve pre-outage data. Retry the interval.
@@ -398,6 +408,17 @@ class PG:
         self.peer_missing = {
             o: plog.missing_vs(self.pg_log)
             for o, plog in self.peer_logs.items() if o in self.acting}
+        # a notify that raced this round (landed after find_best_info
+        # ran) may know newer acked writes: go again rather than
+        # activating and serving stale data. Terminates: the next round
+        # adopts that log, making its head ours.
+        if any(pl.head > self.pg_log.head
+               for pl in self.peer_logs.values()):
+            log.dout(1, f"pg {self.pgid} raced notify knows newer "
+                        f"writes; re-peering")
+            self.state = "peering"
+            self.osd.request_repeer(self, delay=0.2)
+            return
         self.state = "active"
         if self._worker is None:
             self._worker = asyncio.ensure_future(self._op_worker())
@@ -423,6 +444,7 @@ class PG:
             if m.intervals:
                 try:
                     have = {json.dumps(iv) for iv in self.past_intervals}
+                    added = False
                     for iv in json.loads(m.intervals):
                         # prune like advance() does: an interval that
                         # closed before our last clean epoch is already
@@ -432,14 +454,33 @@ class PG:
                                 len(iv) >= 2 and \
                                 iv[1] >= self.last_epoch_clean:
                             self.past_intervals.append(iv)
+                            added = True
+                    if added:
+                        # persist: merged intervals gate activation
+                        # exactly like our own (advance() persists for
+                        # the same reason) — a crash must not forget
+                        # them
+                        try:
+                            self.osd.store.queue_transaction(
+                                self._meta_txn(Transaction()))
+                        except StoreError as e:
+                            log.error(f"pg {self.pgid} interval "
+                                      f"persist failed: {e}")
                 except (ValueError, TypeError):
                     pass
-            if self.is_primary() and plog.head > self.pg_log.head and \
-                    self.state in ("active", "recovering", "clean"):
+            if self.is_primary() and plog.head > self.pg_log.head:
+                # the stray knows writes we don't. Re-peer when settled;
+                # when a round is mid-flight (it may already have passed
+                # find_best_info), queue ANOTHER round — peer_logs keeps
+                # this log, and _notifiers guarantees the stray is
+                # re-queried even if it gets wiped
                 log.dout(1, f"pg {self.pgid} stray osd.{m.from_osd} "
                             f"knows newer writes; re-peering")
-                self.state = "peering"
-                self.osd.request_repeer(self, delay=0.1)
+                if self.state in ("active", "recovering", "clean"):
+                    self.state = "peering"
+                    self.osd.request_repeer(self, delay=0.1)
+                # mid-peering arrivals are handled by the end-of-round
+                # raced-notify check in _peer_inner
         expected = self._expected_infos or set(
             o for o in self.live_acting() if o != self.osd.whoami)
         if self._info_waiter and not self._info_waiter.done() and \
@@ -701,6 +742,11 @@ class PG:
             clog.append(entry)
         if child_logs:
             self.pg_log.entries = keep
+            # the parent's head must describe entries it still HAS:
+            # keeping a head that moved to a child would win
+            # find_best_info with a log that lacks writes a sibling
+            # replica retained
+            self.pg_log.head = keep[-1].version if keep else eversion()
             for child_cid, clog in child_logs.items():
                 clog.entries.sort(key=lambda en: (en.version.epoch,
                                                   en.version.v))
