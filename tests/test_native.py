@@ -201,6 +201,37 @@ class TestJaxReverseShim:
         vt.destroy(be)
 
 
+class TestSanitizerTier:
+    """ASan build of the native runtime (the reference's sanitizer qa
+    tier, scaled to this runtime): instrumented encode + decode +
+    dlopen plugin load must run with leak detection on and report
+    nothing (ASan exits non-zero on any finding)."""
+
+    def test_asan_encode_decode_verify(self):
+        import os
+        import pathlib
+        native = pathlib.Path(__file__).resolve().parent.parent / "native"
+        r = subprocess.run(
+            ["make", "-C", str(native), "SANITIZE=address",
+             "BUILD=build-asan"],
+            capture_output=True, text=True, timeout=300)
+        if r.returncode != 0:
+            pytest.skip(f"asan build unavailable: {r.stderr[-200:]}")
+        build = native / "build-asan"
+        for workload, extra in (("encode", []),
+                                ("decode", ["--erasures", "2"])):
+            out = subprocess.run(
+                [str(build / "ec_bench"), "--plugin", "rsvan", "--dir",
+                 str(build), "--workload", workload, "--size", "262144",
+                 "--iterations", "2", "--parameter", "k=8",
+                 "--parameter", "m=3", "--verify"] + extra,
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ, ASAN_OPTIONS="detect_leaks=1"))
+            assert out.returncode == 0, (workload, out.stderr[-500:])
+            assert "verify: ok" in out.stderr
+            assert "AddressSanitizer" not in out.stderr
+
+
 class TestNativeBench:
     def test_ec_bench_binary(self):
         from ceph_tpu.interop.native import native_build_dir
