@@ -19,11 +19,26 @@ north-star ``crush_mappings_per_s`` (batched pg->osd mapping rate).
 
 import json
 import os
+import re
 import sys
 import time
 import traceback
 
 BASELINE_GIBS = 7.5  # ISA-L RS k=8,m=3 single-core (BASELINE.md external row)
+
+_ANSI = re.compile(r"\x1b\[[0-9;]*m")
+
+
+def _short_err(limit: int = 400) -> str:
+    """Compact one-line rendering of the current exception.
+
+    Round 4's lesson: a raw ``format_exc`` of a TPU compile error embeds
+    kilobytes of runtime log (with ANSI escapes) into the JSON line and
+    the driver fails to parse it — the whole round's number is lost.
+    Strip escapes, keep the last few non-empty lines, hard-cap length."""
+    s = _ANSI.sub("", traceback.format_exc(limit=2))
+    lines = [ln.strip() for ln in s.splitlines() if ln.strip()]
+    return " | ".join(lines[-4:])[:limit]
 
 
 def ec_metrics() -> tuple[dict, dict, dict]:
@@ -73,7 +88,7 @@ def crush_metric() -> dict:
             n_osds=10240, n_pgs=n_pgs, num_rep=3,
             variants=("mixed_weight", "choose_args"))
     except Exception:
-        res["variants_error"] = traceback.format_exc(limit=3)
+        res["variants_error"] = _short_err()
     return res
 
 
@@ -142,13 +157,13 @@ def main() -> None:
             detail.pop("crush_error", None)
             break
         except Exception:
-            detail["crush_error"] = traceback.format_exc(limit=3)
+            detail["crush_error"] = _short_err()
             if attempt == 1:
                 time.sleep(90)
     try:
         detail["balancer"] = balancer_metric()
     except Exception:
-        detail["balancer_error"] = traceback.format_exc(limit=3)
+        detail["balancer_error"] = _short_err()
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),

@@ -34,6 +34,7 @@ import json
 from ceph_tpu.cephfs import CephFSLite, FSError, _fileobj, _norm
 from ceph_tpu.msg import Dispatcher, Messenger
 from ceph_tpu.msg.message import Message, register
+from ceph_tpu.utils.locks import KeyedLocks
 from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("mds")
@@ -109,8 +110,8 @@ class MDSDaemon(Dispatcher):
         # concurrent conflicting opens both see the pre-revoke holder
         # table and both grant themselves exclusivity. User-counted so
         # entries drop when the last opener leaves (no per-path leak).
-        self._open_locks: dict[str, asyncio.Lock] = {}
-        self._open_lock_users: dict[str, int] = {}
+        self._open_locks = KeyedLocks()
+        self._req_tasks: set[asyncio.Task] = set()
         self._journal_seq = 0
         self.addr = None
 
@@ -124,6 +125,14 @@ class MDSDaemon(Dispatcher):
         return self.addr
 
     async def stop(self) -> None:
+        # cancel detached request handlers FIRST: a handler parked in
+        # the 30 s revoke wait must not outlive the daemon and mutate
+        # caps / append journal events a later MDS would replay
+        for t in list(self._req_tasks):
+            t.cancel()
+        if self._req_tasks:
+            await asyncio.gather(*self._req_tasks,
+                                 return_exceptions=True)
         await self.msgr.shutdown()
 
     # -- journaling (ref: MDLog + EUpdate, segments of one) ---------------
@@ -198,7 +207,17 @@ class MDSDaemon(Dispatcher):
             await self._handle_session(msg)
             return True
         if isinstance(msg, MClientRequest):
-            await self._handle_request(msg)
+            # Own task, NOT awaited: the messenger's reader loop
+            # dispatches serially per connection, so an open blocked in
+            # the revoke/ack wait would head-of-line-block every later
+            # frame from that client — including its own CAP_OP_ACK,
+            # deadlocking two clients that each hold a cap the other's
+            # open needs (the reference MDS never blocks the dispatcher
+            # on Locker revocation). Per-path _open_locks keep the
+            # ordering that matters.
+            t = asyncio.ensure_future(self._handle_request(msg))
+            self._req_tasks.add(t)
+            t.add_done_callback(self._req_task_done)
             return True
         if isinstance(msg, MClientCaps):
             await self._handle_caps(msg)
@@ -278,6 +297,12 @@ class MDSDaemon(Dispatcher):
                 for key in keys:
                     self._revoke_waiters.pop(key, None)
 
+    def _req_task_done(self, t: asyncio.Task) -> None:
+        self._req_tasks.discard(t)
+        if not t.cancelled() and t.exception() is not None:
+            log.dout(0, f"client request task failed: "
+                        f"{t.exception()!r}")
+
     async def _handle_request(self, m: MClientRequest) -> None:
         if m.src not in self.sessions:
             await m.conn.send_message(MClientReply(
@@ -304,46 +329,41 @@ class MDSDaemon(Dispatcher):
                 payload = json.dumps(await self.fs.stat(m.path)).encode()
             elif m.op == "open":
                 want = int(m.flags)
-                st = None
-                try:
-                    st = await self.fs.stat(m.path)
-                except FSError:
-                    if want != CAP_FW:
-                        raise
-                if st is not None and st["type"] != "file":
-                    raise FSError(-21, "EISDIR")
-                if st is None:                       # create on open-w
-                    await self._journaled_apply(
-                        {"op": "create", "path": m.path})
-                # revoke + grant under the per-path lock: two
-                # concurrent conflicting opens must decide sequentially
-                # or both can believe they hold exclusivity
-                lock = self._open_locks.setdefault(m.path,
-                                                   asyncio.Lock())
-                self._open_lock_users[m.path] = \
-                    self._open_lock_users.get(m.path, 0) + 1
-                try:
-                    async with lock:
-                        await self._revoke_conflicting(m.path, m.src,
-                                                       want)
-                        self._cap_seq += 1
-                        cap_seq = self._cap_seq
-                        ent = self.caps.setdefault(m.path, {}) \
-                            .setdefault(m.src, [0, 0])
-                        ent[0] = max(ent[0], want)   # FW absorbs FR
-                        ent[1] += 1
-                        cap_mode = ent[0]
-                        # re-stat AFTER the revoke wait: a writer's
-                        # setattr may have landed while we blocked
-                        try:
-                            st = await self.fs.stat(m.path)
-                        except FSError:
-                            st = None
-                finally:
-                    self._open_lock_users[m.path] -= 1
-                    if self._open_lock_users[m.path] <= 0:
-                        self._open_lock_users.pop(m.path, None)
-                        self._open_locks.pop(m.path, None)
+                # stat + create-on-open + revoke + grant all under the
+                # per-path lock: two concurrent conflicting opens must
+                # decide sequentially or both can believe they hold
+                # exclusivity — and the existence check must be atomic
+                # with the create, or a racing open-w's create (a
+                # write_full truncate) can land AFTER the first opener
+                # was granted FW and wrote data, destroying an
+                # acknowledged write.
+                async with self._open_locks.hold(m.path):
+                    st = None
+                    try:
+                        st = await self.fs.stat(m.path)
+                    except FSError:
+                        if want != CAP_FW:
+                            raise
+                    if st is not None and st["type"] != "file":
+                        raise FSError(-21, "EISDIR")
+                    if st is None:                   # create on open-w
+                        await self._journaled_apply(
+                            {"op": "create", "path": m.path})
+                    await self._revoke_conflicting(m.path, m.src,
+                                                   want)
+                    self._cap_seq += 1
+                    cap_seq = self._cap_seq
+                    ent = self.caps.setdefault(m.path, {}) \
+                        .setdefault(m.src, [0, 0])
+                    ent[0] = max(ent[0], want)       # FW absorbs FR
+                    ent[1] += 1
+                    cap_mode = ent[0]
+                    # re-stat AFTER the revoke wait: a writer's
+                    # setattr may have landed while we blocked
+                    try:
+                        st = await self.fs.stat(m.path)
+                    except FSError:
+                        st = None
                 payload = json.dumps(
                     {"size": 0 if st is None else st["size"],
                      "oid": _fileobj(m.path)}).encode()

@@ -98,6 +98,9 @@ PERF = (_PCB("crush_mapper")
         .add_time("pack_seconds", "time spent constructing Mappers")
         .add_u64_counter("kernel_plans", "fused Pallas kernel plan builds")
         .add_u64_counter("kernel_compiles", "fused-kernel jit wrappers built")
+        .add_u64_counter("kernel_exec_failures",
+                         "fused-kernel compile/run failures that degraded "
+                         "this Mapper to the XLA path")
         .add_u64_counter("rule_compiles", "XLA rule-body jit builds")
         .add_u64_counter("sweep_compiles", "aggregated-sweep jit builds")
         .add_u64_counter("reweights", "set_device_weights calls")
@@ -924,6 +927,25 @@ class Mapper:
         self._kernel_fns.clear()
 
     # -- fused Pallas kernel path (round 4) --------------------------------
+    def _disable_kernel(self, where: str, exc: Exception) -> None:
+        """Permanently drop to the XLA path after a kernel failure.
+
+        The fused kernel is an optimization, never a correctness
+        dependency: any compile/runtime failure (e.g. a libtpu with a
+        tighter scoped-VMEM limit than the build_plan model assumes)
+        must degrade to the always-correct XLA path instead of killing
+        the caller — round 4's driver bench died exactly this way."""
+        from ceph_tpu.utils.logging import get_logger
+        get_logger("crush").dout(
+            0, f"fused CRUSH kernel failed in {where} "
+               f"({type(exc).__name__}: {str(exc)[:200]}) — "
+               f"falling back to the XLA path for this Mapper")
+        PERF.inc("kernel_exec_failures")
+        self._kernel_mode = None
+        self._kernel_plans.clear()
+        self._kernel_bodies.clear()
+        self._kernel_fns.clear()
+
     def _kernel_plan(self, ruleno: int):
         if ruleno not in self._kernel_plans:
             from ceph_tpu.crush import pallas_mapper as _pm
@@ -973,7 +995,7 @@ class Mapper:
             self.cfg["type_depth"], plan.target_type, 0)
             if plan.recurse else None)
         root_row = -1 - root
-        lanes = _pm.LANES
+        lanes = plan.lanes
 
         def fn_body(arrs, xs):
             n = xs.shape[0]
@@ -1093,22 +1115,39 @@ class Mapper:
         else:
             fn = self._rule_fn(ruleno, result_max)
         block = self._block_for(kb is not None)
-        with jax.enable_x64(True):
-            xs = jnp.asarray(xs, dtype=jnp.uint32)
-            n = xs.shape[0]
-            PERF.inc("pgs_mapped", int(n))
-            if n <= block:
-                return fn(self.arrays, xs)
-            pieces = []
-            for start in range(0, n, block):
-                piece = xs[start:start + block]
-                if piece.shape[0] < block:       # pad the tail block so the
-                    pad = block - piece.shape[0]       # jit cache stays at
-                    piece = jnp.pad(piece, (0, pad))   # one entry per shape
-                    pieces.append(fn(self.arrays, piece)[:-pad])
+        try:
+            with jax.enable_x64(True):
+                xs = jnp.asarray(xs, dtype=jnp.uint32)
+                n = xs.shape[0]
+                if n <= block:
+                    out = fn(self.arrays, xs)
                 else:
-                    pieces.append(fn(self.arrays, piece))
-            return jnp.concatenate(pieces, axis=0)
+                    pieces = []
+                    for start in range(0, n, block):
+                        piece = xs[start:start + block]
+                        if piece.shape[0] < block:  # pad the tail block
+                            pad = block - piece.shape[0]  # so the jit
+                            piece = jnp.pad(piece, (0, pad))  # cache
+                            pieces.append(      # stays one entry/shape
+                                fn(self.arrays, piece)[:-pad])
+                        else:
+                            pieces.append(fn(self.arrays, piece))
+                    out = jnp.concatenate(pieces, axis=0)
+                if kb is not None:
+                    # dispatch is async: an execution-time kernel
+                    # failure would otherwise surface at the CALLER's
+                    # materialization, past this except. A one-element
+                    # readback (not block_until_ready — on this
+                    # platform that returns pre-execution) forces it
+                    # here where the fallback can catch it.
+                    np.asarray(out[0])
+        except Exception as e:
+            if kb is None:
+                raise                        # XLA path: a real error
+            self._disable_kernel("map_pgs", e)
+            return self.map_pgs(ruleno, xs, result_max)
+        PERF.inc("pgs_mapped", int(n))       # success only: the failed
+        return out                           # attempt must not double-count
 
     def sweep(self, ruleno: int, start_x: int, n: int, result_max: int,
               device_counts_size: int | None = None):
@@ -1142,16 +1181,31 @@ class Mapper:
         nblocks = -(-n // block)
 
         step_fn = _compiled_sweep(fn_body, firstn, nd, block, result_max)
-        PERF.inc("pgs_mapped", int(n))
-        PERF.inc("sweep_blocks", int(nblocks))
-        with jax.enable_x64(True):
-            counts = jnp.zeros(nd + 1, dtype=jnp.int64)
-            bad = jnp.int64(0)
-            for i in range(nblocks):
-                counts, bad = step_fn(self.arrays, counts, bad,
-                                      jnp.uint32(start_x + i * block),
-                                      jnp.int64(n - i * block))
-            return counts[:nd], bad
+        try:
+            with jax.enable_x64(True):
+                counts = jnp.zeros(nd + 1, dtype=jnp.int64)
+                bad = jnp.int64(0)
+                for i in range(nblocks):
+                    counts, bad = step_fn(self.arrays, counts, bad,
+                                          jnp.uint32(start_x + i * block),
+                                          jnp.int64(n - i * block))
+                    if kb is not None and i == 0:
+                        # force the first block's execution (tiny
+                        # readback; see map_pgs): a kernel that fails
+                        # at run time must fail INSIDE this try. Later
+                        # blocks run the identical program, so only
+                        # the first can reveal a compile/launch fault,
+                        # and the rest still pipeline.
+                        np.asarray(counts[0])
+        except Exception as e:
+            if kb is None:
+                raise                        # XLA path: a real error
+            self._disable_kernel("sweep", e)
+            return self.sweep(ruleno, start_x, n, result_max,
+                              device_counts_size)
+        PERF.inc("pgs_mapped", int(n))       # success only (no double
+        PERF.inc("sweep_blocks", int(nblocks))   # count via the retry)
+        return counts[:nd], bad
 
 
 def _tunables_key(t):

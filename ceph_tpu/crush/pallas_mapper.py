@@ -89,8 +89,30 @@ SPEC_EXTRA = 2      # candidates beyond numrep; slot s scans
                     # falls back (P(fallback) ~ collision^(SPEC_EXTRA+1))
 MAX_REWEIGHT = 128  # largest non-full-device list the kernel carries
 LANES = int(_os.environ.get("CEPH_TPU_KERNEL_LANES", "1024"))
-                    # PG lanes per grid cell (VMEM: ~4 MiB peak at the
-                    # canonical map's 640-row host level)
+                    # MAX PG lanes per grid cell; build_plan narrows
+                    # per map so the working set fits scoped VMEM
+MIN_LANES = 128     # one TPU lane tile; below this the kernel loses to
+                    # the XLA path anyway, so build_plan declines
+# Scoped-VMEM budget for one grid cell. The driver's libtpu enforces a
+# 16 MiB kernel-vmem stack; Mosaic holds ~12 S-wide temps live through
+# a choose (measured: the 10240-OSD FLAT map — root S=2560 — allocated
+# 121.47M at 1024 lanes = 11.6 live (S,N) i32 arrays), plus the fetch's
+# (2R, N) planes and (P, N) one-hot. Model both and keep 4 MiB headroom.
+VMEM_BUDGET = 12 << 20
+_LIVE_TEMPS = 12
+
+
+def _plan_lanes(sizes) -> int:
+    """Widest power-of-two lane count whose VMEM model fits the budget,
+    or 0 when even MIN_LANES does not (caller declines the plan)."""
+    per_lane = 0
+    for S, P in sizes:
+        R = 2 * S + 1
+        per_lane = max(per_lane, 4 * (_LIVE_TEMPS * S + 2 * R + P))
+    lanes = min(LANES, VMEM_BUDGET // max(per_lane, 1))
+    if lanes < MIN_LANES:
+        return 0
+    return 1 << (lanes.bit_length() - 1)
 
 
 # ---------------------------------------------------------------------------
@@ -128,6 +150,7 @@ class KernelPlan:                               # hash -> usable as a
     rw_ids: np.ndarray     # (K,) int32 non-full device ids (maybe empty)
     rw_w: np.ndarray       # (K,) int32 their 16.16 reweights
     zg2dT: np.ndarray      # (256, 256) f32 {0,1}, [lo, hi] ln-equality
+    lanes: int             # grid-cell width fitting VMEM_BUDGET
 
 
 def build_plan(m: CrushMap, packed, ruleno: int,
@@ -266,13 +289,18 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     assert not zg2[:128].any(), "zg pairs must all have hi >= 128"
     zg2dT = np.ascontiguousarray(
         zg2[128:].T).astype(np.float32)             # (256 lo, 128 hi)
+    lanes = _plan_lanes(sizes)
+    if not lanes:
+        return None          # flat/huge-bucket map: the per-cell working
+                             # set cannot fit scoped VMEM at any useful
+                             # width — the XLA path is the right tool
     return KernelPlan(
         levels=tuple(levels), sizes=tuple(sizes),
         l_main=l_main, l_leaf=l_leaf,
         numrep_arg=choose.arg1, recurse=recurse,
         vary_r=t.chooseleaf_vary_r, tries=t.choose_total_tries,
         target_type=target_type, rw_ids=rw_ids, rw_w=rw_w,
-        zg2dT=zg2dT)
+        zg2dT=zg2dT, lanes=lanes)
 
 
 # ---------------------------------------------------------------------------
@@ -513,8 +541,9 @@ def _run_kernel(plan: KernelPlan, xs: jax.Array, numrep: int,
                 interpret: bool = False):
     """xs (N,) int32 -> (leaves (N, numrep) int32, bad (N,) bool).
 
-    N must be a multiple of LANES."""
+    N must be a multiple of plan.lanes."""
     n = xs.shape[0]
+    LANES = plan.lanes
     assert n % LANES == 0, n
     n_cand = numrep + SPEC_EXTRA
     l_total = plan.l_main + plan.l_leaf

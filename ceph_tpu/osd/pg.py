@@ -718,6 +718,7 @@ class PG:
         # growth mid-recovery). Moving the entries lets the child's
         # peering see exactly the divergence the parent's log recorded.
         child_logs: dict[str, PGLog] = {}
+        child_seen: dict[str, set] = {}
         keep: list[LogEntry] = []
         for entry in self.pg_log.entries:
             raw = osdmap.object_locator_to_pg(
@@ -739,6 +740,18 @@ class PG:
                 except StoreError:
                     pass
                 child_logs[child_cid] = clog
+                # crash idempotency: a crash after the child's merged
+                # log persisted but before the parent's trimmed meta
+                # did re-runs this split with the moved entries ALREADY
+                # in the loaded child log — appending them again would
+                # duplicate them and skew head/version accounting
+                child_seen[child_cid] = {
+                    (e.version.epoch, e.version.v, e.oid)
+                    for e in clog.entries}
+            key = (entry.version.epoch, entry.version.v, entry.oid)
+            if key in child_seen[child_cid]:
+                continue
+            child_seen[child_cid].add(key)
             clog.append(entry)
         if child_logs:
             self.pg_log.entries = keep

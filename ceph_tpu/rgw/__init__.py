@@ -37,6 +37,7 @@ from xml.sax.saxutils import escape
 
 from ceph_tpu.rados import IoCtx, ObjectOperationError
 from ceph_tpu.rgw import auth as sigv4
+from ceph_tpu.utils.locks import KeyedLocks
 from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("rgw")
@@ -65,6 +66,18 @@ class RGWGateway:
         self.users = users or {}
         self._server: asyncio.AbstractServer | None = None
         self.port: int | None = None
+        # Serialize mutations of one (bucket, key): object PUT/DELETE,
+        # part uploads, and multipart complete/abort are
+        # read-modify-write sequences over the bucket-index manifest
+        # row — racing them can leave a manifest referencing part
+        # objects the other path just removed (GET then 500s) or
+        # orphan parts. Single-process gateway, so in-memory locks
+        # suffice (the reference shards this through the bucket-index
+        # OSD class ops).
+        self._key_locks = KeyedLocks()
+
+    def _key_lock(self, bucket: str, key: str):
+        return self._key_locks.hold((bucket, key))
 
     async def start(self, host: str = "127.0.0.1",
                     port: int = 0) -> int:
@@ -150,30 +163,39 @@ class RGWGateway:
             if method == "POST" and "uploads" in q:
                 return await self._initiate_multipart(bucket, key)
             if method == "POST" and "uploadId" in q:
-                return await self._complete_multipart(
-                    bucket, key, q["uploadId"], body)
+                async with self._key_lock(bucket, key):
+                    return await self._complete_multipart(
+                        bucket, key, q["uploadId"], body)
             if method == "PUT" and "uploadId" in q:
                 pn = q.get("partNumber", "")
                 if not pn.isdigit():
                     return ("400 Bad Request", "application/xml",
                             b"<Error><Code>InvalidPartNumber</Code>"
                             b"</Error>", {})
-                return await self._put_part(
-                    bucket, key, q["uploadId"], int(pn), body)
+                # under the key lock: a part landing after a racing
+                # abort removed the upload meta would re-create the
+                # part object + index row with nothing left to ever
+                # clean them up
+                async with self._key_lock(bucket, key):
+                    return await self._put_part(
+                        bucket, key, q["uploadId"], int(pn), body)
             if method == "DELETE" and "uploadId" in q:
-                return await self._abort_multipart(bucket, key,
-                                                   q["uploadId"])
+                async with self._key_lock(bucket, key):
+                    return await self._abort_multipart(bucket, key,
+                                                       q["uploadId"])
             if method == "GET" and "uploadId" in q:
                 return await self._list_parts(bucket, key,
                                               q["uploadId"])
             if method == "PUT":
-                return await self._put_object(bucket, key, body)
+                async with self._key_lock(bucket, key):
+                    return await self._put_object(bucket, key, body)
             if method == "GET":
                 return await self._get_object(bucket, key)
             if method == "HEAD":
                 return await self._get_object(bucket, key, head=True)
             if method == "DELETE":
-                return await self._delete_object(bucket, key)
+                async with self._key_lock(bucket, key):
+                    return await self._delete_object(bucket, key)
             return "405 Method Not Allowed", "text/plain", b"", {}
         except ObjectOperationError as e:
             if e.errno == -2:

@@ -75,6 +75,12 @@ int main(int argc, char** argv) {
     for (int i = 0; i < erasures; ++i) want.push_back(i);
     for (int i = erasures; i < k + m && (int)avail.size() < k; ++i)
       avail.push_back(i);
+    if ((int)avail.size() != k) {  // unreachable given erasures <= m,
+      std::fprintf(stderr,        // but never index past avail below
+                   "only %zu survivors for k=%d (erasures=%d, m=%d)\n",
+                   avail.size(), k, erasures, m);
+      std::exit(2);
+    }
     in.assign(static_cast<size_t>(k) * chunk, 0);
     for (int i = 0; i < k; ++i) {
       const uint8_t* src = avail[i] < k

@@ -196,3 +196,100 @@ def test_mds_cap_revoke_between_clients():
         finally:
             await c.stop()
     run(go())
+
+
+def test_mds_cross_open_no_deadlock():
+    """Two clients each hold FW on one file and concurrently open the
+    OTHER's file: each open revokes a cap whose ack arrives on the
+    holder's connection. If the MDS dispatched requests inline in
+    ms_dispatch (pre round-5), each ack sat head-of-line blocked behind
+    that client's own pending open and both opens stalled to the 30 s
+    revoke timeout — requests must run in their own tasks."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            io = await _pool(c)
+            mds = MDSDaemon(io)
+            await mds.fs.mount()
+            addr = await mds.start()
+            a = await CephFSClient(
+                await c.client.open_ioctx("fs"), addr).mount()
+            b = await CephFSClient(
+                await c.client.open_ioctx("fs"), addr).mount()
+            ha = await a.open_file("/f1", "w")
+            await ha.write(b"1")
+            hb = await b.open_file("/f2", "w")
+            await hb.write(b"2")
+            assert mds.caps["/f1"][a.msgr.name][0] == CAP_FW
+            assert mds.caps["/f2"][b.msgr.name][0] == CAP_FW
+            # cross opens, concurrently; well under the 30 s revoke
+            # timeout both must succeed
+            h2, h1 = await asyncio.wait_for(asyncio.gather(
+                a.open_file("/f2", "w"), b.open_file("/f1", "w")),
+                timeout=20)
+            assert h2.valid and h1.valid
+            assert mds.caps["/f2"][a.msgr.name][0] == CAP_FW
+            assert mds.caps["/f1"][b.msgr.name][0] == CAP_FW
+            for h in (h1, h2):
+                await h.close()
+            await a.unmount()
+            await b.unmount()
+            await mds.stop()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_mds_create_on_open_race_preserves_write():
+    """Two racing open-w's on a new path: the loser's create (a
+    write_full truncate) must not land after the winner was granted FW
+    and wrote data. The create gate below stalls each create-write at
+    exactly the advisor's window — after the journal apply's stat-guard,
+    before the truncating write — so the pre-fix interleaving (B's
+    create truncating A's acknowledged write) is forced
+    deterministically; the fix puts stat+create inside the per-path
+    open lock, so B never reaches a second create at all."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            io = await _pool(c)
+            mds = MDSDaemon(io)
+            await mds.fs.mount()
+            addr = await mds.start()
+            a = await CephFSClient(
+                await c.client.open_ioctx("fs"), addr).mount()
+            b = await CephFSClient(
+                await c.client.open_ioctx("fs"), addr).mount()
+            orig = mds.fs.write_file
+            gates = [asyncio.Event(), asyncio.Event()]
+            seen = 0
+
+            async def gated(path, data):
+                nonlocal seen
+                if path == "/race.txt" and data == b"":
+                    i = min(seen, 1)
+                    seen += 1
+                    await gates[i].wait()
+                return await orig(path, data)
+
+            mds.fs.write_file = gated
+            ta = asyncio.create_task(a.open_file("/race.txt", "w"))
+            await asyncio.sleep(0.3)       # a reaches its gated create
+            tb = asyncio.create_task(b.open_file("/race.txt", "w"))
+            await asyncio.sleep(0.3)       # pre-fix: b statted ENOENT
+            gates[0].set()                 # a's create lands; a granted
+            ha = await asyncio.wait_for(ta, 20)
+            await ha.write(b"precious")    # acknowledged client write
+            gates[1].set()                 # pre-fix: b's create NOW
+            hb = await asyncio.wait_for(tb, 20)   # truncates it
+            assert hb.valid
+            data = await b.read_file("/race.txt")
+            assert data == b"precious", data
+            await hb.close()
+            await ha.close()
+            await a.unmount()
+            await b.unmount()
+            await mds.stop()
+        finally:
+            await c.stop()
+    run(go())
