@@ -86,7 +86,8 @@ def crush_metric() -> dict:
     try:
         res["variants"] = sweep_rate_variants(
             n_osds=10240, n_pgs=n_pgs, num_rep=3,
-            variants=("mixed_weight", "choose_args"))
+            variants=("mixed_weight", "choose_args",
+                      "choose_args_quantized"))
     except Exception:
         res["variants_error"] = _short_err()
     return res

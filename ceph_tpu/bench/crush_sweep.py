@@ -64,13 +64,36 @@ def choose_args_map(n_osds: int = 10240):
     """Canonical map + a balancer-style choose_args weight-set (per-item
     weights perturbed a few percent) under key 0 — the form
     `ceph balancer` emits via pg-upmap's sibling, crush-compat
-    weight-sets (ref: src/crush/CrushWrapper choose_args)."""
+    weight-sets (ref: src/crush/CrushWrapper choose_args). Continuous
+    per-item perturbation makes every bucket ~size distinct weights, so
+    this variant measures the XLA general path."""
     from ceph_tpu.crush.types import ChooseArg
     m = canonical_map(n_osds)
     rng = np.random.default_rng(42)
     args = {}
     for bid, b in m.buckets.items():
         scale = rng.uniform(0.9, 1.1, size=b.size)
+        ws = [max(1, int(w * s)) for w, s in zip(b.weights, scale)]
+        args[bid] = ChooseArg(weight_set=[ws])
+    m.choose_args[0] = args
+    return m
+
+
+def choose_args_quantized_map(n_osds: int = 10240):
+    """choose_args_map with each bucket's weight-set snapped to <= 4
+    distinct values — the form a TPU-first balancer should emit when it
+    uses crush-compat weight-sets at all (our mgr balancer's default is
+    pg-upmap, which never touches weights): quantization keeps every
+    bucket inside the fused kernel's weight-class draw
+    (pallas_mapper MAX_CLASSES), trading a few percent of correction
+    resolution for a ~30x mapping-rate difference."""
+    from ceph_tpu.crush.types import ChooseArg
+    m = canonical_map(n_osds)
+    rng = np.random.default_rng(42)
+    args = {}
+    levels = np.array([0.92, 0.97, 1.03, 1.08])
+    for bid, b in m.buckets.items():
+        scale = levels[rng.integers(0, 4, size=b.size)]
         ws = [max(1, int(w * s)) for w, s in zip(b.weights, scale)]
         args[bid] = ChooseArg(weight_set=[ws])
     m.choose_args[0] = args
@@ -144,8 +167,9 @@ def sweep_rate_variants(n_osds: int = 10240, n_pgs: int = 1 << 21,
     overhead either way)."""
     builders = {
         "uniform": (canonical_map, None, n_pgs),
-        "mixed_weight": (mixed_weight_map, None, max(1 << 16, n_pgs >> 4)),
+        "mixed_weight": (mixed_weight_map, None, n_pgs),
         "choose_args": (choose_args_map, 0, max(1 << 16, n_pgs >> 4)),
+        "choose_args_quantized": (choose_args_quantized_map, 0, n_pgs),
     }
     out = {}
     for name in variants:

@@ -112,6 +112,7 @@ class MDSDaemon(Dispatcher):
         # entries drop when the last opener leaves (no per-path leak).
         self._open_locks = KeyedLocks()
         self._req_tasks: set[asyncio.Task] = set()
+        self._stopping = False
         self._journal_seq = 0
         self.addr = None
 
@@ -127,12 +128,16 @@ class MDSDaemon(Dispatcher):
     async def stop(self) -> None:
         # cancel detached request handlers FIRST: a handler parked in
         # the 30 s revoke wait must not outlive the daemon and mutate
-        # caps / append journal events a later MDS would replay
-        for t in list(self._req_tasks):
-            t.cancel()
-        if self._req_tasks:
-            await asyncio.gather(*self._req_tasks,
-                                 return_exceptions=True)
+        # caps / append journal events a later MDS would replay. The
+        # stopping flag stops ms_dispatch spawning NEW tasks while the
+        # gather below yields to the loop; the while drains any that
+        # slipped in before the flag was observed.
+        self._stopping = True
+        while self._req_tasks:
+            tasks = list(self._req_tasks)
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
         await self.msgr.shutdown()
 
     # -- journaling (ref: MDLog + EUpdate, segments of one) ---------------
@@ -215,6 +220,8 @@ class MDSDaemon(Dispatcher):
             # open needs (the reference MDS never blocks the dispatcher
             # on Locker revocation). Per-path _open_locks keep the
             # ordering that matters.
+            if self._stopping:
+                return True              # shutting down: drop, no task
             t = asyncio.ensure_future(self._handle_request(msg))
             self._req_tasks.add(t)
             t.add_done_callback(self._req_task_done)

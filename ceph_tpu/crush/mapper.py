@@ -1006,21 +1006,30 @@ class Mapper:
                 interpret=interpret)
             leaves, bad = leaves[:n], bad[:n]
 
-            # masked XLA fallback for flagged lanes (candidate-table
-            # exhaustion, P ~ 1e-8/lane): the loop path recomputes the
-            # whole lane bit-exactly. Under lax.cond it costs ONE
-            # scalar reduction + branch when no lane is flagged — the
-            # descents themselves (which are as expensive as the whole
-            # XLA path) never execute in the common case.
-            def _run_fallback(op):
-                arrs_, bad_, xs_, leaves_ = op
-                rows = jnp.full(n, root_row, dtype=jnp.int32)
-                fb = jnp.full((n, numrep), ITEM_NONE, dtype=jnp.int32)
-                fb_lv = jnp.full((n, numrep), ITEM_NONE,
+            # XLA fallback for flagged lanes (candidate-table
+            # exhaustion ~1e-8/lane; ambiguous class draws ~1e-6 to
+            # ~1e-4/lane depending on bucket weight scale — heavy
+            # buckets draw small quotients where genuine floor ties
+            # concentrate): the loop path recomputes flagged lanes
+            # bit-exactly. At kernel-path block widths (2^21 lanes)
+            # flags land EVERY block, so the fallback must not cost
+            # O(block): gather the flagged lanes into a small buffer
+            # (sized ~10x the worst observed flag rate), recompute only
+            # those, scatter back. Fill slots recompute lane xs_[0] and
+            # scatter its (identical, because recomputation is exact)
+            # value — no masking needed. The full-width masked
+            # recompute survives only as the >FB overflow guard.
+            FB = min(n, max(256, n >> 8))
+
+            def _recompute(arrs_, xs_, active):
+                nn = xs_.shape[0]
+                rows = jnp.full(nn, root_row, dtype=jnp.int32)
+                fb = jnp.full((nn, numrep), ITEM_NONE, dtype=jnp.int32)
+                fb_lv = jnp.full((nn, numrep), ITEM_NONE,
                                  dtype=jnp.int32)
                 for rep in range(numrep):
                     item, leaf, ok = _choose_one_firstn(
-                        arrs_, cfg, rows, bad_, xs_, rep,
+                        arrs_, cfg, rows, active, xs_, rep,
                         fb[:, :rep], fb_lv[:, :rep], plan.target_type,
                         plan.recurse, tries, recurse_tries,
                         plan.vary_r)
@@ -1028,9 +1037,30 @@ class Mapper:
                         jnp.where(ok, item, ITEM_NONE))
                     fb_lv = fb_lv.at[:, rep].set(
                         jnp.where(ok, leaf, ITEM_NONE))
-                chosen = fb_lv if plan.recurse else fb
-                return jnp.where(bad_[:, None], _compact(chosen),
-                                 leaves_)
+                return _compact(fb_lv if plan.recurse else fb)
+
+            def _run_fallback(op):
+                def _few(op2):
+                    arrs2, bad2, xs2, leaves2 = op2
+                    # top_k, not jnp.nonzero: nonzero's lowering inside
+                    # a lax.cond crashes this platform's TPU compile
+                    # helper outright (minimal repro: any nonzero under
+                    # cond). top_k is stable, so the FB indices are the
+                    # flagged lanes first, then arbitrary fill lanes —
+                    # whose recomputed (identical) values scatter
+                    # harmlessly.
+                    _, idx = jax.lax.top_k(bad2.astype(jnp.int32), FB)
+                    sub = _recompute(arrs2, xs2[idx],
+                                     jnp.ones(FB, dtype=bool))
+                    return leaves2.at[idx].set(sub)
+
+                def _all(op2):
+                    arrs2, bad2, xs2, leaves2 = op2
+                    out = _recompute(arrs2, xs2, bad2)
+                    return jnp.where(bad2[:, None], out, leaves2)
+
+                return jax.lax.cond(jnp.sum(op[1]) <= FB, _few, _all,
+                                    op)
 
             w = jax.lax.cond(jnp.any(bad), _run_fallback,
                              lambda op: op[3], (arrs, bad, xs, leaves))
@@ -1115,6 +1145,9 @@ class Mapper:
         else:
             fn = self._rule_fn(ruleno, result_max)
         block = self._block_for(kb is not None)
+        if len(xs) == 0:     # the kernel rejects n=0 (and the guard
+            with jax.enable_x64(True):     # readback would IndexError)
+                return jnp.zeros((0, result_max), dtype=jnp.int32)
         try:
             with jax.enable_x64(True):
                 xs = jnp.asarray(xs, dtype=jnp.uint32)

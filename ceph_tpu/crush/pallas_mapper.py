@@ -39,14 +39,32 @@ shifts (Mosaic has no uint32 printf-exact guarantees; int32 two's-
 complement add/sub/xor/shl wrap identically to C uint32, and
 shift_right_logical supplies the unsigned right shift).
 
+Mixed weights (round 5) ride a WEIGHT-CLASS decomposition of the
+straw2 draw: group a bucket's slots by distinct weight (real buckets
+mix 1-4 disk sizes). Within one class the round-3 uniform argument is
+exact — the minimal truncated quotient q = (2^48 - crush_ln(u)) // w
+is attained precisely by the ln-equality class of the maximal hash —
+so the kernel computes ONE exact crush_ln per class (one-hot MXU
+fetches of the 129-entry RH/LH and 256-entry LL tables; a byte ladder
+for the 17x49-bit normalize product), then compares classes by the
+f32 draw neg/w. Lanes whose top two class draws land within a margin
+covering every f32 rounding and integer floor-tie possibility flag to
+the caller's bit-exact XLA fallback (~1e-6 of lanes; gathered compactly
+so the fallback is O(flagged), not O(block)). A single-weight-set
+choose_args map is the same machinery with substituted weights —
+position-independent, so the shared candidate table survives.
+
 Eligibility (build_plan returns None otherwise; the caller keeps the
 XLA path):
 - modern tunables (chooseleaf_stable=1, no legacy local retries),
 - rule shape TAKE root / CHOOSE[LEAF]_FIRSTN / EMIT,
-- every bucket reachable from the root is straw2, non-empty, and
-  uniform-weight (PackedMap.uniform — every real-world bucket),
+- every bucket reachable from the root is straw2, non-empty, with at
+  most MAX_CLASSES distinct positive weights, each <= the ln-gap
+  license G (~2^28.5, i.e. any real disk) — continuous per-item
+  weight perturbations (upstream-balancer-style weight-sets) exceed
+  the class cap and keep the XLA path,
 - uniform hierarchy depth (all root->target->device paths equal),
-- no choose_args weight-set selected,
+- choose_args: at most ONE weight set per bucket and no ids overrides,
 - at most MAX_REWEIGHT non-full devices (is_out then runs as a
   compare-against-list; beyond that the XLA path's full devw table is
   the right tool).
@@ -102,17 +120,66 @@ VMEM_BUDGET = 12 << 20
 _LIVE_TEMPS = 12
 
 
-def _plan_lanes(sizes) -> int:
+MAX_CLASSES = 4     # distinct weights per bucket the kernel carries;
+                    # real buckets mix 1-3 disk sizes (beyond that the
+                    # XLA general path is the right tool)
+# Weight-class draw comparison margin (see _choose_level_cls): lanes
+# whose top two class draws land closer than ABS + best*REL are flagged
+# to the bit-exact XLA fallback. REL covers the f32 rounding of
+# neg (2^-24), w (2^-24) and the divide (2^-24) with ~4x safety; ABS
+# covers integer floor ties (truncated quotients equal while rationals
+# differ), which only matter when the quotients themselves are small —
+# i.e. at heavy bucket weights (a 10k-OSD root draws at d ~ 2^19, so
+# genuine floor ties run ~2^-19/pair and the flagged-lane rate scales
+# with map weight; the fallback buffer in mapper._make_kernel_body
+# scales with block width to absorb this).
+MARGIN_ABS = 1.25
+MARGIN_REL = 2.0 ** -21
+
+
+def _plan_lanes(sizes, rows, kmax) -> int:
     """Widest power-of-two lane count whose VMEM model fits the budget,
     or 0 when even MIN_LANES does not (caller declines the plan)."""
     per_lane = 0
-    for S, P in sizes:
-        R = 2 * S + 1
-        per_lane = max(per_lane, 4 * (_LIVE_TEMPS * S + 2 * R + P))
+    for (S, P), R, K in zip(sizes, rows, kmax):
+        extra = 0
+        if K > 1:
+            # class choose adds the crush_ln machinery per lane: the
+            # (129, N) + (256, N) ln one-hots plus ~35 (1, N) limb temps
+            extra = 129 + 256 + 35
+        per_lane = max(per_lane,
+                       4 * (_LIVE_TEMPS * S + 2 * R + P + extra))
     lanes = min(LANES, VMEM_BUDGET // max(per_lane, 1))
     if lanes < MIN_LANES:
         return 0
     return 1 << (lanes.bit_length() - 1)
+
+
+def _bucket_classes(weights, G):
+    """Per-slot weight-class ids + distinct positive class weights, or
+    None when the bucket is outside the kernel's class model (too many
+    distinct weights, a weight above the ln-gap license G, or no
+    positive weight at all — the scalar rule hands an all-zero bucket
+    to slot 0, which the class model cannot express)."""
+    cls: list[int] = []
+    cws: list[int] = []
+    for w in weights:
+        w = int(w)
+        if w <= 0:
+            cls.append(-1)       # zero-weight slot: never wins
+            continue
+        if w > G:
+            return None
+        if w in cws:
+            cls.append(cws.index(w))
+        else:
+            if len(cws) >= MAX_CLASSES:
+                return None
+            cws.append(w)
+            cls.append(len(cws) - 1)
+    if not cws:
+        return None
+    return cls, cws
 
 
 # ---------------------------------------------------------------------------
@@ -140,6 +207,9 @@ class KernelPlan:                               # hash -> usable as a
 
     levels: tuple          # tuple of np.ndarray (f32)
     sizes: tuple           # (S_l, P_l) pairs, static
+    rows: tuple            # logical row count R_l per level (2S+1 for
+                           # uniform levels; 3S+1+2K for class levels)
+    kmax: tuple            # weight classes per level (1 = uniform draw)
     l_main: int            # levels from root to the target type
     l_leaf: int            # levels from target type to devices
     numrep_arg: int        # rule's arg1 (0 = fill result_max)
@@ -150,6 +220,8 @@ class KernelPlan:                               # hash -> usable as a
     rw_ids: np.ndarray     # (K,) int32 non-full device ids (maybe empty)
     rw_w: np.ndarray       # (K,) int32 their 16.16 reweights
     zg2dT: np.ndarray      # (256, 256) f32 {0,1}, [lo, hi] ln-equality
+    rhlh: np.ndarray | None  # (14, 129) f32 RH/LH byte planes, or None
+    ll: np.ndarray | None    # (6, 256) f32 LL byte planes, or None
     lanes: int             # grid-cell width fitting VMEM_BUDGET
 
 
@@ -161,8 +233,19 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     if t.chooseleaf_stable != 1 or t.choose_local_tries or \
             t.choose_local_fallback_tries:
         return None
+    # choose_args (round 5): a balancer weight-set substitutes the draw
+    # weights per bucket. With a SINGLE weight set the substitution is
+    # position-independent, so the shared-candidate-table trick still
+    # holds and the class machinery absorbs it; per-position sets or
+    # hash-id overrides break those assumptions -> XLA path.
+    ca_map = None
     if choose_args_key is not None and choose_args_key in m.choose_args:
-        return None
+        ca_map = m.choose_args[choose_args_key]
+        for ca in ca_map.values():
+            if getattr(ca, "ids", None):
+                return None
+            if ca.weight_set and len(ca.weight_set) != 1:
+                return None
     rule = m.rules.get(ruleno) if isinstance(m.rules, dict) \
         else (m.rules[ruleno] if ruleno < len(m.rules) else None)
     if rule is None:
@@ -184,6 +267,9 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     # BFS strata: level l = all buckets at depth l from the root; the
     # kernel requires every level to be "pure" (all buckets, or all
     # devices at the end) and the target type to sit at one depth.
+    from ceph_tpu.crush.ln_table import ln_gap_info
+    G, zg = ln_gap_info()
+    bucket_cls: dict[int, tuple] = {}       # bid -> (cls per slot, cws)
     strata: list[list[int]] = [[root]]
     l_main = None
     while True:
@@ -192,8 +278,18 @@ def build_plan(m: CrushMap, packed, ruleno: int,
             b = m.buckets[bid]
             if b.alg != ALG_STRAW2 or b.size == 0:
                 return None
-            if packed.uniform[-1 - bid] != 1:
-                return None
+            if bid not in bucket_cls:
+                ws = b.weights
+                if ca_map is not None and bid in ca_map:
+                    ca = ca_map[bid]
+                    if ca.weight_set:
+                        if len(ca.weight_set[0]) != b.size:
+                            return None
+                        ws = ca.weight_set[0]
+                info = _bucket_classes(ws, G)
+                if info is None:
+                    return None
+                bucket_cls[bid] = info
         types = {m.buckets[bid].type for bid in cur}
         if len(strata) - 1 > 0 or True:
             if types == {target_type}:
@@ -254,10 +350,18 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     row_index = [{bid: i for i, bid in enumerate(lvl)} for lvl in strata]
     levels = []
     sizes = []
+    rows = []
+    kmax = []
     for li, lvl in enumerate(strata):
         S = max(m.buckets[bid].size for bid in lvl)
         P = len(lvl)
-        tbl = np.zeros((2 * S + 1, P), dtype=np.int64)
+        K = max(len(bucket_cls[bid][1]) for bid in lvl)
+        # single-class levels keep the lean uniform layout; multi-class
+        # levels append per-slot class ids and per-class weight halves
+        # (w <= G < 2^29 splits into two sub-32768 values, so the same
+        # biased byte-plane fetch stays exact)
+        R = 2 * S + 1 if K == 1 else 3 * S + 1 + 2 * K
+        tbl = np.zeros((R, P), dtype=np.int64)
         for p, bid in enumerate(lvl):
             b = m.buckets[bid]
             tbl[:b.size, p] = b.items
@@ -267,6 +371,16 @@ def build_plan(m: CrushMap, packed, ruleno: int,
             else:
                 tbl[S:S + b.size, p] = b.items   # device ids
             tbl[2 * S, p] = b.size
+            if K > 1:
+                cls, cws = bucket_cls[bid]
+                # zero-weight (-1) and padding slots get class K: they
+                # match no class and can never win
+                tbl[2 * S + 1:2 * S + 1 + S, p] = K
+                tbl[2 * S + 1:2 * S + 1 + b.size, p] = [
+                    c if c >= 0 else K for c in cls]
+                for c, w in enumerate(cws):
+                    tbl[3 * S + 1 + c, p] = w & 0x7FFF
+                    tbl[3 * S + 1 + K + c, p] = w >> 15
         if tbl.min() < -32768 or tbl.max() >= 32768:
             return None      # byte-plane split covers [-32768, 32768)
         biased = tbl + 32768                     # [0, 65536)
@@ -277,8 +391,8 @@ def build_plan(m: CrushMap, packed, ruleno: int,
                                axis=0).astype(np.float32)
         levels.append(split)
         sizes.append((S, P))
-    from ceph_tpu.crush.ln_table import ln_gap_info
-    _, zg = ln_gap_info()
+        rows.append(R)
+        kmax.append(K)
     # f32, not int8: Mosaic cannot lower int32->int8 casts (the
     # bool one-hot would recurse through _convert_helper); the table
     # holds only {0,1} so f32 is exact. Only hi bytes >= 128 ever have
@@ -289,18 +403,43 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     assert not zg2[:128].any(), "zg pairs must all have hi >= 128"
     zg2dT = np.ascontiguousarray(
         zg2[128:].T).astype(np.float32)             # (256 lo, 128 hi)
-    lanes = _plan_lanes(sizes)
+    rhlh = ll = None
+    if any(k > 1 for k in kmax):
+        rhlh, ll = _ln_plane_tables()
+    lanes = _plan_lanes(sizes, rows, kmax)
     if not lanes:
         return None          # flat/huge-bucket map: the per-cell working
                              # set cannot fit scoped VMEM at any useful
                              # width — the XLA path is the right tool
     return KernelPlan(
         levels=tuple(levels), sizes=tuple(sizes),
+        rows=tuple(rows), kmax=tuple(kmax),
         l_main=l_main, l_leaf=l_leaf,
         numrep_arg=choose.arg1, recurse=recurse,
         vary_r=t.chooseleaf_vary_r, tries=t.choose_total_tries,
         target_type=target_type, rw_ids=rw_ids, rw_w=rw_w,
-        zg2dT=zg2dT, lanes=lanes)
+        zg2dT=zg2dT, rhlh=rhlh, ll=ll, lanes=lanes)
+
+
+@functools.lru_cache(maxsize=1)
+def _ln_plane_tables():
+    """crush_ln's RH/LH (129-entry) and LL (256-entry) tables as f32
+    byte planes for the in-kernel one-hot MXU fetch (same exactness
+    argument as the level-table fetch: every plane value < 256, one-hot
+    weights are {0,1}, so one DEFAULT-precision bf16 pass with f32
+    accumulation is exact). RH <= 2^48 and LH can be exactly 2^48, so
+    both take 7 planes; LL < 2^42 takes 6."""
+    from ceph_tpu.crush.ln_table import ll_table, rh_lh_tables
+    rh, lh = rh_lh_tables()
+    ll = ll_table()
+    rhlh = np.empty((14, 129), dtype=np.float32)
+    for i in range(7):
+        rhlh[i] = ((rh >> np.uint64(8 * i)) & np.uint64(0xFF))
+        rhlh[7 + i] = ((lh >> np.uint64(8 * i)) & np.uint64(0xFF))
+    llp = np.empty((6, 256), dtype=np.float32)
+    for i in range(6):
+        llp[i] = ((ll >> np.uint64(8 * i)) & np.uint64(0xFF))
+    return rhlh, llp
 
 
 # ---------------------------------------------------------------------------
@@ -394,6 +533,128 @@ def _zg_flag(zg_ref, umax):
     return jnp.where(umax > 0, flag, jnp.int32(0))
 
 
+def _onehot_fetch(tab_ref, idx, entries):
+    """(planes, N) f32 rows of ``tab_ref`` selected per lane by ``idx``
+    ((1, N) int32 in [0, entries)) via a one-hot bf16 MXU matmul —
+    exact: plane values < 256, weights {0,1}, f32 accumulation."""
+    n = idx.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (entries, n), 0)
+    oh = (iota == idx).astype(jnp.float32)
+    return jax.lax.dot_general(
+        tab_ref[...], oh, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _crush_ln_neg(rhlh_ref, ll_ref, v):
+    """neg = 2^48 - crush_ln(v) for v (1, N) int32 in [0, 0xFFFF],
+    bit-exact vs ln_table.crush_ln, as (hi, lo) 24-bit int32 limbs.
+
+    Mirrors the fixed-point path (ref: src/crush/mapper.c crush_ln) in
+    lane-parallel int32: normalize x = v+1 into [0x8000, 0x10000]
+    (iexpon), fetch RH/LH by the 129-entry one-hot, walk the 17x49-bit
+    product x_norm * RH byte-by-byte to get the residual index2 (only
+    byte 6 of the product is consumed, so a running-carry ladder of
+    7 sub-2^25 partials suffices), fetch LL, and assemble
+    (iexpon << 44) + ((LH + LL) >> 4) in two 24-bit limbs."""
+    x = v + jnp.int32(1)                             # [1, 0x10000]
+    nb = jnp.zeros_like(x)
+    vv = x
+    for b in (16, 8, 4, 2, 1):                       # bit_length
+        big = vv >= jnp.int32(1 << b)
+        nb = jnp.where(big, nb + jnp.int32(b), nb)
+        vv = jnp.where(big, _srl(vv, b), vv)
+    shift = jnp.maximum(jnp.int32(15) - nb, jnp.int32(0))
+    xn = x << shift                                  # [0x8000, 0x10000]
+    iexpon = jnp.int32(15) - shift
+    j = _srl(xn, 8) - jnp.int32(128)                 # [0, 128]
+    pl = _onehot_fetch(rhlh_ref, j, 129).astype(jnp.int32)  # (14, N)
+    # index2 = ((xn * RH) >> 48) & 0xFF via the byte ladder: partials
+    # xn * rh_byte <= 2^16 * 255 < 2^24, acc < 2^25 — int32 throughout
+    acc = xn * pl[0:1, :]
+    for i in range(1, 7):
+        acc = _srl(acc, 8) + xn * pl[i:i + 1, :]
+    index2 = acc & jnp.int32(0xFF)
+    lp = _onehot_fetch(ll_ref, index2, 256).astype(jnp.int32)  # (6, N)
+    # LH + LL in 24-bit limbs (LH byte 6 is <= 1: the 2^48 endpoint)
+    lh_lo = pl[7:8] + (pl[8:9] << 8) + (pl[9:10] << 16)
+    lh_hi = pl[10:11] + (pl[11:12] << 8) + (pl[12:13] << 16) \
+        + (pl[13:14] << 24)
+    ll_lo = lp[0:1] + (lp[1:2] << 8) + (lp[2:3] << 16)
+    ll_hi = lp[3:4] + (lp[4:5] << 8) + (lp[5:6] << 16)
+    slo = lh_lo + ll_lo                              # < 2^25
+    shi = lh_hi + ll_hi + _srl(slo, 24)
+    slo = slo & jnp.int32(0xFFFFFF)
+    # ln = (iexpon << 44) + ((LH + LL) >> 4), limbs (hi 24..47, lo 0..23)
+    ln_lo = _srl(slo, 4) | ((shi & jnp.int32(0xF)) << 20)
+    ln_hi = _srl(shi, 4) + (iexpon << 20)
+    # neg = 2^48 - ln
+    borrow = (ln_lo > 0).astype(jnp.int32)
+    neg_lo = (jnp.int32(1 << 24) - ln_lo) & jnp.int32(0xFFFFFF)
+    neg_hi = jnp.int32(1 << 24) - ln_hi - borrow
+    return neg_hi, neg_lo
+
+
+def _choose_level_cls(zg_ref, rhlh_ref, ll_ref, x_row, ids, rows_next,
+                      size, cls, wlo, whi, K, r):
+    """One straw2 choose over (S, N) slots with K weight classes.
+
+    The scalar spec's winner is the FIRST slot attaining the maximal
+    draw, draw = trunc((crush_ln(u) - 2^48) / w) (ref: mapper.c
+    bucket_straw2_choose + div64_s64) — equivalently the minimal
+    truncated quotient q = neg // w. Decomposed by weight class:
+    within a class (one w <= G) the minimal q is attained exactly by
+    the ln-equality class of the maximal hash — the round-3 uniform
+    argument — so only ONE exact crush_ln per class is needed, and the
+    cross-class winner is decided by comparing d_c = neg_c / w_c in
+    f32. Lanes whose top two d_c land within MARGIN (covering all f32
+    rounding and integer floor ties) return amb=1 and are recomputed
+    bit-exactly by the caller's XLA fallback; everywhere else the f32
+    order provably equals the exact truncated-quotient order."""
+    S, N = ids.shape
+    xb = jnp.broadcast_to(x_row, (S, N))
+    rb = jnp.broadcast_to(jnp.asarray(r, jnp.int32), (S, N))
+    if "nohash" in _ABLATE:                          # pragma: no cover
+        u = (xb ^ ids ^ rb) & 0xFFFF
+    else:
+        u = _hash3(xb, ids, rb) & 0xFFFF             # (S, N)
+    slot = jax.lax.broadcasted_iota(jnp.int32, (S, N), 0)
+    valid = slot < size
+    big = jnp.float32(3.0e38)
+    best_d = jnp.full((1, N), big, dtype=jnp.float32)
+    second_d = jnp.full((1, N), big, dtype=jnp.float32)
+    best_c = jnp.zeros((1, N), dtype=jnp.int32)
+    best_u = jnp.zeros((1, N), dtype=jnp.int32)
+    for c in range(K):
+        mask = valid & (cls == c)
+        um = jnp.where(mask, u, jnp.int32(-1))
+        umax = jnp.max(um, axis=0, keepdims=True)    # (1, N)
+        nh, nl = _crush_ln_neg(rhlh_ref, ll_ref,
+                               jnp.maximum(umax, 0))
+        w_f = whi[c:c + 1, :].astype(jnp.float32) * jnp.float32(32768.0) \
+            + wlo[c:c + 1, :].astype(jnp.float32)
+        neg_f = nh.astype(jnp.float32) * jnp.float32(16777216.0) \
+            + nl.astype(jnp.float32)
+        d = neg_f / jnp.maximum(w_f, jnp.float32(1.0))
+        d = jnp.where((umax >= 0) & (w_f > 0), d, big)
+        new_min = d < best_d
+        second_d = jnp.where(new_min, best_d, jnp.minimum(second_d, d))
+        best_c = jnp.where(new_min, jnp.int32(c), best_c)
+        best_u = jnp.where(new_min, umax, best_u)
+        best_d = jnp.minimum(best_d, d)
+    margin = jnp.float32(MARGIN_ABS) + best_d * jnp.float32(MARGIN_REL)
+    amb = (second_d - best_d) <= margin              # (1, N) bool
+    thresh = best_u - _zg_flag(zg_ref, best_u)
+    member = valid & (cls == best_c) & (u >= thresh)
+    kk = jnp.where(member, slot, jnp.int32(S))
+    kmin = jnp.min(kk, axis=0, keepdims=True)
+    sel = (slot == kmin).astype(jnp.int32)
+    win_id = jnp.sum(sel * ids, axis=0, keepdims=True,
+                     dtype=jnp.int32)
+    win_next = jnp.sum(sel * rows_next, axis=0, keepdims=True,
+                       dtype=jnp.int32)
+    return win_id, win_next, amb
+
+
 def _choose_level(zg_ref, x_row, ids, rows_next, size, r):
     """One straw2 uniform-weight choose over (S, N) candidate slots.
 
@@ -431,7 +692,7 @@ def _choose_level(zg_ref, x_row, ids, rows_next, size, r):
     return win_id, win_next
 
 
-def _fetch_level(tbl_ref, S, P, row, n):
+def _fetch_level(tbl_ref, S, P, R, row, n):
     """Row tables for per-lane rows via a one-hot bf16 MXU matmul.
 
     The table stores each value as two byte planes (build_plan), both
@@ -440,8 +701,8 @@ def _fetch_level(tbl_ref, S, P, row, n):
     doubling the rows costs nothing here because row counts sit far
     below the MXU's 128-row tile).
 
-    Returns ids (S, N) int32, next_rows (S, N) int32, size (1, N)."""
-    R = 2 * S + 1
+    Returns the debiased logical rows, (R, N) int32 — [0,S) item ids,
+    [S,2S) next rows, [2S] size, plus the class rows when present."""
     if P == 1 or "nofetch" in _ABLATE:
         col = tbl_ref[...][:, 0:1]                   # (2R, 1)
         planes = jnp.broadcast_to(col, (2 * R, n))
@@ -452,12 +713,8 @@ def _fetch_level(tbl_ref, S, P, row, n):
             tbl_ref[...], onehot, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # (2R, N)
     # recombine: hi*256 + lo <= 65535 is exact in f32; debias after
-    full = (planes[R:2 * R, :] * jnp.float32(256.0) +
+    return (planes[R:2 * R, :] * jnp.float32(256.0) +
             planes[0:R, :]).astype(jnp.int32) - jnp.int32(32768)
-    ids = full[0:S, :]
-    nxt = full[S:2 * S, :]
-    size = full[2 * S:2 * S + 1, :]
-    return ids, nxt, size
 
 
 # ---------------------------------------------------------------------------
@@ -468,16 +725,26 @@ def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
     l_total = plan.l_main + plan.l_leaf
     S_list = [s for s, _ in plan.sizes]
     P_list = [p for _, p in plan.sizes]
+    R_list = list(plan.rows)
+    K_list = list(plan.kmax)
+    any_cls = any(k > 1 for k in K_list)
     K = plan.rw_ids.shape[0]
 
     def kernel(*refs):
         xs_ref = refs[0]
         tbl_refs = refs[1:1 + l_total]
         zg_ref = refs[1 + l_total]
-        out_ref = refs[2 + l_total]
-        bad_ref = refs[3 + l_total]
+        nref = 2 + l_total
+        rhlh_ref = ll_ref = None
+        if any_cls:
+            rhlh_ref = refs[nref]
+            ll_ref = refs[nref + 1]
+            nref += 2
+        out_ref = refs[nref]
+        bad_ref = refs[nref + 1]
         x = xs_ref[...]                              # (1, N) int32
         n = x.shape[1]
+        amb_any = jnp.zeros((1, n), dtype=jnp.bool_)
         items_c = []
         leaves_c = []
         ok_c = []
@@ -487,11 +754,25 @@ def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
             # main descent at r; leaf descent at sub_r (descend_once)
             sub_r = (r >> (plan.vary_r - 1)) if plan.vary_r else 0
             for li in range(l_total):
-                ids, nxt, size = _fetch_level(
-                    tbl_refs[li], S_list[li], P_list[li], row, n)
+                S = S_list[li]
+                full = _fetch_level(
+                    tbl_refs[li], S, P_list[li], R_list[li], row, n)
+                ids = full[0:S, :]
+                nxt = full[S:2 * S, :]
+                size = full[2 * S:2 * S + 1, :]
                 rr = r if li < plan.l_main else sub_r
-                win_id, win_next = _choose_level(
-                    zg_ref, x, ids, nxt, size, jnp.int32(rr))
+                if K_list[li] == 1:
+                    win_id, win_next = _choose_level(
+                        zg_ref, x, ids, nxt, size, jnp.int32(rr))
+                else:
+                    kk = K_list[li]
+                    win_id, win_next, amb = _choose_level_cls(
+                        zg_ref, rhlh_ref, ll_ref, x, ids, nxt, size,
+                        full[2 * S + 1:3 * S + 1, :],
+                        full[3 * S + 1:3 * S + 1 + kk, :],
+                        full[3 * S + 1 + kk:3 * S + 1 + 2 * kk, :],
+                        kk, jnp.int32(rr))
+                    amb_any = amb_any | amb
                 if li == plan.l_main - 1:
                     item = win_id                    # target-type bucket
                 row = win_next
@@ -530,7 +811,9 @@ def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
             chosen_l.append(lf_s)
             bad = bad | ~found
         out_ref[...] = jnp.concatenate(chosen_l, axis=0)
-        bad_ref[...] = bad.astype(jnp.int32)
+        # ambiguous class-draw lanes are recomputed whole by the XLA
+        # fallback, exactly like candidate-exhausted lanes
+        bad_ref[...] = (bad | amb_any).astype(jnp.int32)
 
     return kernel
 
@@ -562,6 +845,11 @@ def _run_kernel(plan: KernelPlan, xs: jax.Array, numrep: int,
         operands.append(jnp.asarray(tbl))
     in_specs.append(pl.BlockSpec((256, 128), zero))
     operands.append(jnp.asarray(plan.zg2dT))
+    if plan.rhlh is not None:
+        in_specs.append(pl.BlockSpec((14, 129), zero))
+        operands.append(jnp.asarray(plan.rhlh))
+        in_specs.append(pl.BlockSpec((6, 256), zero))
+        operands.append(jnp.asarray(plan.ll))
     params = {}
     if not interpret:
         params["compiler_params"] = pltpu.CompilerParams(

@@ -23,10 +23,6 @@ class KeyedLocks:
         self._locks: dict = {}
         self._users: dict = {}
 
-    def held(self, key) -> bool:
-        """True when any task currently holds or awaits ``key``."""
-        return key in self._users
-
     @contextlib.asynccontextmanager
     async def hold(self, key):
         lock = self._locks.setdefault(key, asyncio.Lock())

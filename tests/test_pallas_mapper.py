@@ -54,15 +54,59 @@ class TestEligibility:
         p = pack_map(m)
         assert pm.build_plan(m, p, rid, None) is not None
 
-    def test_mixed_weights_ineligible(self):
+    def test_mixed_weights_eligible(self):
+        """Round 5: buckets with few distinct weights ride the kernel
+        via the weight-class draw (was ineligible through round 4)."""
         m, root = builder.build_flat(
             8, weights=[WEIGHT_ONE] * 7 + [2 * WEIGHT_ONE])
         rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        plan = pm.build_plan(m, pack_map(m), rid, None)
+        assert plan is not None and plan.kmax == (2,)
+
+    def test_too_many_weight_classes_ineligible(self):
+        m, root = builder.build_flat(
+            8, weights=[WEIGHT_ONE + i for i in range(8)])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
         assert pm.build_plan(m, pack_map(m), rid, None) is None
 
-    def test_choose_args_ineligible(self):
+    def test_overweight_class_ineligible(self):
+        """A weight above the ln-gap license G voids the within-class
+        argmax argument: the kernel must decline."""
+        from ceph_tpu.crush.ln_table import ln_gap_info
+        G, _ = ln_gap_info()
+        m, root = builder.build_flat(4, weights=[G + 1] * 4)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        assert pm.build_plan(m, pack_map(m), rid, None) is None
+
+    def test_choose_args_single_weight_set_eligible(self):
+        from ceph_tpu.crush.types import ChooseArg
         m, rid = _hier(8, 2)
-        m.choose_args[0] = {}
+        args = {}
+        for bid, b in m.buckets.items():
+            args[bid] = ChooseArg(
+                weight_set=[[2 * int(w) for w in b.weights]])
+        m.choose_args[0] = args
+        plan = pm.build_plan(m, pack_map(m), rid, None,
+                             choose_args_key=0)
+        assert plan is not None
+
+    def test_choose_args_ids_override_ineligible(self):
+        from ceph_tpu.crush.types import ChooseArg
+        m, rid = _hier(8, 2)
+        root = m.rules[rid].steps[0].arg1
+        b = m.buckets[root]
+        m.choose_args[0] = {root: ChooseArg(
+            weight_set=[list(b.weights)],
+            ids=[it + 100 for it in b.items])}
+        assert pm.build_plan(m, pack_map(m), rid, None,
+                             choose_args_key=0) is None
+
+    def test_choose_args_positional_sets_ineligible(self):
+        from ceph_tpu.crush.types import ChooseArg
+        m, rid = _hier(8, 2)
+        root = m.rules[rid].steps[0].arg1
+        ws = [int(w) for w in m.buckets[root].weights]
+        m.choose_args[0] = {root: ChooseArg(weight_set=[ws, ws])}
         assert pm.build_plan(m, pack_map(m), rid, None,
                              choose_args_key=0) is None
 
@@ -82,7 +126,7 @@ class TestEligibility:
     def test_xla_fallback_when_ineligible(self):
         """Ineligible maps silently keep the XLA path through Mapper."""
         m, root = builder.build_flat(
-            6, weights=[WEIGHT_ONE] * 5 + [WEIGHT_ONE * 3])
+            6, weights=[WEIGHT_ONE + i for i in range(6)])  # 6 classes
         rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
         mapper = Mapper(m)
         assert mapper._kernel_body(rid, 3) is None
@@ -139,6 +183,136 @@ class TestBitExact:
             ref = mapper_ref.do_rule(m, rid, int(x), 3,
                                      weight=list(w))
             assert list(out[i]) == ref + [ITEM_NONE] * (3 - len(ref))
+
+    def test_mixed_weight_hierarchy(self):
+        """Alternating 1T/2T disks in every host — the production shape
+        that cliff-edged off the kernel through round 4. Weight-class
+        draw must match the scalar spec bit-exactly."""
+        weights = [WEIGHT_ONE if i % 2 else 2 * WEIGHT_ONE
+                   for i in range(32)]
+        m, root = builder.build_hierarchy(8, 4, n_racks=2,
+                                          osd_weights=weights)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        _assert_kernel_matches_ref(m, rid, 3)
+
+    def test_mixed_flat_four_classes(self):
+        rng = np.random.default_rng(7)
+        w = [int(x) for x in rng.choice(
+            [WEIGHT_ONE, 2 * WEIGHT_ONE, 3 * WEIGHT_ONE,
+             WEIGHT_ONE // 2], size=24)]
+        m, root = builder.build_flat(24, weights=w)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        _assert_kernel_matches_ref(m, rid, 3)
+
+    def test_mixed_weights_with_reweights(self):
+        weights = [WEIGHT_ONE if i % 2 else 2 * WEIGHT_ONE
+                   for i in range(32)]
+        m, root = builder.build_hierarchy(8, 4, n_racks=2,
+                                          osd_weights=weights)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        dw = np.full(32, WEIGHT_ONE, dtype=np.int64)
+        dw[5] = WEIGHT_ONE // 3
+        dw[11] = 0
+        _assert_kernel_matches_ref(m, rid, 3, weights=dw)
+
+    def test_zero_weight_slot_never_wins(self):
+        """A zero-weight item draws S64_MIN in the scalar spec; the
+        class model leaves it classless so it can never win."""
+        w = [WEIGHT_ONE, 0, WEIGHT_ONE, 2 * WEIGHT_ONE,
+             0, WEIGHT_ONE, 2 * WEIGHT_ONE, WEIGHT_ONE]
+        m, root = builder.build_flat(8, weights=w)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        mapper = Mapper(m)
+        assert mapper._kernel_body(rid, 3) is not None
+        got = np.asarray(mapper.map_pgs(
+            rid, np.arange(N_X, dtype=np.uint32), 3))
+        assert not np.isin(got, [1, 4]).any()
+        _assert_kernel_matches_ref(m, rid, 3)
+
+    def test_choose_args_single_weight_set(self):
+        """A balancer-style single weight-set map (per-bucket weights
+        kept to <= MAX_CLASSES distinct values) rides the kernel and
+        matches the scalar spec with the same choose_args."""
+        from ceph_tpu.crush.types import ChooseArg
+        m, rid = _hier(8, 2)
+        args = {}
+        scales = (0.9, 0.95, 1.05, 1.1)
+        for i, (bid, b) in enumerate(sorted(m.buckets.items())):
+            ws = [max(1, int(w * scales[(i + j) % 4]))
+                  for j, w in enumerate(b.weights)]
+            args[bid] = ChooseArg(weight_set=[ws])
+        m.choose_args[0] = args
+        mapper = Mapper(m, choose_args=0)
+        assert mapper._kernel_body(rid, 3) is not None, "ineligible"
+        xs = np.arange(N_X, dtype=np.uint32)
+        got = np.asarray(mapper.map_pgs(rid, xs, 3))
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 3,
+                                     choose_args=args)
+            ref = ref + [ITEM_NONE] * (3 - len(ref))
+            assert list(got[i]) == ref, (int(x), list(got[i]), ref)
+
+    def test_forced_ambiguity_takes_fallback(self, monkeypatch):
+        """With the class-draw margin blown up to cover everything,
+        every lane flags ambiguous and the whole block resolves through
+        the XLA fallback — still bit-exact (proves the fallback wiring
+        end to end, including the >FB overflow path)."""
+        monkeypatch.setattr(pm, "MARGIN_ABS", 1e30)
+        weights = [WEIGHT_ONE if i % 2 else 2 * WEIGHT_ONE
+                   for i in range(16)]
+        m, root = builder.build_hierarchy(4, 4, n_racks=2,
+                                          osd_weights=weights)
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        _assert_kernel_matches_ref(m, rid, 3)
+
+    def test_random_class_mixes(self):
+        """Randomized sweep over host counts, class counts and numrep
+        against the scalar spec."""
+        rng = np.random.default_rng(1234)
+        for _ in range(4):
+            n_hosts = int(rng.integers(3, 9))
+            per = int(rng.integers(2, 5))
+            nw = int(rng.integers(1, 5))
+            wopts = rng.integers(WEIGHT_ONE // 4, 4 * WEIGHT_ONE,
+                                 size=nw)
+            weights = [int(wopts[rng.integers(0, nw)])
+                       for _ in range(n_hosts * per)]
+            m, root = builder.build_hierarchy(
+                n_hosts, per, n_racks=max(1, n_hosts // 3),
+                osd_weights=weights)
+            rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+            numrep = int(rng.integers(1, 4))
+            mapper = Mapper(m)
+            if mapper._kernel_body(rid, numrep) is None:
+                continue                 # rack level exceeded 4 classes
+            xs = np.arange(64, dtype=np.uint32)
+            got = np.asarray(mapper.map_pgs(rid, xs, numrep))
+            for i, x in enumerate(xs):
+                ref = mapper_ref.do_rule(m, rid, int(x), numrep)
+                ref = ref + [ITEM_NONE] * (numrep - len(ref))
+                assert list(got[i]) == ref, (int(x), list(got[i]), ref)
+
+    def test_crush_ln_neg_exact(self):
+        """The in-kernel crush_ln limb pipeline vs ln_table.crush_ln
+        over the full 16-bit domain (interpret mode, batched)."""
+        import jax
+        import jax.numpy as jnp
+        from ceph_tpu.crush.ln_table import crush_ln
+        rhlh, ll = pm._ln_plane_tables()
+        v = np.arange(0x10000, dtype=np.int64)
+        expect = (1 << 48) - crush_ln(v)
+
+        def run(vv):
+            return pm._crush_ln_neg(
+                jnp.asarray(rhlh), jnp.asarray(ll),
+                jnp.asarray(vv, dtype=jnp.int32).reshape(1, -1))
+
+        got_hi, got_lo = jax.jit(run)(v)
+        got = (np.asarray(got_hi, dtype=np.int64) << 24) | \
+            np.asarray(got_lo, dtype=np.int64)
+        mism = np.nonzero(got[0] != expect)[0]
+        assert mism.size == 0, (mism[:5], got[0][mism[:5]],
+                                expect[mism[:5]])
 
     def test_engineered_draw_ties(self):
         """Scan wide x ranges on a small bucket so ln-equality adjacent
