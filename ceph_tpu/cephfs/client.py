@@ -20,7 +20,7 @@ from ceph_tpu.cephfs import FSError, _norm
 from ceph_tpu.cephfs.mds import (
     CAP_FR, CAP_FW, CAP_OP_ACK, CAP_OP_RELEASE, CAP_OP_REVOKE,
     MClientCaps, MClientReply, MClientRequest, MClientSession,
-    SESSION_CLOSE, SESSION_OPEN,
+    SESSION_CLOSE, SESSION_OPEN, SESSION_RENEW,
 )
 from ceph_tpu.msg import Dispatcher, Messenger
 from ceph_tpu.utils.logging import get_logger
@@ -120,6 +120,34 @@ class CephFSClient(Dispatcher):
         self._session_fut: asyncio.Future | None = None
         self._handles: dict[str, list[FileHandle]] = {}
         self._inflight: dict[str, int] = {}     # path -> writes in flight
+        self._renew_task: asyncio.Task | None = None
+        self._own_rados = None          # set by create(): owned identity
+        self.lease_interval = 3.0       # renew beat; the OPEN ack's
+                                        # advertised lease overrides it
+
+    @classmethod
+    async def create(cls, monmap, mds_addr, pool: str,
+                     keyring=None) -> "CephFSClient":
+        """Mount with an OWN RADOS identity — the libcephfs model: ONE
+        entity name carries both the MDS session and the data-path ops,
+        so an MDS eviction's osd blocklist actually fences this
+        client's data writes (data I/O through a shared admin ioctx
+        would dodge the fence)."""
+        from ceph_tpu.rados import Rados
+        CephFSClient._next_id += 1
+        name = f"client.fs{CephFSClient._next_id}"
+        if keyring is not None:
+            keyring.add(name)
+        r = Rados(monmap, name=name, keyring=keyring)
+        await r.connect()
+        io = await r.open_ioctx(pool)
+        # the MDS-facing messenger matches the MDS's auth mode (the
+        # MDS messenger carries no keyring); the DATA path — where the
+        # blocklist fence bites — authenticates through the owned
+        # Rados above. The shared identity is the entity NAME.
+        cl = cls(io, mds_addr, messenger=Messenger(name))
+        cl._own_rados = r
+        return await cl.mount()
 
     # -- session -----------------------------------------------------------
     async def mount(self) -> "CephFSClient":
@@ -127,10 +155,38 @@ class CephFSClient(Dispatcher):
         await self.msgr.send_message(
             MClientSession(op=SESSION_OPEN, cseq=0), self.mds_addr,
             "mds")
-        await asyncio.wait_for(self._session_fut, timeout=10)
+        ack = await asyncio.wait_for(self._session_fut, timeout=10)
+        # cap-lease heartbeat (ref: Client::renew_caps): without it the
+        # MDS evicts us the moment a revoke finds our lease stale. The
+        # OPEN ack advertises the MDS lease (ms); renew at a third of
+        # it so a short-leased MDS never sees a live client go stale.
+        if getattr(ack, "cseq", 0):
+            self.lease_interval = max(0.05, ack.cseq / 3000.0)
+        self._renew_task = asyncio.ensure_future(self._renew_loop())
         return self
 
+    async def _renew_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.lease_interval)
+                try:
+                    await self.msgr.send_message(
+                        MClientSession(op=SESSION_RENEW, cseq=0),
+                        self.mds_addr, "mds")
+                except (ConnectionError, OSError):
+                    # transient (e.g. injected socket failure): a
+                    # single missed beat must NOT end the heartbeat —
+                    # a silently dead renew loop gets a perfectly
+                    # live client evicted and blocklisted at the next
+                    # revoke
+                    continue
+        except asyncio.CancelledError:
+            pass
+
     async def unmount(self) -> None:
+        if self._renew_task is not None:
+            self._renew_task.cancel()
+            self._renew_task = None
         for hs in list(self._handles.values()):   # close() mutates the
             for h in list(hs):                    # dict and the lists
                 await h.close()
@@ -140,6 +196,9 @@ class CephFSClient(Dispatcher):
             "mds")
         await asyncio.wait_for(self._session_fut, timeout=10)
         await self.msgr.shutdown()
+        if self._own_rados is not None:
+            await self._own_rados.shutdown()
+            self._own_rados = None
 
     # -- dispatch ----------------------------------------------------------
     async def ms_dispatch(self, msg) -> bool:
@@ -149,8 +208,9 @@ class CephFSClient(Dispatcher):
                 fut.set_result(msg)
             return True
         if isinstance(msg, MClientSession):
-            if self._session_fut and not self._session_fut.done():
-                self._session_fut.set_result(msg.op)
+            if msg.op != SESSION_RENEW and self._session_fut \
+                    and not self._session_fut.done():
+                self._session_fut.set_result(msg)
             return True
         if isinstance(msg, MClientCaps):
             if msg.op == CAP_OP_REVOKE:

@@ -22,8 +22,14 @@ acking), which is exactly the reference's revoke/ack dance. Sessions
 (ref: MClientSession) gate everything; closing a session drops its
 caps and wakes any waiter blocked on them.
 
-Not rebuilt: dynamic subtree partitioning/multi-MDS, client cap
-leases/timeouts, the full inode lock matrix.
+Cap leases (round 5): clients heartbeat SESSION_RENEW; a holder whose
+lease lapses while a revoke is outstanding is EVICTED (session + caps
+dropped, its revoke waiters resolved) so a dead client cannot hold
+exclusivity hostage — the Session::last_cap_renew + stale-eviction
+behavior in miniature.
+
+Not rebuilt: dynamic subtree partitioning/multi-MDS, the full inode
+lock matrix.
 """
 
 from __future__ import annotations
@@ -41,6 +47,8 @@ log = get_logger("mds")
 
 SESSION_OPEN = 1
 SESSION_CLOSE = 2
+SESSION_RENEW = 3   # client heartbeat keeping its cap lease alive
+                    # (ref: CEPH_SESSION_REQUEST_RENEWCAPS)
 
 CAP_FR = 1          # shared read
 CAP_FW = 2          # exclusive write
@@ -92,12 +100,22 @@ class MDSDaemon(Dispatcher):
     """Single-rank MDS over one metadata/data pool ioctx."""
 
     def __init__(self, ioctx, name: str = "a",
-                 messenger: Messenger | None = None):
+                 messenger: Messenger | None = None,
+                 lease_timeout: float = 10.0,
+                 revoke_timeout: float = 30.0):
         self.fs = CephFSLite(ioctx)
         self.ioctx = ioctx
         self.msgr = messenger or Messenger(f"mds.{name}")
         self.msgr.add_dispatcher(self)
         self.sessions: dict[str, object] = {}       # client -> conn
+        # cap leases (ref: Session::last_cap_renew + the Locker's
+        # stale-session eviction): a client renews via SESSION_RENEW;
+        # one whose lease lapses while sitting on an unacked revoke is
+        # EVICTED (session + caps dropped) instead of stalling every
+        # conflicting open to the revoke timeout.
+        self.lease_timeout = lease_timeout
+        self.revoke_timeout = revoke_timeout
+        self._session_seen: dict[str, float] = {}   # client -> loop time
         # path -> {client: [mode, refcount]}; invariant: at most one
         # CAP_FW holder, never FW alongside another client's FR. A
         # same-client re-open bumps the refcount and can only upgrade
@@ -232,12 +250,25 @@ class MDSDaemon(Dispatcher):
         return False
 
     async def _handle_session(self, m: MClientSession) -> None:
+        now = asyncio.get_event_loop().time()
         if m.op == SESSION_OPEN:
             self.sessions[m.src] = m.conn
+            self._session_seen[m.src] = now
+        elif m.op == SESSION_RENEW:
+            if m.src not in self.sessions:
+                return                   # evicted: renewals are void
+            self._session_seen[m.src] = now
         else:
             self.sessions.pop(m.src, None)
+            self._session_seen.pop(m.src, None)
             self._drop_client_caps(m.src)
-        await m.conn.send_message(MClientSession(op=m.op, cseq=m.cseq))
+        # the OPEN ack advertises the lease (ms) so the client paces
+        # its renewals off the MDS's configuration instead of a
+        # hardcoded beat that could exceed a short lease
+        await m.conn.send_message(MClientSession(
+            op=m.op,
+            cseq=int(self.lease_timeout * 1000)
+            if m.op == SESSION_OPEN else m.cseq))
 
     def _drop_client_caps(self, client: str) -> None:
         for path in list(self.caps):
@@ -296,9 +327,58 @@ class MDSDaemon(Dispatcher):
                     op=CAP_OP_REVOKE, path=path, mode=mode, cseq=seq))
             waits.append(fut)
         if waits:
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + self.revoke_timeout
             try:
-                await asyncio.wait_for(asyncio.gather(*waits),
-                                       timeout=30)
+                while True:
+                    pending = [f for f in waits if not f.done()]
+                    if not pending:
+                        break
+                    slice_t = min(self.lease_timeout,
+                                  deadline - loop.time())
+                    if slice_t <= 0:
+                        raise asyncio.TimeoutError
+                    await asyncio.wait(pending, timeout=slice_t)
+                    # evict holders whose lease lapsed while we waited:
+                    # a dead/hung client must not hold exclusivity
+                    # hostage (drop_client_caps resolves its waiters)
+                    now = loop.time()
+                    for p, holder, seq in keys:
+                        fut = self._revoke_waiters.get((p, holder, seq))
+                        if fut and not fut.done() and \
+                                now - self._session_seen.get(holder, 0) \
+                                > self.lease_timeout:
+                            log.dout(1, f"evicting client {holder}: "
+                                        f"cap lease expired with a "
+                                        f"revoke outstanding")
+                            # FENCE FIRST (ref: MDS eviction pairs with
+                            # an osdmap blocklist): until the OSDs
+                            # refuse the zombie's ops, dropping its
+                            # caps would let it keep writing under the
+                            # stale grant when it resumes. Only after
+                            # the blocklist commits do the waiters
+                            # resolve and the competing open proceed.
+                            try:
+                                ret, rs, _ = await \
+                                    self.ioctx.rados.mon_command(
+                                        {"prefix": "osd blocklist",
+                                         "blocklistop": "add",
+                                         "addr": holder})
+                            except Exception as e:
+                                ret, rs = -1, repr(e)
+                            if ret != 0:
+                                # NO fence, NO eviction: releasing the
+                                # caps without the OSD-level fence
+                                # would let the zombie write under its
+                                # stale grant. Retry next slice; the
+                                # revoke deadline bounds the wait.
+                                log.dout(0, f"blocklist of {holder} "
+                                            f"failed ({rs}); eviction "
+                                            f"deferred")
+                                continue
+                            self.sessions.pop(holder, None)
+                            self._session_seen.pop(holder, None)
+                            self._drop_client_caps(holder)
             finally:
                 # a holder that never acks must not leak its waiter
                 for key in keys:

@@ -180,7 +180,7 @@ def encode_osdmap(m) -> bytes:
     monitor store value)."""
     e = Encoder()
     e.u32(OSDMAP_MAGIC)
-    with e.start(3):                    # v3: + up_thru
+    with e.start(4):                    # v4: + blocklist
         e.u32(m.epoch)
         e.blob(encode_crush_map(m.crush))
         e.u32(m.max_osd)
@@ -199,6 +199,8 @@ def encode_osdmap(m) -> bytes:
         e.map(m.osd_addrs, lambda e, k: e.s32(k), _enc_addr)   # v2
         e.map(m.up_thru, lambda e, k: e.s32(k),
               lambda e, v: e.u32(v))                           # v3
+        e.map(m.blocklist, lambda e, k: e.string(k),
+              lambda e, v: e.f64(v))                           # v4
     return e.tobytes()
 
 
@@ -207,7 +209,7 @@ def decode_osdmap(data: bytes):
     d = Decoder(data)
     if d.u32() != OSDMAP_MAGIC:
         raise EncodingError("bad osdmap magic")
-    with d.start(3) as _v:
+    with d.start(4) as _v:
         epoch = d.u32()
         crush = decode_crush_map(d.blob())
         max_osd = d.u32()
@@ -227,6 +229,9 @@ def decode_osdmap(data: bytes):
             m.osd_addrs = d.map(lambda d: d.s32(), _dec_addr)
         if _v >= 3:
             m.up_thru = d.map(lambda d: d.s32(), lambda d: d.u32())
+        if _v >= 4:
+            m.blocklist = d.map(lambda d: d.string(),
+                                lambda d: d.f64())
     return m
 
 
@@ -234,7 +239,7 @@ def encode_incremental(inc) -> bytes:
     """ref: OSDMap::Incremental::encode — the delta the monitor commits
     per epoch and OSDs apply on subscription."""
     e = Encoder()
-    with e.start(3):                    # v3: + new_up_thru
+    with e.start(4):                    # v4: + blocklist
         e.u32(inc.epoch)
         e.optional(inc.new_max_osd, lambda e, v: e.u32(v))
         e.map(inc.new_pools, lambda e, k: e.s64(k), _enc_pool)
@@ -262,6 +267,9 @@ def encode_incremental(inc) -> bytes:
               lambda e, v: e.s32(v))                              # v2
         e.map(inc.new_up_thru, lambda e, k: e.s32(k),
               lambda e, v: e.u32(v))                              # v3
+        e.map(inc.new_blocklist, lambda e, k: e.string(k),
+              lambda e, v: e.f64(v))                              # v4
+        e.list(inc.old_blocklist, lambda e, v: e.string(v))       # v4
     return e.tobytes()
 
 
@@ -269,7 +277,7 @@ def decode_incremental(data: bytes):
     from ceph_tpu.osd.osdmap import Incremental
     d = Decoder(data)
     inc = Incremental()
-    with d.start(3) as _v:
+    with d.start(4) as _v:
         inc.epoch = d.u32()
         inc.new_max_osd = d.optional(lambda d: d.u32())
         inc.new_pools = d.map(lambda d: d.s64(), _dec_pool)
@@ -295,4 +303,8 @@ def decode_incremental(data: bytes):
         if _v >= 3:
             inc.new_up_thru = d.map(lambda d: d.s32(),
                                     lambda d: d.u32())
+        if _v >= 4:
+            inc.new_blocklist = d.map(lambda d: d.string(),
+                                      lambda d: d.f64())
+            inc.old_blocklist = d.list(lambda d: d.string())
     return inc

@@ -266,10 +266,46 @@ class OSDMonitor(PaxosService):
             "pg dump": self._cmd_pg_dump,
             "osd pg-upmap-items": self._cmd_pg_upmap_items,
             "osd rm-pg-upmap-items": self._cmd_rm_pg_upmap_items,
+            "osd blocklist": self._cmd_blocklist,
         }.get(prefix)
         if handler is None:
             return -22, f"unknown command {prefix!r}", b""
         return await handler(cmd, inbl)
+
+    async def _cmd_blocklist(self, cmd, inbl):
+        """`osd blocklist add|rm|ls <entity> [expire-seconds]` — the
+        cluster-level client fence (ref: OSDMonitor prepare_command
+        "osd blocklist"; used by MDS eviction and lock breaking, so a
+        zombie client cannot write after its caps moved on)."""
+        import time
+        op = cmd.get("blocklistop", "ls")
+        if op == "ls":
+            return 0, "", json.dumps(
+                {"blocklist": self.osdmap.blocklist}).encode()
+        name = cmd.get("addr", "")
+        if not name:
+            return -22, "missing addr", b""
+        if op == "add":
+            expire = float(cmd.get("expire", 3600.0))
+
+            def build(om):
+                inc = Incremental()
+                inc.new_blocklist[name] = time.time() + expire
+                return inc, None
+        elif op == "rm":
+            if name not in self.osdmap.blocklist:
+                return 0, f"{name} isn't blocklisted", b""
+
+            def build(om):
+                inc = Incremental()
+                inc.old_blocklist.append(name)
+                return inc, None
+        else:
+            return -22, f"unknown blocklistop {op!r}", b""
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            return -11, "proposal failed", b""
+        return 0, f"blocklist {op} {name}", b""
 
     async def _cmd_new(self, cmd, inbl):
         """Allocate an osd id (ref: `ceph osd new`)."""

@@ -270,6 +270,17 @@ class OSD(Dispatcher):
 
     async def ms_dispatch(self, msg) -> bool:
         if isinstance(msg, MOSDOp):
+            if self.osdmap is not None and \
+                    self.osdmap.is_blocklisted(msg.src):
+                # cluster-level fence (ref: OSD::ms_handle_fast_connect
+                # blocklist check): an evicted/zombie client's ops are
+                # refused with EBLOCKLISTED no matter when it resumes
+                from ceph_tpu.osd.messages import MOSDOpReply
+                await msg.conn.send_message(MOSDOpReply(
+                    tid=msg.tid, attempt=getattr(msg, "attempt", 0),
+                    result=-108, epoch=self.osdmap.epoch, data=b"",
+                    extra=""))
+                return True
             pg = self._pg_for(str(pg_t(msg.pool, msg.seed)))
             if pg is None or not pg.is_primary():
                 # wrong target: client's map is stale; it will resend

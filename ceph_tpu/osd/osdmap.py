@@ -77,6 +77,10 @@ class Incremental:
     # absolute state overrides (ref: Incremental::new_state xor — here
     # absolute values; used by `osd new` to create EXISTS+down slots)
     new_state: dict[int, int] = field(default_factory=dict)
+    # client entity -> absolute expiry (unix); ref: Incremental::
+    # new_blocklist — fences evicted/zombie clients at the OSDs
+    new_blocklist: dict[str, float] = field(default_factory=dict)
+    old_blocklist: list[str] = field(default_factory=list)
     # ref: Incremental::new_up_thru — the mon grants 'osd X was up
     # through epoch E' when a primary asks before activating; peering
     # uses it to decide whether a past interval may have gone active
@@ -105,7 +109,23 @@ class OSDMap:
         # osd -> highest epoch the mon has granted 'alive through'
         # (ref: osd_info_t::up_thru); peering's maybe-went-active test
         self.up_thru: dict[int, int] = {}
+        # client entity name -> absolute expiry time (unix). ref:
+        # OSDMap blocklist: the cluster-level fence behind MDS client
+        # eviction (and rbd exclusive-lock breaking upstream) — OSDs
+        # refuse ops from blocklisted entities, so a zombie client
+        # whose caps were revoked cannot mutate data after the grant
+        # moved on, no matter when it resumes.
+        self.blocklist: dict[str, float] = {}
         self._mappers: dict[int | None, Mapper] = {}
+
+    def is_blocklisted(self, name: str, now: float | None = None) -> bool:
+        exp = self.blocklist.get(name)
+        if exp is None:
+            return False
+        if now is None:
+            import time
+            now = time.time()
+        return now < exp
 
     # -- state predicates (array-capable) ---------------------------------
     def exists(self, osd):
@@ -252,6 +272,9 @@ class OSDMap:
             self.pg_upmap_items.pop(pg, None)
         self.osd_addrs.update(inc.new_addrs)
         self.up_thru.update(inc.new_up_thru)
+        self.blocklist.update(inc.new_blocklist)
+        for name in inc.old_blocklist:
+            self.blocklist.pop(name, None)
         for mp in self._mappers.values():
             mp.set_device_weights(self._device_weights())
         self.epoch += 1

@@ -17,6 +17,11 @@ copy-on-write children referencing a PROTECTED parent snapshot with
 client-side fallthrough reads and copy-up on first write, like the
 reference's layering (ref: src/librbd/io/CopyupRequest).
 
+Incremental replication (round 5): ``Image.export_diff`` /
+``import_diff`` speak the ``rbd diff v1`` tagged stream
+(from-snap/to-snap/size/write/zero records), so snapshots chain
+between clusters the way ``rbd export-diff | rbd import-diff`` does.
+
 This is also this framework's libradosstriper seat: large-object
 striping over many RADOS objects, client-side.
 """
@@ -24,6 +29,7 @@ striping over many RADOS objects, client-side.
 from __future__ import annotations
 
 import json
+import struct
 
 from ceph_tpu.rados import IoCtx, ObjectOperationError
 
@@ -379,3 +385,139 @@ class Image:
                 "obj_size": self.obj_size,
                 "num_objs": -(-self.size_bytes // self.obj_size),
                 "block_name_prefix": f"rbd_data.{self.name}"}
+
+    # -- incremental export/import ----------------------------------------
+    # ref: rbd export-diff / import-diff (src/tools/rbd/action/
+    # ExportDiff.cc + ImportDiff.cc); stream format per
+    # doc/dev/rbd-diff.rst "rbd diff v1": magic, then tagged records
+    # f=from-snap, t=to-snap, s=size, w=offset/length/data,
+    # z=offset/length (zeroed extent), e=end. Diffs chain: export-diff
+    # from snap A at snap B, import-diff onto a copy holding A,
+    # snap B appears — incremental replication without shipping the
+    # whole image.
+
+    DIFF_MAGIC = b"rbd diff v1\n"
+    _DIFF_GRAIN = 4096
+
+    async def export_diff(self, from_snap: str | None = None) -> bytes:
+        """The v1 diff stream from ``from_snap`` to THIS view (open the
+        image at a snapshot to export up to that snap; at head for
+        up-to-now). ``from_snap=None`` exports the full view (every
+        allocated extent)."""
+        fv = None
+        if from_snap is not None:
+            s = self.snaps.get(from_snap)
+            if s is None:
+                raise ObjectOperationError(-2, f"no snap {from_snap}")
+            if self.snap_name is not None and \
+                    s["id"] >= self.snap_id:
+                raise ObjectOperationError(
+                    -22, "from_snap is not older than the exported view")
+            fv = Image(self.ioctx, self.name, s["size"], self.order,
+                       meta=self.meta, rbd=self.rbd)
+            fv.snap_name = from_snap
+            fv.snap_id = s["id"]
+        out = [self.DIFF_MAGIC]
+        if from_snap is not None:
+            nb = from_snap.encode()
+            out.append(b"f" + struct.pack("<I", len(nb)) + nb)
+        if self.snap_name is not None:
+            nb = self.snap_name.encode()
+            out.append(b"t" + struct.pack("<I", len(nb)) + nb)
+        out.append(b"s" + struct.pack("<Q", self.size_bytes))
+        g = self._DIFF_GRAIN
+        nobj = -(-self.size_bytes // self.obj_size)
+        for idx in range(nobj):
+            off0 = idx * self.obj_size
+            blen = min(self.obj_size, self.size_bytes - off0)
+            b = await self.read(off0, blen)
+            if fv is not None and off0 < fv.size_bytes:
+                a = await fv.read(off0,
+                                  min(self.obj_size,
+                                      fv.size_bytes - off0))
+            else:
+                a = b""
+            a = a.ljust(len(b), b"\0")
+            # classify per grain, merge adjacent same-kind runs. The
+            # loop always takes one final kind="end" pass — even when
+            # the object's length is not a grain multiple — so an open
+            # run covering the tail ALWAYS flushes (a `while pos <=
+            # len` guard silently dropped the last run of any object
+            # with len % grain != 0).
+            run_kind, run_start = None, 0
+            pos = 0
+            while True:
+                if pos < len(b):
+                    ca = a[pos:pos + g]
+                    cb = b[pos:pos + g]
+                    kind = None if ca == cb else \
+                        ("z" if cb.strip(b"\0") == b"" else "w")
+                else:
+                    kind = "end"
+                    pos = len(b)     # clamp: the closing flush must
+                                     # not overstate a tail z-extent
+                if kind != run_kind:
+                    if run_kind == "w":
+                        data = b[run_start:pos]
+                        out.append(b"w" + struct.pack(
+                            "<QQ", off0 + run_start, len(data)) + data)
+                    elif run_kind == "z":
+                        out.append(b"z" + struct.pack(
+                            "<QQ", off0 + run_start, pos - run_start))
+                    run_kind, run_start = kind, pos
+                if kind == "end":
+                    break
+                pos += g
+        out.append(b"e")
+        return b"".join(out)
+
+    async def import_diff(self, stream: bytes) -> None:
+        """Apply a v1 diff stream to this (head, writable) image: the
+        from-snap must exist here, the to-snap is created after the
+        data lands (ref: ImportDiff.cc ordering)."""
+        self._assert_writable()
+        if not stream.startswith(self.DIFF_MAGIC):
+            raise ObjectOperationError(-22, "not an rbd diff v1 stream")
+        pos = len(self.DIFF_MAGIC)
+        end_snap = None
+        ended = False
+        while pos < len(stream) and not ended:
+            tag = stream[pos:pos + 1]
+            pos += 1
+            if tag == b"f":
+                (n,) = struct.unpack_from("<I", stream, pos)
+                name = stream[pos + 4:pos + 4 + n].decode()
+                pos += 4 + n
+                if name not in self.snaps:
+                    raise ObjectOperationError(
+                        -22, f"start snapshot {name} not present")
+            elif tag == b"t":
+                (n,) = struct.unpack_from("<I", stream, pos)
+                end_snap = stream[pos + 4:pos + 4 + n].decode()
+                pos += 4 + n
+            elif tag == b"s":
+                (size,) = struct.unpack_from("<Q", stream, pos)
+                pos += 8
+                await self.resize(size)
+            elif tag == b"w":
+                off, n = struct.unpack_from("<QQ", stream, pos)
+                pos += 16
+                await self.write(off, stream[pos:pos + n])
+                pos += n
+            elif tag == b"z":
+                off, n = struct.unpack_from("<QQ", stream, pos)
+                pos += 16
+                while n:
+                    step = min(n, self.obj_size)
+                    await self.write(off, b"\0" * step)
+                    off += step
+                    n -= step
+            elif tag == b"e":
+                ended = True
+            else:
+                raise ObjectOperationError(
+                    -22, f"unknown diff record {tag!r}")
+        if not ended:
+            raise ObjectOperationError(-22, "truncated diff stream")
+        if end_snap is not None:
+            await self.snap_create(end_snap)

@@ -19,10 +19,18 @@ Construct the gateway with ``users={access: secret}`` to require a
 valid signature on every request (403 AccessDenied otherwise); omit
 it for anonymous mode.
 
+Round 5: presigned URLs (SigV4 query auth — ``auth.presign`` issues,
+the gateway verifies and expires them) and canned ACLs (``private`` /
+``public-read`` at bucket and object level via ``x-amz-acl`` and the
+``?acl`` sub-resource; writes are owner-only, public-read admits
+anonymous GETs — ref: RGWAccessControlPolicy reduced to the two
+grants that matter).
+
 Supported: PUT/DELETE bucket, GET / (list buckets), PUT/GET/HEAD/
 DELETE object, GET bucket (list objects), multipart
-initiate/upload-part/list-parts/list-uploads/complete/abort, SigV4.
-Not built: ACLs, versioning, presigned URLs, multisite replication.
+initiate/upload-part/list-parts/list-uploads/complete/abort, SigV4
+header + presigned query auth, canned ACLs.
+Not built: versioning, multisite replication, full grantee lists.
 """
 
 from __future__ import annotations
@@ -130,29 +138,104 @@ class RGWGateway:
         finally:
             writer.close()
 
+    # -- authn/authz (ref: RGWHandler_REST auth + RGWAccessControlPolicy) --
+    _DENIED = ("403 Forbidden", "application/xml",
+               b"<Error><Code>AccessDenied</Code></Error>", {})
+
+    async def _bucket_meta(self, bucket: str) -> dict | None:
+        """Owner + canned ACL of a bucket ({'owner':..., 'acl':...}),
+        or None when the bucket does not exist. Legacy b'1' rows read
+        as ownerless/private (any authenticated principal passes)."""
+        try:
+            rows = await self.ioctx.get_omap_vals(BUCKETS_ROOT,
+                                                  prefix=bucket)
+        except ObjectOperationError:
+            return None                  # no bucket root object yet
+        raw = rows.get(bucket)
+        if raw is None:
+            return None
+        if raw == b"1":
+            return {"owner": "", "acl": "private"}
+        return json.loads(raw)
+
+    async def _authz(self, ident: str | None, bucket: str, key: str,
+                     write: bool, meta: dict | None) -> bool:
+        """Canned-ACL policy check (only when auth is configured):
+        writes are owner-only; reads pass for the owner or when the
+        bucket (or, for objects, the object) is public-read — which
+        also admits anonymous principals, the presigned-URL
+        complement. ``meta`` is the bucket meta the dispatcher already
+        resolved (one read per request, shared with the acl
+        handlers)."""
+        if meta is None:
+            return True                  # let handlers return NoSuchBucket
+        owner = meta.get("owner", "")
+        if ident is not None and (not owner or ident == owner):
+            return True
+        if write:
+            return False
+        if meta.get("acl") == "public-read":
+            return True
+        if key:
+            try:
+                oacl = await self.ioctx.get_omap_vals(
+                    _index(bucket), prefix=f"a:{key}")
+            except ObjectOperationError:
+                return False
+            if oacl.get(f"a:{key}") == b"public-read":
+                return True
+        return False
+
     # -- op dispatch (ref: RGWOp subclasses) --------------------------------
     async def _dispatch(self, method: str, path: str, query: str,
                         headers: dict[str, str],
                         body: bytes) -> tuple[str, str, bytes, dict]:
-        if self.users:
-            ok, why = sigv4.verify(method, path, query, headers, body,
-                                   self.users)
-            if not ok:
-                log.dout(5, f"sigv4 reject: {why}")
-                return ("403 Forbidden", "application/xml",
-                        b"<Error><Code>AccessDenied</Code></Error>", {})
         q = dict(parse_qsl(query, keep_blank_values=True))
+        ident: str | None = None
+        if self.users:
+            if "X-Amz-Signature" in q:
+                ok, who = sigv4.verify_presigned(method, path, query,
+                                                 headers, self.users)
+            elif "authorization" in headers:
+                ok, who = sigv4.verify(method, path, query, headers,
+                                       body, self.users)
+            else:
+                ok, who = True, None     # anonymous: ACLs gate below
+            if not ok:
+                log.dout(5, f"sigv4 reject: {who}")
+                return self._DENIED
+            ident = who
         parts = [p for p in path.split("/") if p]
         try:
             if not parts:
                 if method == "GET":
-                    return await self._list_buckets()
+                    if self.users and ident is None:
+                        return self._DENIED  # service op: no anonymous
+                    return await self._list_buckets()   # bucket survey
                 return "405 Method Not Allowed", "text/plain", b"", {}
             bucket = parts[0]
             key = "/".join(parts[1:])
+            meta = None
+            if self.users or "acl" in q:
+                meta = await self._bucket_meta(bucket)
+            if self.users:
+                write = method not in ("GET", "HEAD")
+                if not await self._authz(ident, bucket, key, write,
+                                         meta):
+                    return self._DENIED
             if not key:
+                # ?acl sub-resource FIRST: a plain-PUT match would
+                # turn PUT /bucket?acl into bucket creation
+                if method == "GET" and "acl" in q:
+                    return await self._get_acl(bucket, "", meta)
+                if method == "PUT" and "acl" in q:
+                    return await self._put_acl(
+                        bucket, "", headers.get("x-amz-acl", "private"),
+                        meta)
                 if method == "PUT":
-                    return await self._create_bucket(bucket)
+                    return await self._create_bucket(
+                        bucket, ident,
+                        headers.get("x-amz-acl", "private"))
                 if method == "DELETE":
                     return await self._delete_bucket(bucket)
                 if method == "GET" and "uploads" in q:
@@ -160,6 +243,12 @@ class RGWGateway:
                 if method == "GET":
                     return await self._list_objects(bucket)
                 return "405 Method Not Allowed", "text/plain", b"", {}
+            if method == "GET" and "acl" in q:
+                return await self._get_acl(bucket, key, meta)
+            if method == "PUT" and "acl" in q:
+                return await self._put_acl(
+                    bucket, key, headers.get("x-amz-acl", "private"),
+                    meta)
             if method == "POST" and "uploads" in q:
                 return await self._initiate_multipart(bucket, key)
             if method == "POST" and "uploadId" in q:
@@ -188,7 +277,9 @@ class RGWGateway:
                                               q["uploadId"])
             if method == "PUT":
                 async with self._key_lock(bucket, key):
-                    return await self._put_object(bucket, key, body)
+                    return await self._put_object(
+                        bucket, key, body,
+                        acl=headers.get("x-amz-acl"))
             if method == "GET":
                 return await self._get_object(bucket, key)
             if method == "HEAD":
@@ -224,9 +315,73 @@ class RGWGateway:
                f"</ListAllMyBucketsResult>")
         return "200 OK", "application/xml", xml.encode(), {}
 
-    async def _create_bucket(self, bucket: str):
-        await self.ioctx.set_omap(BUCKETS_ROOT, bucket, b"1")
+    async def _create_bucket(self, bucket: str, owner: str | None = None,
+                             acl: str = "private"):
+        if self.users and owner is None:
+            return self._DENIED          # anonymous cannot own a bucket
+        if acl not in ("private", "public-read"):
+            acl = "private"
+        meta = json.dumps({"owner": owner or "", "acl": acl}).encode()
+        await self.ioctx.set_omap(BUCKETS_ROOT, bucket, meta)
         await self.ioctx.set_omap(_index(bucket), "_created", b"1")
+        return "200 OK", "application/xml", b"", {}
+
+    async def _key_exists(self, bucket: str, key: str) -> bool:
+        try:
+            rows = await self.ioctx.get_omap_vals(_index(bucket),
+                                                  prefix=f"k:{key}")
+        except ObjectOperationError:
+            return False
+        return f"k:{key}" in rows
+
+    async def _get_acl(self, bucket: str, key: str,
+                       meta: dict | None = None):
+        if meta is None:
+            meta = await self._bucket_meta(bucket)
+        if meta is None:
+            return "404 Not Found", "application/xml", \
+                b"<Error><Code>NoSuchBucket</Code></Error>", {}
+        acl = meta.get("acl", "private")
+        if key:
+            if not await self._key_exists(bucket, key):
+                return "404 Not Found", "application/xml", \
+                    b"<Error><Code>NoSuchKey</Code></Error>", {}
+            rows = await self.ioctx.get_omap_vals(_index(bucket),
+                                                  prefix=f"a:{key}")
+            oacl = rows.get(f"a:{key}")
+            if oacl is not None:
+                acl = oacl.decode()
+        grants = ('<Grant><Grantee>owner</Grantee>'
+                  '<Permission>FULL_CONTROL</Permission></Grant>')
+        if acl == "public-read":
+            grants += ('<Grant><Grantee>AllUsers</Grantee>'
+                       '<Permission>READ</Permission></Grant>')
+        xml = (f'<?xml version="1.0"?><AccessControlPolicy>'
+               f"<Owner><ID>{escape(meta.get('owner', ''))}</ID></Owner>"
+               f"<AccessControlList>{grants}</AccessControlList>"
+               f"</AccessControlPolicy>")
+        return "200 OK", "application/xml", xml.encode(), {}
+
+    async def _put_acl(self, bucket: str, key: str, acl: str,
+                       meta: dict | None = None):
+        if meta is None:
+            meta = await self._bucket_meta(bucket)
+        if meta is None:
+            return "404 Not Found", "application/xml", \
+                b"<Error><Code>NoSuchBucket</Code></Error>", {}
+        if acl not in ("private", "public-read"):
+            return ("400 Bad Request", "application/xml",
+                    b"<Error><Code>InvalidArgument</Code></Error>", {})
+        if key:
+            if not await self._key_exists(bucket, key):
+                return "404 Not Found", "application/xml", \
+                    b"<Error><Code>NoSuchKey</Code></Error>", {}
+            await self.ioctx.set_omap(_index(bucket), f"a:{key}",
+                                      acl.encode())
+        else:
+            meta["acl"] = acl
+            await self.ioctx.set_omap(BUCKETS_ROOT, bucket,
+                                      json.dumps(meta).encode())
         return "200 OK", "application/xml", b"", {}
 
     async def _delete_bucket(self, bucket: str):
@@ -256,7 +411,8 @@ class RGWGateway:
                f"</ListBucketResult>")
         return "200 OK", "application/xml", xml.encode(), {}
 
-    async def _put_object(self, bucket: str, key: str, body: bytes):
+    async def _put_object(self, bucket: str, key: str, body: bytes,
+                          acl: str | None = None):
         if not await self._bucket_exists(bucket):
             return "404 Not Found", "application/xml", \
                 b"<Error><Code>NoSuchBucket</Code></Error>", {}
@@ -265,6 +421,14 @@ class RGWGateway:
         # "k:" prefix keeps user keys out of the index meta namespace
         await self.ioctx.set_omap(_index(bucket), f"k:{key}",
                                   len(body).to_bytes(8, "little"))
+        if acl in ("private", "public-read"):
+            await self.ioctx.set_omap(_index(bucket), f"a:{key}",
+                                      acl.encode())
+        else:                    # overwrite clears any stale object acl
+            try:
+                await self.ioctx.rm_omap_key(_index(bucket), f"a:{key}")
+            except ObjectOperationError:
+                pass
         etag = hashlib.md5(body).hexdigest()
         return "200 OK", "application/xml", b"", {"ETag": f'"{etag}"'}
 
@@ -324,10 +488,11 @@ class RGWGateway:
             await self.ioctx.remove(_obj(bucket, key))
         except ObjectOperationError:
             pass
-        try:
-            await self.ioctx.rm_omap_key(_index(bucket), f"k:{key}")
-        except ObjectOperationError:
-            pass
+        for row in (f"k:{key}", f"a:{key}"):
+            try:
+                await self.ioctx.rm_omap_key(_index(bucket), row)
+            except ObjectOperationError:
+                pass
         return "204 No Content", "application/xml", b"", {}
 
     # -- multipart (ref: RGWPutObjProcessor_Multipart + RGWObjManifest) ----
@@ -458,6 +623,11 @@ class RGWGateway:
             json.dumps({"parts": parts, "etag": etag}).encode())
         await self.ioctx.set_omap(_index(bucket), f"k:{key}",
                                   total.to_bytes(8, "little"))
+        try:     # like plain PUT: replacing the object clears any
+            await self.ioctx.rm_omap_key(     # stale per-object acl
+                _index(bucket), f"a:{key}")
+        except ObjectOperationError:
+            pass
         # drop upload bookkeeping (parts live on, referenced by the
         # manifest); unlisted parts are garbage-collected now
         for n in sorted(have):

@@ -5,11 +5,13 @@ rgw_create_s3_v4_canonical_request) — request signing and verification
 per the published SigV4 algorithm: canonical request -> string to sign
 -> HMAC chain over (date, region, service, "aws4_request").
 
-Only header-based auth is implemented (``Authorization:
-AWS4-HMAC-SHA256 ...``); presigned query auth and chunked payload
-signing are not. Payload integrity: the ``x-amz-content-sha256``
-header is required on signed requests and checked against the body
-unless it is ``UNSIGNED-PAYLOAD``.
+Header-based auth (``Authorization: AWS4-HMAC-SHA256 ...``) and
+presigned query auth (``X-Amz-Signature=...`` — the shareable-URL
+form, round 5) are implemented; chunked payload signing is not.
+Payload integrity: the ``x-amz-content-sha256`` header is required on
+header-signed requests and checked against the body unless it is
+``UNSIGNED-PAYLOAD``; presigned requests are UNSIGNED-PAYLOAD by
+definition and carry their own expiry (``X-Amz-Expires``).
 """
 
 from __future__ import annotations
@@ -141,6 +143,88 @@ def verify(method: str, path: str, query: str, headers: dict[str, str],
         return False, "payload hash mismatch"
     creq = canonical_request(method, path, query, headers, signed,
                              payload_hash)
+    scope = f"{date}/{region}/{SERVICE}/aws4_request"
+    want = hmac.new(signing_key(secret, date, region),
+                    string_to_sign(amzdate, scope, creq).encode(),
+                    hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(want, given):
+        return False, "signature mismatch"
+    return True, access
+
+
+def presign(method: str, path: str, host: str, access: str,
+            secret: str, expires: int = 3600,
+            region: str = "us-east-1", query: str = "",
+            amzdate: str | None = None) -> str:
+    """Client side: the full query string of a presigned URL (ref: the
+    GET-object sharing flow rgw serves for radosgw-admin-issued keys).
+    Signs method+path+query with the payload pinned UNSIGNED-PAYLOAD
+    and only ``host`` in SignedHeaders, per the SigV4 query spec."""
+    if amzdate is None:
+        amzdate = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y%m%dT%H%M%SZ")
+    date = amzdate[:8]
+    scope = f"{date}/{region}/{SERVICE}/aws4_request"
+    q = list(parse_qsl(query, keep_blank_values=True))
+    q += [("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
+          ("X-Amz-Credential", f"{access}/{scope}"),
+          ("X-Amz-Date", amzdate),
+          ("X-Amz-Expires", str(int(expires))),
+          ("X-Amz-SignedHeaders", "host")]
+    qs = "&".join(f"{quote(k, safe='-_.~')}={quote(v, safe='-_.~')}"
+                  for k, v in q)
+    creq = canonical_request(method, path, qs, {"host": host},
+                             ["host"], UNSIGNED)
+    sig = hmac.new(signing_key(secret, date, region),
+                   string_to_sign(amzdate, scope, creq).encode(),
+                   hashlib.sha256).hexdigest()
+    return qs + f"&X-Amz-Signature={sig}"
+
+
+def verify_presigned(method: str, path: str, query: str,
+                     headers: dict[str, str],
+                     secrets: dict[str, str]) -> tuple[bool, str]:
+    """Server side for X-Amz-Signature query auth: (ok, access|reason).
+
+    The canonical request re-signs every query pair EXCEPT
+    X-Amz-Signature itself; expiry comes from X-Amz-Date +
+    X-Amz-Expires rather than the fixed clock-skew window."""
+    pairs = parse_qsl(query, keep_blank_values=True)
+    params = dict(pairs)
+    given = params.get("X-Amz-Signature")
+    if not given:
+        return False, "missing X-Amz-Signature"
+    if params.get("X-Amz-Algorithm") != "AWS4-HMAC-SHA256":
+        return False, "unsupported X-Amz-Algorithm"
+    try:
+        access, date, region, service, terminal = \
+            params["X-Amz-Credential"].split("/")
+        amzdate = params["X-Amz-Date"]
+        expires = int(params["X-Amz-Expires"])
+        signed = params["X-Amz-SignedHeaders"].split(";")
+    except (KeyError, ValueError):
+        return False, "malformed presigned parameters"
+    if service != SERVICE or terminal != "aws4_request":
+        return False, "bad credential scope"
+    if amzdate[:8] != date:
+        return False, "X-Amz-Date does not match credential date"
+    secret = secrets.get(access)
+    if secret is None:
+        return False, "unknown access key"
+    try:
+        when = datetime.datetime.strptime(
+            amzdate, "%Y%m%dT%H%M%SZ").replace(
+                tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return False, "malformed X-Amz-Date"
+    now = datetime.datetime.now(datetime.timezone.utc)
+    age = (now - when).total_seconds()
+    if age > expires or age < -900:
+        return False, "presigned URL expired"
+    qs = "&".join(f"{quote(k, safe='-_.~')}={quote(v, safe='-_.~')}"
+                  for k, v in pairs if k != "X-Amz-Signature")
+    creq = canonical_request(method, path, qs, headers, signed,
+                             UNSIGNED)
     scope = f"{date}/{region}/{SERVICE}/aws4_request"
     want = hmac.new(signing_key(secret, date, region),
                     string_to_sign(amzdate, scope, creq).encode(),

@@ -293,3 +293,83 @@ def test_mds_create_on_open_race_preserves_write():
         finally:
             await c.stop()
     run(go())
+
+
+def test_mds_cap_lease_eviction_blocklists():
+    """A hung client (no renewals, never acks revokes) must not hold
+    exclusivity hostage: when its lease lapses during a revoke wait
+    the MDS evicts it AND blocklists it at the OSDs before the
+    competing open proceeds — so even if the zombie resumes with its
+    stale FW handle, its data writes bounce with EBLOCKLISTED instead
+    of corrupting the new holder's file (ref: Session lease renewal +
+    Locker stale-session eviction + the paired osdmap blocklist)."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            io = await _pool(c)
+            mds = MDSDaemon(io, lease_timeout=1.0, revoke_timeout=12.0)
+            await mds.fs.mount()
+            addr = await mds.start()
+            monmap = c.client.monc.monmap
+            a = await CephFSClient.create(monmap, addr, "fs",
+                                          keyring=c.keyring)
+            b = await CephFSClient.create(monmap, addr, "fs",
+                                          keyring=c.keyring)
+            ha = await a.open_file("/hostage.txt", "w")
+            await ha.write(b"held")
+            # hang client a: no more renewals, revokes go unanswered
+            a._renew_task.cancel()
+
+            async def never_acks(msg):
+                pass
+
+            a._handle_revoke = never_acks
+            t0 = asyncio.get_event_loop().time()
+            hb = await asyncio.wait_for(b.open_file("/hostage.txt", "w"),
+                                        timeout=10)
+            took = asyncio.get_event_loop().time() - t0
+            assert hb.valid
+            # a was evicted: session and caps gone, and the open did
+            # not ride to the revoke timeout
+            assert a.msgr.name not in mds.sessions
+            assert a.msgr.name not in mds.caps.get("/hostage.txt", {})
+            assert took < 8, took
+            # the zombie resumes and writes DIRECTLY to the data
+            # object under its stale handle. The fence rides the map
+            # push to the OSDs, so probe until the refusal lands; from
+            # then on the zombie can never mutate data again.
+            from ceph_tpu.cephfs import _fileobj
+            fenced = False
+            for _ in range(50):
+                try:
+                    await a.ioctx.write_full(
+                        _fileobj("/hostage.txt"), b"zombie")
+                except ObjectOperationError as e:
+                    assert e.errno == -108, e
+                    fenced = True
+                    break
+                await asyncio.sleep(0.2)
+            assert fenced, "zombie writes were never refused"
+            await hb.write(b"taken")
+            with pytest.raises(ObjectOperationError):
+                await a.ioctx.write_full(_fileobj("/hostage.txt"),
+                                         b"zombie")
+            # ...and the new holder's data survived
+            assert await b.read_file("/hostage.txt") == b"taken"
+            # blocklist is visible and removable via the mon command
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "osd blocklist", "blocklistop": "ls"})
+            assert ret == 0 and a.msgr.name in out.decode()
+            ret, _, _ = await c.client.mon_command(
+                {"prefix": "osd blocklist", "blocklistop": "rm",
+                 "addr": a.msgr.name})
+            assert ret == 0
+            await hb.close()
+            await b.unmount()
+            await a.msgr.shutdown()
+            if a._own_rados is not None:
+                await a._own_rados.shutdown()
+            await mds.stop()
+        finally:
+            await c.stop()
+    run(go())

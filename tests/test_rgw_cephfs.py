@@ -396,3 +396,113 @@ def test_cephfs_namespace():
         finally:
             await c.stop()
     run(go())
+
+
+def test_rgw_presigned_and_acls():
+    """Round 5: canned ACLs (owner-only writes, public-read reads,
+    ?acl sub-resource) and presigned query-auth URLs incl. expiry and
+    tamper rejection (ref: RGWAccessControlPolicy + the SigV4 query
+    flow of rgw_auth_s3)."""
+    async def go():
+        from ceph_tpu.rgw import auth as sigv4
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("rgw", pg_num=8, size=3)
+            await c.wait_for_clean(timeout=90)
+            io = await c.client.open_ioctx("rgw")
+            await _warm(io)
+            gw = RGWGateway(io, users={"OWNER": "sk1", "OTHER": "sk2"})
+            port = await gw.start()
+
+            def signed(method, target, body=b"", access="OWNER",
+                       secret="sk1", amzacl=None):
+                path, _, query = target.partition("?")
+                h = {"host": "x"}
+                if amzacl:
+                    h["x-amz-acl"] = amzacl
+                out = sigv4.sign(method, path, query, h, body,
+                                 access, secret)
+                if amzacl:
+                    out["x-amz-acl"] = amzacl
+                return out
+
+            # OWNER creates a private bucket and an object
+            st, _ = await _http(port, "PUT", "/priv",
+                                headers=signed("PUT", "/priv"))
+            assert st == 200
+            st, _ = await _http(port, "PUT", "/priv/doc", b"secret",
+                                headers=signed("PUT", "/priv/doc",
+                                               b"secret"))
+            assert st == 200
+            # anonymous read: denied; OTHER read: denied (private);
+            # OTHER write: denied (owner-only)
+            st, _ = await _http(port, "GET", "/priv/doc")
+            assert st == 403
+            st, _ = await _http(port, "GET", "/priv/doc",
+                                headers=signed("GET", "/priv/doc",
+                                               access="OTHER",
+                                               secret="sk2"))
+            assert st == 403
+            st, _ = await _http(port, "PUT", "/priv/doc2", b"x",
+                                headers=signed("PUT", "/priv/doc2",
+                                               b"x", access="OTHER",
+                                               secret="sk2"))
+            assert st == 403
+            # object-level public-read via ?acl: anonymous GET passes,
+            # bucket listing stays private
+            st, _ = await _http(port, "PUT", "/priv/doc?acl",
+                                headers=signed("PUT", "/priv/doc?acl",
+                                               amzacl="public-read"))
+            assert st == 200
+            st, data = await _http(port, "GET", "/priv/doc")
+            assert st == 200 and data == b"secret"
+            st, _ = await _http(port, "GET", "/priv")
+            assert st == 403
+            # GET ?acl reflects the grant
+            st, xml = await _http(port, "GET", "/priv/doc?acl",
+                                  headers=signed("GET",
+                                                 "/priv/doc?acl"))
+            assert st == 200 and b"AllUsers" in xml
+            # bucket-level public-read opens listing to anonymous
+            st, _ = await _http(port, "PUT", "/priv?acl",
+                                headers=signed("PUT", "/priv?acl",
+                                               amzacl="public-read"))
+            assert st == 200
+            st, xml = await _http(port, "GET", "/priv")
+            assert st == 200 and b"doc" in xml
+            # overwriting the object clears its stale public acl
+            st, _ = await _http(port, "PUT", "/priv?acl",
+                                headers=signed("PUT", "/priv?acl",
+                                               amzacl="private"))
+            assert st == 200
+            st, _ = await _http(port, "PUT", "/priv/doc", b"v2",
+                                headers=signed("PUT", "/priv/doc",
+                                               b"v2"))
+            assert st == 200
+            st, _ = await _http(port, "GET", "/priv/doc")
+            assert st == 403
+
+            # presigned URL: anonymous GET through the signed query
+            qs = sigv4.presign("GET", "/priv/doc", "x", "OWNER", "sk1",
+                               expires=120)
+            st, data = await _http(port, "GET", f"/priv/doc?{qs}")
+            assert st == 200 and data == b"v2"
+            # tampered query: denied
+            st, _ = await _http(port, "GET",
+                                f"/priv/doc?{qs}&evil=1")
+            assert st == 403
+            # expired: denied
+            old = sigv4.presign(
+                "GET", "/priv/doc", "x", "OWNER", "sk1", expires=60,
+                amzdate="20200101T000000Z")
+            st, _ = await _http(port, "GET", f"/priv/doc?{old}")
+            assert st == 403
+            # presigned with an unknown key: denied
+            bad = sigv4.presign("GET", "/priv/doc", "x", "NOBODY",
+                                "sk1", expires=120)
+            st, _ = await _http(port, "GET", f"/priv/doc?{bad}")
+            assert st == 403
+            await gw.stop()
+        finally:
+            await c.stop()
+    run(go())
