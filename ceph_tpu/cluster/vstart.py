@@ -31,12 +31,14 @@ class Cluster:
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
                  config: dict | None = None, auth: bool = True,
                  data_dir: str | None = None,
-                 mgr_modules: list | None = None):
+                 mgr_modules: list | None = None,
+                 stores: list | None = None):
         self.cfg = dict(DEFAULT_CFG, **(config or {}))
         self.n_mons = n_mons
         self.n_osds = n_osds
         self.auth = auth
         self.data_dir = data_dir       # None = MemStore osds
+        self.stores = stores           # explicit per-osd ObjectStores
         self.keyring = Keyring() if auth else None
         self.monmap = MonMap(fsid="vstart")
         self.mons: list[Monitor] = []
@@ -81,8 +83,11 @@ class Cluster:
                  "host": f"host{i}"})
             assert ret == 0, rs
         for i in range(self.n_osds):
-            store = MemStore() if self.data_dir is None else \
-                WALStore(f"{self.data_dir}/osd{i}")
+            if self.stores is not None:
+                store = self.stores[i]
+            else:
+                store = MemStore() if self.data_dir is None else \
+                    WALStore(f"{self.data_dir}/osd{i}")
             osd = OSD(i, self.monmap, store=store,
                       keyring=self.keyring, config=self.cfg)
             self.osds.append(osd)

@@ -1,0 +1,536 @@
+"""BlueStoreLite: extent-allocated object store over a flat block file.
+
+ref: src/os/bluestore/BlueStore.{h,cc} — the architecture in miniature,
+not a translation:
+
+- object DATA lives on a flat ``block`` file carved into ALLOCATION
+  UNITS by a bitmap allocator (allocator.py); each onode carries an
+  extent map [(logical_off, au, n_aus, crc32)] — BlueStore's
+  ExtentMap/PExtentVector role, with csum_type=crc32c per extent.
+- object METADATA (onodes: size + extents + xattrs + omap) lives in
+  the WALDB key-value store — the RocksDB seat; every ObjectStore
+  Transaction commits as ONE atomic kv batch.
+- WRITES are copy-on-write at AU granularity: the affected AU range is
+  rebuilt into freshly allocated space, the block file is written and
+  flushed BEFORE the kv commit points at it, and the old AUs are freed
+  after — BlueStore's big-write path, which makes torn block writes
+  unreachable (metadata never references half-written space).
+- SMALL overwrites that stay inside one already-allocated AU take the
+  DEFERRED path instead: the bytes ride inside the kv batch (a "D"
+  record) and are applied to the block file after the commit; mount
+  replays any "D" records left by a crash (idempotent: whole-AU
+  rewrite) — BlueStore's deferred_txn machinery.
+- ``fsck`` walks every onode: extents in-bounds, no cross-object
+  overlap, per-extent crc verified against the block file, allocator
+  bitmap consistent with the union of extents (leak/double-use
+  detection) — BlueStore::_fsck's core checks. ``statfs`` reports the
+  allocator's view.
+
+Not rebuilt: blob refcounting for clone sharing (clone copies through
+fresh extents), compression, BlueFS/multi-device tiering, cache
+trimming. Collections/omap/attrs reuse the kv directly.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+
+from ceph_tpu.encoding.denc import Decoder, Encoder
+from ceph_tpu.os_.allocator import AllocatorError, BitmapAllocator
+from ceph_tpu.os_.kv import KVTransaction, WALDB
+from ceph_tpu.os_.objectstore import (
+    OP_CLONE, OP_MKCOLL, OP_OMAP_CLEAR, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS,
+    OP_REMOVE, OP_RMATTR, OP_RMCOLL, OP_SETATTRS, OP_TOUCH, OP_TRUNCATE,
+    OP_WRITE, OP_ZERO,
+    ChecksumError, ObjectStore, StoreError, Transaction,
+)
+
+
+class _Onode:
+    __slots__ = ("size", "extents", "attrs", "omap")
+
+    def __init__(self):
+        self.size = 0
+        # [(loff, au, n_aus, crc32 of the logical bytes)] sorted by
+        # loff; gaps read as zeros (sparse objects)
+        self.extents: list[list[int]] = []
+        self.attrs: dict[str, bytes] = {}
+        self.omap: dict[str, bytes] = {}
+
+
+def _enc_onode(o: _Onode) -> bytes:
+    e = Encoder()
+    e.u64(o.size)
+    e.list(o.extents, lambda e, x:
+           e.u64(x[0]).u64(x[1]).u64(x[2]).u32(x[3]))
+    e.map(o.attrs, lambda e, k: e.string(k), lambda e, v: e.blob(v))
+    e.map(o.omap, lambda e, k: e.string(k), lambda e, v: e.blob(v))
+    return e.tobytes()
+
+
+def _dec_onode(data: bytes) -> _Onode:
+    d = Decoder(data)
+    o = _Onode()
+    o.size = d.u64()
+    o.extents = d.list(lambda d: [d.u64(), d.u64(), d.u64(), d.u32()])
+    o.attrs = d.map(lambda d: d.string(), lambda d: d.blob())
+    o.omap = d.map(lambda d: d.string(), lambda d: d.blob())
+    return o
+
+
+class BlueStore(ObjectStore):
+    """Extent-allocated durable ObjectStore (see module docstring)."""
+
+    AU = 4096                     # min_alloc_size
+    DEFERRED_MAX = 64 << 10       # small-overwrite deferred threshold
+
+    def __init__(self, path: str, size: int = 64 << 20):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.db = WALDB(os.path.join(path, "db"))
+        self.block_path = os.path.join(path, "block")
+        sb = self.db.get("S", "super")
+        if sb is None:
+            self.size = size - size % self.AU
+            with open(self.block_path, "wb") as f:
+                f.truncate(self.size)
+            t = KVTransaction()
+            e = Encoder()
+            e.u64(self.size).u32(self.AU)
+            t.set("S", "super", e.tobytes())
+            self.db.submit_transaction(t)
+        else:
+            d = Decoder(sb)
+            self.size = d.u64()
+            if d.u32() != self.AU:
+                raise StoreError("allocation unit mismatch")
+        self._f = open(self.block_path, "r+b")
+        self.alloc = BitmapAllocator(self.size // self.AU)
+        self.colls: dict[str, set[str]] = {}
+        self.onodes: dict[tuple[str, str], _Onode] = {}
+        self._dseq = 0
+        # au -> bytes queued for deferred write within the CURRENT
+        # transaction (overlay for _read_extent; cleared at commit end)
+        self._pending_au: dict[int, bytes] = {}
+        # crash-injection hook for the qa tier (the messenger's
+        # inject-socket-failures discipline, store-side): raise at the
+        # named commit boundary so tests can exercise replay/rollback
+        self._fail_point: str | None = None
+        self._load()
+
+    def _reset_from_kv(self) -> None:
+        self.alloc = BitmapAllocator(self.size // self.AU)
+        self.colls = {}
+        self.onodes = {}
+        self._load()
+
+    # -- mount/load --------------------------------------------------------
+    def _load(self) -> None:
+        for cid, _ in self.db.get_iterator("L"):
+            self.colls[cid] = set()
+        for key, rec in self.db.get_iterator("O"):
+            cid, _, oid = key.partition("\x00")
+            o = _dec_onode(rec)
+            self.onodes[(cid, oid)] = o
+            self.colls.setdefault(cid, set()).add(oid)
+            self.alloc.mark_used([(x[1], x[2]) for x in o.extents])
+        # deferred replay (crash between kv commit and block write):
+        # whole-AU rewrites are idempotent, so replay-then-delete is
+        # safe regardless of whether the block write had landed
+        replayed = KVTransaction()
+        n = 0
+        for key, rec in sorted(self.db.get_iterator("D")):
+            d = Decoder(rec)
+            au = d.u64()
+            data = d.blob()
+            self._f.seek(au * self.AU)
+            self._f.write(data)
+            replayed.rmkey("D", key)
+            n += 1
+            self._dseq = max(self._dseq, int(key) + 1)
+        if n:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self.db.submit_transaction(replayed)
+
+    # -- block I/O helpers -------------------------------------------------
+    def _read_extent(self, x) -> bytes:
+        loff, au, n_aus, crc = x
+        self._f.seek(au * self.AU)
+        raw = self._f.read(n_aus * self.AU)
+        if self._pending_au:
+            # deferred bytes queued in THIS transaction are not on the
+            # block file yet but MUST be visible to later ops of the
+            # same transaction (a second small overwrite, a clone, a
+            # COW of the same range) — splice the overlay in
+            buf = None
+            for i in range(n_aus):
+                chunk = self._pending_au.get(au + i)
+                if chunk is not None:
+                    if buf is None:
+                        buf = bytearray(raw)
+                    buf[i * self.AU:(i + 1) * self.AU] = chunk
+            if buf is not None:
+                return bytes(buf)
+        return raw
+
+    def _read_range(self, o: _Onode, start: int, end: int) -> bytes:
+        """Logical bytes [start, end) — gaps as zeros, crc verified."""
+        out = bytearray(end - start)
+        for x in o.extents:
+            loff, au, n_aus, crc = x
+            xlen = n_aus * self.AU
+            if loff >= end or loff + xlen <= start:
+                continue
+            raw = self._read_extent(x)
+            if zlib.crc32(raw) != crc:
+                raise ChecksumError(
+                    f"extent crc mismatch at logical {loff}")
+            s = max(start, loff)
+            e = min(end, loff + xlen)
+            out[s - start:e - start] = raw[s - loff:e - loff]
+        return bytes(out)
+
+    def _object_bytes(self, o: _Onode) -> bytes:
+        return self._read_range(o, 0, o.size) if o.size else b""
+
+    # -- transaction apply -------------------------------------------------
+    def queue_transaction(self, t: Transaction) -> None:
+        """All-or-nothing: COW block writes land and flush first, then
+        ONE kv batch commits every metadata change + deferred record;
+        only after the commit are replaced AUs freed and deferred
+        bytes applied in place."""
+        kvt = KVTransaction()
+        to_free: list[tuple[int, int]] = []
+        deferred: list[tuple[int, bytes]] = []
+        dirty: set[tuple[str, str]] = set()
+        wrote_block = False
+        try:
+            for op in t.ops:
+                wb = self._apply_op(op, kvt, to_free, deferred, dirty)
+                wrote_block = wrote_block or wb
+            if self._fail_point == "before_kv_commit":  # crash inject
+                raise StoreError("fail point: before_kv_commit")
+        except Exception:
+            # all-or-nothing: nothing committed to kv, so rebuild the
+            # in-memory caches (onodes, collections, allocator) from
+            # the committed state — a half-applied op list must not
+            # leave RAM diverged from disk
+            self._pending_au.clear()
+            self._reset_from_kv()
+            raise
+        for key in dirty:
+            o = self.onodes.get(key)
+            okey = f"{key[0]}\x00{key[1]}"
+            if o is None:
+                kvt.rmkey("O", okey)
+            else:
+                kvt.set("O", okey, _enc_onode(o))
+        for au, data in deferred:
+            e = Encoder()
+            e.u64(au).blob(data)
+            kvt.set("D", f"{self._dseq:016d}", e.tobytes())
+            self._dseq += 1
+        if wrote_block:
+            self._f.flush()
+            os.fsync(self._f.fileno())       # data durable BEFORE the
+        try:                                 # metadata points at it
+            self.db.submit_transaction(kvt)
+        except Exception:
+            # commit failed: RAM reflects an uncommitted transaction —
+            # rebuild from the kv or every later read serves phantoms
+            self._pending_au.clear()
+            self._reset_from_kv()
+            raise
+        if self._fail_point == "after_kv_commit":      # crash injection
+            raise StoreError("fail point: after_kv_commit")
+        self.alloc.release(to_free)
+        if deferred:
+            drop = KVTransaction()
+            for i, (au, data) in enumerate(deferred):
+                self._f.seek(au * self.AU)
+                self._f.write(data)
+                drop.rmkey("D", f"{self._dseq - len(deferred) + i:016d}")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self.db.submit_transaction(drop)
+        self._pending_au.clear()
+
+    def _onode(self, cid: str, oid: str, create: bool) -> _Onode:
+        if cid not in self.colls:
+            raise StoreError(f"no collection {cid}")
+        o = self.onodes.get((cid, oid))
+        if o is None:
+            if not create:
+                raise StoreError(f"no object {cid}/{oid}")
+            o = _Onode()
+            self.onodes[(cid, oid)] = o
+            self.colls[cid].add(oid)
+        return o
+
+    def _rewrite_range(self, o: _Onode, off: int, data: bytes,
+                       to_free: list) -> None:
+        """COW the AU-aligned range covering [off, off+len(data))."""
+        a0 = off // self.AU * self.AU
+        a1 = -(-(off + len(data)) // self.AU) * self.AU
+        if off == a0 and off + len(data) == a1:
+            # full-cover rewrite: no read of the old bytes — which
+            # also means a corrupt extent CAN be repaired by
+            # overwriting it whole (and no redundant crc work)
+            buf = bytearray(data)
+        else:
+            buf = bytearray(self._read_range(o, a0, a1))
+            buf[off - a0:off - a0 + len(data)] = data
+        new = self.alloc.allocate((a1 - a0) // self.AU)
+        pos = 0
+        new_extents = []
+        for au, n_aus in new:
+            chunk = bytes(buf[pos:pos + n_aus * self.AU])
+            self._f.seek(au * self.AU)
+            self._f.write(chunk)
+            new_extents.append([a0 + pos, au, n_aus, zlib.crc32(chunk)])
+            pos += n_aus * self.AU
+        self._replace_extents(o, a0, a1, new_extents, to_free)
+
+    def _replace_extents(self, o: _Onode, a0: int, a1: int,
+                         new_extents: list, to_free: list) -> None:
+        """Swap the extent-map entries covering AU-aligned [a0, a1)."""
+        kept = []
+        for x in o.extents:
+            loff, au, n_aus, crc = x
+            xlen = n_aus * self.AU
+            if loff >= a1 or loff + xlen <= a0:
+                kept.append(x)
+                continue
+            # extents are AU-aligned and the range is AU-aligned, so
+            # partial overlaps split at AU boundaries
+            if loff < a0:
+                pre = (a0 - loff) // self.AU
+                raw = self._read_extent(x)[:pre * self.AU]
+                kept.append([loff, au, pre, zlib.crc32(raw)])
+                au += pre
+                n_aus -= pre
+                loff = a0
+            if loff + n_aus * self.AU > a1:
+                post = (loff + n_aus * self.AU - a1) // self.AU
+                keep_from = n_aus - post
+                raw = self._read_extent(
+                    [loff, au, n_aus, 0])[keep_from * self.AU:]
+                kept.append([a1, au + keep_from, post, zlib.crc32(raw)])
+                n_aus = keep_from
+            to_free.append((au, n_aus))
+        kept.extend(new_extents)
+        kept.sort(key=lambda x: x[0])
+        o.extents = kept
+
+    def _apply_op(self, op, kvt: KVTransaction, to_free, deferred,
+                  dirty) -> bool:
+        code = op[0]
+        if code == OP_MKCOLL:
+            self.colls.setdefault(op[1], set())
+            kvt.set("L", op[1], b"1")
+            return False
+        if code == OP_RMCOLL:
+            for oid in list(self.colls.get(op[1], ())):
+                self._remove(op[1], oid, to_free, dirty)
+            self.colls.pop(op[1], None)
+            kvt.rmkey("L", op[1])
+            return False
+        cid, oid = op[1], op[2]
+        wrote = False
+        if code == OP_TOUCH:
+            self._onode(cid, oid, create=True)
+        elif code in (OP_WRITE, OP_ZERO):
+            if code == OP_WRITE:
+                off, data = op[3], op[4]
+            else:
+                off, data = op[3], b"\x00" * op[4]
+            o = self._onode(cid, oid, create=True)
+            o.size = max(o.size, off + len(data))
+            if data:
+                au0 = off // self.AU
+                au1 = (off + len(data) - 1) // self.AU
+                covered = self._covering_extent(o, au0, au1)
+                if covered is not None and \
+                        len(data) <= self.DEFERRED_MAX:
+                    # deferred small overwrite: rebuild the covered
+                    # AUs in memory; bytes ride the kv commit
+                    a0 = au0 * self.AU
+                    a1 = (au1 + 1) * self.AU
+                    if off == a0 and off + len(data) == a1:
+                        # full-cover: no read of the old bytes (also
+                        # the repair path for a corrupt extent)
+                        buf = bytearray(data)
+                    else:
+                        buf = bytearray(self._read_range(o, a0, a1))
+                        buf[off - a0:off - a0 + len(data)] = data
+                    loff, au, n_aus, _ = covered
+                    sub = au + (a0 - loff) // self.AU
+                    deferred.append((sub, bytes(buf)))
+                    for i in range((a1 - a0) // self.AU):
+                        self._pending_au[sub + i] = bytes(
+                            buf[i * self.AU:(i + 1) * self.AU])
+                    self._patch_crc(o, covered, a0 - loff, buf)
+                else:
+                    self._rewrite_range(o, off, data, to_free)
+                    wrote = True
+        elif code == OP_TRUNCATE:
+            o = self._onode(cid, oid, create=True)
+            new_size = op[3]
+            if new_size < o.size:
+                lim = -(-new_size // self.AU) * self.AU
+                kept = []
+                for x in o.extents:
+                    loff, au, n_aus, crc = x
+                    if loff >= lim:
+                        to_free.append((au, n_aus))
+                    elif loff + n_aus * self.AU > lim:
+                        keep = (lim - loff) // self.AU
+                        raw = self._read_extent(x)[:keep * self.AU]
+                        kept.append([loff, au, keep, zlib.crc32(raw)])
+                        to_free.append((au + keep, n_aus - keep))
+                    else:
+                        kept.append(x)
+                o.extents = kept
+                if new_size % self.AU:
+                    # zero the dropped tail INSIDE the last kept AU so
+                    # a later size extension reads zeros
+                    self._rewrite_range(
+                        o, new_size,
+                        b"\x00" * (lim - new_size), to_free)
+                    wrote = True
+            o.size = new_size
+        elif code == OP_REMOVE:
+            self._remove(cid, oid, to_free, dirty)
+            dirty.add((cid, oid))
+            return False
+        elif code == OP_SETATTRS:
+            self._onode(cid, oid, create=True).attrs.update(op[3])
+        elif code == OP_RMATTR:
+            self._onode(cid, oid, create=False).attrs.pop(op[3], None)
+        elif code == OP_CLONE:
+            src = self._onode(cid, oid, create=False)
+            dst = self._onode(cid, op[3], create=True)
+            payload = self._object_bytes(src)
+            for x in dst.extents:
+                to_free.append((x[1], x[2]))
+            dst.extents = []
+            dst.size = 0
+            dst.attrs = dict(src.attrs)
+            dst.omap = dict(src.omap)
+            if payload:
+                self._rewrite_range(dst, 0, payload, to_free)
+                wrote = True
+            dst.size = src.size
+            dirty.add((cid, op[3]))
+        elif code == OP_OMAP_SETKEYS:
+            self._onode(cid, oid, create=True).omap.update(op[3])
+        elif code == OP_OMAP_RMKEYS:
+            o = self._onode(cid, oid, create=False)
+            for k in op[3]:
+                o.omap.pop(k, None)
+        elif code == OP_OMAP_CLEAR:
+            self._onode(cid, oid, create=False).omap.clear()
+        else:
+            raise StoreError(f"unknown op {code}")
+        dirty.add((cid, oid))
+        return wrote
+
+    def _covering_extent(self, o: _Onode, au0: int, au1: int):
+        """The single extent covering logical AUs [au0, au1], or None."""
+        for x in o.extents:
+            loff, au, n_aus, _ = x
+            first = loff // self.AU
+            if first <= au0 and au1 < first + n_aus:
+                return x
+        return None
+
+    def _patch_crc(self, o: _Onode, x, rel_off: int,
+                   buf: bytearray) -> None:
+        """Recompute a covering extent's crc after an in-place
+        (deferred) overwrite of buf at rel_off within it."""
+        raw = bytearray(self._read_extent(x))
+        raw[rel_off:rel_off + len(buf)] = buf
+        x[3] = zlib.crc32(bytes(raw))
+
+    def _remove(self, cid: str, oid: str, to_free, dirty) -> None:
+        o = self.onodes.pop((cid, oid), None)
+        if o is not None:
+            to_free.extend((x[1], x[2]) for x in o.extents)
+        self.colls.get(cid, set()).discard(oid)
+        dirty.add((cid, oid))
+
+    # -- reads -------------------------------------------------------------
+    def read(self, cid, oid, offset=0, length=None):
+        o = self._onode(cid, oid, create=False)
+        end = o.size if length is None else min(offset + length, o.size)
+        if offset >= end:
+            return b""
+        return self._read_range(o, offset, end)
+
+    def stat(self, cid, oid):
+        return self._onode(cid, oid, create=False).size
+
+    def exists(self, cid, oid):
+        return (cid, oid) in self.onodes
+
+    def getattrs(self, cid, oid):
+        return dict(self._onode(cid, oid, create=False).attrs)
+
+    def omap_get(self, cid, oid):
+        return dict(self._onode(cid, oid, create=False).omap)
+
+    def list_objects(self, cid):
+        return sorted(self.colls.get(cid, ()))
+
+    def list_collections(self):
+        return sorted(self.colls)
+
+    def collection_exists(self, cid):
+        return cid in self.colls
+
+    # -- admin -------------------------------------------------------------
+    def statfs(self) -> dict:
+        free = self.alloc.free_aus * self.AU
+        return {"total": self.size, "free": free,
+                "allocated": self.size - free, "au": self.AU}
+
+    def fsck(self) -> list[str]:
+        """BlueStore::_fsck's core: extent bounds, cross-object
+        overlap, per-extent crc vs the block file, allocator/extent
+        bitmap consistency (leaks + double-use)."""
+        import numpy as np
+        errors = []
+        seen = np.zeros(self.size // self.AU, dtype=bool)
+        for (cid, oid), o in self.onodes.items():
+            for x in o.extents:
+                loff, au, n_aus, crc = x
+                if au < 0 or (au + n_aus) * self.AU > self.size:
+                    errors.append(f"{cid}/{oid}: extent out of bounds")
+                    continue
+                if seen[au:au + n_aus].any():
+                    errors.append(
+                        f"{cid}/{oid}: extent overlap at au {au}")
+                seen[au:au + n_aus] = True
+                if zlib.crc32(self._read_extent(x)) != crc:
+                    errors.append(
+                        f"{cid}/{oid}: crc mismatch at logical {loff}")
+        leaked = int((self.alloc.used & ~seen).sum())
+        if leaked:
+            errors.append(f"allocator leak: {leaked} AUs marked used "
+                          f"but referenced by no object")
+        missing = int((seen & ~self.alloc.used).sum())
+        if missing:
+            errors.append(f"allocator corruption: {missing} referenced "
+                          f"AUs marked free")
+        return errors
+
+    def mount(self) -> None:
+        pass
+
+    def umount(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self.db.close()
