@@ -81,8 +81,21 @@ def main(argv=None) -> int:
     p.add_argument("--pgid", default=None)
     p.add_argument("--object", default=None)
     p.add_argument("--file", default=None)
+    p.add_argument("--type", default="auto",
+                   choices=["auto", "walstore", "bluestore"],
+                   help="store format (auto sniffs for a BlueStore "
+                        "block file)")
     args = p.parse_args(argv)
-    store = WALStore(args.data_path)
+    import os as _os
+    kind = args.type
+    if kind == "auto":
+        kind = "bluestore" if _os.path.exists(
+            _os.path.join(args.data_path, "block")) else "walstore"
+    if kind == "bluestore":
+        from ceph_tpu.os_.bluestore import BlueStore
+        store = BlueStore(args.data_path)
+    else:
+        store = WALStore(args.data_path)
     try:
         if args.op == "list-pgs":
             for cid in store.list_collections():

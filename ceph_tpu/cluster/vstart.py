@@ -143,9 +143,12 @@ class Cluster:
         """Hard-stop (the qa kill_daemon analog)."""
         await self.osds[osd_id].stop()
 
-    async def revive_osd(self, osd_id: int) -> None:
+    async def revive_osd(self, osd_id: int, store=None) -> None:
+        """``store`` overrides the revived daemon's ObjectStore — pass
+        a freshly remounted store to simulate a real process restart
+        (mount replay) instead of reusing the in-process object."""
         old = self.osds[osd_id]
-        osd = OSD(osd_id, self.monmap, store=old.store,
+        osd = OSD(osd_id, self.monmap, store=store or old.store,
                   keyring=self.keyring, config=self.cfg)
         self.osds[osd_id] = osd
         await osd.boot()

@@ -201,6 +201,7 @@ class BlueStore(ObjectStore):
         ONE kv batch commits every metadata change + deferred record;
         only after the commit are replaced AUs freed and deferred
         bytes applied in place."""
+        self._validate(t.ops)
         kvt = KVTransaction()
         to_free: list[tuple[int, int]] = []
         deferred: list[tuple[int, bytes]] = []
@@ -245,17 +246,57 @@ class BlueStore(ObjectStore):
             raise
         if self._fail_point == "after_kv_commit":      # crash injection
             raise StoreError("fail point: after_kv_commit")
-        self.alloc.release(to_free)
-        if deferred:
-            drop = KVTransaction()
-            for i, (au, data) in enumerate(deferred):
-                self._f.seek(au * self.AU)
-                self._f.write(data)
-                drop.rmkey("D", f"{self._dseq - len(deferred) + i:016d}")
-            self._f.flush()
-            os.fsync(self._f.fileno())
-            self.db.submit_transaction(drop)
-        self._pending_au.clear()
+        try:
+            self.alloc.release(to_free)
+            if deferred:
+                drop = KVTransaction()
+                for i, (au, data) in enumerate(deferred):
+                    self._f.seek(au * self.AU)
+                    self._f.write(data)
+                    drop.rmkey(
+                        "D", f"{self._dseq - len(deferred) + i:016d}")
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self.db.submit_transaction(drop)
+        except Exception:
+            # the kv committed, so the store is durable — but RAM and
+            # the overlay must not keep stale state (a leaked pending
+            # AU would splice old bytes into whatever reuses that AU);
+            # reload replays the committed D records
+            self._pending_au.clear()
+            self._reset_from_kv()
+            raise
+        finally:
+            self._pending_au.clear()
+
+    def _validate(self, ops) -> None:
+        """Precondition dry-run (the MemStore discipline): benign
+        failures — missing objects or collections — must raise BEFORE
+        any mutation, so the common error case never pays the
+        full-store reload the mid-apply rollback path costs."""
+        colls = {c: set(s) for c, s in self.colls.items()}
+        for op in ops:
+            code = op[0]
+            if code == OP_MKCOLL:
+                colls.setdefault(op[1], set())
+                continue
+            if code == OP_RMCOLL:
+                colls.pop(op[1], None)
+                continue
+            cid, oid = op[1], op[2]
+            if cid not in colls:
+                raise StoreError(f"no collection {cid}")
+            if code in (OP_TOUCH, OP_WRITE, OP_ZERO, OP_TRUNCATE,
+                        OP_SETATTRS, OP_OMAP_SETKEYS):
+                colls[cid].add(oid)
+            elif code == OP_CLONE:
+                if oid not in colls[cid]:
+                    raise StoreError(f"no object {cid}/{oid}")
+                colls[cid].add(op[3])
+            elif code == OP_REMOVE:
+                colls[cid].discard(oid)
+            elif oid not in colls[cid]:   # RMATTR / OMAP_RM* / CLEAR
+                raise StoreError(f"no object {cid}/{oid}")
 
     def _onode(self, cid: str, oid: str, create: bool) -> _Onode:
         if cid not in self.colls:
@@ -303,21 +344,33 @@ class BlueStore(ObjectStore):
             if loff >= a1 or loff + xlen <= a0:
                 kept.append(x)
                 continue
+            partial = loff < a0 or loff + xlen > a1
+            if partial:
+                # a split re-stamps sub-extent crcs from the old
+                # bytes: VERIFY them first or latent corruption would
+                # be laundered into a fresh valid checksum (a fully
+                # covered extent is dropped unread, which is also the
+                # repair path for corrupt data)
+                raw = self._read_extent(x)
+                if zlib.crc32(raw) != crc:
+                    raise ChecksumError(
+                        f"extent crc mismatch at logical {loff} "
+                        f"(partial overwrite of a corrupt extent)")
             # extents are AU-aligned and the range is AU-aligned, so
             # partial overlaps split at AU boundaries
             if loff < a0:
                 pre = (a0 - loff) // self.AU
-                raw = self._read_extent(x)[:pre * self.AU]
-                kept.append([loff, au, pre, zlib.crc32(raw)])
+                kept.append([loff, au, pre,
+                             zlib.crc32(raw[:pre * self.AU])])
+                raw = raw[pre * self.AU:]
                 au += pre
                 n_aus -= pre
                 loff = a0
             if loff + n_aus * self.AU > a1:
                 post = (loff + n_aus * self.AU - a1) // self.AU
                 keep_from = n_aus - post
-                raw = self._read_extent(
-                    [loff, au, n_aus, 0])[keep_from * self.AU:]
-                kept.append([a1, au + keep_from, post, zlib.crc32(raw)])
+                kept.append([a1, au + keep_from, post,
+                             zlib.crc32(raw[keep_from * self.AU:])])
                 n_aus = keep_from
             to_free.append((au, n_aus))
         kept.extend(new_extents)
@@ -341,11 +394,32 @@ class BlueStore(ObjectStore):
         wrote = False
         if code == OP_TOUCH:
             self._onode(cid, oid, create=True)
-        elif code in (OP_WRITE, OP_ZERO):
-            if code == OP_WRITE:
-                off, data = op[3], op[4]
-            else:
-                off, data = op[3], b"\x00" * op[4]
+        elif code == OP_ZERO:
+            off, ln = op[3], op[4]
+            o = self._onode(cid, oid, create=True)
+            o.size = max(o.size, off + ln)
+            if ln:
+                # punch the AU-aligned interior as a HOLE (drop the
+                # covered extents — sparse gaps read as zeros), never
+                # allocate for it: a zero of a huge range must FREE
+                # space, not ENOSPC materializing zero bytes
+                h0 = -(-off // self.AU) * self.AU
+                h1 = (off + ln) // self.AU * self.AU
+                edges = []
+                if h1 > h0:
+                    self._replace_extents(o, h0, h1, [], to_free)
+                    edges = [(off, h0), (h1, off + ln)]
+                else:
+                    edges = [(off, off + ln)]
+                for e0, e1 in edges:
+                    if e0 < e1 and any(
+                            x[0] < e1 and x[0] + x[2] * self.AU > e0
+                            for x in o.extents):
+                        self._rewrite_range(o, e0, b"\x00" * (e1 - e0),
+                                            to_free)
+                        wrote = True
+        elif code == OP_WRITE:
+            off, data = op[3], op[4]
             o = self._onode(cid, oid, create=True)
             o.size = max(o.size, off + len(data))
             if data:
@@ -368,10 +442,14 @@ class BlueStore(ObjectStore):
                     loff, au, n_aus, _ = covered
                     sub = au + (a0 - loff) // self.AU
                     deferred.append((sub, bytes(buf)))
+                    # crc verify+patch BEFORE the overlay goes in:
+                    # _patch_crc must see the pre-write bytes (plus
+                    # any EARLIER overlay, whose crc is already
+                    # stamped) or it would flag its own write
+                    self._patch_crc(o, covered, a0 - loff, buf)
                     for i in range((a1 - a0) // self.AU):
                         self._pending_au[sub + i] = bytes(
                             buf[i * self.AU:(i + 1) * self.AU])
-                    self._patch_crc(o, covered, a0 - loff, buf)
                 else:
                     self._rewrite_range(o, off, data, to_free)
                     wrote = True
@@ -387,8 +465,12 @@ class BlueStore(ObjectStore):
                         to_free.append((au, n_aus))
                     elif loff + n_aus * self.AU > lim:
                         keep = (lim - loff) // self.AU
-                        raw = self._read_extent(x)[:keep * self.AU]
-                        kept.append([loff, au, keep, zlib.crc32(raw)])
+                        raw = self._read_extent(x)
+                        if zlib.crc32(raw) != crc:   # no crc laundering
+                            raise ChecksumError(
+                                f"extent crc mismatch at {loff}")
+                        kept.append([loff, au, keep,
+                                     zlib.crc32(raw[:keep * self.AU])])
                         to_free.append((au + keep, n_aus - keep))
                     else:
                         kept.append(x)
@@ -451,6 +533,12 @@ class BlueStore(ObjectStore):
         """Recompute a covering extent's crc after an in-place
         (deferred) overwrite of buf at rel_off within it."""
         raw = bytearray(self._read_extent(x))
+        if not (rel_off == 0 and len(buf) == len(raw)) and \
+                zlib.crc32(bytes(raw)) != x[3]:
+            # partial patch re-stamps the crc over old bytes: verify
+            # them first so latent corruption cannot be laundered
+            raise ChecksumError(
+                "extent crc mismatch under a partial deferred write")
         raw[rel_off:rel_off + len(buf)] = buf
         x[3] = zlib.crc32(bytes(raw))
 

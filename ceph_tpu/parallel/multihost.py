@@ -39,10 +39,18 @@ import sys
 def run_worker(coordinator: str, num_processes: int, process_id: int,
                local_devices: int = 4) -> dict:
     # platform forcing must precede any jax use; the sandbox's
-    # sitecustomize force-selects the remote-TPU backend otherwise
-    os.environ.setdefault(
-        "XLA_FLAGS",
-        f"--xla_force_host_platform_device_count={local_devices}")
+    # sitecustomize force-selects the remote-TPU backend otherwise.
+    # APPEND to any existing XLA_FLAGS (a setdefault would silently
+    # drop the device count — and with it --local-devices — whenever
+    # the caller had unrelated flags set)
+    flag = f"--xla_force_host_platform_device_count={local_devices}"
+    prior = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in prior:
+        os.environ["XLA_FLAGS"] = f"{prior} {flag}".strip()
+    else:
+        import re as _re
+        os.environ["XLA_FLAGS"] = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, prior)
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator, num_processes, process_id)
