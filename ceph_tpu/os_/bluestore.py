@@ -273,29 +273,49 @@ class BlueStore(ObjectStore):
         """Precondition dry-run (the MemStore discipline): benign
         failures — missing objects or collections — must raise BEFORE
         any mutation, so the common error case never pays the
-        full-store reload the mid-apply rollback path costs."""
-        colls = {c: set(s) for c, s in self.colls.items()}
+        full-store reload the mid-apply rollback path costs. A lazy
+        DELTA overlay keeps this O(ops), not O(store): committed state
+        is consulted read-only, only the transaction's own changes are
+        tracked."""
+        live: dict[str, bool] = {}        # coll existence overrides
+        wiped: set[str] = set()           # colls emptied this txn
+        obj: dict[tuple[str, str], bool] = {}   # object overrides
+
+        def coll_ok(cid):
+            return live.get(cid, cid in self.colls)
+
+        def obj_ok(cid, oid):
+            ov = obj.get((cid, oid))
+            if ov is not None:
+                return ov
+            if cid in wiped:
+                return False
+            return oid in self.colls.get(cid, ())
+
         for op in ops:
             code = op[0]
             if code == OP_MKCOLL:
-                colls.setdefault(op[1], set())
+                live[op[1]] = True
                 continue
             if code == OP_RMCOLL:
-                colls.pop(op[1], None)
+                live[op[1]] = False
+                wiped.add(op[1])
+                for k in [k for k, v in obj.items() if k[0] == op[1]]:
+                    del obj[k]
                 continue
             cid, oid = op[1], op[2]
-            if cid not in colls:
+            if not coll_ok(cid):
                 raise StoreError(f"no collection {cid}")
             if code in (OP_TOUCH, OP_WRITE, OP_ZERO, OP_TRUNCATE,
                         OP_SETATTRS, OP_OMAP_SETKEYS):
-                colls[cid].add(oid)
+                obj[(cid, oid)] = True
             elif code == OP_CLONE:
-                if oid not in colls[cid]:
+                if not obj_ok(cid, oid):
                     raise StoreError(f"no object {cid}/{oid}")
-                colls[cid].add(op[3])
+                obj[(cid, op[3])] = True
             elif code == OP_REMOVE:
-                colls[cid].discard(oid)
-            elif oid not in colls[cid]:   # RMATTR / OMAP_RM* / CLEAR
+                obj[(cid, oid)] = False
+            elif not obj_ok(cid, oid):    # RMATTR / OMAP_RM* / CLEAR
                 raise StoreError(f"no object {cid}/{oid}")
 
     def _onode(self, cid: str, oid: str, create: bool) -> _Onode:
@@ -415,44 +435,19 @@ class BlueStore(ObjectStore):
                     if e0 < e1 and any(
                             x[0] < e1 and x[0] + x[2] * self.AU > e0
                             for x in o.extents):
-                        self._rewrite_range(o, e0, b"\x00" * (e1 - e0),
-                                            to_free)
-                        wrote = True
+                        # edges are sub-AU and inside an allocated
+                        # extent, so _do_write defers them — NO
+                        # allocation, keeping zero ENOSPC-free even
+                        # on a full store
+                        wrote |= self._do_write(
+                            o, e0, b"\x00" * (e1 - e0), to_free,
+                            deferred)
         elif code == OP_WRITE:
             off, data = op[3], op[4]
             o = self._onode(cid, oid, create=True)
             o.size = max(o.size, off + len(data))
             if data:
-                au0 = off // self.AU
-                au1 = (off + len(data) - 1) // self.AU
-                covered = self._covering_extent(o, au0, au1)
-                if covered is not None and \
-                        len(data) <= self.DEFERRED_MAX:
-                    # deferred small overwrite: rebuild the covered
-                    # AUs in memory; bytes ride the kv commit
-                    a0 = au0 * self.AU
-                    a1 = (au1 + 1) * self.AU
-                    if off == a0 and off + len(data) == a1:
-                        # full-cover: no read of the old bytes (also
-                        # the repair path for a corrupt extent)
-                        buf = bytearray(data)
-                    else:
-                        buf = bytearray(self._read_range(o, a0, a1))
-                        buf[off - a0:off - a0 + len(data)] = data
-                    loff, au, n_aus, _ = covered
-                    sub = au + (a0 - loff) // self.AU
-                    deferred.append((sub, bytes(buf)))
-                    # crc verify+patch BEFORE the overlay goes in:
-                    # _patch_crc must see the pre-write bytes (plus
-                    # any EARLIER overlay, whose crc is already
-                    # stamped) or it would flag its own write
-                    self._patch_crc(o, covered, a0 - loff, buf)
-                    for i in range((a1 - a0) // self.AU):
-                        self._pending_au[sub + i] = bytes(
-                            buf[i * self.AU:(i + 1) * self.AU])
-                else:
-                    self._rewrite_range(o, off, data, to_free)
-                    wrote = True
+                wrote = self._do_write(o, off, data, to_free, deferred)
         elif code == OP_TRUNCATE:
             o = self._onode(cid, oid, create=True)
             new_size = op[3]
@@ -528,19 +523,48 @@ class BlueStore(ObjectStore):
                 return x
         return None
 
-    def _patch_crc(self, o: _Onode, x, rel_off: int,
-                   buf: bytearray) -> None:
-        """Recompute a covering extent's crc after an in-place
-        (deferred) overwrite of buf at rel_off within it."""
-        raw = bytearray(self._read_extent(x))
-        if not (rel_off == 0 and len(buf) == len(raw)) and \
-                zlib.crc32(bytes(raw)) != x[3]:
-            # partial patch re-stamps the crc over old bytes: verify
-            # them first so latent corruption cannot be laundered
-            raise ChecksumError(
-                "extent crc mismatch under a partial deferred write")
-        raw[rel_off:rel_off + len(buf)] = buf
-        x[3] = zlib.crc32(bytes(raw))
+    def _do_write(self, o: _Onode, off: int, data: bytes,
+                  to_free, deferred) -> bool:
+        """Apply one write payload: deferred when it fits inside one
+        already-allocated extent (no allocation, bytes ride the kv
+        batch), COW otherwise. Returns True when the block file was
+        written (caller fsyncs before the commit)."""
+        au0 = off // self.AU
+        au1 = (off + len(data) - 1) // self.AU
+        covered = self._covering_extent(o, au0, au1)
+        if covered is None or len(data) > self.DEFERRED_MAX:
+            self._rewrite_range(o, off, data, to_free)
+            return True
+        loff, au, n_aus, crc = covered
+        a0 = au0 * self.AU
+        a1 = (au1 + 1) * self.AU
+        xlen = n_aus * self.AU
+        if off == loff and len(data) == xlen:
+            # whole-extent overwrite: no read of the old bytes (the
+            # corrupt-extent repair path) and the crc is just the data
+            raw = bytearray(data)
+            covered[3] = zlib.crc32(data)
+        else:
+            # ONE read+verify of the covering extent serves both the
+            # deferred buffer build and the crc re-stamp (reading via
+            # _read_range and again in a patch helper doubled the I/O
+            # and crc work on the hottest path). Partial overwrites of
+            # a corrupt extent refuse: re-stamping would launder the
+            # rot into a valid checksum.
+            raw = bytearray(self._read_extent(covered))
+            if zlib.crc32(bytes(raw)) != crc:
+                raise ChecksumError(
+                    f"extent crc mismatch at logical {loff} (partial "
+                    f"overwrite of a corrupt extent)")
+            raw[off - loff:off - loff + len(data)] = data
+            covered[3] = zlib.crc32(bytes(raw))
+        sub = au + (a0 - loff) // self.AU
+        buf = bytes(raw[a0 - loff:a1 - loff])
+        deferred.append((sub, buf))
+        for i in range((a1 - a0) // self.AU):
+            self._pending_au[sub + i] = buf[i * self.AU:
+                                            (i + 1) * self.AU]
+        return False
 
     def _remove(self, cid: str, oid: str, to_free, dirty) -> None:
         o = self.onodes.pop((cid, oid), None)

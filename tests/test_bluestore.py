@@ -358,26 +358,31 @@ def test_osd_crash_remount_on_bluestore(tmp_path):
 
 class TestReviewRegressions2:
     def test_partial_overwrite_of_corrupt_extent_refuses(self, tmp_path):
-        """A partial overwrite that would SPLIT a corrupt extent must
-        refuse rather than re-stamp a fresh crc over rotten bytes
-        (laundering); the full-cover overwrite remains the repair
-        path."""
+        """A partial overwrite of a corrupt extent must refuse rather
+        than re-stamp a fresh crc over rotten bytes (laundering), on
+        BOTH paths — the deferred in-place patch and the COW split —
+        while the full-cover overwrite remains the repair path."""
         s = mk(tmp_path)
         s.queue_transaction(T().create_collection("c"))
-        s.queue_transaction(T().write("c", "o", 0, b"G" * 16384))
+        s.queue_transaction(T().write("c", "o", 0, b"G" * (128 << 10)))
         au = s.onodes[("c", "o")].extents[0][1]
         s._f.seek(au * s.AU + 3)
         s._f.write(b"\x99")
         s._f.flush()
         with pytest.raises(ChecksumError):
-            # COW of AUs 1-2 splits the 4-AU extent: pre-slice covers
-            # the corrupt AU 0 -> must refuse
+            # 8 KiB fits DEFERRED_MAX: the deferred patch verifies
             s.queue_transaction(
                 T().write("c", "o", 4096, b"W" * 8192))
+        with pytest.raises(ChecksumError):
+            # 80 KiB > DEFERRED_MAX: the COW _replace_extents split's
+            # pre-slice covers the corrupt AU 0 and must also refuse
+            s.queue_transaction(
+                T().write("c", "o", 4096, b"W" * (80 << 10)))
         # full-cover rewrite still repairs
-        s2 = mk(tmp_path)  # reopen: the failed txn forced a reload
-        s2.queue_transaction(T().write("c", "o", 0, b"R" * 16384))
-        assert s2.read("c", "o") == b"R" * 16384
+        s2 = mk(tmp_path)  # reopen: the failed txns forced reloads
+        s2.queue_transaction(
+            T().write("c", "o", 0, b"R" * (128 << 10)))
+        assert s2.read("c", "o") == b"R" * (128 << 10)
         assert s2.fsck() == []
         s2.umount()
         s.db.close()
@@ -416,3 +421,21 @@ class TestReviewRegressions2:
         assert s.read("c", "o") == b"keep"
         assert s.fsck() == []
         s.umount()
+
+
+def test_unaligned_zero_on_full_store(tmp_path):
+    """Zeroing with unaligned edges on a COMPLETELY full store must
+    succeed: interior AUs punch into the free list and the sub-AU
+    edges take the deferred (allocation-free) path."""
+    s = mk(tmp_path, size=128 << 10)            # 32 AUs
+    s.queue_transaction(T().create_collection("c"))
+    s.queue_transaction(T().write("c", "o", 0, b"F" * (128 << 10)))
+    assert s.statfs()["free"] == 0
+    s.queue_transaction(T().zero("c", "o", 100, (120 << 10)))
+    got = s.read("c", "o")
+    assert got[:100] == b"F" * 100
+    assert got[100:100 + (120 << 10)] == b"\x00" * (120 << 10)
+    assert got[100 + (120 << 10):] == b"F" * ((8 << 10) - 100)
+    assert s.statfs()["free"] > 0
+    assert s.fsck() == []
+    s.umount()

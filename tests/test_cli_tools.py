@@ -100,3 +100,42 @@ def test_rados_put_get_ls_bench(cluster_conf, tmp_path, capsys):
                            "cliobj"]) == 0
     assert rados_cli.main(["-c", cluster_conf, "lspools"]) == 0
     assert "rbd" in capsys.readouterr().out.split()
+
+
+def test_rbd_cli_lifecycle_and_diff(cluster_conf, tmp_path, capsys):
+    """rbd CLI against the served cluster: create/ls/info/snap,
+    export/import, and the export-diff/import-diff replication chain
+    (ref: src/tools/rbd action set)."""
+    from ceph_tpu.bench import rbd_cli
+
+    c = ["-c", cluster_conf, "-p", "rbd"]
+    assert rbd_cli.main(c + ["create", "img", "--size", "131072",
+                             "--order", "16"]) == 0
+    assert rbd_cli.main(c + ["ls"]) == 0
+    assert "img" in capsys.readouterr().out
+    assert rbd_cli.main(c + ["info", "img"]) == 0
+    assert json.loads(capsys.readouterr().out)["size"] == 131072
+
+    # seed data by importing a file, snapshot, mutate, diff-replicate
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"AB" * 8192)                  # 16 KiB
+    assert rbd_cli.main(c + ["import", str(src), "img2",
+                             "--order", "16"]) == 0
+    assert rbd_cli.main(c + ["snap", "create", "img2@s1"]) == 0
+    full = tmp_path / "full.diff"
+    assert rbd_cli.main(c + ["export-diff", "img2@s1",
+                             str(full)]) == 0
+    capsys.readouterr()
+
+    # replicate onto a fresh image via import-diff
+    assert rbd_cli.main(c + ["create", "copy", "--size", "16384",
+                             "--order", "16"]) == 0
+    assert rbd_cli.main(c + ["import-diff", str(full), "copy"]) == 0
+    out = tmp_path / "copy.bin"
+    assert rbd_cli.main(c + ["export", "copy", str(out)]) == 0
+    assert out.read_bytes() == b"AB" * 8192
+    assert rbd_cli.main(c + ["snap", "ls", "copy"]) == 0
+    assert "s1" in capsys.readouterr().out
+
+    assert rbd_cli.main(c + ["rm", "img"]) == 0
+    capsys.readouterr()
