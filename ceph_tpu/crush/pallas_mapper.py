@@ -194,15 +194,17 @@ class KernelPlan:                               # hash -> usable as a
     builds it once per map and reuses the object, so each map compiles
     once.
 
-    levels[l] is a (2*(2*S_l + 1), P_l) f32 table, transposed for the
+    levels[l] is a (2*R_l, P_l) f32 table, transposed for the
     (rows, P) @ (P, N) MXU fetch: logical rows [0,S) item ids, [S,2S)
     next-level row index (device id at the last level), row 2S the
-    bucket size — each logical value v stored as TWO byte planes
-    lo=(v+32768)&0xFF (rows [0,R)) and hi=(v+32768)>>8 (rows [R,2R)),
-    both in [0,256) and hence EXACT in one bf16 MXU pass (DEFAULT
-    precision; HIGHEST's 6 passes made this fetch the kernel's
-    dominant cost — measured 6x on the canonical map's 640-host
-    level). build_plan declines maps with |value| >= 32768.
+    bucket size; multi-class levels (kmax[l] > 1) append [2S+1,3S+1)
+    per-slot class ids and 2*K rows of class-weight halves
+    (w & 0x7FFF, w >> 15). Each logical value v is stored as TWO byte
+    planes lo=(v+32768)&0xFF (rows [0,R)) and hi=(v+32768)>>8 (rows
+    [R,2R)), both in [0,256) and hence EXACT in one bf16 MXU pass
+    (DEFAULT precision; HIGHEST's 6 passes made this fetch the
+    kernel's dominant cost — measured 6x on the canonical map's
+    640-host level). build_plan declines maps with |value| >= 32768.
     """
 
     levels: tuple          # tuple of np.ndarray (f32)

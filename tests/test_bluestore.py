@@ -229,7 +229,7 @@ class TestBlueStore:
                               stores=stores).start()
             try:
                 await c.client.pool_create("p", pg_num=8, size=3)
-                await c.wait_for_clean(timeout=90)
+                await c.wait_for_clean(timeout=240)
                 io = await c.client.open_ioctx("p")
                 for i in range(10):
                     await io.write_full(f"obj{i}", f"v{i}".encode()
@@ -332,7 +332,7 @@ def test_osd_crash_remount_on_bluestore(tmp_path):
         c = await Cluster(n_mons=1, n_osds=3, stores=stores).start()
         try:
             await c.client.pool_create("p", pg_num=8, size=3)
-            await c.wait_for_clean(timeout=90)
+            await c.wait_for_clean(timeout=240)
             io = await c.client.open_ioctx("p")
             for i in range(12):
                 await io.write_full(f"obj{i}", f"v{i}".encode() * 200)
@@ -346,7 +346,7 @@ def test_osd_crash_remount_on_bluestore(tmp_path):
             remounted = mk(tmp_path / "osd2")
             assert remounted.fsck() == []
             await c.revive_osd(2, store=remounted)
-            await c.wait_for_clean(timeout=90)
+            await c.wait_for_clean(timeout=240)
             for i in range(12):
                 assert await io.read(f"obj{i}") == \
                     f"v{i}".encode() * 200

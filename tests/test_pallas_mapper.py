@@ -7,14 +7,22 @@ devices (the compare-list is_out), and collision-heavy small maps where
 replica slots contend (the shared candidate table + fallback flagging).
 """
 
-import os
-
 import numpy as np
 import pytest
 
-os.environ.setdefault("CEPH_TPU_CRUSH_KERNEL", "interpret")
-
 import jax.numpy as jnp
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode(monkeypatch):
+    """Interpret-mode kernel for THESE tests only — restored after
+    each one. A module-level os.environ.setdefault here leaked
+    interpret mode into the whole pytest process at collection time
+    (imports happen before any test runs), silently routing EVERY
+    cluster test's CRUSH mapping through the Pallas interpreter —
+    ~3x total suite wall time and mass not-clean timeouts on a loaded
+    host."""
+    monkeypatch.setenv("CEPH_TPU_CRUSH_KERNEL", "interpret")
 
 from ceph_tpu.crush import builder, mapper_ref
 from ceph_tpu.crush import pallas_mapper as pm
@@ -324,14 +332,12 @@ class TestBitExact:
         xs = (np.arange(256, dtype=np.uint32) * 2654435761) & 0x7FFFFFFF
         _assert_kernel_matches_ref(m, rid, 3, xs=xs.astype(np.uint32))
 
-    def test_sweep_counts_match_xla(self):
+    def test_sweep_counts_match_xla(self, monkeypatch):
         m, rid = _hier(16, 4)
         mk = Mapper(m, block=1 << 14)
-        os.environ["CEPH_TPU_CRUSH_KERNEL"] = "0"
-        try:
-            mx = Mapper(m, block=1 << 14)
-        finally:
-            os.environ["CEPH_TPU_CRUSH_KERNEL"] = "interpret"
+        monkeypatch.setenv("CEPH_TPU_CRUSH_KERNEL", "0")
+        mx = Mapper(m, block=1 << 14)
+        monkeypatch.setenv("CEPH_TPU_CRUSH_KERNEL", "interpret")
         assert mk._kernel_mode == "interpret" and mx._kernel_mode is None
         ck, bk = mk.sweep(rid, 0, 3000, 3)
         cx, bx = mx.sweep(rid, 0, 3000, 3)
