@@ -39,7 +39,7 @@ def test_split_populated_pool_and_pgp_migration():
         try:
             await c.client.pool_create("data", pg_num=4, size=3,
                                        min_size=2)
-            await c.wait_for_clean(timeout=120)
+            await c.wait_for_clean(timeout=240)
             io = await c.client.open_ioctx("data")
             await _write_all(io)
             # phase 1: split in place (pgp_num stays at 4)
@@ -47,7 +47,7 @@ def test_split_populated_pool_and_pgp_migration():
                 {"prefix": "osd pool set", "pool": "data",
                  "var": "pg_num", "val": "8"})
             assert ret == 0, rs
-            await c.wait_for_clean(timeout=120)
+            await c.wait_for_clean(timeout=240)
             await _assert_all_readable(io)
             status = await c.client.status()
             assert status["pgmap"]["num_pgs"] >= 8
@@ -67,7 +67,7 @@ def test_split_populated_pool_and_pgp_migration():
                 {"prefix": "osd pool set", "pool": "data",
                  "var": "pgp_num", "val": "8"})
             assert ret == 0, rs
-            await c.wait_for_clean(timeout=120)
+            await c.wait_for_clean(timeout=240)
             await _assert_all_readable(io)
             # writes keep working post-split
             await io.write_full("post-split", b"fresh")
@@ -107,7 +107,7 @@ def test_autoscaler_grows_populated_pool():
         try:
             await c.client.pool_create("data", pg_num=4, size=3,
                                        min_size=2)
-            await c.wait_for_clean(timeout=120)
+            await c.wait_for_clean(timeout=240)
             io = await c.client.open_ioctx("data")
             await _write_all(io)
 
@@ -128,7 +128,7 @@ def test_autoscaler_grows_populated_pool():
                     f"autoscaler stalled at pg_num={pg_num} " \
                     f"pgp_num={pgp_num}"
                 await asyncio.sleep(1.0)
-            await c.wait_for_clean(timeout=120)
+            await c.wait_for_clean(timeout=240)
             await _assert_all_readable(io)
         finally:
             await c.stop()
