@@ -8,6 +8,7 @@ through MonClient, mirroring the reference's command spellings:
     ... osd pool create <name> <pg_num> [replicated|erasure [profile]]
     ... osd pool set <name> <var> <val>
     ... osd out <id> | osd in <id> | osd down <id>
+    ... osd blocklist add|rm <entity> [expire-s] | osd blocklist ls
     ... osd map <pool> <object>
     ... osd erasure-code-profile set <name> k=2 m=1 ...
     ... config set <who> <name> <value> | config get <who> [<name>]
@@ -66,6 +67,14 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
         return cmd, b""
     if w[0] == "osd" and w[1] in ("out", "in", "down"):
         return {"prefix": f"osd {w[1]}", "id": int(w[2])}, b""
+    if w[:2] == ["osd", "blocklist"]:
+        # ceph osd blocklist add|rm <entity> [expire-s] | ls
+        cmd = {"prefix": "osd blocklist", "blocklistop": w[2]}
+        if w[2] in ("add", "rm"):
+            cmd["addr"] = w[3]
+            if len(w) > 4:
+                cmd["expire"] = float(w[4])
+        return cmd, b""
     if w[:2] == ["osd", "reweight"]:
         return {"prefix": "osd reweight", "id": int(w[2]),
                 "weight": float(w[3])}, b""

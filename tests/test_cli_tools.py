@@ -139,3 +139,20 @@ def test_rbd_cli_lifecycle_and_diff(cluster_conf, tmp_path, capsys):
 
     assert rbd_cli.main(c + ["rm", "img"]) == 0
     capsys.readouterr()
+
+
+def test_ceph_osd_blocklist_cli(cluster_conf, capsys):
+    """ceph osd blocklist add/ls/rm through the CLI (the fence behind
+    MDS eviction, operator-driven)."""
+    assert ceph_cli.main(["-c", cluster_conf, "osd", "blocklist",
+                          "add", "client.evil", "600"]) == 0
+    capsys.readouterr()
+    assert ceph_cli.main(["-c", cluster_conf, "osd", "blocklist",
+                          "ls"]) == 0
+    assert "client.evil" in capsys.readouterr().out
+    assert ceph_cli.main(["-c", cluster_conf, "osd", "blocklist",
+                          "rm", "client.evil"]) == 0
+    capsys.readouterr()
+    assert ceph_cli.main(["-c", cluster_conf, "osd", "blocklist",
+                          "ls"]) == 0
+    assert "client.evil" not in capsys.readouterr().out
