@@ -29,7 +29,7 @@ def parse_command(words: list[str]) -> tuple[dict, bytes]:
     """argv words -> mon command dict (ref: ceph CLI's cmdmap)."""
     try:
         return _parse_command(words)
-    except IndexError:
+    except (IndexError, ValueError):   # truncated words / bad numerics
         raise SystemExit(
             f"unrecognized/incomplete command: {' '.join(words)!r}")
 

@@ -439,3 +439,61 @@ def test_unaligned_zero_on_full_store(tmp_path):
     assert s.statfs()["free"] > 0
     assert s.fsck() == []
     s.umount()
+
+
+def test_thrash_on_bluestore_with_remounts(tmp_path):
+    """Small kill/revive thrash where every revive REMOUNTS the
+    victim's BlueStore from disk (fresh instance — deferred replay,
+    allocator rebuild): acked writes must survive recovery onto a
+    store that went through a real restart, and every store fscks
+    clean at the end (ref: the Thrasher discipline over the
+    store_test crash matrix)."""
+    import asyncio
+    import random
+
+    from ceph_tpu.cluster.vstart import Cluster
+
+    async def go():
+        rng = random.Random(5)
+        stores = [mk(tmp_path / f"osd{i}") for i in range(4)]
+        c = await Cluster(
+            n_mons=1, n_osds=4, stores=stores,
+            config={"mon_osd_down_out_interval": 600.0}).start()
+        try:
+            await c.client.pool_create("t", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=240)
+            io = await c.client.open_ioctx("t")
+            acked: dict[str, bytes] = {}
+            seq = 0
+
+            async def write_some(n: int) -> None:
+                nonlocal seq
+                for _ in range(n):
+                    oid = f"obj{seq % 20}"
+                    data = bytes([seq % 256]) * rng.randint(1, 4096)
+                    await io.write_full(oid, data)
+                    acked[oid] = data
+                    seq += 1
+
+            await write_some(10)
+            for _ in range(2):
+                victim = rng.randrange(4)
+                await c.kill_osd(victim)
+                stores[victim].umount()
+                await c.wait_for_osd_down(victim, timeout=60)
+                for oid, data in list(acked.items())[:4]:
+                    assert await io.read(oid) == data
+                await write_some(6)
+                remounted = mk(tmp_path / f"osd{victim}")
+                stores[victim] = remounted
+                await c.revive_osd(victim, store=remounted)
+                await c.wait_for_clean(timeout=240)
+                await write_some(4)
+            for oid, data in acked.items():
+                assert await io.read(oid) == data, oid
+            for st in stores:
+                assert st.fsck() == [], "store fsck after thrash"
+        finally:
+            await c.stop()
+    asyncio.run(go())
