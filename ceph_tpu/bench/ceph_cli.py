@@ -13,6 +13,18 @@ through MonClient, mirroring the reference's command spellings:
     ... osd erasure-code-profile set <name> k=2 m=1 ...
     ... config set <who> <name> <value> | config get <who> [<name>]
     ... quorum_status | mon dump | health
+
+Admin-socket commands (`ceph daemon <asok-path> <command>`, ref:
+src/ceph.in daemon mode) talk to one daemon out-of-band:
+
+    ... daemon /tmp/osd.0.asok ops              # in-flight client ops
+    ... daemon /tmp/osd.0.asok dump_historic_ops
+    ... daemon /tmp/osd.0.asok dump_slow_ops    # past complaint time
+    ... daemon /tmp/cluster.asok fault ls       # runtime fault sets
+    ... daemon /tmp/cluster.asok '{"prefix": "fault install",
+        "name": "p", "rules": [{"kind": "partition",
+        "a": "osd.0", "b": "osd.1"}]}'
+    ... daemon /tmp/cluster.asok fault clear
 """
 
 from __future__ import annotations
@@ -102,7 +114,34 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
     raise SystemExit(f"unrecognized command: {j!r}")
 
 
+async def _run_daemon(words: list[str]) -> int:
+    """`ceph daemon <asok-path> <command...>` — out-of-band admin
+    socket access (ref: src/ceph.in's daemon mode)."""
+    from ceph_tpu.utils.admin_socket import daemon_command
+    if len(words) < 2:
+        print("usage: daemon <asok-path> <command|json>",
+              file=sys.stderr)
+        return 1
+    path, rest = words[0], " ".join(words[1:])
+    try:
+        cmd = json.loads(rest)
+        if not isinstance(cmd, dict):
+            raise ValueError
+    except (json.JSONDecodeError, ValueError):
+        cmd = {"prefix": rest}
+    try:
+        out = await daemon_command(path, cmd)
+    except (ConnectionError, OSError) as e:
+        print(f"Error: cannot reach admin socket {path}: {e}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2, default=str))
+    return 1 if isinstance(out, dict) and "error" in out else 0
+
+
 async def _run(conf: str, words: list[str], out_file: str | None) -> int:
+    if words and words[0] == "daemon":
+        return await _run_daemon(words[1:])
     monmap, keyring = read_conf(conf)
     mc = MonClient("client.admin", monmap, keyring=keyring)
     try:

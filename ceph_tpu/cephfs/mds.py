@@ -359,7 +359,7 @@ class MDSDaemon(Dispatcher):
                             # the blocklist commits do the waiters
                             # resolve and the competing open proceed.
                             try:
-                                ret, rs, _ = await \
+                                ret, rs, outbl = await \
                                     self.ioctx.rados.mon_command(
                                         {"prefix": "osd blocklist",
                                          "blocklistop": "add",
@@ -376,6 +376,18 @@ class MDSDaemon(Dispatcher):
                                             f"failed ({rs}); eviction "
                                             f"deferred")
                                 continue
+                            # EPOCH BARRIER (ref: upstream eviction's
+                            # wait-for-blocklist-epoch): the mon commit
+                            # alone is not a fence — an OSD still on a
+                            # pre-blocklist map would accept the
+                            # zombie's writes. Wait until every OSD
+                            # that could serve them has OBSERVED the
+                            # blocklist epoch; if that can't be proven
+                            # inside the revoke window, keep the caps
+                            # (defer, like a failed blocklist).
+                            if not await self._blocklist_barrier(
+                                    holder, outbl):
+                                continue
                             self.sessions.pop(holder, None)
                             self._session_seen.pop(holder, None)
                             self._drop_client_caps(holder)
@@ -383,6 +395,32 @@ class MDSDaemon(Dispatcher):
                 # a holder that never acks must not leak its waiter
                 for key in keys:
                     self._revoke_waiters.pop(key, None)
+
+    async def _blocklist_barrier(self, holder: str,
+                                 outbl: bytes) -> bool:
+        """Wait until the OSDs observe the blocklist epoch (the fence
+        is enforced OSD-side against each OSD's OWN map). True when
+        proven; False defers the eviction to the next revoke slice."""
+        try:
+            epoch = int(json.loads(outbl).get("epoch", 0)) if outbl \
+                else 0
+        except (json.JSONDecodeError, ValueError):
+            epoch = 0
+        if not epoch:
+            # old mon without epoch reporting: nothing to barrier on;
+            # keep the pre-barrier behavior rather than deadlocking
+            return True
+        objecter = getattr(self.ioctx.rados, "objecter", None)
+        if objecter is None:
+            return True
+        try:
+            await objecter.wait_for_map_on_osds(
+                epoch, timeout=min(self.lease_timeout, 10.0))
+            return True
+        except Exception as e:
+            log.dout(0, f"epoch barrier for {holder} (epoch {epoch}) "
+                        f"not reached: {e}; eviction deferred")
+            return False
 
     def _req_task_done(self, t: asyncio.Task) -> None:
         self._req_tasks.discard(t)

@@ -46,6 +46,10 @@ class Cluster:
         self.mgr = None
         self.mgr_modules = mgr_modules       # None = no mgr
         self.client: Rados | None = None
+        # cluster-wide fault table (sim/faults.FaultInjector): set via
+        # install_faults(); revived daemons inherit it
+        self.faults = None
+        self.asok = None                     # --serve admin socket
 
     async def start(self) -> "Cluster":
         names = "abcdefgh"[:self.n_mons]
@@ -100,6 +104,33 @@ class Cluster:
         await self.client.connect()
         return self
 
+    # -- fault injection (ref: qa/tasks/ceph_manager.py helpers) -----------
+    def install_faults(self, injector) -> None:
+        """Attach one FaultInjector to every daemon messenger (mons,
+        osds incl. heartbeat, mgr, client). Daemons revived later
+        inherit it. Pass None to detach everywhere."""
+        self.faults = injector
+        for mon in self.mons:
+            mon.msgr.faults = injector
+        for osd in self.osds:
+            osd.msgr.faults = injector
+            osd.hb_msgr.faults = injector
+        if self.mgr is not None:
+            self.mgr.monc.msgr.faults = injector
+        if self.client is not None:
+            self.client.monc.msgr.faults = injector
+
+    async def kill_mon_leader(self) -> Monitor | None:
+        """Hard-stop the current lead mon (ref: the qa mon thrasher).
+        Returns the killed Monitor, or None when there is no leader or
+        killing one would break quorum majority."""
+        lead = self.leader()
+        alive = [m for m in self.mons if not m._stopped]
+        if lead is None or len(alive) - 1 <= len(self.monmap.mons) // 2:
+            return None
+        await lead.stop()
+        return lead
+
     # -- helpers (ref: qa/standalone/ceph-helpers.sh) ----------------------
     def leader(self) -> Monitor | None:
         """The current lead mon, or None mid-election."""
@@ -150,6 +181,9 @@ class Cluster:
         old = self.osds[osd_id]
         osd = OSD(osd_id, self.monmap, store=store or old.store,
                   keyring=self.keyring, config=self.cfg)
+        if self.faults is not None:
+            osd.msgr.faults = self.faults
+            osd.hb_msgr.faults = self.faults
         self.osds[osd_id] = osd
         await osd.boot()
 
@@ -165,7 +199,63 @@ class Cluster:
                 raise TimeoutError(f"osd.{osd_id} still up")
             await asyncio.sleep(0.1)
 
+    async def start_admin_socket(self, path: str) -> None:
+        """Cluster-level admin socket: runtime fault-set control on a
+        served cluster (`ceph daemon <path> fault ...`, see
+        sim/README.md). Commands:
+
+        - ``fault install`` {"name": n, "rules": [rule dicts]}
+        - ``fault clear``   {"name": n}  (omit name: clear all)
+        - ``fault ls``      -> the installed table
+        """
+        from ceph_tpu.sim.faults import FaultInjector, rule_from_dict
+        from ceph_tpu.utils.admin_socket import AdminSocket
+
+        def _injector() -> FaultInjector:
+            if self.faults is None:
+                self.install_faults(FaultInjector())
+            return self.faults
+
+        def fault_install(cmd):
+            rules = [rule_from_dict(r) for r in cmd.get("rules", [])]
+            if not rules:
+                return {"error": "no rules"}
+            _injector().install(cmd.get("name", "default"), rules)
+            return {"installed": cmd.get("name", "default"),
+                    "rules": len(rules)}
+
+        def fault_clear(cmd):
+            if self.faults is None:
+                return {"cleared": []}
+            name = cmd.get("name")
+            if name:
+                return {"cleared": [name] if self.faults.clear(name)
+                        else []}
+            names = list(self.faults.describe())
+            self.faults.clear_all()
+            return {"cleared": names}
+
+        self.asok = AdminSocket(path)
+        self.asok.register("fault install", fault_install,
+                           "install a named fault set (rules: list of "
+                           "{kind,a,b,...} dicts)")
+        self.asok.register("fault clear", fault_clear,
+                           "clear one named fault set (or all)")
+        self.asok.register(
+            "fault ls",
+            lambda: self.faults.describe() if self.faults else {},
+            "list installed fault sets")
+        self.asok.register(
+            "status", lambda: {
+                "mons": [m.name for m in self.mons if not m._stopped],
+                "osds": [o.whoami for o in self.osds
+                         if not o._stopped]},
+            "cluster daemon summary")
+        await self.asok.start()
+
     async def stop(self) -> None:
+        if self.asok:
+            await self.asok.stop()
         if self.client:
             await self.client.shutdown()
         if self.mgr:
@@ -193,12 +283,20 @@ async def _serve(args) -> None:
     """Run a cluster until killed, publishing its conf for the ceph/
     rados CLIs (the long-lived half of vstart.sh)."""
     from ceph_tpu.cluster.conf import write_conf
+    cfg = {}
+    if args.asok:
+        # daemon admin sockets land next to the cluster one, so
+        # `ceph_cli daemon <dir>/osd.N.asok ops` works out of the box
+        import os
+        cfg["admin_socket_dir"] = os.path.dirname(args.asok) or "."
     c = await Cluster(n_mons=args.mon_num, n_osds=args.osd_num,
-                      data_dir=args.data_dir).start()
+                      data_dir=args.data_dir, config=cfg).start()
     if args.pool:
         await c.client.pool_create(args.pool, pg_num=args.pg_num)
         await c.wait_for_clean(timeout=300)
     write_conf(args.conf, c.monmap, c.keyring)
+    if args.asok:
+        await c.start_admin_socket(args.asok)
     print(f"cluster up; conf at {args.conf}", flush=True)
     try:
         while True:
@@ -222,6 +320,9 @@ def main(argv=None) -> None:
     p.add_argument("--conf", default="/tmp/ceph_tpu.conf")
     p.add_argument("--data-dir", default=None,
                    help="durable WALStore osd data under this dir")
+    p.add_argument("--asok", default=None,
+                   help="cluster admin socket path (runtime fault "
+                        "injection: `ceph daemon <asok> fault ...`)")
     args = p.parse_args(argv)
     if args.serve:
         asyncio.run(_serve(args))

@@ -65,6 +65,7 @@ import time
 import numpy as np
 
 import jax
+from ceph_tpu.utils.platform import enable_x64 as _enable_x64
 import jax.numpy as jnp
 from jax import lax
 
@@ -805,7 +806,7 @@ class Mapper:
         if device_weights is None:
             device_weights = np.full(p.max_devices, WEIGHT_ONE,
                                      dtype=np.int64)
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             from ceph_tpu.crush.ln_table import ln_gap_info
             _, zg = ln_gap_info()
             self.arrays = {
@@ -911,7 +912,7 @@ class Mapper:
         all-devices-full flag flips (then exactly one)."""
         PERF.inc("reweights")
         _was = self._skip_is_out
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             self.arrays["device_weights"] = jnp.asarray(device_weights,
                                                         dtype=jnp.int64)
             self.arrays["devw_c"] = jnp.asarray(
@@ -1146,10 +1147,10 @@ class Mapper:
             fn = self._rule_fn(ruleno, result_max)
         block = self._block_for(kb is not None)
         if len(xs) == 0:     # the kernel rejects n=0 (and the guard
-            with jax.enable_x64(True):     # readback would IndexError)
+            with _enable_x64(True):     # readback would IndexError)
                 return jnp.zeros((0, result_max), dtype=jnp.int32)
         try:
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 xs = jnp.asarray(xs, dtype=jnp.uint32)
                 n = xs.shape[0]
                 if n <= block:
@@ -1215,7 +1216,7 @@ class Mapper:
 
         step_fn = _compiled_sweep(fn_body, firstn, nd, block, result_max)
         try:
-            with jax.enable_x64(True):
+            with _enable_x64(True):
                 counts = jnp.zeros(nd + 1, dtype=jnp.int64)
                 bad = jnp.int64(0)
                 for i in range(nblocks):

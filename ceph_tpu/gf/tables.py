@@ -96,7 +96,8 @@ def gf_matmul_np(m: np.ndarray, x: np.ndarray) -> np.ndarray:
     """
     m = np.asarray(m, dtype=np.uint8)
     x = np.asarray(x, dtype=np.uint8)
-    prod = mul_table()[m[:, :, *(None,) * (x.ndim - 1)], x[None]]
+    midx = (slice(None), slice(None)) + (None,) * (x.ndim - 1)
+    prod = mul_table()[m[midx], x[None]]
     return np.bitwise_xor.reduce(prod, axis=1)
 
 

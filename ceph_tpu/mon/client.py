@@ -67,11 +67,24 @@ class MonClient(Dispatcher):
             # never regress: a lagging peon may answer with an old full
             if self.osdmap is None or epoch > self.osdmap.epoch:
                 self.osdmap = decode_osdmap(m.full[epoch])
+        gap = False
         for e in sorted(m.incrementals):
             if self.osdmap is not None and \
                     e == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(
                     decode_incremental(m.incrementals[e]))
+            elif self.osdmap is not None and e > self.osdmap.epoch + 1:
+                gap = True
+        if gap and self.osdmap is not None:
+            # publishes we never received (dropped frames / a flaky
+            # link): the mon's cursor moved past us, so without a
+            # re-want we would ignore every future inc and stay stale
+            # forever (ref: MonClient::sub_want + renew_subs — subs
+            # are re-requested, not assumed delivered)
+            log.dout(1, f"osdmap inc gap at {self.osdmap.epoch}; "
+                        f"re-subscribing")
+            asyncio.ensure_future(
+                self.subscribe("osdmap", self.osdmap.epoch + 1))
         for fut in self._osdmap_waiters:
             if not fut.done():
                 fut.set_result(self.osdmap)

@@ -128,7 +128,11 @@ class MMonGetOSDMap(Message):
 @register
 class MPGStats(Message):
     """OSD -> mon pg stat report (ref: src/messages/MPGStats.h);
-    per-pg stats as an encoded blob map keyed by 'pool.seed'."""
+    per-pg stats as an encoded blob map keyed by 'pool.seed'.
+    ``slow_ops`` piggybacks the daemon's OpTracker slow-op count so
+    the mon can raise a SLOW_OPS health warning (ref: the osd_perf /
+    health_check path upstream routes through the mgr)."""
 
     TYPE = 145
-    FIELDS = [("osd", "s32"), ("epoch", "u32"), ("stats", "map:str:blob")]
+    FIELDS = [("osd", "s32"), ("epoch", "u32"),
+              ("stats", "map:str:blob"), ("slow_ops", "u32")]

@@ -53,6 +53,9 @@ class OSDMonitor(PaxosService):
             "mon_osd_down_out_interval", 600.0)
         # pg stats: "pool.seed" -> dict (latest primary report)
         self.pg_stats: dict[str, dict] = {}
+        # osd -> in-flight ops past the complaint threshold (from the
+        # MPGStats piggyback; feeds the SLOW_OPS health warning)
+        self.osd_slow_ops: dict[int, int] = {}
         # serializes map mutations: concurrent handlers must not build
         # incrementals against the same base epoch
         self._inc_lock = asyncio.Lock()
@@ -169,6 +172,7 @@ class OSDMonitor(PaxosService):
             inc.new_weight[m.osd] = WEIGHT_ONE      # auto-in on boot
         self.failure_reporters.pop(m.osd, None)
         self.down_at.pop(m.osd, None)
+        self.osd_slow_ops.pop(m.osd, None)   # fresh incarnation
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.osd} boot -> up (epoch "
                     f"{self.osdmap.epoch})")
@@ -187,6 +191,9 @@ class OSDMonitor(PaxosService):
         inc = Incremental()
         inc.new_down = [m.target]
         self.failure_reporters.pop(m.target, None)
+        # a dead daemon can't send the clearing report: drop its
+        # slow-op count or the SLOW_OPS warning outlives it
+        self.osd_slow_ops.pop(m.target, None)
         self.down_at[m.target] = asyncio.get_event_loop().time()
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.target} marked down "
@@ -198,12 +205,37 @@ class OSDMonitor(PaxosService):
                 self.pg_stats[pgid] = json.loads(blob)
             except json.JSONDecodeError:
                 pass
+        slow = getattr(m, "slow_ops", 0)
+        if slow:
+            self.osd_slow_ops[m.osd] = slow
+        else:
+            self.osd_slow_ops.pop(m.osd, None)
 
     async def tick(self) -> None:
         """Auto-out: down past the interval -> weight 0
-        (ref: OSDMonitor::tick mon_osd_down_out_interval)."""
+        (ref: OSDMonitor::tick mon_osd_down_out_interval); plus
+        expired-blocklist trimming (ref: OSDMonitor::tick ->
+        prepare_pending's blocklist expiry sweep): entries whose
+        expiry passed are folded into an incremental so the map stops
+        growing without bound."""
         om = self.osdmap
-        if om is None or not self.down_at:
+        if om is None:
+            return
+        import time
+        wall = time.time()
+        expired = [name for name, exp in om.blocklist.items()
+                   if exp <= wall]
+        if expired:
+            def build(cur):
+                inc = Incremental()
+                inc.old_blocklist = [
+                    n for n, exp in cur.blocklist.items()
+                    if exp <= wall]
+                return (inc, None) if inc.old_blocklist else None
+            ok, _ = await self._propose_change(build)
+            if ok:
+                log.dout(1, f"trimmed expired blocklist: {expired}")
+        if not self.down_at:
             return
         now = asyncio.get_event_loop().time()
         inc = Incremental()
@@ -280,8 +312,13 @@ class OSDMonitor(PaxosService):
         import time
         op = cmd.get("blocklistop", "ls")
         if op == "ls":
+            # expired entries are dead: don't report them even before
+            # the periodic tick folds their removal into the map
+            now = time.time()
             return 0, "", json.dumps(
-                {"blocklist": self.osdmap.blocklist}).encode()
+                {"blocklist": {n: exp for n, exp in
+                               self.osdmap.blocklist.items()
+                               if exp > now}}).encode()
         name = cmd.get("addr", "")
         if not name:
             return -22, "missing addr", b""
@@ -305,7 +342,11 @@ class OSDMonitor(PaxosService):
         ok, _ = await self._propose_change(build)
         if not ok:
             return -11, "proposal failed", b""
-        return 0, f"blocklist {op} {name}", b""
+        # report the epoch the fence is visible at: eviction's epoch
+        # barrier (Objecter.wait_for_map_on_osds) needs it to prove
+        # the OSDs enforce the blocklist before caps move on
+        return 0, f"blocklist {op} {name}", json.dumps(
+            {"epoch": self.osdmap.epoch}).encode()
 
     async def _cmd_new(self, cmd, inbl):
         """Allocate an osd id (ref: `ceph osd new`)."""

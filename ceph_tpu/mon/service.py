@@ -113,5 +113,13 @@ class HealthMonitor(PaxosService):
             checks["PG_DEGRADED"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{pg['degraded_pgs']} pgs degraded"}
+        slow = mon.osdmon.osd_slow_ops
+        if slow:
+            total = sum(slow.values())
+            osds = ", ".join(f"osd.{o}" for o in sorted(slow))
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{total} slow ops, daemons [{osds}] have "
+                           f"slow ops (ref: OpTracker complaint time)"}
         status = "HEALTH_OK" if not checks else "HEALTH_WARN"
         return {"status": status, "checks": checks}

@@ -154,6 +154,14 @@ class Connection:
         return zlib.crc32(body).to_bytes(4, "little")
 
     async def _send_frame(self, tag: int, seq: int, body: bytes) -> None:
+        inj = self.msgr.faults
+        if inj is not None:
+            act = inj.on_frame(self.msgr.name, self.peer_name)
+            if act == "drop":          # one-way blackhole: swallow
+                return
+            if act == "cut":           # partition: like a socket reset
+                self._abort()
+                raise ConnectionError_("injected partition (send)")
         if self.msgr._inject_failure():
             self._abort()
             raise ConnectionError_("injected socket failure (send)")
@@ -224,7 +232,21 @@ class Connection:
         lossless connections (resent after reconnect until acked).
         Server-side (accepted) connections cannot reconnect — a failed
         send raises so the caller knows the reply was lost and the peer
-        must re-request (ref: OSD replies on reset client sessions)."""
+        must re-request (ref: OSD replies on reset client sessions).
+
+        Message-level fault shaping (sim/faults.py) runs BEFORE the
+        send lock and the seq assignment: a delayed/reordered message
+        is overtaken by later sends and still gets an in-order seq, so
+        the receiver's dedup machinery stays coherent; a duplicated
+        message goes out twice under distinct seqs (end-to-end reqid
+        dedup makes it exactly-once)."""
+        inj = self.msgr.faults
+        if inj is not None and \
+                await inj.on_message(self.msgr.name, self.peer_name):
+            await self._send_message_once(msg)    # injected duplicate
+        await self._send_message_once(msg)
+
+    async def _send_message_once(self, msg: Message) -> None:
         async with self._send_lock:
             sess = self.session
             if sess is not None:
@@ -310,6 +332,9 @@ class Messenger:
         self.peer_policies: dict[str, Policy] = {}  # entity type -> policy
         self.max_frame = max_frame
         self.inject_socket_failures = inject_socket_failures
+        # richer per-peer-pair fault table (sim/faults.FaultInjector):
+        # partitions/drops/delays/dup/reorder, installed at runtime
+        self.faults = None
         self._rng = random.Random(seed)
         # instance nonce: distinguishes this daemon incarnation so peers
         # reset replay-dedup state after a restart (ref: entity_addr_t
@@ -418,6 +443,11 @@ class Messenger:
 
     async def _client_handshake(self, addr: EntityAddr,
                                 peer_name: str) -> Connection:
+        if self.faults is not None and \
+                self.faults.blocks_connect(self.name, peer_name):
+            # partitioned pair: the SYN never lands
+            raise ConnectionError_(
+                f"injected partition: {self.name} -> {peer_name}")
         reader, writer = await asyncio.open_connection(addr.host, addr.port)
         try:
             return await asyncio.wait_for(

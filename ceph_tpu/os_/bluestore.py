@@ -245,6 +245,14 @@ class BlueStore(ObjectStore):
             self._reset_from_kv()
             raise
         if self._fail_point == "after_kv_commit":      # crash injection
+            # the kv batch committed, so the store is durable — but the
+            # deferred block writes and alloc.release below never ran.
+            # Same discipline as the other failure paths: rebuild RAM
+            # from the committed kv (which replays the D records) so a
+            # REUSED instance isn't left with a stale overlay or an
+            # allocator that still holds the replaced AUs.
+            self._pending_au.clear()
+            self._reset_from_kv()
             raise StoreError("fail point: after_kv_commit")
         try:
             self.alloc.release(to_free)

@@ -25,9 +25,10 @@ from ceph_tpu.os_.objectstore import MemStore, ObjectStore
 from ceph_tpu.osd.ec_pg import ECPG
 from ceph_tpu.osd.messages import (
     MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
-    MOSDECSubOpWriteReply, MOSDOp, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
-    MOSDPGPushReply, MOSDPGQuery, MOSDPing, MOSDRepOp, MOSDRepOpReply,
-    MOSDRepScrub, MOSDRepScrubMap, MPGCleanNotice, PING, PING_REPLY,
+    MOSDECSubOpWriteReply, MOSDMapPing, MOSDOp, MOSDPGInfo, MOSDPGPull,
+    MOSDPGPush, MOSDPGPushReply, MOSDPGQuery, MOSDPing, MOSDRepOp,
+    MOSDRepOpReply, MOSDRepScrub, MOSDRepScrubMap, MPGCleanNotice, PING,
+    PING_REPLY,
 )
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.types import pg_t
@@ -72,6 +73,7 @@ class OSD(Dispatcher):
         # ref: OSD op tracking + admin socket
         self.op_tracker = OpTracker(
             slow_op_warn_s=cfg.get("osd_op_complaint_time", 30.0))
+        self._slow_reported = 0     # last slow-op count sent monward
         self.asok = None
         self._asok_dir = cfg.get("admin_socket_dir")
 
@@ -152,6 +154,12 @@ class OSD(Dispatcher):
                 "dump_historic_ops",
                 self.op_tracker.dump_historic_ops,
                 "recently completed ops")
+            self.asok.register(
+                "ops", self.op_tracker.dump_ops_in_flight,
+                "in-flight client ops (alias of dump_ops_in_flight)")
+            self.asok.register(
+                "dump_slow_ops", self.op_tracker.dump_slow_ops,
+                "in-flight ops older than the complaint threshold")
             self.asok.register(
                 "config show", lambda: dict(self.config),
                 "daemon configuration")
@@ -269,6 +277,15 @@ class OSD(Dispatcher):
         return pg
 
     async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MOSDMapPing):
+            # epoch-barrier probe: report the map we actually serve
+            # ops against (ref: the OSD side of epoch barriers)
+            from ceph_tpu.osd.messages import MOSDMapPingReply
+            await msg.conn.send_message(MOSDMapPingReply(
+                tid=msg.tid,
+                epoch=self.osdmap.epoch if self.osdmap else 0,
+                from_osd=self.whoami))
+            return True
         if isinstance(msg, MOSDOp):
             if self.osdmap is not None and \
                     self.osdmap.is_blocklisted(msg.src):
@@ -469,11 +486,16 @@ class OSD(Dispatcher):
                 stats = {p: json.dumps(pg.stats()).encode()
                          for p, pg in self.pgs.items()
                          if pg.is_primary()}
-                if not stats:
+                slow = len(self.op_tracker.slow_ops())
+                # keep reporting until a zero count has been sent: a
+                # daemon whose slow ops drained while it held no
+                # primary PGs must still clear the mon's warning
+                if not stats and not slow and not self._slow_reported:
                     continue
                 await self.monc.send_report(MPGStats(
                     osd=self.whoami, epoch=self.osdmap.epoch,
-                    stats=stats))
+                    stats=stats, slow_ops=slow))
+                self._slow_reported = slow
         except asyncio.CancelledError:
             pass
 

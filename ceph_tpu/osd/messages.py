@@ -258,3 +258,23 @@ class MPGCleanNotice(Message):
 
     TYPE = 178
     FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32")]
+
+
+@register
+class MOSDMapPing(Message):
+    """Client -> OSD: which osdmap epoch do you hold? The probe behind
+    the Objecter's osdmap epoch barrier (ref: upstream eviction's
+    wait-for-blocklist-epoch via Objecter::wait_for_map + the OSD's
+    map gate): the caller needs proof a specific OSD has OBSERVED an
+    epoch, not just that the mon committed it."""
+
+    TYPE = 181
+    FIELDS = [("tid", "u64"), ("epoch", "u32")]
+
+
+@register
+class MOSDMapPingReply(Message):
+    """OSD -> client: the osdmap epoch this OSD currently serves."""
+
+    TYPE = 182
+    FIELDS = [("tid", "u64"), ("epoch", "u32"), ("from_osd", "s32")]

@@ -5,6 +5,22 @@ client's own OSDMap (object -> PG -> acting primary, the client-side
 placement that is the whole point of CRUSH), tracks in-flight ops, and
 resends when the map changes or the target replies EAGAIN/times out
 (ref: Objecter::_calc_target + handle_osd_map resend logic).
+
+Robustness layer (the Thrasher tier rides on it):
+
+- every op is bounded by a configurable ``op_timeout`` and
+  ``max_attempts``; resends back off exponentially, so a thrashed or
+  partitioned target makes ops FAIL CLEANLY with -ETIMEDOUT instead
+  of hanging or hot-looping;
+- every op is a ``TrackedOp`` in ``self.op_tracker`` (ref:
+  src/common/TrackedOp) with per-attempt events, dumpable as
+  ``dump_ops_in_flight``/``dump_historic_ops``;
+- ``wait_for_map_on_osds(epoch)`` is the **osdmap epoch barrier**:
+  it probes OSDs with MOSDMapPing until each reports an observed
+  epoch >= the target (ref: upstream eviction's barrier — the mon
+  committing an epoch says nothing about which OSDs enforce it yet).
+  CephFS eviction uses it so caps are only dropped after the OSDs
+  that could serve a zombie's writes have seen the blocklist epoch.
 """
 
 from __future__ import annotations
@@ -17,9 +33,12 @@ import numpy as np
 from ceph_tpu.mon.client import MonClient
 from ceph_tpu.msg import Dispatcher, EntityAddr
 from ceph_tpu.msg.messenger import ConnectionError_
-from ceph_tpu.osd.messages import MOSDOpReply, make_osd_op
+from ceph_tpu.osd.messages import (
+    MOSDMapPing, MOSDMapPingReply, MOSDOpReply, make_osd_op,
+)
 from ceph_tpu.osd.types import ObjectLocator
 from ceph_tpu.utils.logging import get_logger
+from ceph_tpu.utils.op_tracker import OpTracker
 
 log = get_logger("objecter")
 
@@ -31,10 +50,17 @@ class ObjectOperationError(Exception):
 
 
 class Objecter(Dispatcher):
-    def __init__(self, monc: MonClient):
+    def __init__(self, monc: MonClient, op_timeout: float = 20.0,
+                 max_attempts: int = 50,
+                 slow_op_warn_s: float = 5.0):
         self.monc = monc
         self.msgr = monc.msgr
         self.msgr.add_dispatcher(self)
+        # default per-op deadline and resend cap (ref: objecter's
+        # rados_osd_op_timeout): thrashed ops fail cleanly, not hang
+        self.op_timeout = op_timeout
+        self.max_attempts = max_attempts
+        self.op_tracker = OpTracker(slow_op_warn_s=slow_op_warn_s)
         self._tid = 0
         # keyed on (tid, attempt): the tid is the LOGICAL op id (stable
         # across resends for OSD-side dedup), but a late reply from a
@@ -43,6 +69,8 @@ class Objecter(Dispatcher):
         # the retry's map refresh (ref: Objecter op->attempts /
         # MOSDOp::get_retry_attempt).
         self._waiters: dict[tuple[int, int], asyncio.Future] = {}
+        # epoch-barrier probes keyed by tid
+        self._map_ping_waiters: dict[int, asyncio.Future] = {}
 
     async def ms_dispatch(self, msg) -> bool:
         if isinstance(msg, MOSDOpReply):
@@ -50,6 +78,11 @@ class Objecter(Dispatcher):
                 (msg.tid, getattr(msg, "attempt", 0)), None)
             if fut and not fut.done():
                 fut.set_result(msg)
+            return True
+        if isinstance(msg, MOSDMapPingReply):
+            fut = self._map_ping_waiters.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result(msg.epoch)
             return True
         return False
 
@@ -72,24 +105,48 @@ class Objecter(Dispatcher):
         raise ObjectOperationError(-2, f"no pool {name!r}")
 
     async def op_submit(self, pool_id: int, oid: str, ops: list[tuple],
-                        timeout: float = 20.0, seed: int | None = None,
+                        timeout: float | None = None,
+                        seed: int | None = None,
                         snapc: tuple | None = None, snap_id: int = 0):
-        """Send one op bundle; retries across map changes.
+        """Send one op bundle; retries across map changes with
+        exponential backoff, bounded by ``timeout`` (None = the
+        objecter's op_timeout) and ``max_attempts``.
         ``seed`` overrides name hashing for PG-targeted ops (pgls).
         ``snapc``/``snap_id``: self-managed snap write context / read
         snap (ref: Objecter::Op snapc+snapid).
         Returns (result, data, extra_dict)."""
-        deadline = asyncio.get_event_loop().time() + timeout
-        attempt = 0
+        if timeout is None:
+            timeout = self.op_timeout
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
         # One tid for the whole logical op: resends must carry the SAME
         # reqid so the PG's dedup (pg.py _reqid_results) recognizes a
         # retry of an already-applied op instead of re-executing it
         # (ref: Objecter keeps op->tid across resends; osd_reqid_t).
         self._tid += 1
         tid = self._tid
+        tracked = self.op_tracker.create(
+            f"osd_op(client tid {tid} pool {pool_id} {oid!r} "
+            f"{len(ops)} ops)")
+        try:
+            return await self._op_submit_inner(
+                pool_id, oid, ops, deadline, tid, seed, snapc,
+                snap_id, tracked)
+        finally:
+            tracked.finish()
+
+    async def _op_submit_inner(self, pool_id, oid, ops, deadline, tid,
+                               seed, snapc, snap_id, tracked):
+        loop = asyncio.get_event_loop()
+        attempt = 0
         while True:
-            if asyncio.get_event_loop().time() > deadline:
+            if loop.time() > deadline:
+                tracked.mark_event("timed out")
                 raise ObjectOperationError(-110, f"op on {oid} timed out")
+            if attempt >= self.max_attempts:
+                tracked.mark_event("retries exhausted")
+                raise ObjectOperationError(
+                    -110, f"op on {oid} failed after {attempt} attempts")
             osdmap = await self.monc.wait_for_osdmap()
             if seed is not None:
                 _, _, _, actp = osdmap.pg_to_up_acting_osds(
@@ -99,12 +156,15 @@ class Objecter(Dispatcher):
                 pg_seed, primary = self._calc_target(osdmap, pool_id,
                                                      oid)
             if primary < 0 or primary not in osdmap.osd_addrs:
+                tracked.mark_event("no primary; waiting for map")
                 await self._refresh_map(osdmap)
                 continue
             host, port, _hb = osdmap.osd_addrs[primary]
-            fut = asyncio.get_event_loop().create_future()
+            fut = loop.create_future()
             self._waiters[(tid, attempt)] = fut
             try:
+                tracked.mark_event(
+                    f"sent to osd.{primary} (attempt {attempt})")
                 await self.msgr.send_message(
                     make_osd_op(tid, osdmap.epoch, pool_id, pg_seed,
                                 oid, ops, attempt=attempt,
@@ -112,21 +172,115 @@ class Objecter(Dispatcher):
                     EntityAddr(host, port), f"osd.{primary}")
                 reply = await asyncio.wait_for(
                     fut, timeout=min(5.0 + attempt,
-                                     deadline -
-                                     asyncio.get_event_loop().time()))
+                                     deadline - loop.time()))
             except (asyncio.TimeoutError, ConnectionError, OSError,
                     ConnectionError_):
                 self._waiters.pop((tid, attempt), None)
                 attempt += 1
+                tracked.mark_event("attempt failed; backing off")
                 await self._refresh_map(osdmap)
+                await asyncio.sleep(
+                    min(0.05 * (1 << min(attempt, 5)), 1.0))
                 continue
             if reply.result == -11:       # wrong target / not active
                 attempt += 1
+                tracked.mark_event("EAGAIN (stale target)")
                 await self._refresh_map(osdmap)
                 await asyncio.sleep(min(0.1 * attempt, 1.0))
                 continue
+            tracked.mark_event("reply received")
             extra = json.loads(reply.extra) if reply.extra else {}
             return reply.result, reply.data, extra
+
+    # -- osdmap epoch barrier ----------------------------------------------
+    async def wait_for_map_on_osds(self, epoch: int,
+                                   osds: list[int] | None = None,
+                                   timeout: float = 15.0) -> None:
+        """Block until every targeted OSD reports an observed osdmap
+        epoch >= ``epoch`` (ref: upstream eviction's epoch barrier /
+        Objecter::wait_for_map — but against the OSDs' own view, which
+        is the one that enforces blocklists). ``osds`` defaults to
+        every up OSD in the client's current map; down OSDs are
+        skipped (they re-fetch maps on boot before serving ops).
+        Raises ObjectOperationError(-110) if the barrier can't be
+        proven within ``timeout``."""
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        try:
+            # the probe set must come from a map that already CONTAINS
+            # the target epoch's view: deriving it from an older map
+            # would silently skip an OSD that booted between our map
+            # and the target epoch — the exact stale-enforcer the
+            # barrier exists to catch. (An OSD booting later still
+            # observes >= its own boot epoch > ours before serving.)
+            osdmap = await self.monc.wait_for_osdmap(
+                min_epoch=epoch if osds is None else 1,
+                timeout=max(0.1, deadline - loop.time()))
+        except TimeoutError as e:
+            raise ObjectOperationError(
+                -110, f"epoch barrier {epoch}: client map never "
+                      f"reached it ({e})") from e
+        if osds is None:
+            osds = [o for o in range(osdmap.max_osd)
+                    if bool(osdmap.is_up(np.asarray(o)))
+                    and o in osdmap.osd_addrs]
+        pending = set(osds)
+        tracked = self.op_tracker.create(
+            f"osdmap_barrier(epoch {epoch} osds {sorted(pending)})")
+        try:
+            while pending:
+                if loop.time() > deadline:
+                    tracked.mark_event("timed out")
+                    raise ObjectOperationError(
+                        -110, f"epoch barrier {epoch} not observed by "
+                              f"osds {sorted(pending)}")
+                order = sorted(pending)
+                # concurrent probes: unreachable OSDs must not burn
+                # the budget serially in front of reachable ones
+                got_all = await asyncio.gather(
+                    *[self._probe_osd_epoch(o, deadline, osdmap)
+                      for o in order])
+                for o, got in zip(order, got_all):
+                    if got is not None and got >= epoch:
+                        pending.discard(o)
+                        tracked.mark_event(f"osd.{o} at {got}")
+                if pending:
+                    # an unreached/stale OSD may just need the next
+                    # map publish; also refresh our own view so a
+                    # now-down OSD drops out of the barrier set
+                    await asyncio.sleep(0.1)
+                    osdmap = await self.monc.wait_for_osdmap()
+                    pending = {
+                        o for o in pending
+                        if o < osdmap.max_osd and
+                        bool(osdmap.is_up(np.asarray(o))) and
+                        o in osdmap.osd_addrs}
+            tracked.mark_event("barrier reached")
+        finally:
+            tracked.finish()
+
+    async def _probe_osd_epoch(self, osd: int, deadline: float,
+                               osdmap) -> int | None:
+        """One MOSDMapPing round-trip; None on timeout/conn failure."""
+        loop = asyncio.get_event_loop()
+        ent = osdmap.osd_addrs.get(osd)
+        if ent is None:
+            return None
+        self._tid += 1
+        tid = self._tid
+        fut = loop.create_future()
+        self._map_ping_waiters[tid] = fut
+        try:
+            await self.msgr.send_message(
+                MOSDMapPing(tid=tid, epoch=0),
+                EntityAddr(ent[0], ent[1]), f"osd.{osd}")
+            return await asyncio.wait_for(
+                fut, timeout=max(0.05, min(1.0, deadline - loop.time())))
+        except (asyncio.TimeoutError, ConnectionError, OSError,
+                ConnectionError_):
+            return None
+        finally:
+            self._map_ping_waiters.pop(tid, None)
 
     async def _refresh_map(self, cur) -> None:
         await self.monc.subscribe(

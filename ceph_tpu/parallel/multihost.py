@@ -35,6 +35,8 @@ import json
 import os
 import sys
 
+from ceph_tpu.utils.platform import enable_x64 as _enable_x64
+
 
 def run_worker(coordinator: str, num_processes: int, process_id: int,
                local_devices: int = 4) -> dict:
@@ -117,7 +119,7 @@ def run_worker(coordinator: str, num_processes: int, process_id: int,
     rid = builder.add_simple_rule(cm, root, builder.TYPE_HOST)
     mapper = Mapper(cm, block=1 << 9)
     # replicated operands must be global arrays in multi-controller
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         mapper.arrays = jax.device_put(
             mapper.arrays, NamedSharding(mesh1, P()))
     n_pgs = 256 * len(devs)

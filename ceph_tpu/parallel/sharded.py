@@ -12,6 +12,7 @@ from __future__ import annotations
 import functools
 
 import jax
+from ceph_tpu.utils.platform import enable_x64 as _enable_x64
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -81,7 +82,8 @@ def _compiled_sharded_sweep(rule_key, firstn, nd, mesh, block, local_n,
     # check_vma off: the rule VM's while_loop carries start from
     # unvarying constants, which the varying-manual-axes checker
     # rejects even though the computation is correctly per-shard
-    return jax.jit(jax.shard_map(
+    from ceph_tpu.utils.platform import shard_map as _shard_map
+    return jax.jit(_shard_map(
         local, mesh=mesh,
         in_specs=(P(), P()),
         out_specs=(P(), P()),
@@ -117,5 +119,5 @@ def sharded_crush_sweep(mesh: Mesh, mapper, ruleno: int, start_x: int,
         mapper._rule_key(ruleno, result_max),
         mapper.rule_is_firstn(ruleno), mapper.packed.max_devices,
         mesh, block, local_n, result_max)
-    with jax.enable_x64(True):
+    with _enable_x64(True):
         return fn(mapper.arrays, jnp.uint32(start_x))

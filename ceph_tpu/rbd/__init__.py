@@ -474,37 +474,53 @@ class Image:
     async def import_diff(self, stream: bytes) -> None:
         """Apply a v1 diff stream to this (head, writable) image: the
         from-snap must exist here, the to-snap is created after the
-        data lands (ref: ImportDiff.cc ordering)."""
+        data lands (ref: ImportDiff.cc ordering). Every record read is
+        bounds-checked: a stream truncated mid-record raises a clean
+        ObjectOperationError(-22) instead of leaking struct.error to
+        callers like rbd_cli."""
         self._assert_writable()
         if not stream.startswith(self.DIFF_MAGIC):
             raise ObjectOperationError(-22, "not an rbd diff v1 stream")
         pos = len(self.DIFF_MAGIC)
         end_snap = None
         ended = False
+
+        def need(n: int) -> None:
+            if pos + n > len(stream):
+                raise ObjectOperationError(-22, "truncated diff stream")
+
         while pos < len(stream) and not ended:
             tag = stream[pos:pos + 1]
             pos += 1
             if tag == b"f":
+                need(4)
                 (n,) = struct.unpack_from("<I", stream, pos)
+                need(4 + n)
                 name = stream[pos + 4:pos + 4 + n].decode()
                 pos += 4 + n
                 if name not in self.snaps:
                     raise ObjectOperationError(
                         -22, f"start snapshot {name} not present")
             elif tag == b"t":
+                need(4)
                 (n,) = struct.unpack_from("<I", stream, pos)
+                need(4 + n)
                 end_snap = stream[pos + 4:pos + 4 + n].decode()
                 pos += 4 + n
             elif tag == b"s":
+                need(8)
                 (size,) = struct.unpack_from("<Q", stream, pos)
                 pos += 8
                 await self.resize(size)
             elif tag == b"w":
+                need(16)
                 off, n = struct.unpack_from("<QQ", stream, pos)
                 pos += 16
+                need(n)
                 await self.write(off, stream[pos:pos + n])
                 pos += n
             elif tag == b"z":
+                need(16)
                 off, n = struct.unpack_from("<QQ", stream, pos)
                 pos += 16
                 while n:

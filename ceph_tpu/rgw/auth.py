@@ -206,6 +206,15 @@ def verify_presigned(method: str, path: str, query: str,
         return False, "malformed presigned parameters"
     if service != SERVICE or terminal != "aws4_request":
         return False, "bad credential scope"
+    # SigV4 query-auth bounds: expiry must be positive and at most 7
+    # days (ref: rgw's X-Amz-Expires validation) — otherwise a key
+    # holder can mint effectively never-expiring URLs
+    if expires <= 0 or expires > 604800:
+        return False, "X-Amz-Expires out of range (0, 604800]"
+    # a presigned signature not bound to the host header could be
+    # replayed against another endpoint sharing the key
+    if "host" not in signed:
+        return False, "SignedHeaders must include host"
     if amzdate[:8] != date:
         return False, "X-Amz-Date does not match credential date"
     secret = secrets.get(access)

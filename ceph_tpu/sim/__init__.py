@@ -1,10 +1,21 @@
-"""Cluster-churn simulation (failure/recovery rebalance analysis).
+"""Cluster fault simulation: static churn analysis + live thrashing.
 
-The TPU-shaped stand-in for the reference's thrashing suites
-(ref: qa/tasks/ceph_manager.py Thrasher; src/tools/osdmaptool.cc
---test-map-pgs): replay OSD add/remove/reweight events over an OSDMap and
-measure, for every epoch, how much data CRUSH remaps — all placements
-computed batch-wise on the accelerator.
+Two tiers (see README.md in this package):
+
+- **static** (churn.py): replay OSD add/remove/reweight events over an
+  OSDMap and measure, per epoch, how much data CRUSH remaps — all
+  placements computed batch-wise on the accelerator (ref:
+  src/tools/osdmaptool.cc --test-map-pgs).
+- **live** (faults.py + thrasher.py): a runtime-installable messenger
+  fault layer (partitions, one-way drops, delay, duplication,
+  reorder — named, composable per peer-pair) and a seeded Thrasher
+  that drives it against a running vstart cluster under continuing
+  client writes (ref: qa/tasks/ceph_manager.py Thrasher +
+  `ms inject socket failures`).
 """
 
 from ceph_tpu.sim.churn import ChurnSim, ChurnEvent, StepReport  # noqa: F401
+from ceph_tpu.sim.faults import (                                # noqa: F401
+    FaultInjector, FaultRule, delay, drop, duplicate, partition, reorder,
+)
+from ceph_tpu.sim.thrasher import Thrasher                       # noqa: F401

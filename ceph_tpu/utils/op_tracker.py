@@ -79,3 +79,12 @@ class OpTracker:
     def slow_ops(self) -> list[TrackedOp]:
         return [op for op in self.inflight.values()
                 if op.duration > self.slow_op_warn_s]
+
+    def dump_slow_ops(self) -> dict:
+        """ref: admin socket dump_slow_ops — the in-flight ops past
+        the complaint threshold (what the SLOW_OPS health warning and
+        the mon's slow-op count are built from)."""
+        ops = sorted(self.slow_ops(), key=lambda o: o.start)
+        return {"num_slow_ops": len(ops),
+                "complaint_time": self.slow_op_warn_s,
+                "ops": [op.dump() for op in ops]}

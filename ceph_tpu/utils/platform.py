@@ -14,6 +14,33 @@ from __future__ import annotations
 import os
 
 
+def enable_x64(new_val: bool = True):
+    """Scoped 64-bit-dtype context, portable across jax releases:
+    ``jax.enable_x64`` (newer) vs ``jax.experimental.enable_x64``
+    (the only spelling in the pinned 0.4.x)."""
+    import jax
+
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx
+    return ctx(new_val)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Portable shard_map: ``jax.shard_map(check_vma=...)`` (newer)
+    vs ``jax.experimental.shard_map.shard_map(check_rep=...)`` (the
+    pinned 0.4.x spelling of the same replication checker knob)."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_exp
+    return sm_exp(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_rep=check_vma)
+
+
 def honor_platform_env() -> None:
     plats = os.environ.get("JAX_PLATFORMS")
     if plats:

@@ -21,6 +21,7 @@ def fd_of(m, osd, fd_type):
 
 
 class TestBalancer:
+    @pytest.mark.slow
     def test_flattens_skewed_distribution(self):
         """Natural CRUSH skew on a smallish map must drop to within the
         default upmap_max_deviation=5 (the reference balancer's done
@@ -47,6 +48,7 @@ class TestBalancer:
                      for o in vals}
             assert len(hosts) == 3
 
+    @pytest.mark.slow
     def test_ec_pool_balances_positionally(self):
         m = osdmaptool.create_simple(40, 512, 5, erasure=True)
         _, before = deviation_stats(m)
@@ -56,6 +58,7 @@ class TestBalancer:
         up, _, _, _ = m.map_pool(1)
         assert not (up == ITEM_NONE).any()   # no holes introduced
 
+    @pytest.mark.slow
     def test_reverts_existing_upmap_feeding_overfull(self):
         from ceph_tpu.osd.types import pg_t
         m = osdmaptool.create_simple(16, 256, 3, erasure=False)

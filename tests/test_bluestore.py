@@ -319,6 +319,7 @@ def test_objectstore_tool_on_bluestore(tmp_path):
     assert out.returncode == 0
 
 
+@pytest.mark.slow
 def test_osd_crash_remount_on_bluestore(tmp_path):
     """Kill an OSD, REMOUNT its BlueStore from disk (fresh instance —
     the real restart path incl. deferred replay), revive, and verify
@@ -441,6 +442,7 @@ def test_unaligned_zero_on_full_store(tmp_path):
     s.umount()
 
 
+@pytest.mark.slow
 def test_thrash_on_bluestore_with_remounts(tmp_path):
     """Small kill/revive thrash where every revive REMOUNTS the
     victim's BlueStore from disk (fresh instance — deferred replay,
@@ -497,3 +499,38 @@ def test_thrash_on_bluestore_with_remounts(tmp_path):
         finally:
             await c.stop()
     asyncio.run(go())
+
+
+def test_after_kv_commit_failpoint_leaves_reusable_store(tmp_path):
+    """ADVICE low #5: the after_kv_commit fail point fires after the
+    kv batch committed but before the deferred block writes and
+    allocator release ran. The same cleanup as the other failure
+    paths must run, so a REUSED instance (no remount) serves the
+    committed content, has a consistent allocator, and fscks clean."""
+    s = mk(tmp_path)
+    s.queue_transaction(T().create_collection("c"))
+    s.queue_transaction(T().write("c", "o", 0, b"A" * 4096))
+    alloc_before = s.statfs()["allocated"]
+    s._fail_point = "after_kv_commit"
+    with pytest.raises(StoreError):
+        s.queue_transaction(T().write("c", "o", 10, b"CRASH"))
+    s._fail_point = None
+    # the kv committed: the deferred overwrite is durable and must be
+    # visible on the SAME instance (pre-fix the overlay was stale and
+    # the allocator still held any replaced AUs)
+    want = b"A" * 10 + b"CRASH" + b"A" * (4096 - 15)
+    assert s.read("c", "o") == want
+    assert s.statfs()["allocated"] == alloc_before
+    assert s.fsck() == []
+    # and the instance keeps working: COW rewrite + new object
+    s.queue_transaction(T().write("c", "o", 0, b"B" * 65536))
+    s.queue_transaction(T().write("c", "o2", 0, b"fresh"))
+    assert s.read("c", "o") == b"B" * 65536
+    assert s.read("c", "o2") == b"fresh"
+    assert s.fsck() == []
+    s.umount()
+    # remount agrees (nothing replayed twice, nothing leaked)
+    s2 = mk(tmp_path)
+    assert s2.read("c", "o") == b"B" * 65536
+    assert s2.fsck() == []
+    s2.umount()

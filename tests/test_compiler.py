@@ -150,6 +150,7 @@ class TestCompile:
             b = np.asarray(mapper2.map_pgs(rule, xs, 3))
             assert (a == b).all(), f"rule {rule} diverged after round-trip"
 
+    @pytest.mark.slow
     def test_tester_integration(self):
         tester = CrushTester(self.map)
         res = tester.test(0, 3, 0, 255)

@@ -117,6 +117,7 @@ class TestJaxMatchesScalar:
         rid = builder.add_simple_rule(m, root, builder.TYPE_HOST, indep=True)
         assert_match(m, rid, 5)
 
+    @pytest.mark.slow
     def test_uniform_buckets(self):
         m, root = builder.build_hierarchy(5, 4, alg=ALG_UNIFORM)
         rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
@@ -124,6 +125,7 @@ class TestJaxMatchesScalar:
         rid2 = builder.add_simple_rule(m, root, builder.TYPE_HOST, indep=True)
         assert_match(m, rid2, 4)
 
+    @pytest.mark.slow
     def test_three_level_multistep(self):
         m, root = builder.build_hierarchy(8, 2, n_racks=4)
         rid = builder.add_multistep_rule(m, root, [
@@ -131,12 +133,14 @@ class TestJaxMatchesScalar:
             RuleStep(OP_CHOOSELEAF_FIRSTN, 2, builder.TYPE_HOST)])
         assert_match(m, rid, 4)
 
+    @pytest.mark.slow
     def test_choose_indep_direct_osd(self):
         m, root = builder.build_hierarchy(6, 3)
         rid = builder.add_multistep_rule(
             m, root, [RuleStep(OP_CHOOSE_INDEP, 0, 0)], indep=True)
         assert_match(m, rid, 4)
 
+    @pytest.mark.slow
     def test_failure_holes(self):
         """More shards than failure domains: indep emits NONE holes,
         firstn underfills — both must match the spec exactly."""
@@ -154,6 +158,7 @@ class TestJaxMatchesScalar:
         assert_match(m, rid, 3,
                      weights=[0x10000, 0x8000, 0x10000, 0x10000, 0, 0x4000])
 
+    @pytest.mark.slow
     def test_out_of_range_device_rejected_both_paths(self):
         """A device id beyond the reweight vector is out (ref: mapper.c
         is_out item >= weight_max) — and BOTH compiled variants
@@ -174,6 +179,7 @@ class TestJaxMatchesScalar:
         rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
         assert_match(m, rid, 3)
 
+    @pytest.mark.slow
     def test_randomized_maps(self, rng):
         """Fuzz: random hierarchy shapes, algs, weights, rule kinds."""
         for trial in range(4):
@@ -222,6 +228,7 @@ class TestJaxMatchesScalar:
         counts, bad = mapper.sweep(rid, 0, 64, 3)
         assert np.asarray(counts).sum() == (got != ITEM_NONE).sum()
 
+    @pytest.mark.slow
     def test_straw_v1_matches_scalar(self):
         from ceph_tpu.crush.types import ALG_STRAW
         rng = np.random.default_rng(3)
@@ -232,6 +239,7 @@ class TestJaxMatchesScalar:
         rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
         assert_match(m, rid, 3)
 
+    @pytest.mark.slow
     def test_tree_matches_scalar(self):
         from ceph_tpu.crush.types import ALG_TREE
         rng = np.random.default_rng(4)
@@ -322,6 +330,7 @@ class TestDerivedStateInvalidation:
         assert before != after
         assert_match(m, rid, 2)   # vectorized still matches the spec
 
+    @pytest.mark.slow
     def test_tree_insert_adds_leaf(self):
         from ceph_tpu.crush.types import ALG_TREE
         m, root = builder.build_flat(4, alg=ALG_TREE)

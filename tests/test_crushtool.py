@@ -9,6 +9,7 @@ from ceph_tpu.crush.tester import CrushTester
 from ceph_tpu.crush.types import WEIGHT_ONE
 
 
+@pytest.mark.slow
 class TestCrushTester:
     def test_counts_and_badmaps(self):
         m, root = builder.build_hierarchy(5, 2)
@@ -46,6 +47,7 @@ class TestCrushTester:
 
 
 class TestCrushtoolCLI:
+    @pytest.mark.slow
     def test_build_test_json(self, capsys):
         out = main(["--build", "--num-osds", "8", "--hosts", "4", "--test",
                     "--num-rep", "2", "--max-x", "127", "--json"])
@@ -53,6 +55,7 @@ class TestCrushtoolCLI:
         assert out["bad_mappings"] == 0
         assert out["utilization"]["placements"] == 256
 
+    @pytest.mark.slow
     def test_weight_flag(self):
         out = main(["--build", "--num-osds", "4", "--test", "--num-rep",
                     "2", "--max-x", "127", "--weight", "1", "0.0"])

@@ -104,7 +104,7 @@ def test_ec_degraded_read_and_write():
                           for cid in o.store.list_collections()
                           if "victim" in o.store.list_objects(cid))
             await c.kill_osd(holder)
-            await c.wait_for_osd_down(holder, timeout=20)
+            await c.wait_for_osd_down(holder, timeout=60)
             # degraded read must decode via parity
             assert await io.read("victim") == data
             # degraded write (2 of 3 shards live = min_size)
@@ -126,7 +126,7 @@ def test_ec_shard_reconstruction_on_revive():
             for oid, data in objs.items():
                 await io.write_full(oid, data)
             await c.kill_osd(2)
-            await c.wait_for_osd_down(2, timeout=20)
+            await c.wait_for_osd_down(2, timeout=60)
             # mutate while the shard osd is gone -> osd.2 goes stale
             objs["e0"] = b"replaced!" * 100
             await io.write_full("e0", objs["e0"])

@@ -224,6 +224,7 @@ if __name__ == "__main__":
         print(f"wrote {GOLDEN}")
 
 
+@pytest.mark.slow
 def test_crushtool_binary_roundtrip(tmp_path, capsys):
     from ceph_tpu.bench import crushtool
     bin_f = tmp_path / "map.bin"

@@ -1,0 +1,194 @@
+"""Osdmap epoch barrier: eviction under a stale-map OSD.
+
+The advisor-flagged race (ADVICE r5 medium): MDS eviction blocklists a
+zombie client at the MON, but OSDs enforce ``is_blocklisted()``
+against their OWN osdmap — an OSD that has not yet received the
+blocklist epoch will happily accept the zombie's writes after the new
+holder was granted FW. The fix is the epoch barrier
+(``Objecter.wait_for_map_on_osds``): eviction drops caps only after
+the OSDs have observably caught up.
+
+These tests force the race window deterministically with the fault
+layer: a one-way ``mon.* -> osd.*`` blackhole freezes the OSD's map
+at a pre-blocklist epoch. The regression test shows the corruption
+with the barrier disabled (the pre-fix behavior); the fix test shows
+the barrier holding eviction until the map lands, after which the
+zombie's very FIRST resumed write bounces — no probe window.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.cephfs import _fileobj
+from ceph_tpu.cephfs.client import CephFSClient
+from ceph_tpu.cephfs.mds import MDSDaemon
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.rados import ObjectOperationError
+from ceph_tpu.sim import faults as F
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _setup(c):
+    """size-1 pool on the single OSD: every object's primary is
+    osd.0, so 'the OSD with the stale map' is deterministic."""
+    await c.client.pool_create("fs", pg_num=4, size=1, min_size=1)
+    await c.wait_for_clean(timeout=120)
+    io = await c.client.open_ioctx("fs")
+    for _ in range(30):
+        try:
+            await io.write_full("_warm", b"x")
+            break
+        except ObjectOperationError:
+            await asyncio.sleep(1)
+    return io
+
+
+def _hang(client):
+    """Make a client a zombie: no renewals, revokes unanswered."""
+    client._renew_task.cancel()
+
+    async def never_acks(msg):
+        pass
+    client._handle_revoke = never_acks
+
+
+async def _teardown(c, mds, clients):
+    for cl in clients:
+        try:
+            await cl.msgr.shutdown()
+            if cl._own_rados is not None:
+                await cl._own_rados.shutdown()
+        except Exception:
+            pass
+    await mds.stop()
+    await c.stop()
+
+
+def test_eviction_waits_for_osd_to_observe_blocklist_epoch():
+    """WITH the barrier: while osd.0's map is frozen pre-blocklist,
+    the competing open must NOT be granted; once the map flows again
+    the open completes and the zombie's first write is already
+    fenced (-EBLOCKLISTED, no probe loop)."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=1).start()
+        inj = F.FaultInjector(seed=4)
+        c.install_faults(inj)
+        io = await _setup(c)
+        mds = MDSDaemon(io, lease_timeout=1.0, revoke_timeout=25.0)
+        await mds.fs.mount()
+        addr = await mds.start()
+        monmap = c.client.monc.monmap
+        a = await CephFSClient.create(monmap, addr, "fs",
+                                      keyring=c.keyring)
+        b = await CephFSClient.create(monmap, addr, "fs",
+                                      keyring=c.keyring)
+        try:
+            ha = await a.open_file("/fence.txt", "w")
+            await ha.write(b"held")
+            _hang(a)
+            # freeze osd.0's osdmap: map publishes are mon -> osd
+            inj.install("stale-map", [F.drop("mon.*", "osd.*")])
+            topen = asyncio.ensure_future(
+                b.open_file("/fence.txt", "w"))
+            # the lease lapses at ~1s and the MDS blocklists a — but
+            # the barrier must hold the grant while osd.0 is stale
+            await asyncio.sleep(3.0)
+            assert not topen.done(), \
+                "open granted while osd.0 had a pre-blocklist map"
+            # map flows again: barrier passes, eviction completes
+            inj.clear("stale-map")
+            hb = await asyncio.wait_for(topen, timeout=30)
+            assert hb.valid
+            await hb.write(b"taken")
+            # the zombie resumes: its FIRST write must already bounce
+            # (the barrier proved osd.0 enforces the fence before any
+            # cap moved) — the pre-barrier code needed a probe loop
+            with pytest.raises(ObjectOperationError) as ei:
+                await a.ioctx.write_full(_fileobj("/fence.txt"),
+                                         b"zombie")
+            assert ei.value.errno == -108
+            assert await b.read_file("/fence.txt") == b"taken"
+            await hb.close()
+            await b.unmount()
+        finally:
+            inj.clear_all()
+            await _teardown(c, mds, [a, b])
+    run(go())
+
+
+def test_eviction_without_barrier_lets_zombie_corrupt():
+    """WITHOUT the barrier (pre-fix behavior, barrier stubbed out):
+    the same scenario lets the zombie's write land on the stale OSD
+    AFTER the new holder wrote — the corruption the barrier exists to
+    prevent. This is the regression proof: if the barrier stops being
+    wired into eviction, the previous test fails; this one documents
+    exactly what goes wrong."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=1).start()
+        inj = F.FaultInjector(seed=4)
+        c.install_faults(inj)
+        io = await _setup(c)
+        mds = MDSDaemon(io, lease_timeout=1.0, revoke_timeout=25.0)
+
+        async def no_barrier(holder, outbl):
+            return True                      # pre-fix: mon commit only
+        mds._blocklist_barrier = no_barrier
+        await mds.fs.mount()
+        addr = await mds.start()
+        monmap = c.client.monc.monmap
+        a = await CephFSClient.create(monmap, addr, "fs",
+                                      keyring=c.keyring)
+        b = await CephFSClient.create(monmap, addr, "fs",
+                                      keyring=c.keyring)
+        try:
+            ha = await a.open_file("/fence.txt", "w")
+            await ha.write(b"held")
+            _hang(a)
+            inj.install("stale-map", [F.drop("mon.*", "osd.*")])
+            # without the barrier the open is granted while osd.0 is
+            # still on the pre-blocklist map
+            hb = await asyncio.wait_for(
+                b.open_file("/fence.txt", "w"), timeout=20)
+            assert hb.valid
+            await hb.write(b"taken!")
+            # the zombie's write is ACCEPTED by the stale osd.0 and
+            # clobbers the new holder's acknowledged data
+            # equal-length payloads: read_file is MDS-size-bounded,
+            # and the zombie never told the MDS about its write
+            await a.ioctx.write_full(_fileobj("/fence.txt"), b"zombie")
+            got = await b.read_file("/fence.txt")
+            assert got == b"zombie", \
+                "stale-map corruption no longer reproduces; the " \
+                "no-barrier stub may be dead code now"
+            await hb.close()
+            await b.unmount()
+        finally:
+            inj.clear_all()
+            await _teardown(c, mds, [a, b])
+    run(go())
+
+
+def test_blocklist_add_reports_commit_epoch():
+    """`osd blocklist add` returns the epoch the fence commits at —
+    the value the barrier needs."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=1).start()
+        try:
+            before = c.client.monc.osdmap.epoch
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "osd blocklist", "blocklistop": "add",
+                 "addr": "client.zombie", "expire": 60.0})
+            assert ret == 0
+            epoch = json.loads(out)["epoch"]
+            assert epoch > before
+            # and the barrier proves the (sole) OSD observed it
+            await c.client.objecter.wait_for_map_on_osds(
+                epoch, timeout=15.0)
+        finally:
+            await c.stop()
+    run(go())

@@ -1,0 +1,285 @@
+"""Fault-injection layer: messenger-level fault sets + live-cluster
+partition/heal + slow-op surfacing.
+
+ref test model: the msgr fault-injection cases of
+src/test/msgr/test_msgr.cc plus the qa thrash suites' partition
+helpers — here driven through ceph_tpu.sim.faults installed on live
+messengers and a vstart cluster.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.msg import Dispatcher, Message, Messenger, register
+from ceph_tpu.rados import ObjectOperationError
+from ceph_tpu.sim import faults as F
+
+
+@register
+class MFault(Message):
+    TYPE = 910
+    FIELDS = [("x", "u64")]
+
+
+class Collector(Dispatcher):
+    def __init__(self):
+        self.got = []
+
+    async def ms_dispatch(self, msg):
+        if isinstance(msg, MFault):
+            self.got.append(msg.x)
+            return True
+        return False
+
+
+async def _wait(pred, timeout=10.0):
+    t0 = asyncio.get_event_loop().time()
+    while not pred():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise TimeoutError
+        await asyncio.sleep(0.01)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _pair(inj):
+    """client/server messenger pair with the injector installed on
+    both ends."""
+    server = Messenger("osd.9")
+    sink = Collector()
+    server.add_dispatcher(sink)
+    addr = await server.bind()
+    client = Messenger("client.f")
+    client.faults = inj
+    server.faults = inj
+    return server, sink, addr, client
+
+
+def test_delay_fault_delays_messages():
+    async def go():
+        inj = F.FaultInjector(seed=1)
+        server, sink, addr, client = await _pair(inj)
+        inj.install("lag", [F.delay("client.*", "osd.*", 0.3)])
+        t0 = asyncio.get_event_loop().time()
+        await client.send_message(MFault(x=1), addr, "osd.9")
+        await _wait(lambda: sink.got)
+        took = asyncio.get_event_loop().time() - t0
+        assert took >= 0.3, took
+        # healing removes the delay
+        inj.clear("lag")
+        t0 = asyncio.get_event_loop().time()
+        await client.send_message(MFault(x=2), addr, "osd.9")
+        await _wait(lambda: len(sink.got) == 2)
+        assert asyncio.get_event_loop().time() - t0 < 0.25
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_duplicate_fault_sends_twice_with_distinct_seqs():
+    """Message-level duplication delivers the payload twice under
+    distinct seqs — proving end-to-end dedup (PG reqid tables) is
+    what must make ops exactly-once, not the transport."""
+    async def go():
+        inj = F.FaultInjector(seed=1)
+        server, sink, addr, client = await _pair(inj)
+        inj.install("dup", [F.duplicate("client.*", "osd.*",
+                                        prob=1.0)])
+        await client.send_message(MFault(x=7), addr, "osd.9")
+        await _wait(lambda: len(sink.got) == 2)
+        assert sink.got == [7, 7]
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_reorder_fault_overtakes_next_message():
+    async def go():
+        inj = F.FaultInjector(seed=1)
+        server, sink, addr, client = await _pair(inj)
+        conn = await client.connect(addr, "osd.9")
+        inj.install("swap", [F.reorder("client.*", "osd.*", prob=1.0,
+                                       hold_s=2.0)])
+        # concurrent sends: the first is held until the second passes
+        await asyncio.gather(conn.send_message(MFault(x=1)),
+                             conn.send_message(MFault(x=2)))
+        await _wait(lambda: len(sink.got) == 2)
+        assert sink.got == [2, 1], sink.got
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_reorder_hold_bound_never_loses_a_lone_message():
+    async def go():
+        inj = F.FaultInjector(seed=1)
+        server, sink, addr, client = await _pair(inj)
+        inj.install("swap", [F.reorder("client.*", "osd.*", prob=1.0,
+                                       hold_s=0.2)])
+        await client.send_message(MFault(x=5), addr, "osd.9")
+        await _wait(lambda: sink.got)      # released by the bound
+        assert sink.got == [5]
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_one_way_drop_is_a_silent_blackhole():
+    async def go():
+        inj = F.FaultInjector(seed=1)
+        server, sink, addr, client = await _pair(inj)
+        conn = await client.connect(addr, "osd.9")
+        inj.install("hole", [F.drop("client.*", "osd.9")])
+        await conn.send_message(MFault(x=1))   # swallowed, no error
+        await asyncio.sleep(0.2)
+        assert sink.got == []
+        inj.clear("hole")
+        await conn.send_message(MFault(x=2))
+        await _wait(lambda: sink.got)
+        assert sink.got == [2]
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_partition_cuts_both_connects_and_established_conns():
+    async def go():
+        from ceph_tpu.msg.messenger import ConnectionError_
+        inj = F.FaultInjector(seed=1)
+        server, sink, addr, client = await _pair(inj)
+        conn = await client.connect(addr, "osd.9")
+        inj.install("split", [F.partition("client.f", "osd.9")])
+        with pytest.raises(ConnectionError_):
+            await conn.send_message(MFault(x=1))
+        with pytest.raises(ConnectionError_):
+            await client.connect(addr, "osd.9")
+        inj.clear("split")                 # heal: traffic resumes
+        await client.send_message(MFault(x=2), addr, "osd.9")
+        await _wait(lambda: sink.got)
+        assert sink.got == [2]
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_cluster_partition_heal_converges_with_data_intact():
+    """Two OSDs partitioned from each other mid-writes: the cluster
+    keeps serving (min_size=2 of 3 replicas reachable), and after the
+    heal it converges to clean with every acked write readable."""
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=4,
+            config={"mon_osd_down_out_interval": 600.0,
+                    "mon_osd_min_down_reporters": 2}).start()
+        try:
+            inj = F.FaultInjector(seed=2)
+            c.install_faults(inj)
+            await c.client.pool_create("p", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("p")
+            acked = {}
+            for i in range(8):
+                data = bytes([i]) * 512
+                await io.write_full(f"pre{i}", data)
+                acked[f"pre{i}"] = data
+            inj.install("split01", [F.partition("osd.0", "osd.1")])
+            # degraded-but-serving: writes must still complete (the
+            # objecter retries around any primary whose replica set
+            # straddles the cut; generous timeout for the storm)
+            for i in range(6):
+                data = bytes([100 + i]) * 512
+                await io.write_full(f"mid{i}", data, timeout=60.0)
+                acked[f"mid{i}"] = data
+            inj.clear("split01")
+            await c.wait_for_clean(timeout=240)
+            for oid, data in acked.items():
+                assert await io.read(oid) == data, oid
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_partitioned_target_fails_cleanly_and_feeds_slow_ops():
+    """A client partitioned from every OSD: ops fail with -ETIMEDOUT
+    (bounded retry, no hang) and the stuck server-side op surfaces as
+    a SLOW_OPS health warning sourced from the OSD's OpTracker."""
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=3,
+            config={"mon_osd_down_out_interval": 600.0,
+                    "mon_osd_min_down_reporters": 2,
+                    "osd_op_complaint_time": 0.3}).start()
+        try:
+            inj = F.FaultInjector(seed=3)
+            c.install_faults(inj)
+            await c.client.pool_create("p", pg_num=4, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("p")
+            await io.write_full("ok", b"fine")
+            # cut the client off from every osd: a write must fail
+            # cleanly inside its timeout instead of hanging
+            inj.install("isolate",
+                        [F.partition("client.admin", "osd.*")])
+            t0 = asyncio.get_event_loop().time()
+            with pytest.raises(ObjectOperationError) as ei:
+                await io.write_full("stuck", b"x" * 64, timeout=2.0)
+            took = asyncio.get_event_loop().time() - t0
+            assert ei.value.errno == -110
+            assert took < 10, took
+            inj.clear("isolate")
+            # server-side: blackhole every osd -> osd.0 frame (rep ops
+            # into osd.0, or acks back when osd.0 is primary) without
+            # tripping the 2-reporter failure threshold, so any write
+            # wedges at its primary, ages past the complaint time, and
+            # surfaces in the health report
+            inj.install("ack-hole", [F.drop("osd.*", "osd.0")])
+            write = asyncio.ensure_future(
+                io.write_full("slow", b"y" * 64, timeout=60.0))
+            try:
+                await _wait(lambda: any(
+                    len(o.op_tracker.slow_ops()) > 0
+                    for o in c.osds), timeout=20.0)
+                status = None
+                for _ in range(60):
+                    status = await c.client.status()
+                    if "SLOW_OPS" in status["health"]["checks"]:
+                        break
+                    await asyncio.sleep(0.2)
+                assert "SLOW_OPS" in status["health"]["checks"], \
+                    status["health"]
+            finally:
+                inj.clear_all()
+                write.cancel()
+                try:
+                    await write
+                except (asyncio.CancelledError, ObjectOperationError):
+                    pass
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_objecter_dump_ops_records_attempts():
+    """Client-side op tracking: a thrashed op's TrackedOp timeline
+    records the resend attempts (the dump_historic_ops view)."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("p", pg_num=4, size=3)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("p")
+            await io.write_full("a", b"1")
+            hist = c.client.objecter.op_tracker.dump_historic_ops()
+            assert hist["num_ops"] >= 1
+            events = [e["event"] for e in hist["ops"][-1]["events"]]
+            assert any(e.startswith("sent to osd.") for e in events)
+            assert "reply received" in events
+        finally:
+            await c.stop()
+    run(go())

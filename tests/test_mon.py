@@ -262,3 +262,39 @@ def test_monclient_survives_mon_death():
         assert len(q["quorum"]) == 2
         await stop_all(mons, [mc])
     run(go())
+
+
+def test_blocklist_expired_entries_trimmed():
+    """ADVICE low #3: expired blocklist entries must disappear — from
+    `osd blocklist ls` immediately, and from the MAP itself via the
+    leader's periodic trim (upstream OSDMonitor trims on tick), so
+    the map/encoding stops growing without bound."""
+    async def go():
+        mons, monmap = await start_mons(1)
+        lead = await wait_quorum(mons)
+        mc = MonClient("client.admin", monmap)
+        try:
+            ret, rs, out = await mc.command(
+                {"prefix": "osd blocklist", "blocklistop": "add",
+                 "addr": "client.ghost", "expire": 0.5})
+            assert ret == 0, rs
+            ret, _, out = await mc.command(
+                {"prefix": "osd blocklist", "blocklistop": "ls"})
+            assert ret == 0
+            assert "client.ghost" in json.loads(out)["blocklist"]
+            assert "client.ghost" in lead.osdmon.osdmap.blocklist
+            # after expiry: ls filters it instantly...
+            await asyncio.sleep(0.6)
+            ret, _, out = await mc.command(
+                {"prefix": "osd blocklist", "blocklistop": "ls"})
+            assert ret == 0
+            assert json.loads(out)["blocklist"] == {}
+            # ...and the tick folds the removal into an incremental,
+            # shrinking the authoritative map
+            await wait_for(
+                lambda: "client.ghost" not in
+                lead.osdmon.osdmap.blocklist,
+                timeout=10.0, msg="blocklist trim")
+        finally:
+            await stop_all(mons, [mc])
+    run(go())

@@ -131,6 +131,7 @@ class TestEligibility:
         dw = np.full(8, WEIGHT_ONE, dtype=np.int64)     # ids go to 15
         assert pm.build_plan(m, pack_map(m), rid, dw) is None
 
+    @pytest.mark.slow
     def test_xla_fallback_when_ineligible(self):
         """Ineligible maps silently keep the XLA path through Mapper."""
         m, root = builder.build_flat(
@@ -145,6 +146,7 @@ class TestEligibility:
             assert list(out[i]) == ref + [ITEM_NONE] * (3 - len(ref))
 
 
+@pytest.mark.slow
 class TestBitExact:
     def test_three_level_chooseleaf(self):
         m, rid = _hier(16, 4)
@@ -403,6 +405,7 @@ class TestVmemPlanning:
         rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
         assert pm.build_plan(m, pack_map(m), rid, None) is None
 
+    @pytest.mark.slow
     def test_mid_map_narrows_lanes(self):
         m, root = builder.build_flat(640)
         rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
@@ -424,6 +427,7 @@ class TestVmemPlanning:
 
 
 class TestRuntimeFallback:
+    @pytest.mark.slow
     def test_kernel_failure_degrades_to_xla(self, monkeypatch):
         """A kernel that explodes at run time (e.g. a libtpu with a
         tighter VMEM limit than the model assumes) must degrade to the

@@ -3,6 +3,7 @@ have real call sites; ref: src/common/perf_counters.h +
 perf_counters_collection.h, `ceph daemon ... perf dump`)."""
 
 import json
+import pytest
 
 import numpy as np
 
@@ -25,6 +26,7 @@ class TestCollection:
 
 
 class TestWiredCallSites:
+    @pytest.mark.slow
     def test_crush_tester_counts(self):
         from ceph_tpu.crush import builder
         from ceph_tpu.crush.tester import CrushTester
@@ -97,6 +99,7 @@ class TestMapperLifecycleCounters:
         assert flipped["reweight_recompiles"] == \
             after_same["reweight_recompiles"] + 1
 
+    @pytest.mark.slow
     def test_sweep_counters(self):
         import numpy as np
         from ceph_tpu.crush import builder

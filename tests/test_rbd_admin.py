@@ -226,3 +226,51 @@ def test_rbd_export_import_diff():
         finally:
             await c.stop()
     run(go())
+
+
+def test_import_diff_truncated_stream_raises_cleanly():
+    """ADVICE low #4: a diff stream truncated mid-record must raise
+    ObjectOperationError(-22, 'truncated diff stream') on every record
+    type — never a raw struct.error leaking to rbd_cli — and must not
+    partially corrupt the image before the malformed record."""
+    import struct
+
+    from ceph_tpu.rbd import Image
+
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("rbd", pg_num=8, size=3)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("rbd")
+            rbd = RBD(io)
+            await rbd.create("dst", 128 << 10, order=16)
+            dst = await rbd.open("dst")
+            magic = Image.DIFF_MAGIC
+            cases = [
+                magic + b"s" + b"\x01\x02",            # size cut short
+                magic + b"w" + struct.pack("<Q", 0),   # header cut
+                magic + b"w" + struct.pack("<QQ", 0, 4096) + b"xy",
+                magic + b"z" + struct.pack("<Q", 0)[:4],
+                magic + b"t" + struct.pack("<I", 10) + b"abc",
+                magic + b"f" + b"\xff",
+            ]
+            for bad in cases:
+                with pytest.raises(ObjectOperationError) as ei:
+                    await dst.import_diff(bad)
+                assert ei.value.errno == -22, bad
+                assert "truncated" in str(ei.value) or \
+                    "not present" in str(ei.value), bad
+            # missing end record still reports truncation
+            with pytest.raises(ObjectOperationError) as ei:
+                await dst.import_diff(
+                    magic + b"w" + struct.pack("<QQ", 0, 4) + b"good")
+            assert ei.value.errno == -22
+            # a well-formed stream still applies after the failures
+            await dst.import_diff(
+                magic + b"w" + struct.pack("<QQ", 0, 4) + b"good" +
+                b"e")
+            assert await dst.read(0, 4) == b"good"
+        finally:
+            await c.stop()
+    run(go())

@@ -506,3 +506,36 @@ def test_rgw_presigned_and_acls():
         finally:
             await c.stop()
     run(go())
+
+
+def test_presigned_expiry_clamp_and_host_binding():
+    """ADVICE low #2: X-Amz-Expires must be clamped to (0, 604800]
+    and SignedHeaders must include host — otherwise a key holder can
+    mint effectively never-expiring or host-unbound URLs."""
+    from ceph_tpu.rgw import auth as sigv4
+
+    secrets = {"AK": "sk"}
+
+    def verify(expires=None, signed_headers=None):
+        qs = sigv4.presign("GET", "/b/o", "host1", "AK", "sk",
+                           expires=120 if expires is None else expires)
+        if signed_headers is not None:
+            qs = qs.replace("X-Amz-SignedHeaders=host",
+                            f"X-Amz-SignedHeaders={signed_headers}")
+        return sigv4.verify_presigned("GET", "/b/o", qs,
+                                      {"host": "host1"}, secrets)
+
+    ok, who = verify()
+    assert ok and who == "AK"
+    # zero / negative / over-7-day expiry: rejected with a clear
+    # reason (not a signature mismatch)
+    for bad in (0, -5, 604801, 10**9):
+        ok, why = verify(expires=bad)
+        assert not ok and "X-Amz-Expires" in why, (bad, why)
+    # exactly 7 days is the legal maximum
+    ok, _ = verify(expires=604800)
+    assert ok
+    # host missing from SignedHeaders: rejected before any signature
+    # work (a sig over host-free headers could be replayed elsewhere)
+    ok, why = verify(signed_headers="x-amz-date")
+    assert not ok and "host" in why

@@ -71,6 +71,7 @@ def test_thrash_replicated_pool():
 
 
 @pytest.mark.parametrize("seed", [7, 21])
+@pytest.mark.slow
 def test_thrash_deep_mixed_pools(seed):
     """8 OSDs / 3 mons / replicated + EC pools / 4 rounds with
     kill-during-recovery, a mon kill, and pg_num growth mid-storm."""

@@ -1,0 +1,109 @@
+"""Seeded Thrasher: deterministic schedules + live smoke storms.
+
+ref test model: qa/tasks/ceph_manager.py Thrasher as consumed by the
+rados/thrash suites — a seeded random storm of kills, revives,
+partitions and degraded links under continuing client writes, after
+which the cluster must converge clean with every acked write intact
+and every store fscking clean.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.os_.bluestore import BlueStore
+from ceph_tpu.sim.thrasher import Thrasher
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_plan_is_pure_function_of_seed():
+    """Reproducibility is the whole point of a seeded thrasher: the
+    schedule must be identical for one seed and differ across seeds."""
+    a = Thrasher.plan(7, 40)
+    b = Thrasher.plan(7, 40)
+    c = Thrasher.plan(8, 40)
+    assert a == b
+    assert a != c
+    assert len(a) == 40
+    kinds = {x["op"] for x in a}
+    assert "kill_osd" in kinds and "partition" in kinds
+
+
+def _mk_store(tmp_path, i):
+    return BlueStore(str(tmp_path / f"osd{i}" / "bs"))
+
+
+def _thrash_cluster_config():
+    return {
+        "mon_osd_down_out_interval": 600.0,
+        "mon_osd_min_down_reporters": 2,
+        # oversubscribed single-core host: production-shaped mon
+        # timing so elections don't loop under recovery load (the
+        # deep-thrash lesson from tests/test_thrash.py)
+        "mon_lease": 4.0, "mon_lease_interval": 0.5,
+        "mon_election_timeout": 1.0, "mon_paxos_timeout": 8.0,
+    }
+
+
+def test_thrasher_smoke_seeded(tmp_path):
+    """Short seeded storm on BlueStore with revive-via-remount and a
+    mon-leader kill in the mix: the four Thrasher invariants hold and
+    the executed log matches the seeded schedule's feasible actions."""
+    async def go():
+        stores = [_mk_store(tmp_path, i) for i in range(4)]
+        c = await Cluster(n_mons=3, n_osds=4, stores=stores,
+                          config=_thrash_cluster_config()).start()
+        try:
+            await c.client.pool_create("t", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=240)
+            io = await c.client.open_ioctx("t")
+
+            def remount(i):
+                return _mk_store(tmp_path, i)
+
+            th = Thrasher(c, seed=1234, store_factory=remount,
+                          min_live_osds=3)
+            log = await th.thrash(io, steps=14)
+            assert log, "thrasher executed nothing"
+            summary = await th.settle_and_verify(io, timeout=300)
+            assert summary["acked_writes"] > 0
+            assert summary["fscked_stores"] == 4
+        finally:
+            await c.stop()
+    run(go())
+
+
+@pytest.mark.slow
+def test_thrasher_storm_deep(tmp_path):
+    """The acceptance storm: longer seeded run with partitions, OSD
+    kill/revive-with-remount and mon leader kills under continuing
+    writes; converges clean, all acked data readable, all stores
+    fsck clean."""
+    async def go():
+        stores = [_mk_store(tmp_path, i) for i in range(5)]
+        c = await Cluster(n_mons=3, n_osds=5, stores=stores,
+                          config=_thrash_cluster_config()).start()
+        try:
+            await c.client.pool_create("t", pg_num=16, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=240)
+            io = await c.client.open_ioctx("t")
+
+            def remount(i):
+                return _mk_store(tmp_path, i)
+
+            th = Thrasher(c, seed=99, store_factory=remount,
+                          min_live_osds=3)
+            await th.thrash(io, steps=70)
+            summary = await th.settle_and_verify(io, timeout=600)
+            assert summary["acked_writes"] > 10
+            assert summary["fscked_stores"] == 5
+        finally:
+            await c.stop()
+    run(go())
