@@ -9,6 +9,7 @@ through MonClient, mirroring the reference's command spellings:
     ... osd pool set <name> <var> <val>
     ... osd out <id> | osd in <id> | osd down <id>
     ... osd blocklist add|rm <entity> [expire-s] | osd blocklist ls
+    ... pg repair <pgid>
     ... osd map <pool> <object>
     ... osd erasure-code-profile set <name> k=2 m=1 ...
     ... config set <who> <name> <value> | config get <who> [<name>]
@@ -87,6 +88,10 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
             if len(w) > 4:
                 cmd["expire"] = float(w[4])
         return cmd, b""
+    if w[:2] == ["pg", "repair"]:
+        # ceph pg repair <pgid> — rewrite digest-mismatched replicas
+        # from the authoritative copy (mon messages the acting primary)
+        return {"prefix": "pg repair", "pgid": w[2]}, b""
     if w[:2] == ["osd", "reweight"]:
         return {"prefix": "osd reweight", "id": int(w[2]),
                 "weight": float(w[3])}, b""

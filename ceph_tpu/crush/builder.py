@@ -279,3 +279,67 @@ def add_multistep_rule(map_: CrushMap, root: int, steps: list[RuleStep],
                 steps=[RuleStep(OP_TAKE, root), *steps, RuleStep(OP_EMIT)])
     map_.rules[rid] = rule
     return rid
+
+
+# -- choose_args weight-set discipline --------------------------------------
+# The vectorized mapper's fused kernel carries at most 4 distinct
+# positive weights per bucket (crush/pallas_mapper.py MAX_CLASSES); a
+# weight-set where every item gets its own continuous weight — what an
+# unconstrained crush-compat balancer emits — forces every draw onto
+# the general ln-table path, measured ~35x slower (BENCH_r05
+# variants.choose_args). Quantizing to <=4 classes keeps balancer
+# output on the kernel path at negligible balance cost.
+KERNEL_WEIGHT_CLASSES = 4
+
+
+def choose_args_weight_classes(m: CrushMap) -> int:
+    """Worst-case distinct positive weights any single weight-set
+    vector carries (0 = no choose_args). Above KERNEL_WEIGHT_CLASSES
+    the map leaves the fused-kernel mapping path."""
+    worst = 0
+    for args in m.choose_args.values():
+        for arg in args.values():
+            for ws in arg.weight_set:
+                worst = max(worst,
+                            len({int(w) for w in ws if int(w) > 0}))
+    return worst
+
+
+def quantize_choose_args(m: CrushMap, key: int | None = None,
+                         max_classes: int = KERNEL_WEIGHT_CLASSES
+                         ) -> int:
+    """Snap every choose_args weight-set vector (of set ``key``, or
+    all sets) to at most ``max_classes`` distinct positive weights.
+
+    Deterministic quantile binning: the sorted positive weights are cut
+    into ``max_classes`` contiguous groups and every member takes its
+    group's mean (16.16 fixed point, like the raw weights). Zero/
+    negative weights (drained items) are preserved exactly — class
+    membership must not resurrect them. Returns the worst per-vector
+    class count after quantization (<= max_classes)."""
+    keys = [key] if key is not None else list(m.choose_args)
+    worst = 0
+    for k in keys:
+        for arg in m.choose_args.get(k, {}).values():
+            for ws in arg.weight_set:
+                pos = sorted({int(w) for w in ws if int(w) > 0})
+                if len(pos) > max_classes:
+                    # contiguous quantile groups over the DISTINCT
+                    # sorted weights; each maps to its group mean
+                    groups: dict[int, int] = {}
+                    n = len(pos)
+                    for gi in range(max_classes):
+                        lo = gi * n // max_classes
+                        hi = (gi + 1) * n // max_classes
+                        members = pos[lo:hi]
+                        if not members:
+                            continue
+                        mean = sum(members) // len(members)
+                        for w in members:
+                            groups[w] = mean
+                    for i, w in enumerate(ws):
+                        if int(w) > 0:
+                            ws[i] = groups[int(w)]
+                worst = max(worst,
+                            len({int(w) for w in ws if int(w) > 0}))
+    return worst

@@ -255,6 +255,8 @@ class OSDMonitor(PaxosService):
         objects = 0
         nbytes = 0
         degraded = 0
+        backfilling = 0
+        backfill = {"scanned": 0, "pushed": 0, "removed": 0}
         for st in self.pg_stats.values():
             s = st.get("state", "unknown")
             states[s] = states.get(s, 0) + 1
@@ -262,9 +264,17 @@ class OSDMonitor(PaxosService):
             nbytes += st.get("num_bytes", 0)
             if "degraded" in s or "undersized" in s or "down" in s:
                 degraded += 1
+            if "backfill" in s:
+                backfilling += 1
+            bf = st.get("backfill")
+            if bf:
+                for k in backfill:
+                    backfill[k] += bf.get(k, 0)
         return {"num_pgs": len(self.pg_stats), "states": states,
                 "num_objects": objects, "num_bytes": nbytes,
-                "degraded_pgs": degraded}
+                "degraded_pgs": degraded,
+                "backfilling_pgs": backfilling,
+                "backfill_progress": backfill}
 
     # -- commands ----------------------------------------------------------
     async def handle_command(self, cmd, inbl=b""):
@@ -296,6 +306,7 @@ class OSDMonitor(PaxosService):
             "osd setcrushmap": self._cmd_setcrushmap,
             "osd map": self._cmd_map,
             "pg dump": self._cmd_pg_dump,
+            "pg repair": self._cmd_pg_repair,
             "osd pg-upmap-items": self._cmd_pg_upmap_items,
             "osd rm-pg-upmap-items": self._cmd_rm_pg_upmap_items,
             "osd blocklist": self._cmd_blocklist,
@@ -718,3 +729,41 @@ class OSDMonitor(PaxosService):
         return 0, "", json.dumps({
             "summary": self.pg_summary(),
             "pg_stats": self.pg_stats}).encode()
+
+    async def _cmd_pg_repair(self, cmd, inbl):
+        """`ceph pg repair <pgid>` (ref: OSDMonitor prepare_command
+        "pg repair" -> MOSDScrub with repair=true): instruct the PG's
+        acting primary to run a repair scrub — digest-mismatched
+        replicas are rewritten from the authoritative copy, bad EC
+        shards rebuilt through decode. The mon computes the primary
+        from the map and messages it directly, like the reference's
+        mon->OSD scrub ordering."""
+        from ceph_tpu.osd.messages import MOSDPGRepair
+        from ceph_tpu.osd.types import pg_t
+        om = self.osdmap
+        try:
+            pg = pg_t.parse(cmd["pgid"])
+        except (KeyError, ValueError):
+            return -22, "usage: pg repair <pgid>", b""
+        pool = om.pools.get(pg.pool)
+        if pool is None or pg.seed >= pool.pg_num:
+            return -2, f"pg {cmd['pgid']} does not exist", b""
+        _up, _upp, _acting, actp = om.pg_to_up_acting_osds(
+            pg.pool, [pg.seed])
+        primary = int(actp[0])
+        if primary < 0 or not bool(om.is_up(np.asarray(primary))):
+            return -11, f"pg {cmd['pgid']} has no live primary", b""
+        ent = om.osd_addrs.get(primary)
+        if not ent:
+            return -11, f"osd.{primary} has no address", b""
+        from ceph_tpu.msg import EntityAddr
+        try:
+            await asyncio.wait_for(self.mon.msgr.send_message(
+                MOSDPGRepair(pgid=str(pg), epoch=om.epoch,
+                             from_osd=-1),
+                EntityAddr(ent[0], ent[1]), f"osd.{primary}"),
+                timeout=2.0)
+        except Exception as e:
+            return -11, f"cannot reach osd.{primary}: {e}", b""
+        return 0, f"instructing pg {pg} on osd.{primary} to repair", \
+            b""

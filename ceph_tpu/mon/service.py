@@ -108,11 +108,38 @@ class HealthMonitor(PaxosService):
                 checks["OSD_DOWN"] = {
                     "severity": "HEALTH_WARN",
                     "summary": f"{int(down.sum())} osds down"}
+        if om is not None and om.crush.choose_args:
+            # choose_args discipline (ref: the TPU mapper's fused
+            # kernel carrying <= 4 weight classes per bucket): a
+            # continuous weight-set silently drops every mapping onto
+            # the ~35x-slower general path — surface it instead
+            from ceph_tpu.crush.builder import (
+                KERNEL_WEIGHT_CLASSES, choose_args_weight_classes,
+            )
+            worst = choose_args_weight_classes(om.crush)
+            if worst > KERNEL_WEIGHT_CLASSES:
+                checks["CRUSH_CHOOSE_ARGS_CONTINUOUS"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": (
+                        f"crush choose_args carry {worst} distinct "
+                        f"weights per bucket (> "
+                        f"{KERNEL_WEIGHT_CLASSES}): placement runs on "
+                        f"the slow general path; quantize the "
+                        f"weight-sets (crush.builder."
+                        f"quantize_choose_args)")}
         pg = mon.osdmon.pg_summary()
         if pg.get("degraded_pgs"):
             checks["PG_DEGRADED"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{pg['degraded_pgs']} pgs degraded"}
+        if pg.get("backfilling_pgs"):
+            prog = pg.get("backfill_progress", {})
+            checks["PG_BACKFILLING"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (
+                    f"{pg['backfilling_pgs']} pgs backfilling "
+                    f"({prog.get('pushed', 0)} objects pushed, "
+                    f"{prog.get('scanned', 0)} scanned)")}
         slow = mon.osdmon.osd_slow_ops
         if slow:
             total = sum(slow.values())

@@ -24,14 +24,18 @@ from ceph_tpu.msg import Dispatcher, EntityAddr, Keyring, Messenger, Policy
 from ceph_tpu.os_.objectstore import MemStore, ObjectStore
 from ceph_tpu.osd.ec_pg import ECPG
 from ceph_tpu.osd.messages import (
-    MOSDECSubOpRead, MOSDECSubOpReadReply, MOSDECSubOpWrite,
-    MOSDECSubOpWriteReply, MOSDMapPing, MOSDOp, MOSDPGInfo, MOSDPGPull,
-    MOSDPGPush, MOSDPGPushReply, MOSDPGQuery, MOSDPing, MOSDRepOp,
+    MBackfillReserve, MOSDECSubOpRead, MOSDECSubOpReadReply,
+    MOSDECSubOpWrite,
+    MOSDECSubOpWriteReply, MOSDMapPing, MOSDOp, MOSDPGBackfill,
+    MOSDPGBackfillReply, MOSDPGInfo, MOSDPGPull,
+    MOSDPGPush, MOSDPGPushReply, MOSDPGQuery, MOSDPGRepair, MOSDPGScan,
+    MOSDPGScanReply, MOSDPing, MOSDRepOp,
     MOSDRepOpReply, MOSDRepScrub, MOSDRepScrubMap, MPGCleanNotice, PING,
     PING_REPLY,
 )
 from ceph_tpu.osd.pg import PG
-from ceph_tpu.osd.types import pg_t
+from ceph_tpu.osd.recovery import AsyncReserver, RecoveryThrottle
+from ceph_tpu.osd.types import MAX_OID, pg_t
 from ceph_tpu.utils.logging import get_logger
 from ceph_tpu.utils.op_tracker import OpTracker
 
@@ -76,6 +80,47 @@ class OSD(Dispatcher):
         self._slow_reported = 0     # last slow-op count sent monward
         self.asok = None
         self._asok_dir = cfg.get("admin_socket_dir")
+        # backfill reservations + recovery QoS (ref: AsyncReserver /
+        # osd_max_backfills; the mClock-analog throttle): local slots
+        # bound how many PGs this OSD backfills AS PRIMARY, remote
+        # slots how many it accepts AS TARGET, and every recovery push
+        # waits on the shared throttle so client ops keep priority
+        max_backfills = cfg.get("osd_max_backfills", 1)
+        self.local_reserver = AsyncReserver(max_backfills)
+        self.remote_reserver = AsyncReserver(max_backfills)
+        self.recovery_throttle = RecoveryThrottle(
+            max_active=cfg.get("osd_recovery_max_active", 8),
+            bytes_per_s=cfg.get("osd_recovery_max_bytes", 0))
+
+    def backfill_toofull(self) -> bool:
+        """Reject incoming backfill reservations past the full ratio
+        (ref: OSDService::check_backfill_full -> backfill_toofull).
+        Only meaningful when a capacity is configured — the stores
+        this framework runs on have no intrinsic size. The store sweep
+        is O(objects), and rejected primaries re-request every
+        ~osd_backfill_retry_interval, so the verdict is cached for a
+        second instead of recomputed per request."""
+        cap = int(self.config.get("osd_capacity_bytes", 0))
+        if cap <= 0:
+            return False
+        now = asyncio.get_event_loop().time()
+        cached = getattr(self, "_toofull_cache", None)
+        if cached is not None and now - cached[0] < 1.0:
+            return cached[1]
+        ratio = float(self.config.get("osd_backfill_full_ratio", 0.85))
+        used = 0
+        try:
+            for cid in self.store.list_collections():
+                for oid in self.store.list_objects(cid):
+                    try:
+                        used += self.store.stat(cid, oid)
+                    except Exception:
+                        pass
+        except Exception:
+            return False
+        full = used >= cap * ratio
+        self._toofull_cache = (now, full)
+        return full
 
     # -- service facade used by PG ----------------------------------------
     def next_tid(self) -> int:
@@ -163,6 +208,21 @@ class OSD(Dispatcher):
             self.asok.register(
                 "config show", lambda: dict(self.config),
                 "daemon configuration")
+            self.asok.register(
+                "backfill status", lambda: {
+                    "local_reservations": self.local_reserver.dump(),
+                    "remote_reservations": self.remote_reserver.dump(),
+                    "throttle": self.recovery_throttle.dump(),
+                    "pgs": {p: {"state": pg.state,
+                                "last_backfill": pg.last_backfill,
+                                **pg.backfill_stats,
+                                "targets": {
+                                    str(o): wm for o, wm in
+                                    pg.backfill_targets.items()}}
+                            for p, pg in self.pgs.items()
+                            if pg.backfill_targets or
+                            pg.last_backfill != MAX_OID}},
+                "backfill reservations, throttle and per-pg progress")
             await self.asok.start()
         self._hb_task = asyncio.ensure_future(self._hb_loop())
         self._stats_task = asyncio.ensure_future(self._stats_loop())
@@ -181,6 +241,8 @@ class OSD(Dispatcher):
                 pg._worker.cancel()
             if pg._peering_task:
                 pg._peering_task.cancel()
+            if pg._backfill_task:
+                pg._backfill_task.cancel()
         if self.asok:
             await self.asok.stop()
         await self.msgr.shutdown()
@@ -391,6 +453,40 @@ class OSD(Dispatcher):
             pg = self._pg_for(msg.pgid)
             if pg is not None:
                 pg.handle_clean_notice(msg)
+            return True
+        if isinstance(msg, MOSDPGScan):
+            # create=True: a scan can beat the target's own map
+            # consume to a PG it is about to host
+            pg = self._pg_for(msg.pgid, create=True)
+            if pg is not None:
+                pg.handle_pg_scan(msg)
+            return True
+        if isinstance(msg, MOSDPGScanReply):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                pg.handle_scan_reply(msg)
+            return True
+        if isinstance(msg, MOSDPGBackfill):
+            pg = self._pg_for(msg.pgid, create=True)
+            if pg is not None:
+                pg.handle_backfill(msg)
+            return True
+        if isinstance(msg, MOSDPGBackfillReply):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None:
+                pg.handle_backfill_reply(msg)
+            return True
+        if isinstance(msg, MBackfillReserve):
+            pg = self._pg_for(msg.pgid, create=True)
+            if pg is not None:
+                pg.handle_backfill_reserve(msg)
+            return True
+        if isinstance(msg, MOSDPGRepair):
+            pg = self._pg_for(msg.pgid)
+            if pg is not None and pg.is_primary():
+                # ref: the PG_REPAIR scrub flavor: detect + rewrite
+                # from the authoritative copy, then re-verify
+                asyncio.ensure_future(pg.scrubber.repair())
             return True
         if isinstance(msg, MOSDRepScrub):
             pg = self._pg_for(msg.pgid)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import zlib
 
 import numpy as np
 
@@ -124,17 +125,24 @@ class ECPG(PG):
             self._subread_waiters.pop(tid, None)
 
     async def _gather(self, oid: str, first: int, count: int,
-                      version: eversion):
+                      version: eversion,
+                      exclude: frozenset = frozenset()):
         """Collect this stripe range's chunks from live, fresh shards
         and reconstruct data chunks 0..k-1 -> (count, k, C) uint8.
 
         Shards whose object version differs (missed writes / stale
         after outage) are excluded; decode fills the gaps
-        (ref: ECCommon::ReadPipeline get_remaining_shards)."""
+        (ref: ECCommon::ReadPipeline get_remaining_shards).
+        ``exclude``: acting POSITIONS never used as sources — a shard
+        being rebuilt (suspect by definition: missing, stale, or
+        scrub-flagged corrupt) must not contribute to its own
+        reconstruction."""
         C = self.sinfo.chunk_size
         off, ln = first * C, count * C
         avail: dict[int, np.ndarray] = {}
         for pos, osd_id in enumerate(self.acting):
+            if pos in exclude:
+                continue
             # stop once decodable: all data shards, or any k once the
             # data positions have been tried (MDS property)
             if set(range(self.k)) <= set(avail) or \
@@ -189,6 +197,18 @@ class ECPG(PG):
         reqid = (m.src, getattr(m.conn, "peer_session", 0), m.tid)
         store = self.osd.store
         oid = m.oid
+        ec_mutating = {OSD_OP_WRITE, OSD_OP_WRITEFULL,
+                       OSD_OP_TRUNCATE, OSD_OP_ZERO, OSD_OP_DELETE,
+                       OSD_OP_SETXATTR, OSD_OP_OMAP_SET,
+                       OSD_OP_OMAP_RM}
+        if self._backfill_blocked(
+                oid, any(c in ec_mutating for c in m.op_codes)):
+            # same degraded-object gate as the replicated path: ops on
+            # objects above this primary's own watermark park; READS
+            # inside the in-flight scan range stay served (they never
+            # mutate, so they cannot race the watermark advance)
+            await self._reply(m, -11, b"", {})
+            return
         if oid in self.my_missing:
             # this primary's own shard of the object is still being
             # recovered: the op must neither see -ENOENT nor mutate
@@ -355,7 +375,7 @@ class ECPG(PG):
         version = eversion(self.epoch, self.last_user_version)
         entry = self.pg_log.add(
             version, oid, OP_DELETE if deleted else OP_MODIFY)
-        self.pg_log.trim()
+        self.pg_log.trim(keep=self._trim_keep())
         self._meta_txn_store()
         if deleted:
             return await self._fan_out_delete(oid, entry)
@@ -390,17 +410,33 @@ class ECPG(PG):
         # fan the per-shard sub-ops out (ref: ECBackend sub writes)
         tid = self.osd.next_tid()
         entry_blob = entry.encode()
+        whole = write_full is not None
         per_osd: dict[int, MOSDECSubOpWrite] = {}
         for pos, osd_id in enumerate(self.acting):
             if osd_id < 0 or not self.osd.osd_is_up(osd_id):
                 continue                   # hole: recovery rebuilds it
+            if not self._should_send_repop(osd_id, oid):
+                continue    # backfill target above its watermark: the
+                #             scan rebuilds this shard; a sub-op now
+                #             would materialize a partial object
             shard = data_chunks[:, pos, :] if pos < self.k else \
                 parity[:, pos - self.k, :]
+            shard_bytes = shard.tobytes()
+            attrs = dict(attrs_delta)
+            # per-shard write-time checksum (ref: ECBackend hinfo):
+            # valid only when this write covers the WHOLE object (a
+            # partial overwrite can't know the full-shard crc without
+            # reading the rest, so it invalidates it — exactly the
+            # reference's append-only hinfo discipline). Scrub repair
+            # uses it to LOCATE a corrupt shard, which the code alone
+            # cannot do at m=1.
+            attrs["_hcrc"] = zlib.crc32(shard_bytes).to_bytes(
+                4, "little") if whole else b""
             per_osd[osd_id] = MOSDECSubOpWrite(
                 tid=tid, epoch=self.epoch, pgid=self.cid, oid=oid,
-                first_stripe=first, data=shard.tobytes(),
+                first_stripe=first, data=shard_bytes,
                 truncate_stripes=trunc_stripes, size=size,
-                remove=False, attrs=attrs_delta, omap=omap_delta,
+                remove=False, attrs=attrs, omap=omap_delta,
                 omap_rm=list(omap_rm), log_entry=entry_blob)
         committed = await self._fan_out_subops(tid, per_osd)
         if committed < self.k:
@@ -416,7 +452,8 @@ class ECPG(PG):
         tid = self.osd.next_tid()
         per_osd = {}
         for osd_id in set(o for o in self.acting if o >= 0):
-            if self.osd.osd_is_up(osd_id):
+            if self.osd.osd_is_up(osd_id) and \
+                    self._should_send_repop(osd_id, oid):
                 per_osd[osd_id] = MOSDECSubOpWrite(
                     tid=tid, epoch=self.epoch, pgid=self.cid, oid=oid,
                     first_stripe=0, data=b"", truncate_stripes=0,
@@ -487,7 +524,7 @@ class ECPG(PG):
         if not local:
             entry = LogEntry.decode(m.log_entry)
             self.pg_log.append(entry)
-            self.pg_log.trim()
+            self.pg_log.trim(keep=self._trim_keep())
             self.last_user_version = max(self.last_user_version,
                                          entry.version.v)
         self._meta_txn(t)
@@ -586,24 +623,70 @@ class ECPG(PG):
                              size: int, apply_local: bool = False,
                              push_to: int | None = None) -> bytes:
         count = self.sinfo.object_stripes(size) or 1
-        data_chunks = await self._gather(oid, 0, count, ver)
+        # never source the position being rebuilt: its stored bytes
+        # are missing, stale, or corrupt — rebuilding it FROM itself
+        # would faithfully reproduce the damage
+        data_chunks = await self._gather(oid, 0, count, ver,
+                                         exclude=frozenset({shard}))
         if shard < self.k:
             shard_bytes = data_chunks[:, shard, :].tobytes()
         else:
             parity = np.asarray(self.ec.encode_batch(data_chunks))
             shard_bytes = parity[:, shard - self.k, :].tobytes()
         if apply_local:
+            import zlib as _zlib
             t = Transaction()
             t.remove(self.cid, oid)
             t.write(self.cid, oid, 0, shard_bytes)
             attrs = {"_v": _vblob(ver),
-                     "_size": size.to_bytes(8, "little")}
+                     "_size": size.to_bytes(8, "little"),
+                     "_hcrc": _zlib.crc32(shard_bytes).to_bytes(
+                         4, "little")}
             t.setattrs(self.cid, oid, attrs)
             self.osd.store.queue_transaction(t)
         return shard_bytes
 
     def make_push(self, oid: str, target: int | None = None):
         raise NotImplementedError("EC pushes are built asynchronously")
+
+    async def _build_backfill_push(self, oid: str, target: int):
+        """EC recovery/backfill push: the target POSITION's shard,
+        regenerated from any k live fresh shards (ref: ECBackend
+        handle_recovery_read_complete). exists=False when the object
+        is gone everywhere (the target reaps its stale shard)."""
+        from ceph_tpu.osd.messages import MOSDPGPush
+        try:
+            pos = self.acting.index(target)
+        except ValueError:
+            return None
+        try:
+            ver, size = await self._authoritative_meta(oid)
+            if size is None:
+                return MOSDPGPush(
+                    pgid=self.cid, epoch=self.epoch, oid=oid,
+                    version_epoch=0, version_v=0, exists=False,
+                    data=b"", attrs={}, omap={},
+                    from_osd=self.osd.whoami)
+            shard_bytes = await self._rebuild_shard(oid, pos, ver, size)
+            omap = {}
+            try:
+                omap = dict(self.osd.store.omap_get(self.cid, oid))
+            except StoreError:
+                pass
+            import zlib as _zlib
+            return MOSDPGPush(
+                pgid=self.cid, epoch=self.epoch, oid=oid,
+                version_epoch=ver.epoch, version_v=ver.v,
+                exists=True, data=shard_bytes,
+                attrs={"_v": _vblob(ver),
+                       "_size": size.to_bytes(8, "little"),
+                       "_hcrc": _zlib.crc32(shard_bytes).to_bytes(
+                           4, "little")},
+                omap=omap, from_osd=self.osd.whoami)
+        except Exception as e:
+            log.dout(1, f"pg {self.pgid} ec push {oid}->osd.{target} "
+                        f"build failed: {e}")
+            return None
 
     async def _recover(self) -> None:
         """Regenerate each missing peer shard from k live shards
@@ -612,54 +695,25 @@ class ECPG(PG):
             return
         if any(self.peer_missing.values()):
             self.state = "recovering"
-        from ceph_tpu.osd.messages import MOSDPGPush
         sends: list = []
         for o, missing in list(self.peer_missing.items()):
             if not self.osd.osd_is_up(o):
                 continue
-            try:
-                pos = self.acting.index(o)
-            except ValueError:
+            if o not in self.acting:
                 missing.clear()
                 continue
             for oid in list(missing):
-                try:
-                    ver, size = await self._authoritative_meta(oid)
-                    if size is None:
-                        push = MOSDPGPush(
-                            pgid=self.cid, epoch=self.epoch, oid=oid,
-                            version_epoch=0, version_v=0, exists=False,
-                            data=b"", attrs={}, omap={},
-                            from_osd=self.osd.whoami)
-                    else:
-                        shard_bytes = await self._rebuild_shard(
-                            oid, pos, ver, size)
-                        omap = {}
-                        try:
-                            omap = {
-                                k: v for k, v in
-                                self.osd.store.omap_get(
-                                    self.cid, oid).items()}
-                        except StoreError:
-                            pass
-                        push = MOSDPGPush(
-                            pgid=self.cid, epoch=self.epoch, oid=oid,
-                            version_epoch=ver.epoch, version_v=ver.v,
-                            exists=True, data=shard_bytes,
-                            attrs={"_v": _vblob(ver),
-                                   "_size": size.to_bytes(8, "little")},
-                            omap=omap, from_osd=self.osd.whoami)
-                except Exception as e:
-                    log.dout(1, f"pg {self.pgid} ec push {oid}->{o} "
-                                f"build failed: {e}")
-                    continue
-                sends.append((o, oid, push))
+                push = await self._build_backfill_push(oid, o)
+                if push is not None:
+                    sends.append((o, oid, push))
         # a shard only counts as recovered once ACKED — the gate is
         # shared with the replicated path (PG._send_gated_pushes)
         if await self._send_gated_pushes(sends):
             return
         if not any(self.peer_missing.values()) and \
                 self.state in ("active", "recovering"):
+            if self._maybe_start_backfill():
+                return          # clean is decided when backfill ends
             if len(self.live_acting()) >= self.pool.size:
                 self._mark_clean()
             else:

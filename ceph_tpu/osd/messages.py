@@ -192,11 +192,20 @@ class MOSDPGInfo(Message):
     MOSDPGNotify): a map change moved the PG off this OSD, and the new
     primary — possibly a fresh instance with no history — must learn
     this stray exists before activating empty. ``intervals`` ships the
-    sender's past_intervals (JSON) for the primary's coverage gate."""
+    sender's past_intervals (JSON) for the primary's coverage gate.
+    ``last_backfill`` is the sender's persisted backfill watermark
+    (ref: pg_info_t.last_backfill) — MAX_OID on every complete
+    replica; anything lower marks the sender a mid-backfill target
+    whose store only holds objects <= the watermark."""
 
     TYPE = 171
     FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32"),
-              ("log", "blob"), ("notify", "u8"), ("intervals", "str")]
+              ("log", "blob"), ("notify", "u8"), ("intervals", "str"),
+              ("last_backfill", "str"),
+              # authoritative log head at the sender's last persisted
+              # watermark advance: the resume-safety token (see
+              # MOSDPGBackfill)
+              ("backfill_at_epoch", "u32"), ("backfill_at_v", "u64")]
 
 
 @register
@@ -278,3 +287,94 @@ class MOSDMapPingReply(Message):
 
     TYPE = 182
     FIELDS = [("tid", "u64"), ("epoch", "u32"), ("from_osd", "s32")]
+
+
+# -- backfill (ref: src/messages/MOSDPGScan.h + MOSDPGBackfill.h) ----------
+
+@register
+class MOSDPGScan(Message):
+    """Backfill collection scan request (ref: MOSDPGScan GET_DIGEST):
+    list your sorted object names in (begin, end] with their versions.
+    ``end`` == MAX_OID means unbounded; ``limit`` > 0 pages the reply
+    (the sender advances ``begin`` to the reply's ``up_to``)."""
+
+    TYPE = 183
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("tid", "u64"),
+              ("begin", "str"), ("end", "str"), ("limit", "u32"),
+              ("from_osd", "s32")]
+
+
+@register
+class MOSDPGScanReply(Message):
+    """Scan digest (ref: MOSDPGScan DIGEST / BackfillInterval):
+    oid -> 12-byte version blob (epoch u32le + v u64le, the _v xattr
+    layout). ``up_to`` is the exclusive-upper bound actually covered:
+    every object the sender holds in (begin, up_to] is listed — MAX_OID
+    when the collection is exhausted."""
+
+    TYPE = 184
+    FIELDS = [("pgid", "str"), ("tid", "u64"), ("from_osd", "s32"),
+              ("objects", "map:str:blob"), ("up_to", "str")]
+
+
+BACKFILL_OP_RESET = 1      # primary -> target: you are a backfill
+#                            target; persist last_backfill = MIN
+BACKFILL_OP_PROGRESS = 2   # primary -> target: watermark advanced
+BACKFILL_OP_FINISH = 3     # primary -> target: complete; adopt the
+#                            shipped log, persist last_backfill = MAX
+
+
+@register
+class MOSDPGBackfill(Message):
+    """Backfill watermark control (ref: MOSDPGBackfill PROGRESS/
+    FINISH): the target persists ``last_backfill`` so a restart
+    resumes the scan instead of starting over. FINISH additionally
+    carries the primary's pg log so the target's log is continuous
+    with the authoritative history it now fully holds."""
+
+    TYPE = 185
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("tid", "u64"),
+              ("op", "u8"), ("last_backfill", "str"), ("log", "blob"),
+              # the authoritative head this watermark is valid AT: on
+              # rejoin, resuming from the watermark is only sound if
+              # the authoritative log is still continuous with this
+              # point (everything that changed below the watermark
+              # since is then derivable from the retained log); else
+              # the target must rescan from MIN
+              ("at_epoch", "u32"), ("at_v", "u64"),
+              ("from_osd", "s32")]
+
+
+@register
+class MOSDPGBackfillReply(Message):
+    TYPE = 186
+    FIELDS = [("pgid", "str"), ("tid", "u64"), ("op", "u8"),
+              ("result", "s32"), ("from_osd", "s32")]
+
+
+RESERVE_REQUEST = 1
+RESERVE_GRANT = 2
+RESERVE_REJECT = 3         # no free slot: retry later (backfill_wait)
+RESERVE_TOOFULL = 4        # target past its full ratio (backfill_toofull)
+RESERVE_RELEASE = 5
+
+
+@register
+class MBackfillReserve(Message):
+    """Remote backfill reservation (ref: MBackfillReserve + the OSD's
+    AsyncReserver): the primary holds a LOCAL slot and asks each
+    target for a REMOTE slot before scanning, capping concurrent
+    backfills per OSD at osd_max_backfills."""
+
+    TYPE = 187
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("tid", "u64"),
+              ("op", "u8"), ("from_osd", "s32")]
+
+
+@register
+class MOSDPGRepair(Message):
+    """Mon -> acting primary: run a repair scrub on this PG (ref: the
+    mon's `ceph pg repair` -> MOSDScrub(repair=true) path)."""
+
+    TYPE = 188
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32")]

@@ -31,8 +31,11 @@ import json
 
 from ceph_tpu.os_.objectstore import StoreError, Transaction
 from ceph_tpu.osd.messages import (
-    MOSDOp, MOSDOpReply, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
-    MOSDPGPushReply, MOSDPGQuery, MOSDRepOp, MOSDRepOpReply,
+    BACKFILL_OP_FINISH, BACKFILL_OP_PROGRESS, BACKFILL_OP_RESET,
+    MBackfillReserve, MOSDOp, MOSDOpReply, MOSDPGBackfill,
+    MOSDPGBackfillReply, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
+    MOSDPGPushReply, MOSDPGQuery, MOSDPGScan, MOSDPGScanReply,
+    MOSDRepOp, MOSDRepOpReply,
     MWatchNotify, OSD_OP_DELETE,
     OSD_OP_GETXATTR, OSD_OP_NOTIFY, OSD_OP_NOTIFY_ACK, OSD_OP_OMAP_GET,
     OSD_OP_OMAP_SET, OSD_OP_PGLS,
@@ -40,10 +43,13 @@ from ceph_tpu.osd.messages import (
     OSD_OP_STAT,
     OSD_OP_TRUNCATE, OSD_OP_UNWATCH, OSD_OP_WATCH, OSD_OP_WRITE,
     OSD_OP_WRITEFULL, OSD_OP_ZERO,
+    RESERVE_GRANT, RESERVE_REJECT, RESERVE_RELEASE, RESERVE_REQUEST,
+    RESERVE_TOOFULL,
 )
 from ceph_tpu.osd.pg_log import OP_DELETE, OP_MODIFY, LogEntry, PGLog, \
     eversion
-from ceph_tpu.osd.types import pg_t
+from ceph_tpu.osd.recovery import PERF as RECOVERY_PERF
+from ceph_tpu.osd.types import MAX_OID, MIN_OID, pg_t
 from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("osd")
@@ -93,6 +99,43 @@ class PG:
         self.past_intervals: list[list] = []
         self.interval_start = 0           # epoch current acting set began
         self.last_epoch_clean = 0
+        # backfill (ref: pg_info_t.last_backfill + PeeringState's
+        # backfill machinery). ``last_backfill`` is THIS instance's
+        # persisted watermark: the store holds every object <= it (in
+        # sorted-name order); MAX_OID = complete. ``backfill_targets``
+        # is primary-side state: acting peers whose logs are NOT
+        # continuous with the authoritative log (or who reported an
+        # incomplete watermark) -> their current watermark; log-delta
+        # recovery cannot serve them, the scan/push machinery must.
+        self.last_backfill = MAX_OID
+        # the authoritative head last_backfill was last valid AT (ref:
+        # the role of pg_info_t.last_update for backfill peers):
+        # resuming from the watermark after a rejoin is only sound if
+        # the authoritative log is still continuous with this point —
+        # then every sub-watermark change since is derivable from the
+        # retained log; otherwise the scan must restart from MIN.
+        self.backfill_at = eversion()
+        self.backfill_targets: dict[int, str] = {}
+        self.peer_last_backfill: dict[int, str] = {}
+        self.peer_backfill_at: dict[int, eversion] = {}
+        self._backfill_task: asyncio.Task | None = None
+        # the (wm, end] name range a backfill scan is comparing RIGHT
+        # NOW: mutations inside it park with -EAGAIN so a write — or a
+        # brand-new object, invisible to the batch snapshot — cannot
+        # slip between the scan's version read and the watermark
+        # advance (the reference blocks ops on objects being
+        # backfilled). None = no scan in flight.
+        self._backfill_inflight: tuple[str, str] | None = None
+        self._backfill_waiters: dict[int, asyncio.Future] = {}
+        # reservation nonces: the tid under which the target granted
+        # its remote slot (target side) / each target granted ours
+        # (primary side). A RELEASE only frees the grant whose tid it
+        # carries — the fault layer duplicates messages by design, and
+        # a duplicated release must not free a RE-acquired grant.
+        self._remote_grant_tid = 0
+        self._reserve_tids: dict[int, int] = {}
+        self.backfill_stats = {"scanned": 0, "pushed": 0,
+                               "removed": 0, "resumed_from": ""}
         # peering scratch
         self.peer_logs: dict[int, PGLog] = {}
         self.peer_missing: dict[int, dict[str, LogEntry]] = {}
@@ -163,6 +206,9 @@ class PG:
             self.past_intervals = meta.get("past_intervals", [])
             self.interval_start = meta.get("interval_start", 0)
             self.last_epoch_clean = meta.get("last_epoch_clean", 0)
+            self.last_backfill = meta.get("last_backfill", MAX_OID)
+            self.backfill_at = eversion(
+                *meta.get("backfill_at", (0, 0)))
 
     def _meta_txn(self, t: Transaction) -> Transaction:
         t.omap_setkeys(self.cid, PGMETA, {
@@ -171,8 +217,23 @@ class PG:
                 "past_intervals": self.past_intervals,
                 "interval_start": self.interval_start,
                 "last_epoch_clean": self.last_epoch_clean,
+                "last_backfill": self.last_backfill,
+                "backfill_at": list(self.backfill_at),
             }).encode()})
         return t
+
+    def _trim_keep(self) -> int:
+        """Retained pg-log length (ref: osd_min_pg_log_entries). The
+        log tail this leaves behind is the log-delta recovery horizon:
+        a peer whose head predates it must be backfilled."""
+        return int(self.osd.config.get("osd_min_pg_log_entries", 1000))
+
+    def _backfill_enabled(self) -> bool:
+        """Escape hatch for the seed-reproduction regression test
+        (tests/test_backfill.py): with backfill off, a peer past the
+        log horizon silently gets only the retained log delta — the
+        exact data-loss hole backfill exists to close."""
+        return bool(self.osd.config.get("osd_backfill", True))
 
     @property
     def scrubber(self):
@@ -185,7 +246,11 @@ class PG:
         return self.primary == self.osd.whoami
 
     def role_active(self) -> bool:
-        return self.state in ("active", "recovering", "clean")
+        # backfill runs ONLINE: client ops keep flowing while the scan
+        # copies history (only the per-object gates in _execute park)
+        return self.state in ("active", "recovering", "clean",
+                              "backfilling", "backfill_wait",
+                              "backfill_toofull")
 
     # -- interval changes --------------------------------------------------
     def advance(self, up: list[int], acting: list[int], primary: int,
@@ -220,6 +285,13 @@ class PG:
         self.epoch = epoch
         if not changed and self.role_active():
             return
+        if changed:
+            # interval actually ended: stop any backfill run and free
+            # its reservations. NOT on mere epoch bumps — a replica
+            # falls through here on every unrelated map change, and
+            # releasing its remote reservation slot mid-scan would let
+            # a second primary in past osd_max_backfills.
+            self._cancel_backfill()
         if self._peering_task:
             self._peering_task.cancel()
             self._peering_task = None
@@ -247,7 +319,38 @@ class PG:
                         pgid=self.cid, epoch=epoch,
                         from_osd=self.osd.whoami,
                         log=self.pg_log.encode(), notify=1,
-                        intervals=json.dumps(self.past_intervals))))
+                        intervals=json.dumps(self.past_intervals),
+                        last_backfill=self.last_backfill,
+                        backfill_at_epoch=self.backfill_at.epoch,
+                        backfill_at_v=self.backfill_at.v)))
+
+    def _cancel_backfill(self) -> None:
+        """Interval change / teardown: stop the scan and free every
+        reservation (the target's persisted watermark survives — the
+        next primary resumes from it, which is the whole point)."""
+        if self._backfill_task is not None:
+            self._backfill_task.cancel()
+            self._backfill_task = None
+        self._backfill_inflight = None
+        self.osd.local_reserver.cancel(self.cid)
+        # target-side slot too: a dead primary never sends RELEASE, but
+        # its death moves the map, which lands here on every target
+        self.osd.remote_reserver.cancel(self.cid)
+        for o in list(self.backfill_targets):
+            if o != self.osd.whoami and self.osd.osd_is_up(o):
+                asyncio.ensure_future(self._send_reserve_op(
+                    o, RESERVE_RELEASE,
+                    self._reserve_tids.get(o, 0)))
+        self.backfill_targets = {}
+
+    async def _send_reserve_op(self, osd: int, op: int,
+                               tid: int = 0) -> None:
+        try:
+            await self.osd.send_osd(osd, MBackfillReserve(
+                pgid=self.cid, epoch=self.epoch, tid=tid, op=op,
+                from_osd=self.osd.whoami))
+        except Exception:
+            pass          # peer death releases its slots anyway
 
     def live_acting(self) -> list[int]:
         return [o for o in self.acting
@@ -349,12 +452,55 @@ class PG:
             self.state = "peering"    # retry once the grant's map lands
             self.osd.request_repeer(self, delay=0.3)
             return
-        # authoritative log: max head (ref: find_best_info)
-        best_osd = self.osd.whoami
-        best = self.pg_log
-        for o, plog in self.peer_logs.items():
+        # authoritative log: max head (ref: find_best_info) — among
+        # COMPLETE candidates only (last_backfill == MAX): a mid-
+        # backfill peer's log may be current while its store lacks most
+        # objects, so its info must never win authority (ref:
+        # find_best_info's infos-with-incomplete-last_backfill skip).
+        # With every candidate incomplete there is no authoritative
+        # store anywhere: block rather than activate and serve holes.
+        backfill_on = self._backfill_enabled()
+        infos = [(self.osd.whoami, self.pg_log, self.last_backfill)]
+        infos += [(o, plog, self.peer_last_backfill.get(o, MAX_OID))
+                  for o, plog in self.peer_logs.items()]
+        if backfill_on:
+            complete = [c for c in infos if c[2] == MAX_OID]
+            if not complete:
+                log.dout(1, f"pg {self.pgid} incomplete: every "
+                            f"candidate is mid-backfill")
+                self.state = "peering"
+                self.osd.request_repeer(self, delay=1.0)
+                return
+        else:
+            complete = infos
+        best_osd, best, _ = complete[0]
+        for o, plog, _lb in complete[1:]:
             if plog.head > best.head:
                 best, best_osd = plog, o
+        if backfill_on and \
+                best.head < max(c[1].head for c in infos):
+            # the newest log lives ONLY on a mid-backfill candidate:
+            # adopting the best complete log would roll back writes
+            # acknowledged in a later interval (the incomplete holder
+            # has them for oids <= its watermark; the dead primary had
+            # the rest). Upstream calls this 'down' — block until the
+            # missing holder returns, never silently discard.
+            log.dout(1, f"pg {self.pgid} down: newest log only on an "
+                        f"incomplete (mid-backfill) peer")
+            self.state = "peering"
+            self.osd.request_repeer(self, delay=1.0)
+            return
+        if backfill_on and best_osd != self.osd.whoami and \
+                not best.continuous_with(self.pg_log.head) and \
+                self.last_backfill == MAX_OID:
+            # THIS osd's own history predates the authoritative log's
+            # tail (fresh store, or a rejoin from past the horizon)
+            # AND the map made it primary: its missing set below is
+            # incomplete by construction, so demote its own watermark —
+            # the self-backfill block under it rebuilds the store from
+            # a complete peer before anything is served. (A persisted
+            # watermark < MAX is kept: that is resume progress.)
+            self.last_backfill = MIN_OID
         if best_osd != self.osd.whoami:
             # merge may ADD to my_missing; leftovers from an earlier
             # interval whose pulls failed must stay until recovered —
@@ -363,6 +509,22 @@ class PG:
             self.my_missing.update(self.pg_log.merge(best))
             t = self._meta_txn(Transaction())
             self.osd.store.queue_transaction(t)
+        if backfill_on and self.last_backfill != MAX_OID:
+            # our own resume-safety check (mirror of the per-target
+            # one below): entries newer than our backfill_at with oids
+            # under our watermark are changes we provably missed —
+            # pull them as log-delta; if the log can no longer prove
+            # the sub-watermark region, restart our scan from MIN
+            if self.pg_log.continuous_with(self.backfill_at):
+                for oid, e in self.pg_log.newest_per_object().items():
+                    if oid <= self.last_backfill and \
+                            e.version > self.backfill_at and \
+                            self._version_blob(oid) != \
+                            e.version.epoch.to_bytes(4, "little") + \
+                            e.version.v.to_bytes(8, "little"):
+                        self.my_missing[oid] = e
+            else:
+                self.last_backfill = MIN_OID
         if self.my_missing:
             # pull objects the primary itself lacks. Source selection
             # matters: a peer whose log never saw the object would stay
@@ -400,20 +562,78 @@ class PG:
                 self.state = "peering"
                 self.osd.request_repeer(self, delay=0.5)
                 return
+        if backfill_on and self.last_backfill != MAX_OID:
+            # THIS primary is itself mid-backfill (it was a target when
+            # the map promoted it — there is no pg_temp here to prevent
+            # that): before serving anything it must finish its own
+            # copy, pulling the scan from a complete peer. Runs inline
+            # in peering (ops queue behind role_active) — the working
+            # sets this framework runs keep it short.
+            src = best_osd if best_osd != self.osd.whoami else next(
+                (o for o, _pl, _lb in complete
+                 if o != self.osd.whoami and self.osd.osd_is_up(o)),
+                None)
+            if src is None or not await self._backfill_self(src):
+                self.state = "peering"
+                self.osd.request_repeer(self, delay=0.5)
+                return
         self.last_user_version = max(self.last_user_version,
                                      self.pg_log.head.v)
         # per-peer missing sets (ref: GetMissing) — acting peers only:
         # prior strays answered queries but take no recovery pushes
-        # (they leave the set at the next clean interval)
-        self.peer_missing = {
-            o: plog.missing_vs(self.pg_log)
-            for o, plog in self.peer_logs.items() if o in self.acting}
+        # (they leave the set at the next clean interval). A peer whose
+        # log is NOT continuous with the authoritative log (its head
+        # predates our tail — it missed more history than the retained
+        # log can describe) or who reports an incomplete last_backfill
+        # becomes a BACKFILL TARGET: its missing set cannot be derived
+        # from the log, the scan machinery rebuilds it. Its log-derived
+        # missing is kept only for oids <= its watermark (objects it is
+        # supposed to hold current — e.g. it missed repops while briefly
+        # down mid-backfill); everything above is the scan's job.
+        self.backfill_targets = {}
+        self.peer_missing = {}
+        for o, plog in self.peer_logs.items():
+            if o not in self.acting:
+                continue
+            missing = plog.missing_vs(self.pg_log)
+            lb = self.peer_last_backfill.get(o, MAX_OID)
+            if backfill_on and \
+                    (lb != MAX_OID or
+                     not self.pg_log.continuous_with(plog.head)):
+                at = self.peer_backfill_at.get(o, eversion())
+                if lb != MAX_OID and \
+                        self.pg_log.continuous_with(at):
+                    # RESUME: the retained log proves exactly what
+                    # changed below the watermark since it was last
+                    # valid — push those as log-delta, scan the rest
+                    wm = lb
+                    missing = {oid: e for oid, e in missing.items()
+                               if oid <= wm}
+                    for oid, e in \
+                            self.pg_log.newest_per_object().items():
+                        if oid <= wm and e.version > at:
+                            missing[oid] = e
+                else:
+                    # fresh join, or the target was away so long the
+                    # sub-watermark deltas fell off the log: nothing
+                    # below the watermark is provably current — the
+                    # scan must restart from MIN
+                    wm = MIN_OID
+                    missing = {}
+                self.backfill_targets[o] = wm
+                log.dout(1, f"pg {self.pgid} osd.{o} needs backfill "
+                            f"(log head {plog.head} < tail "
+                            f"{self.pg_log.tail}; watermark "
+                            f"{wm!r})")
+            self.peer_missing[o] = missing
         # a notify that raced this round (landed after find_best_info
         # ran) may know newer acked writes: go again rather than
         # activating and serving stale data. Terminates: the next round
-        # adopts that log, making its head ours.
+        # adopts that log, making its head ours. (Backfill targets are
+        # exempt: their entries are a subset of ours by construction.)
         if any(pl.head > self.pg_log.head
-               for pl in self.peer_logs.values()):
+               for o, pl in self.peer_logs.items()
+               if o not in self.backfill_targets):
             log.dout(1, f"pg {self.pgid} raced notify knows newer "
                         f"writes; re-peering")
             self.state = "peering"
@@ -429,11 +649,17 @@ class PG:
     def handle_pg_query(self, m: MOSDPGQuery) -> None:
         asyncio.ensure_future(self.osd.send_osd(m.from_osd, MOSDPGInfo(
             pgid=self.cid, epoch=self.epoch, from_osd=self.osd.whoami,
-            log=self.pg_log.encode(), notify=0, intervals="")))
+            log=self.pg_log.encode(), notify=0, intervals="",
+            last_backfill=self.last_backfill,
+            backfill_at_epoch=self.backfill_at.epoch,
+            backfill_at_v=self.backfill_at.v)))
 
     def handle_pg_info(self, m: MOSDPGInfo) -> None:
         plog = PGLog.decode(m.log)
         self.peer_logs[m.from_osd] = plog
+        self.peer_last_backfill[m.from_osd] = m.last_backfill
+        self.peer_backfill_at[m.from_osd] = eversion(
+            m.backfill_at_epoch, m.backfill_at_v)
         if m.notify:
             # unsolicited stray announcement (ref: MOSDPGNotify): merge
             # its interval history so the coverage gate knows this OSD,
@@ -925,11 +1151,24 @@ class PG:
             return
         if not any(self.peer_missing.values()) and \
                 self.state in ("active", "recovering"):
+            if self._maybe_start_backfill():
+                return          # clean is decided when backfill ends
             if len(self.live_acting()) >= self.pool.size:
                 self._mark_clean()
             else:
                 self.state = "active"
             self._promote_pending_eagain()
+
+    def _maybe_start_backfill(self) -> bool:
+        """Kick the backfill driver when peering flagged targets.
+        Returns True while backfill owns the clean decision."""
+        if self._backfill_task is not None:
+            return True
+        if not self.backfill_targets:
+            return False
+        self._backfill_task = asyncio.ensure_future(
+            self._backfill())
+        return True
 
     def _mark_clean(self) -> None:
         """Every acting replica has every object at full size: past
@@ -1028,6 +1267,10 @@ class PG:
         mutating = {OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_TRUNCATE,
                     OSD_OP_ZERO, OSD_OP_DELETE, OSD_OP_SETXATTR,
                     OSD_OP_OMAP_SET, OSD_OP_SNAPTRIM}
+        if self._backfill_blocked(
+                m.oid, any(c in mutating for c in m.op_codes)):
+            await self._reply(m, -11, b"", {})          # -EAGAIN
+            return
         if any(c in mutating for c in m.op_codes) and \
                 reqid in self._reqid_results:
             # resend of an applied-but-unacked mutation: return the
@@ -1226,6 +1469,19 @@ class PG:
         dedup result)."""
         if len(self.live_acting()) < self.pool.min_size:
             return -11, False, None                     # -EAGAIN
+        # backfill straddle gate: one txn can touch the head AND its
+        # snap clones, whose names sort far apart. For a backfill
+        # target the whole txn must be send-or-skip by its watermark —
+        # sending would materialize partial state for the above-
+        # watermark oid, skipping would silently drop the below-
+        # watermark one (the scan never revisits covered ground). A
+        # straddling txn parks until the watermark moves past it.
+        if self.backfill_targets:
+            txn_oids = [oid] + list(extra_oids or [])
+            for lb in self.backfill_targets.values():
+                below = [x <= lb for x in txn_oids]
+                if any(below) and not all(below):
+                    return -11, False, None             # -EAGAIN
         self.last_user_version += 1
         version = eversion(self.epoch, self.last_user_version)
         entry = self.pg_log.add(
@@ -1239,7 +1495,7 @@ class PG:
             extra_entries.append(self.pg_log.add(
                 eversion(self.epoch, self.last_user_version),
                 clone_oid, OP_MODIFY))
-        self.pg_log.trim()
+        self.pg_log.trim(keep=self._trim_keep())
         if not deleted:
             t.setattrs(self.cid, oid, {"_v":
                        version.epoch.to_bytes(4, "little") +
@@ -1247,7 +1503,8 @@ class PG:
         self._meta_txn(t)
         txn_blob = t.encode()
         replicas = [o for o in self.live_acting()
-                    if o != self.osd.whoami]
+                    if o != self.osd.whoami
+                    and self._should_send_repop(o, oid)]
         tid = self.osd.next_tid()
         waiter = None
         if replicas:
@@ -1318,7 +1575,7 @@ class PG:
             self.pg_log.append(e2)
             self.last_user_version = max(self.last_user_version,
                                          e2.version.v)
-        self.pg_log.trim()
+        self.pg_log.trim(keep=self._trim_keep())
         self.last_user_version = max(self.last_user_version,
                                      entry.version.v)
 
@@ -1381,6 +1638,467 @@ class PG:
             if not ent[1].done():
                 ent[1].set_result(True)
 
+    # -- backfill (ref: PrimaryLogPG's backfill state machine) -------------
+    def _version_blob(self, oid: str) -> bytes:
+        """The object's 12-byte ``_v`` xattr (epoch u32le + v u64le) —
+        the scan digest's version token. Identical layout on replicated
+        objects and EC shards, so one comparison serves both."""
+        try:
+            return self.osd.store.getattrs(self.cid, oid).get("_v", b"")
+        except StoreError:
+            return b""
+
+    async def _build_backfill_push(self, oid: str, target: int):
+        """Whole-object push for a backfill target (replicated PGs push
+        the primary's byte-identical copy; ECPG overrides to rebuild
+        the target POSITION's shard). None = cannot build right now."""
+        return self.make_push(oid)
+
+    async def _backfill_push_acked(self, oid: str, target: int) -> bool:
+        """One throttled, ACK-gated backfill push. The QoS throttle
+        (osd_recovery_max_active + osd_recovery_max_bytes) runs HERE —
+        client ops never touch it, so under contention backfill queues
+        behind its own budget while foreground writes flow."""
+        push = await self._build_backfill_push(oid, target)
+        if push is None:
+            return False
+        release = await self.osd.recovery_throttle.acquire(
+            len(push.data))
+        fut = asyncio.get_event_loop().create_future()
+        self._push_ack_waiters[(target, oid)] = fut
+        try:
+            await self.osd.send_osd(target, push)
+            await asyncio.wait([fut], timeout=5.0)
+            return fut.done()
+        except Exception as e:
+            log.dout(1, f"pg {self.pgid} backfill push {oid}->"
+                        f"osd.{target} failed: {e}")
+            return False
+        finally:
+            release()
+            self._push_ack_waiters.pop((target, oid), None)
+
+    async def _scan_peer(self, osd_id: int, begin: str, end: str,
+                         limit: int = 0):
+        """Request a peer's sorted (begin, end] object/version digest
+        (ref: MOSDPGScan round trip). None on timeout/failure."""
+        tid = self.osd.next_tid()
+        fut = asyncio.get_event_loop().create_future()
+        self._backfill_waiters[tid] = fut
+        try:
+            await self.osd.send_osd(osd_id, MOSDPGScan(
+                pgid=self.cid, epoch=self.epoch, tid=tid, begin=begin,
+                end=end, limit=limit, from_osd=self.osd.whoami))
+            return await asyncio.wait_for(fut, timeout=5.0)
+        except Exception:
+            return None
+        finally:
+            self._backfill_waiters.pop(tid, None)
+
+    async def _backfill_ctl(self, target: int, op: int,
+                            watermark: str) -> bool:
+        """Watermark control round trip: the target PERSISTS the new
+        last_backfill before acking, so an acked PROGRESS/FINISH is a
+        durable resume point (FINISH ships the authoritative log — the
+        target is then log-continuous and a normal replica)."""
+        tid = self.osd.next_tid()
+        fut = asyncio.get_event_loop().create_future()
+        self._backfill_waiters[tid] = fut
+        try:
+            head = self.pg_log.head
+            await self.osd.send_osd(target, MOSDPGBackfill(
+                pgid=self.cid, epoch=self.epoch, tid=tid, op=op,
+                last_backfill=watermark,
+                log=self.pg_log.encode()
+                if op == BACKFILL_OP_FINISH else b"",
+                at_epoch=head.epoch, at_v=head.v,
+                from_osd=self.osd.whoami))
+            m = await asyncio.wait_for(fut, timeout=5.0)
+            return m.result == 0
+        except Exception:
+            return False
+        finally:
+            self._backfill_waiters.pop(tid, None)
+
+    async def _reserve_remote(self, target: int) -> str:
+        """'grant' | 'reject' | 'toofull' from the target's reserver."""
+        tid = self.osd.next_tid()
+        fut = asyncio.get_event_loop().create_future()
+        self._backfill_waiters[tid] = fut
+        try:
+            await self.osd.send_osd(target, MBackfillReserve(
+                pgid=self.cid, epoch=self.epoch, tid=tid,
+                op=RESERVE_REQUEST, from_osd=self.osd.whoami))
+            m = await asyncio.wait_for(fut, timeout=3.0)
+            if m.op == RESERVE_GRANT:
+                self._reserve_tids[target] = tid
+                return "grant"
+            return "toofull" if m.op == RESERVE_TOOFULL else "reject"
+        except Exception:
+            return "reject"
+        finally:
+            self._backfill_waiters.pop(tid, None)
+
+    async def _backfill(self) -> None:
+        """Primary backfill driver: reserve (local slot, then one
+        remote slot per target, capped at osd_max_backfills on each
+        OSD), then scan/push each target forward from its persisted
+        watermark. backfill_wait = waiting on a slot; backfill_toofull
+        = a target refused for fullness; backfilling = scans running."""
+        # interval identity, NOT the raw epoch: map epochs advance for
+        # unrelated reasons (up_thru grants, other pools) without
+        # ending this interval — only an acting-set change (which bumps
+        # interval_start and cancels this task anyway) invalidates us
+        interval = self.interval_start
+        granted_remote: list[int] = []
+        try:
+            self.state = "backfill_wait"
+            await self.osd.local_reserver.request(self.cid)
+            while True:
+                if self.interval_start != interval or \
+                        not self.is_primary():
+                    return
+                verdicts: dict[int, str] = {}
+                for o in list(self.backfill_targets):
+                    if self.osd.osd_is_up(o):
+                        verdicts[o] = await self._reserve_remote(o)
+                if not verdicts:
+                    return        # every target down: the map decides
+                if all(v == "grant" for v in verdicts.values()):
+                    granted_remote = list(verdicts)
+                    break
+                for o, v in verdicts.items():
+                    if v == "grant":          # don't sit on slots
+                        asyncio.ensure_future(self._send_reserve_op(
+                            o, RESERVE_RELEASE,
+                            self._reserve_tids.get(o, 0)))
+                self.state = "backfill_toofull" if "toofull" in \
+                    verdicts.values() else "backfill_wait"
+                await asyncio.sleep(float(self.osd.config.get(
+                    "osd_backfill_retry_interval", 0.5)))
+            self.state = "backfilling"
+            RECOVERY_PERF.inc("backfills_started")
+            for o in sorted(self.backfill_targets):
+                if self.interval_start != interval or \
+                        not self.is_primary():
+                    return
+                if self.osd.osd_is_up(o):
+                    await self._backfill_one(o, interval)
+            if self.interval_start != interval or \
+                    not self.is_primary():
+                return
+            if not self.backfill_targets:
+                RECOVERY_PERF.inc("backfills_completed")
+            # the clean decision belongs to the ONE canonical path in
+            # _recover — re-enter it after this task unwinds (the
+            # finally below releases slots and clears the task pointer
+            # first, so _maybe_start_backfill can restart failed
+            # targets after a beat)
+            self.state = "active"
+            loop = asyncio.get_event_loop()
+            loop.call_later(
+                1.0 if self.backfill_targets else 0.0,
+                lambda: asyncio.ensure_future(self._recover()))
+        finally:
+            # _cancel_backfill (interval change) already nulled the
+            # task pointer and freed the slots — and a NEW driver may
+            # have taken them by the time this cancelled frame unwinds.
+            # Only the still-current task may release.
+            if self._backfill_task is asyncio.current_task():
+                self._backfill_task = None
+                self._backfill_inflight = None
+                self.osd.local_reserver.release(self.cid)
+                for o in granted_remote:
+                    asyncio.ensure_future(self._send_reserve_op(
+                        o, RESERVE_RELEASE,
+                        self._reserve_tids.get(o, 0)))
+
+    async def _backfill_one(self, target: int, interval: int) -> bool:
+        """Scan/push one target forward to MAX_OID. Every batch:
+        compare the primary's sorted collection slice against the
+        target's digest, push differing/missing objects (ACK-gated),
+        remove target-side extras, and only THEN advance the persisted
+        watermark — so a crash at any point resumes at a boundary
+        where the invariant 'target holds every object <= watermark'
+        still holds."""
+        wm = self.backfill_targets.get(target, MIN_OID)
+        if self.peer_last_backfill.get(target, MAX_OID) == MAX_OID:
+            # fresh/discontinuous target: durably mark it incomplete
+            # BEFORE the first scan — from here until FINISH its info
+            # says 'backfill me', whatever crashes
+            if not await self._backfill_ctl(target, BACKFILL_OP_RESET,
+                                            MIN_OID):
+                return False
+            self.peer_last_backfill[target] = MIN_OID
+            wm = MIN_OID
+        elif wm > MIN_OID:
+            self.backfill_stats["resumed_from"] = wm
+        scan_max = int(self.osd.config.get("osd_backfill_scan_max", 64))
+        store = self.osd.store
+        while True:
+            if self.interval_start != interval or \
+                    not self.is_primary() or \
+                    not self.osd.osd_is_up(target):
+                return False
+            try:
+                names = sorted(
+                    o for o in store.list_objects(self.cid)
+                    if o != PGMETA and o > wm)
+            except StoreError:
+                return False
+            batch = names[:scan_max]
+            end = MAX_OID if len(names) <= scan_max else batch[-1]
+            # block mutations over the WHOLE open range, not just the
+            # snapshot: an object created in (wm, end] mid-batch would
+            # be invisible to both this scan and the repop gate. Held
+            # until the watermark advance lands so nothing slips into
+            # the supposedly-covered region.
+            self._backfill_inflight = (wm, end)
+            try:
+                reply = await self._scan_peer(target, wm, end)
+                if reply is None:
+                    return False
+                theirs = dict(reply.objects)
+                for oid in batch:
+                    self.backfill_stats["scanned"] += 1
+                    RECOVERY_PERF.inc("backfill_objects_scanned")
+                    mine = self._version_blob(oid)
+                    if mine and theirs.get(oid) == mine:
+                        continue          # identical version: skip
+                    if not await self._backfill_push_acked(oid, target):
+                        return False
+                    self.backfill_stats["pushed"] += 1
+                    RECOVERY_PERF.inc("backfill_objects_pushed")
+                for oid in sorted(set(theirs) - set(batch)):
+                    # the target holds an object this primary doesn't:
+                    # it was deleted past the target's horizon — the
+                    # removal push (exists=False) reaps it
+                    if oid == PGMETA or store.exists(self.cid, oid):
+                        continue
+                    if not await self._backfill_push_acked(oid, target):
+                        return False
+                    self.backfill_stats["removed"] += 1
+                    RECOVERY_PERF.inc("backfill_objects_pushed")
+                op = BACKFILL_OP_FINISH if end == MAX_OID \
+                    else BACKFILL_OP_PROGRESS
+                if not await self._backfill_ctl(target, op, end):
+                    return False
+                wm = end
+                self.peer_last_backfill[target] = end
+                if end != MAX_OID:
+                    self.backfill_targets[target] = end
+            finally:
+                self._backfill_inflight = None
+            if end == MAX_OID:
+                self.backfill_targets.pop(target, None)
+                log.dout(1, f"pg {self.pgid} backfill of osd.{target} "
+                            f"complete")
+                return True
+
+    async def _backfill_self(self, src: int) -> bool:
+        """Reverse backfill: THIS primary is incomplete (it was a
+        backfill target when the map promoted it). Page the complete
+        peer's digest and pull every object we lack or hold stale,
+        advancing OUR persisted watermark; remove local objects the
+        source doesn't list (deleted past our horizon). Runs inside
+        peering, before any op can be served."""
+        interval = self.interval_start
+        scan_max = int(self.osd.config.get("osd_backfill_scan_max", 64))
+        store = self.osd.store
+        wm = self.last_backfill
+        if wm > MIN_OID:
+            self.backfill_stats["resumed_from"] = wm
+        log.dout(1, f"pg {self.pgid} self-backfill from osd.{src} "
+                    f"(watermark {wm!r})")
+        while wm != MAX_OID:
+            if self.interval_start != interval:
+                return False
+            reply = await self._scan_peer(src, wm, MAX_OID,
+                                          limit=scan_max)
+            if reply is None:
+                return False
+            theirs = dict(reply.objects)
+            for oid in sorted(theirs):
+                RECOVERY_PERF.inc("backfill_objects_scanned")
+                if store.exists(self.cid, oid) and \
+                        self._version_blob(oid) == theirs[oid]:
+                    continue
+                release = await self.osd.recovery_throttle.acquire(0)
+                try:
+                    await self._pull(src, oid)
+                finally:
+                    release()
+                if self._version_blob(oid) != theirs[oid]:
+                    # the pull timed out or delivered something other
+                    # than the version the source listed: do NOT
+                    # advance the watermark over a stale copy
+                    return False
+                RECOVERY_PERF.inc("backfill_objects_pushed")
+            try:
+                extras = [o for o in store.list_objects(self.cid)
+                          if o != PGMETA and wm < o <= reply.up_to
+                          and o not in theirs]
+            except StoreError:
+                extras = []
+            for oid in extras:
+                try:
+                    store.queue_transaction(
+                        Transaction().remove(self.cid, oid))
+                    self._clone_idx = None
+                except StoreError:
+                    return False
+            wm = reply.up_to
+            self.last_backfill = wm
+            # our log IS the authoritative log here (adopted in this
+            # peering round), so its head is the point this watermark
+            # is valid at
+            self.backfill_at = self.pg_log.head
+            try:
+                store.queue_transaction(self._meta_txn(Transaction()))
+            except StoreError as e:
+                log.error(f"pg {self.pgid} self-backfill watermark "
+                          f"persist failed: {e}")
+                return False
+        return True
+
+    # target-side handlers --------------------------------------------------
+    def handle_pg_scan(self, m: MOSDPGScan) -> None:
+        out: dict[str, bytes] = {}
+        up_to = m.end
+        try:
+            names = sorted(
+                o for o in self.osd.store.list_objects(self.cid)
+                if o != PGMETA and m.begin < o <= m.end)
+        except StoreError:
+            names = []
+        if m.limit and len(names) > m.limit:
+            names = names[:m.limit]
+            up_to = names[-1]
+        for oid in names:
+            out[oid] = self._version_blob(oid)
+
+        async def _reply():
+            try:
+                await m.conn.send_message(MOSDPGScanReply(
+                    pgid=self.cid, tid=m.tid, from_osd=self.osd.whoami,
+                    objects=out, up_to=up_to))
+            except Exception:
+                pass                  # requester's timeout covers it
+        asyncio.ensure_future(_reply())
+
+    def handle_scan_reply(self, m: MOSDPGScanReply) -> None:
+        fut = self._backfill_waiters.get(m.tid)
+        if fut and not fut.done():
+            fut.set_result(m)
+
+    def handle_backfill(self, m: MOSDPGBackfill) -> None:
+        """Target half of the watermark protocol: persist BEFORE
+        acking (an acked watermark must survive a crash). Messages
+        from a superseded interval are dropped — a delayed/duplicated
+        FINISH from a dead primary must not mark a freshly-RESET
+        target complete with a stale log (the fault layer delays and
+        duplicates messages by design)."""
+        if m.epoch < self.interval_start:
+            log.dout(1, f"pg {self.pgid} ignoring stale backfill op "
+                        f"{m.op} from epoch {m.epoch} < interval "
+                        f"{self.interval_start}")
+            return
+        result = 0
+        if m.op == BACKFILL_OP_RESET:
+            self.last_backfill = MIN_OID
+            self.backfill_at = eversion(m.at_epoch, m.at_v)
+        elif m.op == BACKFILL_OP_PROGRESS:
+            self.last_backfill = m.last_backfill
+            self.backfill_at = eversion(m.at_epoch, m.at_v)
+        elif m.op == BACKFILL_OP_FINISH:
+            if m.log:
+                self.pg_log = PGLog.decode(m.log)
+                self.last_user_version = max(self.last_user_version,
+                                             self.pg_log.head.v)
+            self.last_backfill = MAX_OID
+            self.backfill_at = eversion()
+        try:
+            self.osd.store.queue_transaction(
+                self._meta_txn(Transaction()))
+        except StoreError as e:
+            log.error(f"pg {self.pgid} backfill watermark persist "
+                      f"failed: {e}")
+            result = -5
+
+        async def _reply():
+            try:
+                await m.conn.send_message(MOSDPGBackfillReply(
+                    pgid=self.cid, tid=m.tid, op=m.op, result=result,
+                    from_osd=self.osd.whoami))
+            except Exception:
+                pass
+        asyncio.ensure_future(_reply())
+
+    def handle_backfill_reply(self, m: MOSDPGBackfillReply) -> None:
+        fut = self._backfill_waiters.get(m.tid)
+        if fut and not fut.done():
+            fut.set_result(m)
+
+    def handle_backfill_reserve(self, m: MBackfillReserve) -> None:
+        if m.op == RESERVE_REQUEST:
+            if m.epoch < self.interval_start:
+                return    # superseded primary: no reply, no slot leak
+            if self.osd.backfill_toofull():
+                verdict = RESERVE_TOOFULL
+                RECOVERY_PERF.inc("reservations_toofull")
+            elif self.osd.remote_reserver.try_request(self.cid):
+                verdict = RESERVE_GRANT
+                self._remote_grant_tid = m.tid
+            else:
+                verdict = RESERVE_REJECT
+
+            async def _reply():
+                try:
+                    await m.conn.send_message(MBackfillReserve(
+                        pgid=self.cid, epoch=self.epoch, tid=m.tid,
+                        op=verdict, from_osd=self.osd.whoami))
+                except Exception:
+                    pass
+            asyncio.ensure_future(_reply())
+        elif m.op == RESERVE_RELEASE:
+            if m.epoch < self.interval_start:
+                return    # delayed release from a dead primary
+            if m.tid and m.tid != self._remote_grant_tid:
+                return    # duplicate of an ALREADY-honored release:
+                #           the slot has been re-granted under a new
+                #           tid in the meantime — don't free that one
+            self._remote_grant_tid = 0
+            self.osd.remote_reserver.release(self.cid)
+        else:                             # GRANT / REJECT / TOOFULL
+            fut = self._backfill_waiters.get(m.tid)
+            if fut and not fut.done():
+                fut.set_result(m)
+
+    def _should_send_repop(self, peer: int, oid: str) -> bool:
+        """Ongoing-write gate for backfill targets (ref: PrimaryLogPG
+        should_send_op): a target holds exactly the objects <= its
+        watermark, so writes at-or-below it MUST replicate (or the
+        already-copied object diverges silently) and writes above it
+        MUST NOT (the txn would materialize a partial object the scan
+        then wrongly version-matches; the scan will copy it whole)."""
+        lb = self.backfill_targets.get(peer)
+        return lb is None or oid <= lb
+
+    def _backfill_blocked(self, oid: str, mutating: bool) -> bool:
+        """Degraded-object gate (ref: wait_for_unreadable_object /
+        wait_for_degraded_object): ops park with -EAGAIN while (a)
+        this primary's own copy is above its own watermark — it may
+        not hold the object at all — or (b) the object sits in the
+        batch a backfill scan is comparing RIGHT NOW (mutations only:
+        a write between the version read and the watermark advance
+        would be invisible to both the scan and the repop gate)."""
+        if self.last_backfill != MAX_OID and oid > self.last_backfill:
+            return True
+        if not mutating or self._backfill_inflight is None:
+            return False
+        lo, hi = self._backfill_inflight
+        return lo < oid <= hi
+
     # -- stats -------------------------------------------------------------
     def stats(self) -> dict:
         objs = [o for o in self.osd.store.list_objects(self.cid)
@@ -1396,9 +2114,24 @@ class PG:
         if self.is_primary():
             live = len(self.live_acting())
             if live < self.pool.size and self.role_active():
+                # also during backfill states: a SECOND replica down
+                # mid-backfill is genuine under-replication monitoring
+                # must see, not business-as-usual backfill
                 state = f"{self.state}+undersized+degraded"
-        return {"state": state, "num_objects": len(objs),
-                "num_bytes": nbytes,
-                "acting": self.acting, "up": self.up,
-                "last_update": str(self.pg_log.head),
-                "scrub_errors": self.scrub_errors}
+        out = {"state": state, "num_objects": len(objs),
+               "num_bytes": nbytes,
+               "acting": self.acting, "up": self.up,
+               "last_update": str(self.pg_log.head),
+               "scrub_errors": self.scrub_errors}
+        if self.backfill_targets or \
+                self.last_backfill != MAX_OID or \
+                self.backfill_stats["pushed"] or \
+                self.backfill_stats["scanned"]:
+            # backfill progress rides MPGStats into `ceph status` /
+            # pg dump (ref: pg_stat_t's backfill fields)
+            out["backfill"] = {
+                "targets": {str(o): wm for o, wm in
+                            sorted(self.backfill_targets.items())},
+                "last_backfill": self.last_backfill,
+                **self.backfill_stats}
+        return out

@@ -78,6 +78,22 @@ class PGLog:
             self.entries = self.entries[-keep:]
             self.tail = self.entries[0].version
 
+    def continuous_with(self, peer_head: eversion) -> bool:
+        """Can a peer whose log head is ``peer_head`` be recovered by
+        log delta against this (authoritative) log?
+
+        ref: PGLog::proc_replica_log / PeeringState choose_acting's
+        backfill decision — log-delta recovery is only sound when the
+        peer's last_update falls inside this log's retained window
+        (peer_head >= tail): everything the peer might be missing is
+        then still in ``entries``. A peer whose head predates the tail
+        (including a fresh empty-log join, head == 0'0, once this log
+        has been trimmed) has divergence older than anything retained —
+        its missing set CANNOT be computed from the log and the peer
+        must be backfilled instead. An untrimmed log (tail == 0'0)
+        retains full history, so every peer is log-recoverable."""
+        return self.tail == eversion() or peer_head >= self.tail
+
     def newest_per_object(self) -> dict[str, LogEntry]:
         out: dict[str, LogEntry] = {}
         for entry in self.entries:

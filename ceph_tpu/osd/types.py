@@ -21,6 +21,16 @@ POOL_TYPE_ERASURE = 3     # ref: pg_pool_t::TYPE_ERASURE
 
 FLAG_HASHPSPOOL = 1 << 2  # ref: pg_pool_t::FLAG_HASHPSPOOL
 
+# last_backfill watermark bounds (ref: hobject_t::get_max / is_max —
+# pg_info_t.last_backfill). Backfill scans the collection in plain
+# string-sorted object-name order; "" (MIN) sorts before every name and
+# MAX_OID after every name this framework can generate (object names
+# are JSON-safe strings; U+FFFF is a noncharacter that never appears in
+# them). last_backfill == MAX_OID means "fully backfilled" — the normal
+# state of every complete replica.
+MIN_OID = ""
+MAX_OID = "\uffff"
+
 
 def ceph_stable_mod(x, b, bmask, xp=np):
     """ref: src/include/ceph_hash.h ceph_stable_mod — the split-aware mod

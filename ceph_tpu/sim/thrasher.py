@@ -267,6 +267,79 @@ class Thrasher:
             # an empty acked set
         return self.actions_log
 
+    async def backfill_storm(self, io, writes: int = 60,
+                             partitions: int = 0,
+                             fresh_store: bool = False) -> dict:
+        """The horizon-crossing storm (the backfill acceptance shape):
+        kill one OSD, write PAST the pg-log trim horizon (the cluster
+        must run with a small ``osd_min_pg_log_entries`` for ``writes``
+        to cross it), then revive the victim — with its old store
+        (stale rejoin) or a fresh one (``fresh_store=True``, the
+        replace-an-OSD case) — optionally under concurrent partitions.
+        The revived OSD's logs are beyond log-delta reach, so only
+        backfill can converge it. Finish with ``settle_and_verify``:
+        every acked write must survive on a CLEAN cluster, which
+        (given the trimmed logs) proves the backfill path moved the
+        history. Returns {victim, acked_writes, horizon_writes}."""
+        rng = random.Random(self.seed ^ 0xBACF111)
+        live = self._live_osds()
+        if len(live) <= self.min_live_osds:
+            raise RuntimeError("not enough live osds for a backfill "
+                               "storm")
+        victim = live[rng.randrange(len(live))]
+        await self.c.kill_osd(victim)
+        store = self.c.osds[victim].store
+        if self.store_factory is not None and hasattr(store, "umount"):
+            store.umount()
+        self.downed.append(victim)
+        self._log(f"backfill storm: kill osd.{victim}")
+        try:
+            await self.c.wait_for_osd_down(victim, timeout=60)
+        except TimeoutError:
+            self._log(f"osd.{victim} not marked down in time")
+        for i in range(partitions):
+            live = self._live_osds()
+            if len(live) < 2 or \
+                    len(self.active_sets) >= self.max_active_sets:
+                break
+            x, y = rng.sample(live, 2)
+            name = f"bf-part-{x}-{y}-{i}"
+            self.injector.install(
+                name, [F.partition(f"osd.{x}", f"osd.{y}")])
+            self.active_sets.append(name)
+            self._log(f"backfill storm: partition osd.{x}<->osd.{y}")
+        written = 0
+        for i in range(writes):
+            oid = f"bf-{self.seed}-{i:05d}"
+            data = bytes([i % 256]) * rng.randint(1, 2048)
+            try:
+                await io.write_full(oid, data,
+                                    timeout=self.write_timeout)
+                self.acked[oid] = data
+                written += 1
+            except Exception as e:
+                self._write_errors += 1
+                log.dout(5, f"backfill-storm write {oid} failed: "
+                            f"{e!r}")
+        self._log(f"backfill storm: {written}/{writes} writes past "
+                  f"the horizon")
+        for name in list(self.active_sets):
+            self.injector.clear(name)
+            self.active_sets.remove(name)
+            self._log(f"backfill storm: heal [{name}]")
+        self.downed.remove(victim)
+        new_store = None
+        if fresh_store:
+            from ceph_tpu.os_.objectstore import MemStore
+            new_store = MemStore()        # a REPLACED osd: empty disk
+        elif self.store_factory is not None:
+            new_store = self.store_factory(victim)
+        await self.c.revive_osd(victim, store=new_store)
+        self._log(f"backfill storm: revive osd.{victim}"
+                  f"{' (fresh store)' if fresh_store else ''}")
+        return {"victim": victim, "acked_writes": written,
+                "horizon_writes": writes}
+
     async def settle_and_verify(self, io, timeout: float = 240.0,
                                 fsck_stores=None) -> dict:
         """Heal everything, revive everything, converge, verify.

@@ -68,6 +68,34 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
            "plugin=jax technique=reed_sol_van k=2 m=2",
            "default EC profile"),
     Option("mon_max_pg_per_osd", int, 250, "pg-per-osd health limit"),
+    # pg-log / recovery / backfill (ref: osd.yaml.in osd_min_pg_log_entries,
+    # osd_max_backfills, osd_recovery_max_active, osd_backfill_scan_*).
+    Option("osd_min_pg_log_entries", int, 1000,
+           "pg-log entries retained by trim; the log tail this leaves is "
+           "the log-delta recovery horizon — peers older than it backfill",
+           min=1),
+    Option("osd_backfill", bool, True,
+           "enable the backfill recovery mode (off reproduces the "
+           "silent past-horizon under-replication the seed had)"),
+    Option("osd_max_backfills", int, 1,
+           "max concurrent backfills one OSD participates in, as "
+           "primary (local reservations) or target (remote)", min=1),
+    Option("osd_backfill_scan_max", int, 64,
+           "objects per backfill scan batch", min=1),
+    Option("osd_backfill_retry_interval", float, 0.5,
+           "seconds between reservation retries (backfill_wait)"),
+    Option("osd_recovery_max_active", int, 8,
+           "max in-flight recovery/backfill pushes per OSD", min=1),
+    Option("osd_recovery_max_bytes", int, 0,
+           "recovery push budget in bytes/s (token bucket; 0 = "
+           "unlimited) — deprioritizes recovery vs client I/O", min=0),
+    Option("osd_backfill_full_ratio", float, 0.85,
+           "refuse incoming backfills above this fraction of "
+           "osd_capacity_bytes (backfill_toofull)"),
+    Option("osd_capacity_bytes", int, 0,
+           "advertised store capacity for fullness checks (0 = "
+           "unlimited; the in-memory stores have no intrinsic size)",
+           min=0),
     # CRUSH tunables defaults (jewel profile; ref: src/crush/CrushWrapper.h
     # set_tunables_jewel).
     Option("crush_choose_total_tries", int, 50, "descent retry budget"),

@@ -1,0 +1,440 @@
+"""Backfill: last_backfill machinery, log-continuity peering, QoS.
+
+ref test model: qa/suites/rados/thrash with backfill_toofull /
+osd-backfill-* in qa/standalone/osd — the second recovery mode.
+The horizon-crossing pair is the acceptance shape from VERDICT weak
+#1: write PAST the pg-log trim horizon, lose a replica, join a fresh
+OSD. Without backfill the seed silently under-replicates while
+reporting clean (reproduced here with ``osd_backfill: False``); with
+it the PG converges with zero missing objects and full data
+integrity, resumably across target restarts, under per-OSD
+reservation caps.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.os_.objectstore import MemStore
+from ceph_tpu.osd.pg_log import LogEntry, PGLog, eversion
+from ceph_tpu.osd.recovery import AsyncReserver, RecoveryThrottle
+from ceph_tpu.osd.types import MAX_OID
+from ceph_tpu.sim.thrasher import Thrasher
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+HORIZON_CFG = {
+    # tiny retained log so a ~50-object working set crosses the trim
+    # horizon inside the tier-1 budget (osd_min_pg_log_entries default
+    # is 1000 — same machinery, production scale)
+    "osd_min_pg_log_entries": 5,
+    "mon_osd_down_out_interval": 600.0,
+}
+
+
+# -- units -----------------------------------------------------------------
+
+def test_log_continuity():
+    """continuous_with is the backfill decision: an untrimmed log can
+    delta-recover anyone; a trimmed one only peers whose head is at or
+    past its tail."""
+    log = PGLog()
+    for i in range(1, 8):
+        log.append(LogEntry(eversion(1, i), f"o{i}", 1))
+    assert log.continuous_with(eversion())       # never trimmed
+    log.trim(keep=3)
+    assert log.tail == eversion(1, 5)
+    assert not log.continuous_with(eversion())   # empty-log join
+    assert not log.continuous_with(eversion(1, 4))
+    assert log.continuous_with(eversion(1, 5))
+    assert log.continuous_with(eversion(1, 7))
+
+
+def test_async_reserver_cap_and_peak():
+    async def go():
+        r = AsyncReserver(2)
+        await r.request("a")
+        await r.request("b")
+        assert not r.try_request("c")
+        waited = asyncio.ensure_future(r.request("c"))
+        await asyncio.sleep(0)
+        assert not waited.done()
+        r.release("a")
+        await waited
+        assert r.granted == {"b", "c"}
+        assert r.peak == 2                 # never exceeded the cap
+        assert r.try_request("b")          # re-request is idempotent
+        r.cancel("b")
+        r.cancel("c")
+        assert not r.granted
+    run(go())
+
+
+def test_recovery_throttle_rate_limits():
+    async def go():
+        th = RecoveryThrottle(max_active=2, bytes_per_s=100_000)
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        for _ in range(4):                 # 4 x 50KB at 100KB/s
+            (await th.acquire(50_000))()
+        elapsed = loop.time() - t0
+        # first second of burst is free; the rest must have waited
+        assert elapsed >= 0.8, elapsed
+        assert th.throttled_ops >= 1
+    run(go())
+
+
+# -- the horizon-crossing pair (VERDICT weak #1) ---------------------------
+
+async def _write_past_horizon(c, io, n_before=10, n_after=40,
+                              victim=2):
+    """Write, lose `victim`, write PAST the trim horizon. Returns the
+    acked data set."""
+    data = {}
+    for i in range(n_before + n_after):
+        oid = f"o{i:04d}"
+        payload = bytes([i % 256]) * 256
+        await io.write_full(oid, payload)
+        data[oid] = payload
+        if i == n_before - 1:
+            await c.kill_osd(victim)
+            await c.wait_for_osd_down(victim, timeout=60)
+    return data
+
+
+def _replica_count(c, oid):
+    return sum(1 for o in c.osds if not o._stopped
+               for cid in o.store.list_collections()
+               if o.store.exists(cid, oid))
+
+
+def test_horizon_silent_loss_without_backfill():
+    """The seed reproduction: with backfill disabled, a fresh OSD
+    joining past the horizon receives only the retained log delta —
+    the PG reports clean while most objects are under-replicated
+    (lose the survivors next and acked data is gone)."""
+    async def go():
+        cfg = dict(HORIZON_CFG, osd_backfill=False)
+        c = await Cluster(n_mons=1, n_osds=3, config=cfg).start()
+        try:
+            await c.client.pool_create("t", pg_num=2, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            data = await _write_past_horizon(c, io)
+            await c.revive_osd(2, store=MemStore())   # fresh join
+            await c.wait_for_clean(timeout=120)       # ...it LIES
+            lost = [oid for oid in data
+                    if _replica_count(c, oid) < 3]
+            # only the last osd_min_pg_log_entries per PG were pushed
+            assert len(lost) > len(data) // 2, (
+                f"expected silent under-replication, lost={len(lost)}")
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_horizon_backfill_converges():
+    """The same scenario with backfill on (default): the discontinuous
+    join becomes a backfill target, the scan copies all of history,
+    the PG converges with ZERO missing objects on all acting OSDs, and
+    per-OSD concurrent backfills never exceeded osd_max_backfills=1
+    (asserted via the reservers' high-water marks)."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3,
+                          config=dict(HORIZON_CFG)).start()
+        try:
+            await c.client.pool_create("t", pg_num=2, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            data = await _write_past_horizon(c, io)
+            await c.revive_osd(2, store=MemStore())
+            # client writes stay serviceable DURING backfill
+            await asyncio.wait_for(
+                io.write_full("during-backfill", b"x" * 64),
+                timeout=10.0)
+            data["during-backfill"] = b"x" * 64
+            await c.wait_for_clean(timeout=120)
+            lost = [oid for oid in data
+                    if _replica_count(c, oid) < 3]
+            assert lost == [], f"under-replicated after backfill: " \
+                               f"{lost[:5]} (+{len(lost)} total)"
+            for oid, payload in data.items():
+                assert await io.read(oid) == payload, oid
+            pushed = sum(pg.backfill_stats["pushed"]
+                         for o in c.osds for pg in o.pgs.values())
+            assert pushed > 0, "backfill never pushed anything"
+            for o in c.osds:
+                assert o.local_reserver.peak <= 1, \
+                    f"osd.{o.whoami} exceeded osd_max_backfills"
+                assert o.remote_reserver.peak <= 1
+            # every watermark retired to MAX
+            for o in c.osds:
+                for pg in o.pgs.values():
+                    assert pg.last_backfill == MAX_OID
+                    assert not pg.backfill_targets
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_backfill_resumable_across_target_restart():
+    """Restart the target mid-backfill: the persisted last_backfill
+    watermark survives the remount and the next backfill resumes from
+    it instead of rescanning from MIN (acceptance criterion #4)."""
+    async def go():
+        from ceph_tpu.os_.bluestore import BlueStore
+        import json as _json
+        import tempfile
+        tmp = tempfile.mkdtemp(prefix="bfres")
+        cfg = dict(HORIZON_CFG,
+                   osd_backfill_scan_max=4,
+                   osd_recovery_max_bytes=60_000)   # ~30 obj/s at 2KB
+        c = await Cluster(n_mons=1, n_osds=3, config=cfg).start()
+        try:
+            await c.client.pool_create("t", pg_num=1, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            data = {}
+            for i in range(60):
+                oid = f"o{i:04d}"
+                payload = bytes([i % 256]) * 2048
+                await io.write_full(oid, payload)
+                data[oid] = payload
+                if i == 9:
+                    await c.kill_osd(2)
+                    await c.wait_for_osd_down(2, timeout=60)
+            # rejoin on a persistent (BlueStore) disk so the watermark
+            # survives the mid-backfill restart below
+            store = BlueStore(f"{tmp}/osd2")
+            await c.revive_osd(2, store=store)
+
+            def persisted_watermark():
+                st = c.osds[2].store
+                for cid in st.list_collections():
+                    try:
+                        blob = st.omap_get(cid, "_pgmeta_").get(
+                            "peering")
+                    except Exception:
+                        continue
+                    if blob:
+                        lb = _json.loads(blob).get("last_backfill",
+                                                   MAX_OID)
+                        if lb != MAX_OID:
+                            return lb
+                return None
+
+            # wait until at least one PROGRESS persisted (lb advanced
+            # past MIN but not complete), then hard-restart the target
+            deadline = asyncio.get_event_loop().time() + 30
+            wm = None
+            while True:
+                wm = persisted_watermark()
+                if wm:                      # non-empty, non-MAX
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(
+                        "no backfill progress persisted in time")
+                await asyncio.sleep(0.02)
+            await c.kill_osd(2)
+            store.umount()
+            await c.wait_for_osd_down(2, timeout=60)
+            remounted = BlueStore(f"{tmp}/osd2")
+            await c.revive_osd(2, store=remounted)
+            await c.wait_for_clean(timeout=180)
+            # the new run RESUMED: some primary recorded picking up a
+            # mid-scan watermark (not MIN, not MAX)
+            resumed = [pg.backfill_stats["resumed_from"]
+                       for o in c.osds for pg in o.pgs.values()
+                       if pg.backfill_stats["resumed_from"]]
+            assert resumed, "backfill restarted from scratch"
+            # the resume point is AT or PAST the watermark we saw
+            # persisted before the restart — never back at MIN
+            assert any(r >= wm for r in resumed), (resumed, wm)
+            lost = [oid for oid in data
+                    if _replica_count(c, oid) < 3]
+            assert lost == [], f"under-replicated: {lost[:5]}"
+            for oid, payload in data.items():
+                assert await io.read(oid) == payload, oid
+            errs = remounted.fsck() if hasattr(remounted, "fsck") \
+                else []
+            assert errs == [], errs
+        finally:
+            await c.stop()
+    run(go())
+
+
+@pytest.mark.slow
+def test_horizon_backfill_ec_pool():
+    """EC variant: a fresh shard-holder joining past the horizon gets
+    its POSITION's shards rebuilt by the backfill scan (decode + re-
+    encode), and the degraded gate keeps reads correct throughout."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=4,
+                          config=dict(HORIZON_CFG)).start()
+        try:
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "osd erasure-code-profile set",
+                 "name": "p21", "profile": ["k=2", "m=1",
+                                            "crush-failure-domain=osd"]})
+            assert ret == 0, rs
+            await c.client.pool_create("e", pg_num=2,
+                                       pool_type="erasure",
+                                       erasure_code_profile="p21")
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("e")
+            data = {}
+            for i in range(40):
+                oid = f"e{i:04d}"
+                payload = bytes([i % 256]) * 1024
+                await io.write_full(oid, payload)
+                data[oid] = payload
+                if i == 7:
+                    await c.kill_osd(3)
+                    await c.wait_for_osd_down(3, timeout=60)
+            await c.revive_osd(3, store=MemStore())
+            await c.wait_for_clean(timeout=180)
+            for oid, payload in data.items():
+                assert await io.read(oid) == payload, oid
+            # every acting shard OSD holds every object's shard
+            lost = [oid for oid in data
+                    if _replica_count(c, oid) < 3]
+            assert lost == [], lost
+        finally:
+            await c.stop()
+    run(go())
+
+
+# -- thrasher backfill storm (satellite: sim/thrasher wiring) --------------
+
+def test_thrasher_backfill_storm_smoke():
+    """Thrasher.backfill_storm: kill, write past the horizon, revive
+    with a FRESH store (the replace-an-OSD case), settle-and-verify —
+    acked-data survival across the horizon proves the backfill path
+    moved the history."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3,
+                          config=dict(HORIZON_CFG)).start()
+        try:
+            await c.client.pool_create("t", pg_num=2, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            th = Thrasher(c, seed=77, min_live_osds=2)
+            res = await th.backfill_storm(io, writes=40,
+                                          fresh_store=True)
+            assert res["acked_writes"] > 30
+            summary = await th.settle_and_verify(io, timeout=180)
+            assert summary["acked_writes"] == res["acked_writes"]
+            lost = [oid for oid in th.acked
+                    if _replica_count(c, oid) < 3]
+            assert lost == [], lost
+        finally:
+            await c.stop()
+    run(go())
+
+
+@pytest.mark.slow
+def test_thrasher_backfill_storm_deep(tmp_path):
+    """The acceptance storm on BlueStore: horizon-crossing writes
+    under a concurrent partition, revive-with-remount, then a full
+    settle-and-verify (clean + acked-data survival + store fsck)."""
+    async def go():
+        from ceph_tpu.os_.bluestore import BlueStore
+
+        def mk(i):
+            return BlueStore(str(tmp_path / f"osd{i}" / "bs"))
+
+        stores = [mk(i) for i in range(4)]
+        cfg = dict(HORIZON_CFG,
+                   mon_osd_min_down_reporters=2,
+                   mon_lease=4.0, mon_lease_interval=0.5,
+                   mon_election_timeout=1.0, mon_paxos_timeout=8.0)
+        c = await Cluster(n_mons=3, n_osds=4, stores=stores,
+                          config=cfg).start()
+        try:
+            await c.client.pool_create("t", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=240)
+            io = await c.client.open_ioctx("t")
+            th = Thrasher(c, seed=4242, store_factory=mk,
+                          min_live_osds=3)
+            res = await th.backfill_storm(io, writes=120,
+                                          partitions=1,
+                                          fresh_store=True)
+            assert res["acked_writes"] > 60
+            summary = await th.settle_and_verify(io, timeout=600)
+            # the victim was REPLACED with a fresh MemStore (no fsck);
+            # the three surviving BlueStores must all fsck clean
+            assert summary["fscked_stores"] == 3
+            lost = [oid for oid in th.acked
+                    if _replica_count(c, oid) < 3]
+            assert lost == [], lost[:10]
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_resume_repairs_sub_watermark_changes_past_horizon():
+    """The resume-safety criterion: while the target is down
+    mid-backfill, an ALREADY-BACKFILLED object (below its watermark)
+    is modified and the update then falls off the retained log. A
+    naive resume would skip the sub-watermark region and leave the
+    stale copy forever; the persisted ``backfill_at`` point makes
+    peering either re-derive the delta (log still continuous with it)
+    or restart the scan from MIN — the object must be current on the
+    target after convergence either way."""
+    async def go():
+        cfg = dict(HORIZON_CFG,
+                   osd_backfill_scan_max=4,
+                   osd_recovery_max_bytes=60_000)
+        c = await Cluster(n_mons=1, n_osds=3, config=cfg).start()
+        try:
+            await c.client.pool_create("t", pg_num=1, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            for i in range(40):
+                await io.write_full(f"o{i:04d}",
+                                    bytes([i % 256]) * 2048)
+                if i == 9:
+                    await c.kill_osd(2)
+                    await c.wait_for_osd_down(2, timeout=60)
+            await c.revive_osd(2, store=MemStore())
+            # wait for the scan to advance past o0000, then kill the
+            # target mid-backfill
+            pg2 = None
+            deadline = asyncio.get_event_loop().time() + 30
+            while True:
+                pg2 = next(iter(c.osds[2].pgs.values()), None)
+                if pg2 is not None and \
+                        "" < pg2.last_backfill < MAX_OID:
+                    break
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.02)
+            wm = pg2.last_backfill
+            await c.kill_osd(2)
+            await c.wait_for_osd_down(2, timeout=60)
+            # modify a SUB-watermark object, then push its entry past
+            # the retained log horizon (keep=5)
+            changed = bytes(b"NEW!") * 512
+            await io.write_full("o0000", changed)
+            assert "o0000" < wm
+            for i in range(10):
+                await io.write_full(f"zfill{i}", b"z" * 64)
+            old_store = c.osds[2].store      # keeps its pre-kill state
+            await c.revive_osd(2, store=old_store)
+            await c.wait_for_clean(timeout=180)
+            # the stale sub-watermark copy must have been repaired
+            pg2 = next(iter(c.osds[2].pgs.values()))
+            assert old_store.read(pg2.cid, "o0000") == changed
+            assert await io.read("o0000") == changed
+        finally:
+            await c.stop()
+    run(go())

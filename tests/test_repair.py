@@ -1,0 +1,179 @@
+"""`ceph pg repair`: the scrub repair path (VERDICT missing #6).
+
+ref test model: qa/standalone/scrub/osd-scrub-repair.sh — corrupt a
+copy behind the cluster's back, `ceph pg repair`, and the digest-
+mismatched replica is rewritten from the authoritative copy (majority
+vote across whole-object digests; the reference picks by object-info
+digest). EC: a bad shard is regenerated from the survivors through
+the decode path.
+"""
+
+import asyncio
+import os
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.os_.objectstore import Transaction
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def _pg_holding(c, oid, primary: bool):
+    for o in c.osds:
+        for pg in o.pgs.values():
+            if pg.is_primary() == primary and \
+                    oid in o.store.list_objects(pg.cid):
+                return o, pg
+    return None, None
+
+
+def test_pg_repair_replicated():
+    """Replica corruption repairs from the primary; PRIMARY corruption
+    repairs from the replica majority (the vote must out-rank the
+    primary's own bad copy); the `pg repair` mon command drives the
+    same path end-to-end."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("s", pg_num=2, size=3)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("s")
+            good = b"\xabGOOD" * 64
+            await io.write_full("r1", good)
+
+            # 1: corrupt a REPLICA copy
+            osd, pg = _pg_holding(c, "r1", primary=False)
+            assert pg is not None
+            osd.store.queue_transaction(
+                Transaction().write(pg.cid, "r1", 0, b"CORRUPT"))
+            posd = next(x for x in c.osds if x.whoami == pg.primary)
+            ppg = posd.pgs[pg.cid]
+            rep = await ppg.scrubber.repair()
+            assert rep["errors_before"], rep
+            assert rep["repaired"] >= 1, rep
+            assert rep["errors_after"] == [], rep
+            assert osd.store.read(pg.cid, "r1") == good
+            assert ppg.scrub_errors == 0
+
+            # 2: corrupt the PRIMARY's copy — majority wins
+            posd.store.queue_transaction(
+                Transaction().write(ppg.cid, "r1", 0, b"BADPRIM"))
+            rep = await ppg.scrubber.repair()
+            assert rep["errors_after"] == [], rep
+            assert posd.store.read(ppg.cid, "r1") == good
+
+            # 3: the CLI/mon path (`ceph pg repair <pgid>`)
+            osd.store.queue_transaction(
+                Transaction().write(pg.cid, "r1", 0, b"AGAIN"))
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "pg repair", "pgid": pg.cid})
+            assert ret == 0, rs
+            deadline = asyncio.get_event_loop().time() + 15
+            while osd.store.read(pg.cid, "r1") != good:
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "mon-driven repair never landed"
+                await asyncio.sleep(0.1)
+
+            # unknown pg errors cleanly
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "pg repair", "pgid": "9.0"})
+            assert ret == -2, rs
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_pg_repair_ec_shard():
+    """A corrupted parity shard is detected by deep scrub and
+    regenerated from the data shards via the existing decode/encode
+    path; the inconsistent flag clears."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "osd erasure-code-profile set",
+                 "name": "p21",
+                 "profile": ["k=2", "m=1",
+                             "crush-failure-domain=osd",
+                             "stripe_unit=512"]})
+            assert ret == 0, rs
+            await c.client.pool_create("e", pg_num=2,
+                                       pool_type="erasure",
+                                       erasure_code_profile="p21")
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("e")
+            payload = os.urandom(3000)
+            await io.write_full("obj", payload)
+            prim_pg = next(pg for o in c.osds
+                           for pg in o.pgs.values()
+                           if pg.is_primary() and
+                           "obj" in o.store.list_objects(pg.cid))
+            parity_osd = next(o for o in c.osds
+                              if o.whoami == prim_pg.acting[2])
+            parity_osd.store.queue_transaction(
+                Transaction().write(prim_pg.cid, "obj", 10, b"XXXX"))
+            rep = await prim_pg.scrubber.repair()
+            assert rep["errors_before"], rep
+            assert rep["errors_after"] == [], rep
+            assert prim_pg.scrub_errors == 0
+            assert await io.read("obj") == payload
+            # a fresh deep scrub agrees the shard is sound again
+            rep = await prim_pg.scrubber.scrub(deep=True)
+            assert rep["errors"] == [], rep
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_pg_repair_ec_data_shard():
+    """The adversarial case: corrupting a DATA shard also makes the
+    regenerated parity disagree with the stored (good) parity — a
+    naive repair would 'fix' the good parity from the bad data and
+    canonicalize the corruption. Leave-one-out identification must
+    pin the actual culprit and rebuild IT from the survivors."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "osd erasure-code-profile set",
+                 "name": "p21",
+                 "profile": ["k=2", "m=1",
+                             "crush-failure-domain=osd",
+                             "stripe_unit=512"]})
+            assert ret == 0, rs
+            await c.client.pool_create("e", pg_num=2,
+                                       pool_type="erasure",
+                                       erasure_code_profile="p21")
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("e")
+            payload = os.urandom(3000)
+            await io.write_full("obj", payload)
+            prim_pg = next(pg for o in c.osds
+                           for pg in o.pgs.values()
+                           if pg.is_primary() and
+                           "obj" in o.store.list_objects(pg.cid))
+            # corrupt DATA shard position 0
+            data_osd = next(o for o in c.osds
+                            if o.whoami == prim_pg.acting[0])
+            parity_osd = next(o for o in c.osds
+                              if o.whoami == prim_pg.acting[2])
+            good_parity = parity_osd.store.read(prim_pg.cid, "obj")
+            good_data0 = data_osd.store.read(prim_pg.cid, "obj")
+            data_osd.store.queue_transaction(
+                Transaction().write(prim_pg.cid, "obj", 7, b"ROT"))
+            rep = await prim_pg.scrubber.repair()
+            assert rep["errors_before"], rep
+            assert any("shard 0 identified corrupt" in f
+                       for f in rep["errors_before"]), rep
+            assert rep["errors_after"] == [], rep
+            # the DATA shard was restored; the parity NEVER rewritten
+            # from corrupt data
+            assert data_osd.store.read(prim_pg.cid, "obj") == \
+                good_data0
+            assert parity_osd.store.read(prim_pg.cid, "obj") == \
+                good_parity
+            assert await io.read("obj") == payload
+        finally:
+            await c.stop()
+    run(go())
