@@ -137,16 +137,17 @@ def dec_pg_t(d: Decoder) -> pg_t:
 
 
 def _enc_pool(e: Encoder, p: PGPool) -> None:
-    with e.start(1):
+    with e.start(2):                    # v2: + quotas
         e.s64(p.id).u32(p.pg_num).u32(p.pgp_num).u8(p.type)
         e.u32(p.size).u32(p.min_size).s32(p.crush_rule).u64(p.flags)
         e.u8(p.object_hash).string(p.erasure_code_profile).string(p.name)
         e.bool(p.pg_temp_primaries_first)
         e.string(json.dumps(p.extra) if p.extra else "")
+        e.u64(p.quota_bytes).u64(p.quota_objects)          # v2
 
 
 def _dec_pool(d: Decoder) -> PGPool:
-    with d.start(1):
+    with d.start(2) as _v:
         p = PGPool(id=d.s64(), pg_num=d.u32(), pgp_num=d.u32(),
                    type=d.u8(), size=d.u32(), min_size=d.u32(),
                    crush_rule=d.s32(), flags=d.u64(),
@@ -155,6 +156,9 @@ def _dec_pool(d: Decoder) -> PGPool:
                    pg_temp_primaries_first=d.bool())
         extra = d.string()
         p.extra = json.loads(extra) if extra else {}
+        if _v >= 2:
+            p.quota_bytes = d.u64()
+            p.quota_objects = d.u64()
     return p
 
 
@@ -180,7 +184,7 @@ def encode_osdmap(m) -> bytes:
     monitor store value)."""
     e = Encoder()
     e.u32(OSDMAP_MAGIC)
-    with e.start(4):                    # v4: + blocklist
+    with e.start(5):                    # v5: + service flags
         e.u32(m.epoch)
         e.blob(encode_crush_map(m.crush))
         e.u32(m.max_osd)
@@ -201,6 +205,7 @@ def encode_osdmap(m) -> bytes:
               lambda e, v: e.u32(v))                           # v3
         e.map(m.blocklist, lambda e, k: e.string(k),
               lambda e, v: e.f64(v))                           # v4
+        e.u64(m.flags)                                         # v5
     return e.tobytes()
 
 
@@ -209,7 +214,7 @@ def decode_osdmap(data: bytes):
     d = Decoder(data)
     if d.u32() != OSDMAP_MAGIC:
         raise EncodingError("bad osdmap magic")
-    with d.start(4) as _v:
+    with d.start(5) as _v:
         epoch = d.u32()
         crush = decode_crush_map(d.blob())
         max_osd = d.u32()
@@ -232,6 +237,8 @@ def decode_osdmap(data: bytes):
         if _v >= 4:
             m.blocklist = d.map(lambda d: d.string(),
                                 lambda d: d.f64())
+        if _v >= 5:
+            m.flags = d.u64()
     return m
 
 
@@ -239,7 +246,7 @@ def encode_incremental(inc) -> bytes:
     """ref: OSDMap::Incremental::encode — the delta the monitor commits
     per epoch and OSDs apply on subscription."""
     e = Encoder()
-    with e.start(4):                    # v4: + blocklist
+    with e.start(5):                    # v5: + service flags
         e.u32(inc.epoch)
         e.optional(inc.new_max_osd, lambda e, v: e.u32(v))
         e.map(inc.new_pools, lambda e, k: e.s64(k), _enc_pool)
@@ -270,6 +277,7 @@ def encode_incremental(inc) -> bytes:
         e.map(inc.new_blocklist, lambda e, k: e.string(k),
               lambda e, v: e.f64(v))                              # v4
         e.list(inc.old_blocklist, lambda e, v: e.string(v))       # v4
+        e.s64(-1 if inc.new_flags is None else inc.new_flags)     # v5
     return e.tobytes()
 
 
@@ -277,7 +285,7 @@ def decode_incremental(data: bytes):
     from ceph_tpu.osd.osdmap import Incremental
     d = Decoder(data)
     inc = Incremental()
-    with d.start(4) as _v:
+    with d.start(5) as _v:
         inc.epoch = d.u32()
         inc.new_max_osd = d.optional(lambda d: d.u32())
         inc.new_pools = d.map(lambda d: d.s64(), _dec_pool)
@@ -307,4 +315,7 @@ def decode_incremental(data: bytes):
             inc.new_blocklist = d.map(lambda d: d.string(),
                                       lambda d: d.f64())
             inc.old_blocklist = d.list(lambda d: d.string())
+        if _v >= 5:
+            nf = d.s64()
+            inc.new_flags = None if nf < 0 else nf
     return inc

@@ -256,6 +256,31 @@ class PrometheusModule(MgrModule):
         for state, n in pg.get("states", {}).items():
             safe = state.replace("+", "_")
             lines.append(f'ceph_pg_state{{state="{safe}"}} {n}')
+        # overload protection: per-OSD utilization ratio, pool quotas,
+        # fullness counts and the osdmap service flags
+        lines.append("# TYPE ceph_osd_utilization gauge")
+        for osd, ut in om.get("osd_utilization", {}).items():
+            cap = ut.get("capacity", 0)
+            ratio = ut.get("used", 0) / cap if cap else 0.0
+            lines.append(
+                f'ceph_osd_utilization{{osd="{osd}"}} {ratio:.6f}')
+        for pq in om.get("pool_quotas", []):
+            name = pq.get("name", str(pq.get("pool")))
+            lines += [
+                f'ceph_pool_quota_bytes{{pool="{name}"}} '
+                f'{pq.get("quota_bytes", 0)}',
+                f'ceph_pool_quota_objects{{pool="{name}"}} '
+                f'{pq.get("quota_objects", 0)}',
+                f'ceph_pool_full{{pool="{name}"}} '
+                f'{pq.get("full", 0)}',
+            ]
+        lines += [
+            f"ceph_osd_nearfull {om.get('num_nearfull_osds', 0)}",
+            f"ceph_osd_full {om.get('num_full_osds', 0)}",
+        ]
+        flags = om.get("flags", "")
+        for fname in (flags.split(",") if flags else []):
+            lines.append(f'ceph_osdmap_flag{{flag="{fname}"}} 1')
         # in-process perf counters (ref: prometheus module exporting
         # daemon perf counters)
         for name, counters in PerfCountersCollection.instance() \

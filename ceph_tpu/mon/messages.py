@@ -92,13 +92,16 @@ class MOSDBoot(Message):
 
 @register
 class MOSDFailure(Message):
-    """ref: MOSDFailure — reporter accuses target of being unreachable."""
+    """ref: MOSDFailure — reporter accuses target of being unreachable.
+    ``alive=1`` is the cancellation (ref: OSD::send_still_alive /
+    MOSDFailure::FLAG_ALIVE): the reporter heard the target again
+    within grace, so the mon must drop that reporter's accusation."""
 
     TYPE = 141
     # reporter survives peon->leader forwarding (msg.src gets rewritten
     # to the forwarding mon at each messenger hop)
     FIELDS = [("target", "s32"), ("failed_for", "u32"), ("epoch", "u32"),
-              ("reporter", "str")]
+              ("reporter", "str"), ("alive", "u8")]
 
 
 @register
@@ -126,13 +129,31 @@ class MMonGetOSDMap(Message):
 
 
 @register
+class MOSDMarkMeDown(Message):
+    """OSD -> mon on graceful shutdown (ref: MOSDMarkMeDown): commit
+    my down state in the next incremental instead of burning a full
+    heartbeat-grace period of client timeouts. The OSD observes the
+    committed map (its subscription stays live while stopping) as the
+    ack before it exits. Honored even under ``nodown`` — the flag
+    suppresses failure-report markdowns, not an explicit request."""
+
+    TYPE = 146
+    FIELDS = [("osd", "s32"), ("epoch", "u32")]
+
+
+@register
 class MPGStats(Message):
     """OSD -> mon pg stat report (ref: src/messages/MPGStats.h);
     per-pg stats as an encoded blob map keyed by 'pool.seed'.
     ``slow_ops`` piggybacks the daemon's OpTracker slow-op count so
     the mon can raise a SLOW_OPS health warning (ref: the osd_perf /
-    health_check path upstream routes through the mgr)."""
+    health_check path upstream routes through the mgr).
+    ``used_bytes``/``capacity_bytes`` are the daemon's statfs (ref:
+    osd_stat_t::statfs riding MPGStats): the mon aggregates them into
+    per-OSD utilization and derives NEARFULL/FULL state + the cluster
+    FULL flag. capacity 0 = unbounded store, fullness not tracked."""
 
     TYPE = 145
     FIELDS = [("osd", "s32"), ("epoch", "u32"),
-              ("stats", "map:str:blob"), ("slow_ops", "u32")]
+              ("stats", "map:str:blob"), ("slow_ops", "u32"),
+              ("used_bytes", "u64"), ("capacity_bytes", "u64")]

@@ -18,7 +18,7 @@ from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
     MMonCommand, MMonCommandAck, MMonElection, MMonGetOSDMap, MMonMap,
     MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
-    MOSDFailure, MOSDMap, MPGStats,
+    MOSDFailure, MOSDMap, MOSDMarkMeDown, MPGStats,
 )
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.store import MonitorDBStore
@@ -226,7 +226,8 @@ class Monitor(Dispatcher):
         if isinstance(msg, MMonGetOSDMap):
             await self._send_osdmaps(msg.conn, msg.start_epoch)
             return True
-        if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure, MPGStats)):
+        if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure,
+                            MOSDMarkMeDown, MPGStats)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:
@@ -345,14 +346,34 @@ class Monitor(Dispatcher):
         osd_stat = {}
         if om is not None:
             import numpy as np
-            from ceph_tpu.osd.osdmap import STATE_EXISTS, STATE_UP
+            from ceph_tpu.osd.osdmap import (
+                STATE_EXISTS, STATE_FULL, STATE_NEARFULL, STATE_UP,
+                flag_names,
+            )
             up = int(np.sum((om.osd_state & STATE_UP) != 0))
             inn = int(np.sum((np.asarray(om.osd_weight) > 0) &
                              ((om.osd_state & STATE_EXISTS) != 0)))
             exists = int(np.sum((om.osd_state & STATE_EXISTS) != 0))
             osd_stat = {"epoch": om.epoch, "num_osds": exists,
                         "num_up_osds": up, "num_in_osds": inn,
-                        "pools": len(om.pools)}
+                        "pools": len(om.pools),
+                        "flags": flag_names(om.flags),
+                        "num_nearfull_osds": int(np.sum(
+                            (om.osd_state & STATE_NEARFULL) != 0)),
+                        "num_full_osds": int(np.sum(
+                            (om.osd_state & STATE_FULL) != 0)),
+                        "osd_utilization": {
+                            str(o): {"used": u, "capacity": c}
+                            for o, (u, c) in sorted(
+                                self.osdmon.osd_utilization.items())},
+                        "pool_quotas": [
+                            {"pool": p.id, "name": p.name,
+                             "quota_bytes": p.quota_bytes,
+                             "quota_objects": p.quota_objects,
+                             "full": int(p.is_full())}
+                            for p in om.pools.values()
+                            if p.quota_bytes or p.quota_objects or
+                            p.is_full()]}
         return {
             "fsid": self.monmap.fsid,
             "health": health,

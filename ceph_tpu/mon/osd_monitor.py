@@ -23,13 +23,16 @@ from ceph_tpu.encoding import (
     encode_osdmap,
 )
 from ceph_tpu.mon.messages import (MOSDAlive, MOSDBoot, MOSDFailure,
-                                   MPGStats)
+                                   MOSDMarkMeDown, MPGStats)
 from ceph_tpu.mon.service import PaxosService
 from ceph_tpu.osd.osdmap import (
-    STATE_EXISTS, STATE_UP, Incremental, OSDMap,
+    FLAG_FULL, FLAG_NAMES, FLAG_NODOWN, FLAG_NOIN, FLAG_NOOUT,
+    FLAG_NOUP, STATE_EXISTS, STATE_FULL, STATE_NEARFULL, STATE_UP,
+    Incremental, OSDMap, flag_names,
 )
 from ceph_tpu.osd.types import (
-    POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED, PGPool,
+    FLAG_POOL_FULL_QUOTA, POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED,
+    PGPool,
 )
 from ceph_tpu.utils.logging import get_logger
 
@@ -44,18 +47,32 @@ class OSDMonitor(PaxosService):
     def __init__(self, mon) -> None:
         super().__init__(mon)
         self.osdmap: OSDMap | None = None
-        # failure accounting (leader-side, ref: OSDMonitor failure_info)
-        self.failure_reporters: dict[int, set[str]] = {}
+        # failure accounting (leader-side, ref: OSDMonitor failure_info):
+        # target -> {reporter: report wall time}. Stamped so stale
+        # accusations EXPIRE (mon_osd_reporter_lifetime) instead of
+        # accumulating until two unrelated reports minutes apart
+        # wrongly cross min_down_reporters; a still-alive cancel
+        # (MOSDFailure alive=1) removes its reporter outright.
+        self.failure_reporters: dict[int, dict[str, float]] = {}
         self.down_at: dict[int, float] = {}
         self.min_down_reporters = mon.config.get(
             "mon_osd_min_down_reporters", 2)
         self.down_out_interval = mon.config.get(
             "mon_osd_down_out_interval", 600.0)
+        self.reporter_lifetime = mon.config.get(
+            "mon_osd_reporter_lifetime", 600.0)
         # pg stats: "pool.seed" -> dict (latest primary report)
         self.pg_stats: dict[str, dict] = {}
         # osd -> in-flight ops past the complaint threshold (from the
         # MPGStats piggyback; feeds the SLOW_OPS health warning)
         self.osd_slow_ops: dict[int, int] = {}
+        # osd -> (used_bytes, capacity_bytes) from the MPGStats statfs
+        # piggyback; the fullness tick derives NEARFULL/FULL from it
+        self.osd_utilization: dict[int, tuple[int, int]] = {}
+        # True while the FULL flag was set by the fullness tick (auto);
+        # only an auto-set flag is auto-cleared — an operator's
+        # `osd set full` stays until `osd unset full`
+        self._full_auto = False
         # serializes map mutations: concurrent handlers must not build
         # incrementals against the same base epoch
         self._inc_lock = asyncio.Lock()
@@ -133,6 +150,8 @@ class OSDMonitor(PaxosService):
             await self._handle_failure(msg)
         elif isinstance(msg, MOSDAlive):
             await self._handle_alive(msg)
+        elif isinstance(msg, MOSDMarkMeDown):
+            await self._handle_mark_me_down(msg)
         elif isinstance(msg, MPGStats):
             self._handle_pg_stats(msg)
 
@@ -162,42 +181,87 @@ class OSDMonitor(PaxosService):
 
     async def _handle_boot(self, m: MOSDBoot) -> None:
         """ref: OSDMonitor::prepare_boot — mark up, publish addrs,
-        auto-in on first boot."""
+        auto-in on first boot. ``noup`` suppresses the up transition
+        (the OSD keeps re-announcing until the flag clears); ``noin``
+        suppresses the auto-in."""
         if self.osdmap is None or m.osd >= self.osdmap.max_osd:
+            return
+        if self.osdmap.test_flag(FLAG_NOUP):
+            log.dout(1, f"osd.{m.osd} boot ignored (noup set)")
             return
         inc = Incremental()
         inc.new_up = [m.osd]
         inc.new_addrs[m.osd] = (m.addr_host, m.addr_port, m.hb_port)
-        if self.osdmap.osd_weight[m.osd] == 0:
+        if self.osdmap.osd_weight[m.osd] == 0 and \
+                not self.osdmap.test_flag(FLAG_NOIN):
             inc.new_weight[m.osd] = WEIGHT_ONE      # auto-in on boot
         self.failure_reporters.pop(m.osd, None)
         self.down_at.pop(m.osd, None)
         self.osd_slow_ops.pop(m.osd, None)   # fresh incarnation
+        self.osd_utilization.pop(m.osd, None)
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.osd} boot -> up (epoch "
                     f"{self.osdmap.epoch})")
 
     async def _handle_failure(self, m: MOSDFailure) -> None:
         """ref: OSDMonitor::prepare_failure — mark down once enough
-        distinct reporters accuse the target."""
+        distinct LIVE reporters accuse the target. alive=1 is the
+        cancellation (ref: send_still_alive): the reporter heard the
+        target again, its accusation is withdrawn. ``nodown``
+        suppresses the markdown (reports still accumulate, so
+        unsetting the flag acts on fresh evidence immediately)."""
         om = self.osdmap
         if om is None or m.target >= om.max_osd or \
                 not bool(om.is_up(np.asarray(m.target))):
             return
-        reporters = self.failure_reporters.setdefault(m.target, set())
-        reporters.add(m.reporter or m.src or "?")
+        who = m.reporter or m.src or "?"
+        if getattr(m, "alive", 0):
+            reps = self.failure_reporters.get(m.target)
+            if reps is not None and reps.pop(who, None) is not None:
+                log.dout(5, f"osd.{m.target}: reporter {who} "
+                            f"cancelled (still alive)")
+                if not reps:
+                    self.failure_reporters.pop(m.target, None)
+            return
+        import time
+        reporters = self.failure_reporters.setdefault(m.target, {})
+        reporters[who] = time.time()
         if len(reporters) < self.min_down_reporters:
+            return
+        if om.test_flag(FLAG_NODOWN):
+            log.dout(1, f"osd.{m.target} would be marked down but "
+                        f"nodown is set")
             return
         inc = Incremental()
         inc.new_down = [m.target]
         self.failure_reporters.pop(m.target, None)
         # a dead daemon can't send the clearing report: drop its
-        # slow-op count or the SLOW_OPS warning outlives it
+        # slow-op count and stale statfs or the SLOW_OPS warning /
+        # FULL evidence outlives it
         self.osd_slow_ops.pop(m.target, None)
+        self.osd_utilization.pop(m.target, None)
         self.down_at[m.target] = asyncio.get_event_loop().time()
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.target} marked down "
                     f"({len(reporters)} reporters)")
+
+    async def _handle_mark_me_down(self, m: MOSDMarkMeDown) -> None:
+        """ref: OSDMonitor::prepare_mark_me_down — a gracefully
+        stopping OSD asks for its down commit up front instead of
+        burning a heartbeat-grace period of client timeouts. Explicit
+        request: honored even under nodown."""
+        om = self.osdmap
+        if om is None or m.osd < 0 or m.osd >= om.max_osd or \
+                not bool(om.is_up(np.asarray(m.osd))):
+            return
+        inc = Incremental()
+        inc.new_down = [m.osd]
+        self.failure_reporters.pop(m.osd, None)
+        self.osd_slow_ops.pop(m.osd, None)
+        self.osd_utilization.pop(m.osd, None)
+        self.down_at[m.osd] = asyncio.get_event_loop().time()
+        await self._propose_inc(inc)
+        log.dout(1, f"osd.{m.osd} marked down (mark-me-down)")
 
     def _handle_pg_stats(self, m: MPGStats) -> None:
         for pgid, blob in m.stats.items():
@@ -210,6 +274,12 @@ class OSDMonitor(PaxosService):
             self.osd_slow_ops[m.osd] = slow
         else:
             self.osd_slow_ops.pop(m.osd, None)
+        cap = getattr(m, "capacity_bytes", 0)
+        if cap:
+            self.osd_utilization[m.osd] = \
+                (getattr(m, "used_bytes", 0), cap)
+        else:
+            self.osd_utilization.pop(m.osd, None)
 
     async def tick(self) -> None:
         """Auto-out: down past the interval -> weight 0
@@ -235,7 +305,22 @@ class OSDMonitor(PaxosService):
             ok, _ = await self._propose_change(build)
             if ok:
                 log.dout(1, f"trimmed expired blocklist: {expired}")
+        # failure-report hygiene: a reporter's accusation expires after
+        # mon_osd_reporter_lifetime — two stale reports minutes apart
+        # must not sum to a markdown (ref: the failure_info pruning the
+        # reference does in check_failure)
+        for target, reps in list(self.failure_reporters.items()):
+            for who, at in list(reps.items()):
+                if wall - at > self.reporter_lifetime:
+                    del reps[who]
+            if not reps:
+                self.failure_reporters.pop(target, None)
+        await self._check_fullness()
         if not self.down_at:
+            return
+        if om.test_flag(FLAG_NOOUT):
+            # down_at stamps survive: unsetting noout resumes the
+            # down-out tick with the original down times
             return
         now = asyncio.get_event_loop().time()
         inc = Incremental()
@@ -248,6 +333,95 @@ class OSDMonitor(PaxosService):
                 for osd in inc.new_weight:
                     self.down_at.pop(osd, None)
                 log.dout(1, f"auto-out: {list(inc.new_weight)}")
+
+    async def _check_fullness(self) -> None:
+        """The fullness sweep (ref: OSDMonitor::tick ->
+        update_osd_stat + the pre-luminous full/nearfull flag logic +
+        the pool quota sweep in OSDMonitor::tick):
+
+        - per-OSD statfs vs mon_osd_nearfull_ratio (0.85) /
+          mon_osd_full_ratio (0.95) -> NEARFULL/FULL osd_state bits;
+        - any FULL osd -> the cluster FULL flag (auto-set, auto-
+          cleared once no OSD is full; a manually-set flag sticks);
+        - per-pool aggregate pg stats vs quota_bytes/quota_objects ->
+          FLAG_POOL_FULL_QUOTA toggled in the pool struct.
+
+        All changes ride ONE incremental so clients observe a
+        consistent fullness transition."""
+        nearfull_r = self.mon.config.get("mon_osd_nearfull_ratio", 0.85)
+        full_r = self.mon.config.get("mon_osd_full_ratio", 0.95)
+        util = dict(self.osd_utilization)
+        # pool aggregates from the freshest primary reports
+        pool_bytes: dict[int, int] = {}
+        pool_objs: dict[int, int] = {}
+        for pgid, st in self.pg_stats.items():
+            try:
+                pid = int(pgid.split(".")[0])
+            except ValueError:
+                continue
+            pool_bytes[pid] = pool_bytes.get(pid, 0) + \
+                st.get("num_bytes", 0)
+            pool_objs[pid] = pool_objs.get(pid, 0) + \
+                st.get("num_objects", 0)
+
+        changed_auto: dict = {}
+
+        def build(cur):
+            inc = Incremental()
+            any_full = False
+            for osd in range(cur.max_osd):
+                st = int(cur.osd_state[osd])
+                if not st & STATE_EXISTS:
+                    continue
+                want = st & ~(STATE_NEARFULL | STATE_FULL)
+                # a DOWN osd's last statfs is stale evidence: its
+                # fullness bits clear and it cannot hold the cluster
+                # FULL flag hostage (a dead full OSD would otherwise
+                # park every write forever — boot re-reports anyway)
+                if st & STATE_UP:
+                    used, cap = util.get(osd, (0, 0))
+                    ratio = used / cap if cap > 0 else 0.0
+                    if ratio >= full_r:
+                        want |= STATE_FULL
+                        any_full = True
+                    elif ratio >= nearfull_r:
+                        want |= STATE_NEARFULL
+                if want != st:
+                    inc.new_state[osd] = want
+            flags = cur.flags
+            if any_full and not flags & FLAG_FULL:
+                flags |= FLAG_FULL
+                changed_auto["full"] = True
+            elif not any_full and flags & FLAG_FULL and \
+                    self._full_auto:
+                flags &= ~FLAG_FULL
+                changed_auto["full"] = False
+            if flags != cur.flags:
+                inc.new_flags = flags
+            for pool in cur.pools.values():
+                over = bool(
+                    (pool.quota_bytes and
+                     pool_bytes.get(pool.id, 0) >= pool.quota_bytes) or
+                    (pool.quota_objects and
+                     pool_objs.get(pool.id, 0) >= pool.quota_objects))
+                if over != bool(pool.flags & FLAG_POOL_FULL_QUOTA):
+                    import copy
+                    newpool = copy.deepcopy(pool)
+                    if over:
+                        newpool.flags |= FLAG_POOL_FULL_QUOTA
+                    else:
+                        newpool.flags &= ~FLAG_POOL_FULL_QUOTA
+                    inc.new_pools[pool.id] = newpool
+            if not (inc.new_state or inc.new_flags is not None or
+                    inc.new_pools):
+                return None
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if ok and "full" in changed_auto:
+            self._full_auto = changed_auto["full"]
+            log.dout(1, f"cluster FULL flag "
+                        f"{'set' if self._full_auto else 'cleared'} "
+                        f"by fullness sweep")
 
     # -- pgmap summary -----------------------------------------------------
     def pg_summary(self) -> dict:
@@ -294,6 +468,9 @@ class OSDMonitor(PaxosService):
             "osd erasure-code-profile set": self._cmd_ecp_set,
             "osd erasure-code-profile get": self._cmd_ecp_get,
             "osd erasure-code-profile ls": self._cmd_ecp_ls,
+            "osd set": self._cmd_set_flag,
+            "osd unset": self._cmd_unset_flag,
+            "osd pool set-quota": self._cmd_pool_set_quota,
             "osd down": self._cmd_down,
             "osd out": self._cmd_out,
             "osd in": self._cmd_in,
@@ -358,6 +535,87 @@ class OSDMonitor(PaxosService):
         # the OSDs enforce the blocklist before caps move on
         return 0, f"blocklist {op} {name}", json.dumps(
             {"epoch": self.osdmap.epoch}).encode()
+
+    async def _cmd_set_flag(self, cmd, inbl):
+        """`ceph osd set <flag>` (ref: OSDMonitor prepare_command
+        "osd set"): pauserd, pausewr, full, noout, nodown, noup,
+        noin."""
+        name = cmd.get("key", "")
+        bit = FLAG_NAMES.get(name)
+        if bit is None:
+            return -22, f"unknown flag {name!r} (have: " \
+                        f"{', '.join(FLAG_NAMES)})", b""
+
+        def build(om):
+            if om.flags & bit:
+                return None
+            inc = Incremental()
+            inc.new_flags = om.flags | bit
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if bit == FLAG_FULL:
+            self._full_auto = False      # operator-set: sticky
+        if not ok and not (self.osdmap.flags & bit):
+            return -11, "proposal failed", b""
+        return 0, f"{name} is set", b""
+
+    async def _cmd_unset_flag(self, cmd, inbl):
+        name = cmd.get("key", "")
+        bit = FLAG_NAMES.get(name)
+        if bit is None:
+            return -22, f"unknown flag {name!r}", b""
+
+        def build(om):
+            if not om.flags & bit:
+                return None
+            inc = Incremental()
+            inc.new_flags = om.flags & ~bit
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if bit == FLAG_FULL:
+            self._full_auto = False
+        if not ok and (self.osdmap.flags & bit):
+            return -11, "proposal failed", b""
+        return 0, f"{name} is unset", b""
+
+    async def _cmd_pool_set_quota(self, cmd, inbl):
+        """`ceph osd pool set-quota <pool> max_bytes|max_objects <val>`
+        (ref: OSDMonitor prepare_command "osd pool set-quota"). 0
+        clears the quota; the fullness tick then drops the pool's
+        FULL_QUOTA flag and parked writers resume."""
+        name = cmd.get("pool", "")
+        field_ = cmd.get("field", "")
+        if field_ not in ("max_bytes", "max_objects"):
+            return -22, f"field must be max_bytes|max_objects, " \
+                        f"got {field_!r}", b""
+        try:
+            val = int(cmd.get("val", ""))
+        except (TypeError, ValueError):
+            return -22, f"invalid quota value {cmd.get('val')!r}", b""
+        if val < 0:
+            return -22, "quota must be >= 0", b""
+
+        def build(om):
+            pool = next((p for p in om.pools.values()
+                         if p.name == name), None)
+            if pool is None:
+                return None
+            import copy
+            newpool = copy.deepcopy(pool)
+            if field_ == "max_bytes":
+                newpool.quota_bytes = val
+            else:
+                newpool.quota_objects = val
+            inc = Incremental()
+            inc.new_pools[pool.id] = newpool
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            if not any(p.name == name
+                       for p in self.osdmap.pools.values()):
+                return -2, f"pool '{name}' does not exist", b""
+            return -11, "proposal failed", b""
+        return 0, f"set pool {name} {field_} to {val}", b""
 
     async def _cmd_new(self, cmd, inbl):
         """Allocate an osd id (ref: `ceph osd new`)."""
@@ -635,11 +893,14 @@ class OSDMonitor(PaxosService):
         om = self.osdmap
         out = {
             "epoch": om.epoch, "max_osd": om.max_osd,
+            "flags": flag_names(om.flags),
             "osds": [{
                 "osd": o,
                 "up": int(bool(om.is_up(np.asarray(o)))),
                 "in": int(om.osd_weight[o] > 0),
                 "weight": float(om.osd_weight[o] / WEIGHT_ONE),
+                "nearfull": int(om.is_nearfull(o)),
+                "full": int(om.is_full(o)),
                 "addr": list(om.osd_addrs.get(o, ())),
             } for o in range(om.max_osd)
                 if om.osd_state[o] & STATE_EXISTS],
@@ -648,6 +909,9 @@ class OSDMonitor(PaxosService):
                        "min_size": p.min_size, "pg_num": p.pg_num,
                        "pgp_num": p.pgp_num,
                        "crush_rule": p.crush_rule,
+                       "quota_bytes": p.quota_bytes,
+                       "quota_objects": p.quota_objects,
+                       "full": int(p.is_full()),
                        "erasure_code_profile": p.erasure_code_profile}
                       for p in om.pools.values()],
             "pg_upmap_items": {str(k): [list(x) for x in v]
@@ -665,10 +929,16 @@ class OSDMonitor(PaxosService):
         util = np.zeros(om.max_osd, dtype=np.int64)
         for pid in om.pools:
             util += om.pool_utilization(pid)
-        out = [{"osd": o, "pgs": int(util[o]),
-                "weight": float(om.osd_weight[o] / WEIGHT_ONE)}
-               for o in range(om.max_osd)
-               if om.osd_state[o] & STATE_EXISTS]
+        out = []
+        for o in range(om.max_osd):
+            if not om.osd_state[o] & STATE_EXISTS:
+                continue
+            used, cap = self.osd_utilization.get(o, (0, 0))
+            out.append({
+                "osd": o, "pgs": int(util[o]),
+                "weight": float(om.osd_weight[o] / WEIGHT_ONE),
+                "used_bytes": used, "capacity_bytes": cap,
+                "utilization": used / cap if cap else 0.0})
         return 0, "", json.dumps(out).encode()
 
     async def _cmd_getmap(self, cmd, inbl):

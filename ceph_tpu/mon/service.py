@@ -101,13 +101,44 @@ class HealthMonitor(PaxosService):
                 "summary": f"{len(missing)} monitors down: {missing}"}
         om = mon.osdmon.osdmap
         if om is not None:
-            from ceph_tpu.osd.osdmap import STATE_EXISTS, STATE_UP
+            from ceph_tpu.osd.osdmap import (
+                STATE_EXISTS, STATE_FULL, STATE_NEARFULL, STATE_UP,
+                flag_names,
+            )
             exists = (om.osd_state & STATE_EXISTS) != 0
             down = exists & ((om.osd_state & STATE_UP) == 0)
             if down.any():
                 checks["OSD_DOWN"] = {
                     "severity": "HEALTH_WARN",
                     "summary": f"{int(down.sum())} osds down"}
+            # fullness (ref: OSDMap::check_health OSD_NEARFULL /
+            # OSD_FULL): FULL is an ERR — client writes are parked
+            full = exists & ((om.osd_state & STATE_FULL) != 0)
+            near = exists & ((om.osd_state & STATE_NEARFULL) != 0)
+            if full.any():
+                checks["OSD_FULL"] = {
+                    "severity": "HEALTH_ERR",
+                    "summary": f"{int(full.sum())} full osd(s): "
+                               f"{np.flatnonzero(full).tolist()}"}
+            if near.any():
+                checks["OSD_NEARFULL"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"{int(near.sum())} nearfull osd(s): "
+                               f"{np.flatnonzero(near).tolist()}"}
+            quota_full = [p.name for p in om.pools.values()
+                          if p.is_full()]
+            if quota_full:
+                checks["POOL_QUOTA_FULL"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"pool(s) {quota_full} reached quota "
+                               f"or are marked full: writes park "
+                               f"(-EDQUOT with FULL_TRY)"}
+            if om.flags:
+                # ref: the OSDMAP_FLAGS health check — any service
+                # flag changes client/mon behavior; surface it
+                checks["OSDMAP_FLAGS"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"{flag_names(om.flags)} flag(s) set"}
         if om is not None and om.crush.choose_args:
             # choose_args discipline (ref: the TPU mapper's fused
             # kernel carrying <= 4 weight classes per bucket): a

@@ -71,6 +71,26 @@ _DEC: dict[str, Callable] = {
 }
 
 
+# zero value per codec family: omitted constructor fields default to
+# it, so appending a field to a message's FIELDS doesn't break older
+# construction sites (the reference's versioned-payload evolution)
+def _zero(codec: str):
+    base = codec.split(":", 1)[0]
+    if base in ("u8", "u16", "u32", "u64", "s32", "s64"):
+        return 0
+    if base == "f64":
+        return 0.0
+    if base == "bool":
+        return False
+    if base == "str":
+        return ""
+    if base == "blob":
+        return b""
+    if base == "list":
+        return []
+    return {}                                   # map
+
+
 class Message:
     """Base wire message. Subclasses set TYPE and either a ``FIELDS``
     spec ([(name, codec), ...]) or override encode/decode_payload."""
@@ -79,8 +99,9 @@ class Message:
     FIELDS: ClassVar[list[tuple[str, str]]] = []
 
     def __init__(self, **kw):
-        for name, _ in self.FIELDS:
-            setattr(self, name, kw.pop(name))
+        for name, codec in self.FIELDS:
+            setattr(self, name,
+                    kw.pop(name) if name in kw else _zero(codec))
         if kw:
             raise TypeError(f"unknown fields {sorted(kw)} for "
                             f"{type(self).__name__}")

@@ -19,18 +19,20 @@ import numpy as np
 
 from ceph_tpu.crush.types import ITEM_NONE
 from ceph_tpu.mon.client import MonClient
-from ceph_tpu.mon.messages import MOSDBoot, MOSDFailure, MPGStats
+from ceph_tpu.mon.messages import (MOSDBoot, MOSDFailure,
+                                   MOSDMarkMeDown, MPGStats)
 from ceph_tpu.msg import Dispatcher, EntityAddr, Keyring, Messenger, Policy
 from ceph_tpu.os_.objectstore import MemStore, ObjectStore
 from ceph_tpu.osd.ec_pg import ECPG
 from ceph_tpu.osd.messages import (
-    MBackfillReserve, MOSDECSubOpRead, MOSDECSubOpReadReply,
+    MBackfillReserve, MOSDBackoff, MOSDECSubOpRead, MOSDECSubOpReadReply,
     MOSDECSubOpWrite,
     MOSDECSubOpWriteReply, MOSDMapPing, MOSDOp, MOSDPGBackfill,
     MOSDPGBackfillReply, MOSDPGInfo, MOSDPGPull,
     MOSDPGPush, MOSDPGPushReply, MOSDPGQuery, MOSDPGRepair, MOSDPGScan,
     MOSDPGScanReply, MOSDPing, MOSDRepOp,
-    MOSDRepOpReply, MOSDRepScrub, MOSDRepScrubMap, MPGCleanNotice, PING,
+    MOSDRepOpReply, MOSDRepScrub, MOSDRepScrubMap, MPGCleanNotice,
+    MUTATING_OPS, PING,
     PING_REPLY,
 )
 from ceph_tpu.osd.pg import PG
@@ -38,8 +40,22 @@ from ceph_tpu.osd.recovery import AsyncReserver, RecoveryThrottle
 from ceph_tpu.osd.types import MAX_OID, pg_t
 from ceph_tpu.utils.logging import get_logger
 from ceph_tpu.utils.op_tracker import OpTracker
+from ceph_tpu.utils.perf_counters import PerfCountersBuilder
+from ceph_tpu.utils.throttle import MessageThrottle
 
 log = get_logger("osd")
+
+# process-wide overload-protection counters (exported via `perf dump`
+# + the mgr prometheus module, like osd_recovery's)
+OVERLOAD_PERF = (
+    PerfCountersBuilder("osd_overload")
+    .add_u64_counter("backoffs_sent", "MOSDBackoff BLOCKs sent")
+    .add_u64_counter("backoffs_released", "MOSDBackoff UNBLOCKs sent")
+    .add_u64_counter("failsafe_rejections",
+                     "writes rejected -ENOSPC by the local failsafe")
+    .add_u64_counter("throttle_queued",
+                     "client ops that waited at the admission throttle")
+    .create_perf_counters())
 
 
 class OSD(Dispatcher):
@@ -74,6 +90,7 @@ class OSD(Dispatcher):
         self._scrub_task: asyncio.Task | None = None
         self._stopped = False
         self.up = False
+        self._statfs_reported = 0   # last capacity sent monward
         # ref: OSD op tracking + admin socket
         self.op_tracker = OpTracker(
             slow_op_warn_s=cfg.get("osd_op_complaint_time", 30.0))
@@ -91,23 +108,32 @@ class OSD(Dispatcher):
         self.recovery_throttle = RecoveryThrottle(
             max_active=cfg.get("osd_recovery_max_active", 8),
             bytes_per_s=cfg.get("osd_recovery_max_bytes", 0))
+        # client-op admission throttle (ref: OSD client_messenger
+        # policy throttles, osd_client_message_cap /
+        # osd_client_message_size_cap): ops past the caps queue at
+        # admission instead of dispatching, draining FIFO as in-flight
+        # ops complete
+        self.client_throttle = MessageThrottle(
+            max_ops=int(cfg.get("osd_client_message_cap", 256)),
+            max_bytes=int(cfg.get("osd_client_message_size_cap",
+                                  500 << 20)))
+        self._admit_queue: asyncio.Queue = asyncio.Queue()
+        self._admit_task: asyncio.Task | None = None
+        # used-bytes sweep cache: (stamp, used)
+        self._used_cache: tuple[float, int] | None = None
+        # graceful shutdown in progress: suppresses the
+        # wrongly-marked-down re-boot when OUR mark-me-down commits
+        self._prepared_to_stop = False
 
-    def backfill_toofull(self) -> bool:
-        """Reject incoming backfill reservations past the full ratio
-        (ref: OSDService::check_backfill_full -> backfill_toofull).
-        Only meaningful when a capacity is configured — the stores
-        this framework runs on have no intrinsic size. The store sweep
-        is O(objects), and rejected primaries re-request every
-        ~osd_backfill_retry_interval, so the verdict is cached for a
-        second instead of recomputed per request."""
-        cap = int(self.config.get("osd_capacity_bytes", 0))
-        if cap <= 0:
-            return False
+    def store_used_bytes(self) -> int:
+        """Local statfs (ref: ObjectStore::statfs): total object bytes
+        in the store. O(objects) sweep, cached for half a second —
+        callers are the stats loop, the failsafe at op admission and
+        backfill_toofull."""
         now = asyncio.get_event_loop().time()
-        cached = getattr(self, "_toofull_cache", None)
-        if cached is not None and now - cached[0] < 1.0:
-            return cached[1]
-        ratio = float(self.config.get("osd_backfill_full_ratio", 0.85))
+        if self._used_cache is not None and \
+                now - self._used_cache[0] < 0.5:
+            return self._used_cache[1]
         used = 0
         try:
             for cid in self.store.list_collections():
@@ -117,10 +143,34 @@ class OSD(Dispatcher):
                     except Exception:
                         pass
         except Exception:
+            return 0
+        self._used_cache = (now, used)
+        return used
+
+    def failsafe_full(self) -> bool:
+        """The stale-map-proof last line of defense (ref: OSD
+        osd_failsafe_full_ratio check in OSD::check_full_status):
+        writes are rejected -ENOSPC at op admission against LOCAL
+        statfs — even a client whose map predates the mon's FULL flag
+        cannot push this store over the edge, and the reject happens
+        before any transaction touches the store (never partially
+        applied)."""
+        cap = int(self.config.get("osd_capacity_bytes", 0))
+        if cap <= 0:
             return False
-        full = used >= cap * ratio
-        self._toofull_cache = (now, full)
-        return full
+        ratio = float(self.config.get("osd_failsafe_full_ratio", 0.97))
+        return self.store_used_bytes() >= cap * ratio
+
+    def backfill_toofull(self) -> bool:
+        """Reject incoming backfill reservations past the full ratio
+        (ref: OSDService::check_backfill_full -> backfill_toofull).
+        Only meaningful when a capacity is configured — the stores
+        this framework runs on have no intrinsic size."""
+        cap = int(self.config.get("osd_capacity_bytes", 0))
+        if cap <= 0:
+            return False
+        ratio = float(self.config.get("osd_backfill_full_ratio", 0.85))
+        return self.store_used_bytes() >= cap * ratio
 
     # -- service facade used by PG ----------------------------------------
     def next_tid(self) -> int:
@@ -189,7 +239,14 @@ class OSD(Dispatcher):
                     "epoch": self.osdmap.epoch if self.osdmap else 0,
                     "num_pgs": len(self.pgs),
                     "pgs": {p: pg.state
-                            for p, pg in self.pgs.items()}},
+                            for p, pg in self.pgs.items()},
+                    "client_throttle": self.client_throttle.dump(),
+                    "fullness": {
+                        "used_bytes": self.store_used_bytes(),
+                        "capacity_bytes": int(self.config.get(
+                            "osd_capacity_bytes", 0)),
+                        "failsafe_full": self.failsafe_full(),
+                        "backfill_toofull": self.backfill_toofull()}},
                 "osd state summary")
             self.asok.register(
                 "dump_ops_in_flight",
@@ -209,6 +266,12 @@ class OSD(Dispatcher):
                 "config show", lambda: dict(self.config),
                 "daemon configuration")
             self.asok.register(
+                "dump_backoffs", lambda: {
+                    p: pg.dump_backoffs()
+                    for p, pg in self.pgs.items()
+                    if pg.backoffs},
+                "asserted client backoffs per pg")
+            self.asok.register(
                 "backfill status", lambda: {
                     "local_reservations": self.local_reserver.dump(),
                     "remote_reservations": self.remote_reserver.dump(),
@@ -226,14 +289,37 @@ class OSD(Dispatcher):
             await self.asok.start()
         self._hb_task = asyncio.ensure_future(self._hb_loop())
         self._stats_task = asyncio.ensure_future(self._stats_loop())
+        self._admit_task = asyncio.ensure_future(self._admit_loop())
         if self.scrub_interval > 0:
             self._scrub_task = asyncio.ensure_future(self._scrub_loop())
         log.dout(1, f"osd.{self.whoami} booted at {self.msgr.addr}")
 
-    async def stop(self) -> None:
+    async def stop(self, mark_down: bool = False) -> None:
+        """``mark_down=True`` is the graceful path (ref: OSD::shutdown
+        -> MOSDMarkMeDown): tell the mon we are going so the down
+        commits in the next incremental instead of after a full
+        heartbeat-grace of client timeouts. The Thrasher kill path
+        stays ungraceful by design — it models a crash."""
+        if mark_down and self.up and not self._stopped and \
+                self.osdmap is not None:
+            self._prepared_to_stop = True
+            try:
+                await self.monc.send_report(MOSDMarkMeDown(
+                    osd=self.whoami, epoch=self.osdmap.epoch))
+                # the committed map is the ack: our subscription is
+                # still live, _on_osdmap flips self.up
+                deadline = asyncio.get_event_loop().time() + 3.0
+                while self.up and \
+                        asyncio.get_event_loop().time() < deadline:
+                    await self.monc.subscribe(
+                        "osdmap", self.osdmap.epoch + 1)
+                    await asyncio.sleep(0.05)
+            except Exception as e:
+                log.dout(1, f"osd.{self.whoami} mark-me-down failed "
+                            f"({e}); stopping anyway")
         self._stopped = True
         for task in (self._hb_task, self._stats_task,
-                     self._scrub_task):
+                     self._scrub_task, self._admit_task):
             if task:
                 task.cancel()
         for pg in self.pgs.values():
@@ -254,7 +340,8 @@ class OSD(Dispatcher):
         self.osdmap = osdmap
         was_up = self.up
         self.up = self.osd_is_up(self.whoami)
-        if was_up and not self.up and not self._stopped:
+        if was_up and not self.up and not self._stopped and \
+                not self._prepared_to_stop:
             # wrongly marked down (ref: OSD::_committed_osd_maps "I was
             # wrongly marked down" -> re-boot): announce ourselves again
             log.dout(1, f"osd.{self.whoami} marked down but alive; "
@@ -378,7 +465,37 @@ class OSD(Dispatcher):
                 # keep the per-PG serialization the queue provides.
                 await pg._execute(msg)
                 return True
-            await pg.queue_op(msg)
+            if any(c in MUTATING_OPS for c in msg.op_codes) and \
+                    self.failsafe_full():
+                # stale-map-proof failsafe: this store is past
+                # osd_failsafe_full_ratio — reject BEFORE any txn is
+                # built, whatever epoch (or FULL_TRY flag) the op
+                # carries. Nothing is partially applied.
+                from ceph_tpu.osd.messages import MOSDOpReply
+                OVERLOAD_PERF.inc("failsafe_rejections")
+                log.dout(1, f"osd.{self.whoami} failsafe ENOSPC "
+                            f"for {msg.oid}")
+                await msg.conn.send_message(MOSDOpReply(
+                    tid=msg.tid, attempt=getattr(msg, "attempt", 0),
+                    result=-28, epoch=self.osdmap.epoch
+                    if self.osdmap else 0, data=b"", extra=""))
+                return True
+            queue_cap = int(
+                self.config.get("osd_pg_op_queue_cap", 512))
+            if not pg.role_active() or \
+                    pg.op_queue.qsize() >= queue_cap or \
+                    self._admit_queue.qsize() >= queue_cap:
+                # not ready (peering) or saturated — the per-PG queue
+                # OR the OSD-wide admission backlog (the throttle caps
+                # dispatched ops below the PG cap, so the backlog is
+                # where a flood actually piles up): backoff instead of
+                # queueing unboundedly — the client parks and resends
+                # after our UNBLOCK (ref: the PG Backoff machinery)
+                await pg.send_backoff(msg)
+                return True
+            # admission throttle: past the cap, ops queue here (FIFO)
+            # rather than dispatch (ref: osd_client_message_cap)
+            self._admit_queue.put_nowait(msg)
             return True
         if isinstance(msg, MOSDRepOp):
             pg = self._pg_for(msg.pgid, create=True)
@@ -481,6 +598,10 @@ class OSD(Dispatcher):
             if pg is not None:
                 pg.handle_backfill_reserve(msg)
             return True
+        if isinstance(msg, MOSDBackoff):
+            # a client's ACK_BLOCK — informational only (the backoff
+            # stays asserted until we UNBLOCK)
+            return True
         if isinstance(msg, MOSDPGRepair):
             pg = self._pg_for(msg.pgid)
             if pg is not None and pg.is_primary():
@@ -502,6 +623,39 @@ class OSD(Dispatcher):
                 pg.scrubber.handle_map(msg)
             return True
         return False
+
+    async def _admit_loop(self) -> None:
+        """Admission drain (ref: the messenger dispatch throttle):
+        client ops pass the MessageThrottle in arrival order before
+        reaching their PG's op queue; the throttle slot is released
+        when the PG op worker finishes the op. Backpressure lands
+        HERE, not on the connection reader loop."""
+        try:
+            while not self._stopped:
+                msg = await self._admit_queue.get()
+                cost = sum(len(d) for d in msg.op_datas)
+                if self.client_throttle.saturated:
+                    OVERLOAD_PERF.inc("throttle_queued")
+                await self.client_throttle.acquire(cost)
+                msg._throttle_cost = cost
+                pg = self._pg_for(str(pg_t(msg.pool, msg.seed)))
+                if pg is None or not pg.is_primary():
+                    # the map moved while the op waited for admission
+                    self.client_throttle.release(cost)
+                    from ceph_tpu.osd.messages import MOSDOpReply
+                    try:
+                        await msg.conn.send_message(MOSDOpReply(
+                            tid=msg.tid,
+                            attempt=getattr(msg, "attempt", 0),
+                            result=-11,
+                            epoch=self.osdmap.epoch
+                            if self.osdmap else 0, data=b"", extra=""))
+                    except Exception:
+                        pass
+                    continue
+                await pg.queue_op(msg)
+        except asyncio.CancelledError:
+            pass
 
     # -- heartbeats --------------------------------------------------------
     async def _hb_loop(self) -> None:
@@ -543,6 +697,18 @@ class OSD(Dispatcher):
                             self.hb_grace:
                         self._hb_reported[o] = now
                         await self._report_failure(o)
+                    elif o in self._hb_reported and \
+                            now - self._hb_last_rx[o] <= self.hb_grace:
+                        # the peer resumed within grace after we
+                        # accused it: withdraw the report (ref:
+                        # OSD::send_still_alive) so our stale
+                        # accusation can't later pair with another
+                        # reporter's and wrongly mark it down
+                        self._hb_reported.pop(o, None)
+                        await self.monc.send_report(MOSDFailure(
+                            target=o, failed_for=0,
+                            epoch=self.osdmap.epoch,
+                            reporter=f"osd.{self.whoami}", alive=1))
         except asyncio.CancelledError:
             pass
 
@@ -583,15 +749,25 @@ class OSD(Dispatcher):
                          for p, pg in self.pgs.items()
                          if pg.is_primary()}
                 slow = len(self.op_tracker.slow_ops())
+                # statfs piggyback (ref: osd_stat_t): the mon derives
+                # NEARFULL/FULL state and the cluster FULL flag from
+                # it — reported whenever a capacity is configured
+                cap = int(self.config.get("osd_capacity_bytes", 0))
+                used = self.store_used_bytes() if cap > 0 else 0
                 # keep reporting until a zero count has been sent: a
-                # daemon whose slow ops drained while it held no
-                # primary PGs must still clear the mon's warning
-                if not stats and not slow and not self._slow_reported:
+                # daemon whose slow ops drained (or whose capacity
+                # went back to unbounded) while it held no primary
+                # PGs must still clear the mon's warning/utilization
+                if not stats and not slow and not cap and \
+                        not self._slow_reported and \
+                        not self._statfs_reported:
                     continue
                 await self.monc.send_report(MPGStats(
                     osd=self.whoami, epoch=self.osdmap.epoch,
-                    stats=stats, slow_ops=slow))
+                    stats=stats, slow_ops=slow,
+                    used_bytes=used, capacity_bytes=cap))
                 self._slow_reported = slow
+                self._statfs_reported = cap
         except asyncio.CancelledError:
             pass
 

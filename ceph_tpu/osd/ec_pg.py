@@ -80,6 +80,77 @@ class ECPG(PG):
         self._subop_waiters: dict[
             int, tuple[set[int], asyncio.Future, set[int]]] = {}
         self._subread_waiters: dict[int, asyncio.Future] = {}
+        self._posfix_task: asyncio.Task | None = None
+
+    def advance(self, up, acting, primary, epoch) -> None:
+        old_acting = list(self.acting)
+        super().advance(up, acting, primary, epoch)
+        if self.osd.whoami in acting and acting != old_acting:
+            # the interval moved our position: any shard whose stored
+            # _pos stamp no longer matches must be re-derived — its
+            # bytes stay READABLE everywhere (gather files by stamp),
+            # but redundancy is degraded until this slot holds its own
+            # position's bytes again. Cancel-and-respawn: a sweep
+            # started in a PRIOR interval exits at its guard and must
+            # not gate this interval's sweep.
+            if self._posfix_task is not None:
+                self._posfix_task.cancel()
+            self._posfix_task = asyncio.ensure_future(
+                self._fix_shard_positions())
+
+    async def _fix_shard_positions(self) -> None:
+        """Best-effort self-heal of position-mismatched shards after
+        an acting shuffle (e.g. auto-out remap reverted on revive).
+        Bounded retries: sources may only become decodable once the
+        primary's own recovery lands."""
+        interval = self.interval_start
+        await asyncio.sleep(0.5)            # let peering settle
+        myshard = self.my_shard()
+        if myshard < 0:
+            return
+        # round-based, never gives up silently: a stale shard's
+        # sources may only become decodable once the primary's
+        # recovery pushes land on other holders — keep sweeping (with
+        # a growing pause, loudly) until clean or the interval moves;
+        # stale-position shards are degraded redundancy and must not
+        # be abandoned while this interval lives
+        _round = 0
+        while True:
+            if self.interval_start != interval or \
+                    self.my_shard() != myshard:
+                return                  # interval moved on: its own
+                #                         advance re-triggers the fix
+            try:
+                oids = [o for o in
+                        self.osd.store.list_objects(self.cid)
+                        if o != PGMETA]
+            except StoreError:
+                return
+            stale = [o for o in oids
+                     if 0 <= self._stored_pos(o) != myshard]
+            if not stale:
+                return
+            for oid in stale:
+                if self.interval_start != interval:
+                    return
+                try:
+                    await self._reconstruct_local(oid)
+                    log.dout(1, f"pg {self.pgid} osd."
+                                f"{self.osd.whoami} re-derived {oid} "
+                                f"for position {myshard}")
+                except Exception as e:
+                    # sources not decodable yet (e.g. the primary's
+                    # push to another holder hasn't landed): the next
+                    # round retries
+                    log.dout(10, f"pg {self.pgid} posfix {oid} "
+                                 f"round {_round}: {e!r}")
+            _round += 1
+            if _round % 60 == 0:
+                log.error(f"pg {self.pgid} osd.{self.osd.whoami}: "
+                          f"{len(stale)} position-stale shard(s) "
+                          f"still unhealed after {_round} rounds "
+                          f"(redundancy degraded)")
+            await asyncio.sleep(min(0.5 + 0.1 * _round, 5.0))
 
     # -- shard helpers -----------------------------------------------------
     def my_shard(self) -> int:
@@ -97,6 +168,23 @@ class ECPG(PG):
             return False, b"", eversion(), 0
         return True, data, _vparse(attrs.get("_v")), \
             int.from_bytes(attrs.get("_size", b"\0" * 8), "little")
+
+    def _stored_pos(self, oid: str, default: int = -1) -> int:
+        """The acting POSITION this store's shard bytes were encoded
+        for (the write-time ``_pos`` stamp); ``default`` when the
+        stamp is absent (legacy shard — assume it matches)."""
+        try:
+            attrs = self.osd.store.getattrs(self.cid, oid)
+        except StoreError:
+            return default
+        blob = attrs.get("_pos")
+        if not blob:
+            return default
+        return int.from_bytes(blob, "little", signed=True)
+
+    @staticmethod
+    def _pos_attr(pos: int) -> bytes:
+        return int(pos).to_bytes(4, "little", signed=True)
 
     def _obj_version(self, oid: str) -> eversion:
         return self._local_shard_state(oid)[2]
@@ -126,47 +214,60 @@ class ECPG(PG):
 
     async def _gather(self, oid: str, first: int, count: int,
                       version: eversion,
-                      exclude: frozenset = frozenset()):
+                      exclude_osds: frozenset = frozenset()):
         """Collect this stripe range's chunks from live, fresh shards
         and reconstruct data chunks 0..k-1 -> (count, k, C) uint8.
 
         Shards whose object version differs (missed writes / stale
         after outage) are excluded; decode fills the gaps
         (ref: ECCommon::ReadPipeline get_remaining_shards).
-        ``exclude``: acting POSITIONS never used as sources — a shard
-        being rebuilt (suspect by definition: missing, stale, or
-        scrub-flagged corrupt) must not contribute to its own
-        reconstruction."""
+
+        Chunks are filed under the POSITION the shard's bytes encode
+        (the write-time ``_pos`` stamp), NOT the holder's current
+        acting slot: an interval shuffle (e.g. an auto-out remap
+        while a peer was down, reverted on revive) can leave a
+        surviving OSD at a different slot than the one its stored
+        bytes were encoded for — treating those bytes positionally-
+        by-slot silently decodes garbage. Stamps are authoritative;
+        a shard without one (legacy) is assumed to match its slot.
+
+        ``exclude_osds``: OSDs never used as sources — a holder whose
+        shard is being rebuilt (missing, stale, scrub-flagged) must
+        not contribute to its own reconstruction."""
         C = self.sinfo.chunk_size
         off, ln = first * C, count * C
         avail: dict[int, np.ndarray] = {}
-        for pos, osd_id in enumerate(self.acting):
-            if pos in exclude:
-                continue
-            # stop once decodable: all data shards, or any k once the
-            # data positions have been tried (MDS property)
+        for slot, osd_id in enumerate(self.acting):
+            # stop once decodable: all data positions in hand, or any
+            # k positions once every data SLOT has been tried (MDS
+            # property — same early-stop the pre-stamp code had)
             if set(range(self.k)) <= set(avail) or \
-                    (pos >= self.k and len(avail) >= self.k):
+                    (slot >= self.k and len(avail) >= self.k):
                 break
-            if osd_id < 0 or not self.osd.osd_is_up(osd_id):
+            if osd_id < 0 or osd_id in exclude_osds or \
+                    not self.osd.osd_is_up(osd_id):
                 continue
             if osd_id == self.osd.whoami:
                 exists, data, ver, _size = self._local_shard_state(oid)
                 if not exists or ver != version:
                     continue
+                pos = self._stored_pos(oid, default=slot)
                 chunk = np.zeros(ln, dtype=np.uint8)
                 piece = data[off:off + ln]
                 chunk[:len(piece)] = np.frombuffer(piece, dtype=np.uint8)
-                avail[pos] = chunk.reshape(count, C)
+            else:
+                reply = await self._subread(osd_id, oid, off, ln)
+                if reply is None or not reply.exists:
+                    continue
+                if eversion(reply.version_epoch,
+                            reply.version_v) != version:
+                    continue
+                pos = reply.shard_pos if reply.shard_pos >= 0 else slot
+                chunk = np.zeros(ln, dtype=np.uint8)
+                piece = reply.data[:ln]
+                chunk[:len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+            if pos < 0 or pos >= self.k + self.m or pos in avail:
                 continue
-            reply = await self._subread(osd_id, oid, off, ln)
-            if reply is None or not reply.exists:
-                continue
-            if eversion(reply.version_epoch, reply.version_v) != version:
-                continue
-            chunk = np.zeros(ln, dtype=np.uint8)
-            piece = reply.data[:ln]
-            chunk[:len(piece)] = np.frombuffer(piece, dtype=np.uint8)
             avail[pos] = chunk.reshape(count, C)
         want = set(range(self.k))
         if want <= set(avail):
@@ -423,6 +524,10 @@ class ECPG(PG):
                 parity[:, pos - self.k, :]
             shard_bytes = shard.tobytes()
             attrs = dict(attrs_delta)
+            # position stamp: these bytes encode THIS acting position
+            # — readers/rebuilders trust the stamp over the holder's
+            # (shuffle-prone) slot
+            attrs["_pos"] = self._pos_attr(pos)
             # per-shard write-time checksum (ref: ECBackend hinfo):
             # valid only when this write covers the WHOLE object (a
             # partial overwrite can't know the full-shard crc without
@@ -562,6 +667,7 @@ class ECPG(PG):
         exists, data, ver, size = self._local_shard_state(m.oid)
         piece = data[m.chunk_off:m.chunk_off + m.chunk_len] if exists \
             else b""
+        pos = self._stored_pos(m.oid) if exists else -1
 
         async def _reply():
             try:
@@ -569,7 +675,7 @@ class ECPG(PG):
                     tid=m.tid, pgid=self.cid, oid=m.oid, exists=exists,
                     data=piece, version_epoch=ver.epoch,
                     version_v=ver.v, size=size,
-                    from_osd=self.osd.whoami))
+                    from_osd=self.osd.whoami, shard_pos=pos))
             except Exception:
                 pass
         asyncio.ensure_future(_reply())
@@ -597,8 +703,9 @@ class ECPG(PG):
             t = Transaction().remove(self.cid, oid)
             self.osd.store.queue_transaction(t)
             return
-        await self._rebuild_shard(oid, self.my_shard(), ver, size,
-                                  apply_local=True)
+        await self._rebuild_shard(
+            oid, self.my_shard(), ver, size, apply_local=True,
+            exclude_osds=frozenset({self.osd.whoami}))
 
     async def _authoritative_meta(self, oid: str):
         """(version, size) of the newest live shard copy."""
@@ -621,13 +728,16 @@ class ECPG(PG):
 
     async def _rebuild_shard(self, oid: str, shard: int, ver: eversion,
                              size: int, apply_local: bool = False,
-                             push_to: int | None = None) -> bytes:
+                             exclude_osds: frozenset = frozenset()
+                             ) -> bytes:
         count = self.sinfo.object_stripes(size) or 1
-        # never source the position being rebuilt: its stored bytes
-        # are missing, stale, or corrupt — rebuilding it FROM itself
-        # would faithfully reproduce the damage
+        # never source the holder being rebuilt: its stored bytes are
+        # missing, stale, or corrupt — rebuilding FROM them would
+        # faithfully reproduce the damage. (Exclusion is by OSD, not
+        # position: after an interval shuffle another holder may
+        # legitimately carry this position's bytes.)
         data_chunks = await self._gather(oid, 0, count, ver,
-                                         exclude=frozenset({shard}))
+                                         exclude_osds=exclude_osds)
         if shard < self.k:
             shard_bytes = data_chunks[:, shard, :].tobytes()
         else:
@@ -640,6 +750,7 @@ class ECPG(PG):
             t.write(self.cid, oid, 0, shard_bytes)
             attrs = {"_v": _vblob(ver),
                      "_size": size.to_bytes(8, "little"),
+                     "_pos": self._pos_attr(shard),
                      "_hcrc": _zlib.crc32(shard_bytes).to_bytes(
                          4, "little")}
             t.setattrs(self.cid, oid, attrs)
@@ -667,7 +778,9 @@ class ECPG(PG):
                     version_epoch=0, version_v=0, exists=False,
                     data=b"", attrs={}, omap={},
                     from_osd=self.osd.whoami)
-            shard_bytes = await self._rebuild_shard(oid, pos, ver, size)
+            shard_bytes = await self._rebuild_shard(
+                oid, pos, ver, size,
+                exclude_osds=frozenset({target}))
             omap = {}
             try:
                 omap = dict(self.osd.store.omap_get(self.cid, oid))
@@ -680,6 +793,7 @@ class ECPG(PG):
                 exists=True, data=shard_bytes,
                 attrs={"_v": _vblob(ver),
                        "_size": size.to_bytes(8, "little"),
+                       "_pos": self._pos_attr(pos),
                        "_hcrc": _zlib.crc32(shard_bytes).to_bytes(
                            4, "little")},
                 omap=omap, from_osd=self.osd.whoami)

@@ -34,6 +34,19 @@ OSD_OP_SNAPTRIM = 18       # drop a snap id from the object's clones
 PING = 1
 PING_REPLY = 2
 
+# op codes that mutate object state — the write class pausewr/FULL
+# gating and the OSD failsafe apply to (ref: MOSDOp::may_write()).
+MUTATING_OPS = frozenset((
+    OSD_OP_WRITE, OSD_OP_WRITEFULL, OSD_OP_TRUNCATE, OSD_OP_ZERO,
+    OSD_OP_DELETE, OSD_OP_SETXATTR, OSD_OP_OMAP_SET, OSD_OP_OMAP_RM,
+    OSD_OP_SNAPTRIM,
+))
+
+# MOSDOp.flags bits (ref: include/rados.h CEPH_OSD_FLAG_FULL_TRY):
+# FULL_TRY makes a write to a FULL cluster / full pool fail fast with
+# -ENOSPC / -EDQUOT instead of parking on the objecter's wait queue.
+OSD_FLAG_FULL_TRY = 1 << 20
+
 
 @register
 class MOSDOp(Message):
@@ -54,6 +67,8 @@ class MOSDOp(Message):
         # writes carry (snap_seq, snaps) for clone-on-write; reads
         # carry snap_id (0 = head)
         ("snap_seq", "u64"), ("snaps", "list:u64"), ("snap_id", "u64"),
+        # op flags (ref: MOSDOp::flags — FULL_TRY et al)
+        ("flags", "u32"),
     ]
 
     def unpack_ops(self):
@@ -63,7 +78,8 @@ class MOSDOp(Message):
 
 def make_osd_op(tid: int, epoch: int, pool: int, seed: int, oid: str,
                 ops: list[tuple], attempt: int = 0,
-                snapc: tuple | None = None, snap_id: int = 0) -> MOSDOp:
+                snapc: tuple | None = None, snap_id: int = 0,
+                flags: int = 0) -> MOSDOp:
     """ops: (code, offset, length, name, data) tuples.
 
     ``attempt`` distinguishes objecter resends of one logical op (same
@@ -78,7 +94,7 @@ def make_osd_op(tid: int, epoch: int, pool: int, seed: int, oid: str,
         op_codes=[o[0] for o in ops], op_offs=[o[1] for o in ops],
         op_lens=[o[2] for o in ops], op_names=[o[3] for o in ops],
         op_datas=[o[4] for o in ops],
-        snap_seq=seq, snaps=list(snaps), snap_id=snap_id)
+        snap_seq=seq, snaps=list(snaps), snap_id=snap_id, flags=flags)
 
 
 @register
@@ -166,11 +182,16 @@ class MOSDECSubOpRead(Message):
 
 @register
 class MOSDECSubOpReadReply(Message):
+    # ``shard_pos``: the acting position the stored shard's bytes
+    # were encoded for (the write-time _pos stamp; -1 = unstamped).
+    # Readers must file the chunk under THIS position, not the
+    # holder's current slot — interval shuffles can move a holder.
     TYPE = 167
     FIELDS = [("tid", "u64"), ("pgid", "str"), ("oid", "str"),
               ("exists", "bool"), ("data", "blob"),
               ("version_epoch", "u32"), ("version_v", "u64"),
-              ("size", "u64"), ("from_osd", "s32")]
+              ("size", "u64"), ("from_osd", "s32"),
+              ("shard_pos", "s32")]
 
 
 # -- peering ---------------------------------------------------------------
@@ -378,3 +399,26 @@ class MOSDPGRepair(Message):
 
     TYPE = 188
     FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32")]
+
+
+# -- client backoff (ref: src/messages/MOSDBackoff.h) ----------------------
+
+BACKOFF_OP_BLOCK = 1       # osd -> client: stop sending ops for range
+BACKOFF_OP_ACK_BLOCK = 2   # client -> osd: block acknowledged
+BACKOFF_OP_UNBLOCK = 3     # osd -> client: resume (client resends)
+
+
+@register
+class MOSDBackoff(Message):
+    """OSD -> client flow control (ref: MOSDBackoff + the PG Backoff
+    machinery): when a PG is not yet active (peering) or its op queue
+    is saturated, the primary BLOCKs the [begin, end) object-name
+    range of that PG instead of queueing unboundedly. The Objecter
+    parks matching ops and resumes on UNBLOCK — re-asserted across
+    interval changes, released on activation. ``id`` pairs an UNBLOCK
+    with its BLOCK."""
+
+    TYPE = 189
+    FIELDS = [("op", "u8"), ("id", "u64"), ("pool", "s64"),
+              ("seed", "u32"), ("begin", "str"), ("end", "str"),
+              ("epoch", "u32"), ("from_osd", "s32")]

@@ -22,9 +22,37 @@ from ceph_tpu.osd.types import ObjectLocator, PGPool, pg_t
 MAX_PRIMARY_AFFINITY = 0x10000  # ref: CEPH_OSD_MAX_PRIMARY_AFFINITY
 DEFAULT_PRIMARY_AFFINITY = 0x10000
 
-# osd_state flags (ref: src/osd/OSDMap.h CEPH_OSD_EXISTS / CEPH_OSD_UP).
+# osd_state flags (ref: src/osd/OSDMap.h CEPH_OSD_EXISTS / CEPH_OSD_UP;
+# NEARFULL/FULL mirror the per-OSD fullness state the mon derives from
+# reported statfs against mon_osd_nearfull_ratio / mon_osd_full_ratio).
 STATE_EXISTS = 1
 STATE_UP = 2
+STATE_NEARFULL = 4
+STATE_FULL = 8
+
+# cluster-wide osdmap service flags (ref: src/include/rados.h
+# CEPH_OSDMAP_PAUSERD..NOIN — the `ceph osd set <flag>` surface).
+# pauserd/pausewr park the respective client op classes; FULL parks
+# (or -ENOSPCs, with FULL_TRY) all writes; noout/nodown/noup/noin
+# suppress the corresponding mon state transition.
+FLAG_PAUSERD = 1 << 0
+FLAG_PAUSEWR = 1 << 1
+FLAG_FULL = 1 << 2
+FLAG_NOOUT = 1 << 3
+FLAG_NODOWN = 1 << 4
+FLAG_NOUP = 1 << 5
+FLAG_NOIN = 1 << 6
+
+FLAG_NAMES = {
+    "pauserd": FLAG_PAUSERD, "pausewr": FLAG_PAUSEWR,
+    "full": FLAG_FULL, "noout": FLAG_NOOUT, "nodown": FLAG_NODOWN,
+    "noup": FLAG_NOUP, "noin": FLAG_NOIN,
+}
+
+
+def flag_names(flags: int) -> str:
+    """'noout,full'-style rendering (ref: OSDMap::get_flag_string)."""
+    return ",".join(n for n, bit in FLAG_NAMES.items() if flags & bit)
 
 
 _EMPTY_ROWS = np.empty(0, dtype=np.int64)
@@ -85,6 +113,11 @@ class Incremental:
     # through epoch E' when a primary asks before activating; peering
     # uses it to decide whether a past interval may have gone active
     new_up_thru: dict[int, int] = field(default_factory=dict)
+    # absolute cluster service-flag value (ref: Incremental::new_flags;
+    # -1/None = unchanged). Absolute, not xor: the mon serializes flag
+    # edits under its proposal lock, and an absolute value survives a
+    # replayed incremental.
+    new_flags: int | None = None
 
 
 class OSDMap:
@@ -116,7 +149,13 @@ class OSDMap:
         # whose caps were revoked cannot mutate data after the grant
         # moved on, no matter when it resumes.
         self.blocklist: dict[str, float] = {}
+        # cluster-wide service flags (ref: OSDMap::flags — pauserd,
+        # pausewr, full, noout, nodown, noup, noin)
+        self.flags = 0
         self._mappers: dict[int | None, Mapper] = {}
+
+    def test_flag(self, bit: int) -> bool:
+        return bool(self.flags & bit)
 
     def is_blocklisted(self, name: str, now: float | None = None) -> bool:
         exp = self.blocklist.get(name)
@@ -139,6 +178,12 @@ class OSDMap:
 
     def is_out(self, osd) -> bool:
         return self.osd_weight[osd] == 0
+
+    def is_nearfull(self, osd) -> bool:
+        return bool(self.osd_state[osd] & STATE_NEARFULL)
+
+    def is_full(self, osd) -> bool:
+        return bool(self.osd_state[osd] & STATE_FULL)
 
     # -- mutation (each bumps the epoch; ref: OSDMap::apply_incremental) --
     def _dirty(self, crush_changed: bool = False) -> None:
@@ -272,6 +317,8 @@ class OSDMap:
             self.pg_upmap_items.pop(pg, None)
         self.osd_addrs.update(inc.new_addrs)
         self.up_thru.update(inc.new_up_thru)
+        if inc.new_flags is not None and inc.new_flags >= 0:
+            self.flags = inc.new_flags
         self.blocklist.update(inc.new_blocklist)
         for name in inc.old_blocklist:
             self.blocklist.pop(name, None)

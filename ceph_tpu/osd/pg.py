@@ -151,6 +151,12 @@ class PG:
         # op pipeline
         self.op_queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
+        # asserted client backoffs (ref: PG::Backoff / backoff_map):
+        # client entity -> [backoff id, conn]. Asserted while the PG
+        # is not active (peering) or its op queue is saturated;
+        # re-asserted across interval change, released on activation /
+        # drain. The Objecter parks matching ops until UNBLOCK.
+        self.backoffs: dict[str, list] = {}
         # tid -> [pending_replica_set, future, reqid, timed_out]: one
         # record per in-flight repop. ``timed_out`` marks repops whose
         # client already got -EAGAIN; a late completing reply (or a
@@ -297,13 +303,25 @@ class PG:
             self._peering_task = None
         if self.is_primary():
             self.state = "peering"
+            if changed:
+                # blocked clients stay blocked across the interval
+                # change; released when this peering round activates
+                self.reassert_backoffs()
             self._peering_task = asyncio.ensure_future(self._peer())
         else:
             self.state = "replica" if self.osd.whoami in acting \
                 else "stray"
+            # no longer the primary: our backoffs must not park
+            # clients that should now talk to the new primary
+            self.release_backoffs()
             if self._worker:
                 self._worker.cancel()
                 self._worker = None
+                # admitted-but-unexecuted ops die with the worker:
+                # give their admission-throttle slots back (clients
+                # resend to the new primary) — leaked slots would
+                # eventually wedge the whole OSD's op admission
+                self._drain_op_queue()
             if self.state == "stray" and primary >= 0 \
                     and primary != self.osd.whoami:
                 # announce ourselves to the new primary (ref:
@@ -323,6 +341,75 @@ class PG:
                         last_backfill=self.last_backfill,
                         backfill_at_epoch=self.backfill_at.epoch,
                         backfill_at_v=self.backfill_at.v)))
+
+    # -- client backoffs (ref: PG::add_backoff/release_backoffs) ---------
+    async def send_backoff(self, m: MOSDOp) -> None:
+        """BLOCK the whole PG range for this op's client instead of
+        queueing while we are not active / saturated; the op itself is
+        dropped (the parked Objecter resends after UNBLOCK)."""
+        from ceph_tpu.osd.daemon import OVERLOAD_PERF
+        from ceph_tpu.osd.messages import BACKOFF_OP_BLOCK, MOSDBackoff
+        ent = self.backoffs.get(m.src)
+        if ent is None:
+            ent = [self.osd.next_tid(), m.conn]
+            self.backoffs[m.src] = ent
+        else:
+            ent[1] = m.conn               # freshest connection wins
+        OVERLOAD_PERF.inc("backoffs_sent")
+        try:
+            await m.conn.send_message(MOSDBackoff(
+                op=BACKOFF_OP_BLOCK, id=ent[0], pool=self.pgid.pool,
+                seed=self.pgid.seed, begin=MIN_OID, end=MAX_OID,
+                epoch=self.epoch, from_osd=self.osd.whoami))
+        except Exception:
+            pass          # client's backoff self-heal covers the loss
+
+    def release_backoffs(self) -> None:
+        """UNBLOCK every asserted backoff (activation, drain, or this
+        OSD ceasing to be the primary — a new primary owes the client
+        nothing, so it must stop waiting on us)."""
+        if not self.backoffs:
+            return
+        from ceph_tpu.osd.daemon import OVERLOAD_PERF
+        from ceph_tpu.osd.messages import BACKOFF_OP_UNBLOCK, \
+            MOSDBackoff
+        released = list(self.backoffs.items())
+        self.backoffs = {}
+
+        async def _send(bid, conn):
+            OVERLOAD_PERF.inc("backoffs_released")
+            try:
+                await conn.send_message(MOSDBackoff(
+                    op=BACKOFF_OP_UNBLOCK, id=bid,
+                    pool=self.pgid.pool, seed=self.pgid.seed,
+                    begin=MIN_OID, end=MAX_OID, epoch=self.epoch,
+                    from_osd=self.osd.whoami))
+            except Exception:
+                pass
+        for _src, (bid, conn) in released:
+            asyncio.ensure_future(_send(bid, conn))
+
+    def reassert_backoffs(self) -> None:
+        """Interval change while still primary: the blocked clients
+        stay blocked — re-send the BLOCKs so a client that raced the
+        change keeps parking (ref: backoffs surviving interval
+        change)."""
+        from ceph_tpu.osd.messages import BACKOFF_OP_BLOCK, MOSDBackoff
+
+        async def _send(bid, conn):
+            try:
+                await conn.send_message(MOSDBackoff(
+                    op=BACKOFF_OP_BLOCK, id=bid, pool=self.pgid.pool,
+                    seed=self.pgid.seed, begin=MIN_OID, end=MAX_OID,
+                    epoch=self.epoch, from_osd=self.osd.whoami))
+            except Exception:
+                pass
+        for _src, (bid, conn) in list(self.backoffs.items()):
+            asyncio.ensure_future(_send(bid, conn))
+
+    def dump_backoffs(self) -> dict:
+        return {src: {"id": bid, "begin": MIN_OID, "end": "MAX"}
+                for src, (bid, _conn) in self.backoffs.items()}
 
     def _cancel_backfill(self) -> None:
         """Interval change / teardown: stop the scan and free every
@@ -640,6 +727,10 @@ class PG:
             self.osd.request_repeer(self, delay=0.2)
             return
         self.state = "active"
+        # activation releases the peering backoffs: parked clients
+        # resend and the ops now dispatch (ref: on_activate_complete
+        # releasing PG backoffs)
+        self.release_backoffs()
         if self._worker is None:
             self._worker = asyncio.ensure_future(self._op_worker())
         asyncio.ensure_future(self._recover())
@@ -1214,6 +1305,18 @@ class PG:
     async def queue_op(self, m: MOSDOp) -> None:
         await self.op_queue.put(m)
 
+    def _drain_op_queue(self) -> None:
+        """Release the admission-throttle slot of every queued-but-
+        never-executed op (worker cancelled on primaryship loss)."""
+        while True:
+            try:
+                m = self.op_queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            cost = getattr(m, "_throttle_cost", None)
+            if cost is not None:
+                self.osd.client_throttle.release(cost)
+
     async def _op_worker(self) -> None:
         try:
             while True:
@@ -1233,6 +1336,15 @@ class PG:
                     await self._reply(m, -5, b"", {})       # -EIO
                 finally:
                     tracked.finish()
+                    cost = getattr(m, "_throttle_cost", None)
+                    if cost is not None:
+                        self.osd.client_throttle.release(cost)
+                if self.backoffs and self.role_active() and \
+                        self.op_queue.qsize() <= int(self.osd.config.get(
+                            "osd_pg_op_queue_cap", 512)) // 2:
+                    # saturation backoffs: the queue drained — let the
+                    # parked clients resend
+                    self.release_backoffs()
         except asyncio.CancelledError:
             pass
 

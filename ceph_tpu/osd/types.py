@@ -20,6 +20,13 @@ POOL_TYPE_REPLICATED = 1  # ref: pg_pool_t::TYPE_REPLICATED
 POOL_TYPE_ERASURE = 3     # ref: pg_pool_t::TYPE_ERASURE
 
 FLAG_HASHPSPOOL = 1 << 2  # ref: pg_pool_t::FLAG_HASHPSPOOL
+# pool fullness flags (ref: pg_pool_t::FLAG_FULL / FLAG_FULL_QUOTA):
+# FULL is the operator/mon "no more writes to this pool" bit;
+# FULL_QUOTA is set by the mon's quota sweep when the pool's aggregate
+# usage crosses quota_bytes/quota_objects (writes -EDQUOT / park) and
+# cleared by the same sweep once usage drops or the quota is raised.
+FLAG_POOL_FULL = 1 << 1
+FLAG_POOL_FULL_QUOTA = 1 << 10
 
 # last_backfill watermark bounds (ref: hobject_t::get_max / is_max —
 # pg_info_t.last_backfill). Backfill scans the collection in plain
@@ -104,10 +111,21 @@ class PGPool:
     name: str = ""
     pg_temp_primaries_first: bool = False
     extra: dict = field(default_factory=dict)
+    # pool quotas (ref: pg_pool_t::quota_max_bytes/quota_max_objects;
+    # `ceph osd pool set-quota`): 0 = unlimited. The mon compares the
+    # pool's aggregate pg stats against these on tick and toggles
+    # FLAG_POOL_FULL_QUOTA in the next incremental.
+    quota_bytes: int = 0
+    quota_objects: int = 0
 
     def __post_init__(self) -> None:
         if self.pgp_num is None:
             self.pgp_num = self.pg_num
+
+    def is_full(self) -> bool:
+        """Writes to this pool must park/fail (ref: pg_pool_t::has_flag
+        FLAG_FULL|FLAG_FULL_QUOTA checks in Objecter::target_should_be_paused)."""
+        return bool(self.flags & (FLAG_POOL_FULL | FLAG_POOL_FULL_QUOTA))
 
     # -- masks ------------------------------------------------------------
     @property

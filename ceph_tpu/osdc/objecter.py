@@ -34,8 +34,11 @@ from ceph_tpu.mon.client import MonClient
 from ceph_tpu.msg import Dispatcher, EntityAddr
 from ceph_tpu.msg.messenger import ConnectionError_
 from ceph_tpu.osd.messages import (
-    MOSDMapPing, MOSDMapPingReply, MOSDOpReply, make_osd_op,
+    BACKOFF_OP_ACK_BLOCK, BACKOFF_OP_BLOCK, BACKOFF_OP_UNBLOCK,
+    MOSDBackoff, MOSDMapPing, MOSDMapPingReply, MOSDOpReply,
+    MUTATING_OPS, OSD_FLAG_FULL_TRY, make_osd_op,
 )
+from ceph_tpu.osd.osdmap import FLAG_FULL, FLAG_PAUSERD, FLAG_PAUSEWR
 from ceph_tpu.osd.types import ObjectLocator
 from ceph_tpu.utils.logging import get_logger
 from ceph_tpu.utils.op_tracker import OpTracker
@@ -71,6 +74,21 @@ class Objecter(Dispatcher):
         self._waiters: dict[tuple[int, int], asyncio.Future] = {}
         # epoch-barrier probes keyed by tid
         self._map_ping_waiters: dict[int, asyncio.Future] = {}
+        # server-asserted backoffs (ref: Objecter::OSDSession backoffs):
+        # (pool, pg seed) -> id -> [begin, end, primary, event, t0].
+        # Ops whose oid falls in a recorded range park on the event
+        # until the OSD's UNBLOCK (or the self-heal window expires —
+        # a died OSD can't unblock anyone).
+        self._backoffs: dict[tuple[int, int], dict[int, list]] = {}
+        # in-flight attempt -> (pool, seed, oid): a BLOCK covering an
+        # op whose send is awaiting its reply resolves that attempt
+        # IMMEDIATELY (the OSD dropped the op — waiting out the reply
+        # timeout would stall the resend by seconds)
+        self._inflight: dict[tuple[int, int], tuple[int, int, str]] = {}
+        # seconds a backoff may park ops with no UNBLOCK before the
+        # client drops it and retries (lost-UNBLOCK/dead-OSD self-heal;
+        # a still-inactive PG simply re-asserts it)
+        self.backoff_stall_s = 3.0
 
     async def ms_dispatch(self, msg) -> bool:
         if isinstance(msg, MOSDOpReply):
@@ -84,7 +102,83 @@ class Objecter(Dispatcher):
             if fut and not fut.done():
                 fut.set_result(msg.epoch)
             return True
+        if isinstance(msg, MOSDBackoff):
+            await self._handle_backoff(msg)
+            return True
         return False
+
+    async def _handle_backoff(self, m: MOSDBackoff) -> None:
+        """ref: Objecter::handle_osd_backoff — record BLOCKs (and ack
+        them), release parked ops on UNBLOCK."""
+        key = (m.pool, m.seed)
+        if m.op == BACKOFF_OP_BLOCK:
+            loop = asyncio.get_event_loop()
+            self._backoffs.setdefault(key, {})[m.id] = [
+                m.begin, m.end, m.from_osd, asyncio.Event(),
+                loop.time()]
+            # the blocked op was DROPPED server-side: wake its waiter
+            # now so it re-enters the loop and parks, instead of
+            # burning the whole per-attempt reply timeout first
+            for wkey, (p, s, o) in list(self._inflight.items()):
+                if p == m.pool and s == m.seed and m.begin <= o and \
+                        (not m.end or o < m.end):
+                    fut = self._waiters.pop(wkey, None)
+                    if fut and not fut.done():
+                        fut.set_result(None)
+            try:
+                await m.conn.send_message(MOSDBackoff(
+                    op=BACKOFF_OP_ACK_BLOCK, id=m.id, pool=m.pool,
+                    seed=m.seed, begin=m.begin, end=m.end,
+                    epoch=m.epoch, from_osd=m.from_osd))
+            except Exception:
+                pass
+        elif m.op == BACKOFF_OP_UNBLOCK:
+            ent = self._backoffs.get(key, {}).pop(m.id, None)
+            if ent is not None:
+                ent[3].set()
+            if not self._backoffs.get(key):
+                self._backoffs.pop(key, None)
+
+    def _match_backoff(self, pool_id: int, seed: int,
+                       oid: str) -> list | None:
+        """The recorded backoff covering (pool, seed, oid), if any."""
+        for ent in self._backoffs.get((pool_id, seed), {}).values():
+            begin, end = ent[0], ent[1]
+            if begin <= oid and (not end or oid < end):
+                return ent
+        return None
+
+    def _flag_gate(self, osdmap, pool_id: int,
+                   has_write: bool) -> tuple[str, int] | None:
+        """Why this op must not be sent right now, or None (ref:
+        Objecter::target_should_be_paused + op_submit's ENOSPC
+        check). Returns (reason, errno) — errno 0 means 'park
+        unconditionally' (pause flags), nonzero means FULL_TRY ops
+        fail fast with it instead of parking."""
+        if not has_write and osdmap.test_flag(FLAG_PAUSERD):
+            return "pauserd", 0
+        if has_write and osdmap.test_flag(FLAG_PAUSEWR):
+            return "pausewr", 0
+        if has_write and osdmap.test_flag(FLAG_FULL):
+            return "cluster full", -28                  # -ENOSPC
+        pool = osdmap.pools.get(pool_id)
+        if has_write and pool is not None and pool.is_full():
+            return f"pool '{pool.name}' full", -122     # -EDQUOT
+        return None
+
+    async def _wait_for_new_map(self, cur, deadline: float) -> None:
+        """Park until the map moves past ``cur`` (the wait-queue the
+        pause/full gates put ops on; the incremental clearing the flag
+        resumes them) — bounded so the op deadline still rules."""
+        loop = asyncio.get_event_loop()
+        try:
+            await self.monc.subscribe("osdmap", cur.epoch + 1)
+            await self.monc.wait_for_osdmap(
+                min_epoch=cur.epoch + 1,
+                timeout=max(0.05, min(1.0,
+                                      deadline - loop.time())))
+        except TimeoutError:
+            pass
 
     def _calc_target(self, osdmap, pool_id: int, oid: str):
         """ref: Objecter::_calc_target."""
@@ -107,13 +201,17 @@ class Objecter(Dispatcher):
     async def op_submit(self, pool_id: int, oid: str, ops: list[tuple],
                         timeout: float | None = None,
                         seed: int | None = None,
-                        snapc: tuple | None = None, snap_id: int = 0):
+                        snapc: tuple | None = None, snap_id: int = 0,
+                        flags: int = 0):
         """Send one op bundle; retries across map changes with
         exponential backoff, bounded by ``timeout`` (None = the
         objecter's op_timeout) and ``max_attempts``.
         ``seed`` overrides name hashing for PG-targeted ops (pgls).
         ``snapc``/``snap_id``: self-managed snap write context / read
         snap (ref: Objecter::Op snapc+snapid).
+        ``flags``: MOSDOp flags — OSD_FLAG_FULL_TRY makes writes
+        blocked by a FULL cluster / full pool fail fast (-ENOSPC /
+        -EDQUOT) instead of parking on the flag wait-queue.
         Returns (result, data, extra_dict)."""
         if timeout is None:
             timeout = self.op_timeout
@@ -131,14 +229,16 @@ class Objecter(Dispatcher):
         try:
             return await self._op_submit_inner(
                 pool_id, oid, ops, deadline, tid, seed, snapc,
-                snap_id, tracked)
+                snap_id, tracked, flags)
         finally:
             tracked.finish()
 
     async def _op_submit_inner(self, pool_id, oid, ops, deadline, tid,
-                               seed, snapc, snap_id, tracked):
+                               seed, snapc, snap_id, tracked,
+                               flags=0):
         loop = asyncio.get_event_loop()
         attempt = 0
+        has_write = any(o[0] in MUTATING_OPS for o in ops)
         while True:
             if loop.time() > deadline:
                 tracked.mark_event("timed out")
@@ -148,6 +248,18 @@ class Objecter(Dispatcher):
                 raise ObjectOperationError(
                     -110, f"op on {oid} failed after {attempt} attempts")
             osdmap = await self.monc.wait_for_osdmap()
+            gate = self._flag_gate(osdmap, pool_id, has_write)
+            if gate is not None:
+                reason, errno = gate
+                if errno and (flags & OSD_FLAG_FULL_TRY):
+                    tracked.mark_event(f"failing fast: {reason}")
+                    raise ObjectOperationError(
+                        errno, f"{reason} (FULL_TRY)")
+                # park on the wait-queue: the incremental that clears
+                # the flag (or raises the quota) resumes the op
+                tracked.mark_event(f"parked ({reason})")
+                await self._wait_for_new_map(osdmap, deadline)
+                continue
             if seed is not None:
                 _, _, _, actp = osdmap.pg_to_up_acting_osds(
                     pool_id, [seed])
@@ -159,16 +271,28 @@ class Objecter(Dispatcher):
                 tracked.mark_event("no primary; waiting for map")
                 await self._refresh_map(osdmap)
                 continue
+            backoff = self._match_backoff(pool_id, pg_seed, oid)
+            if backoff is not None:
+                # server-asserted flow control: park until the OSD
+                # UNBLOCKs, the backing-off primary changes, or the
+                # self-heal window expires (UNBLOCK lost / OSD died)
+                tracked.mark_event(
+                    f"parked (backoff from osd.{backoff[2]})")
+                await self._wait_backoff(backoff, pool_id, pg_seed,
+                                         primary, deadline)
+                continue
             host, port, _hb = osdmap.osd_addrs[primary]
             fut = loop.create_future()
             self._waiters[(tid, attempt)] = fut
+            self._inflight[(tid, attempt)] = (pool_id, pg_seed, oid)
             try:
                 tracked.mark_event(
                     f"sent to osd.{primary} (attempt {attempt})")
                 await self.msgr.send_message(
                     make_osd_op(tid, osdmap.epoch, pool_id, pg_seed,
                                 oid, ops, attempt=attempt,
-                                snapc=snapc, snap_id=snap_id),
+                                snapc=snapc, snap_id=snap_id,
+                                flags=flags),
                     EntityAddr(host, port), f"osd.{primary}")
                 reply = await asyncio.wait_for(
                     fut, timeout=min(5.0 + attempt,
@@ -182,15 +306,68 @@ class Objecter(Dispatcher):
                 await asyncio.sleep(
                     min(0.05 * (1 << min(attempt, 5)), 1.0))
                 continue
+            finally:
+                self._inflight.pop((tid, attempt), None)
+            if reply is None:
+                # dropped server-side with a BLOCK: re-enter the loop
+                # — the backoff match at the top parks the op (same
+                # attempt: nothing executed)
+                tracked.mark_event("backed off mid-flight")
+                continue
             if reply.result == -11:       # wrong target / not active
                 attempt += 1
                 tracked.mark_event("EAGAIN (stale target)")
                 await self._refresh_map(osdmap)
                 await asyncio.sleep(min(0.1 * attempt, 1.0))
                 continue
+            if reply.result == -28 and has_write and \
+                    not (flags & OSD_FLAG_FULL_TRY):
+                # OSD failsafe rejection: the cluster is fuller than
+                # our map admits (the op was NOT applied). Wait for
+                # the map to catch up — the next pass parks on the
+                # FULL flag, exactly as if we had never been stale.
+                attempt += 1
+                tracked.mark_event("ENOSPC from failsafe; map stale")
+                await self._wait_for_new_map(osdmap, deadline)
+                continue
             tracked.mark_event("reply received")
             extra = json.loads(reply.extra) if reply.extra else {}
             return reply.result, reply.data, extra
+
+    async def _wait_backoff(self, ent: list, pool_id: int, seed: int,
+                            primary: int, deadline: float) -> None:
+        """Park on one backoff's release event in short slices,
+        dropping the backoff when its asserting primary changed (the
+        interval ended — a new primary owes us no UNBLOCK) or it
+        stalled past ``backoff_stall_s``."""
+        loop = asyncio.get_event_loop()
+        while loop.time() < deadline:
+            try:
+                await asyncio.wait_for(
+                    ent[3].wait(),
+                    timeout=max(0.02, min(0.25,
+                                          deadline - loop.time())))
+                return
+            except asyncio.TimeoutError:
+                pass
+            if ent[2] != primary or \
+                    loop.time() - ent[4] > self.backoff_stall_s:
+                bos = self._backoffs.get((pool_id, seed), {})
+                for bid, e in list(bos.items()):
+                    if e is ent:
+                        bos.pop(bid, None)
+                ent[3].set()
+                return
+            # freshen our view: a moved primary ends the backoff
+            cur = self.monc.osdmap
+            if cur is not None:
+                try:
+                    _, _, _, actp = cur.pg_to_up_acting_osds(
+                        pool_id, [seed])
+                    if int(actp[0]) != primary:
+                        return
+                except KeyError:
+                    return                  # pool vanished
 
     # -- osdmap epoch barrier ----------------------------------------------
     async def wait_for_map_on_osds(self, epoch: int,

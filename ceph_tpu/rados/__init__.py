@@ -25,9 +25,11 @@ from ceph_tpu.osd.messages import (
     OSD_OP_WATCH, OSD_OP_WRITE, OSD_OP_WRITEFULL,
     OSD_OP_ZERO,
 )
+from ceph_tpu.osd.messages import OSD_FLAG_FULL_TRY
 from ceph_tpu.osdc.objecter import Objecter, ObjectOperationError
 
-__all__ = ["Rados", "IoCtx", "ObjectOperationError"]
+__all__ = ["Rados", "IoCtx", "ObjectOperationError",
+           "OSD_FLAG_FULL_TRY"]
 
 
 class _WatchDispatcher(Dispatcher):
@@ -134,7 +136,8 @@ class IoCtx:
         OSD_OP_READ, OSD_OP_STAT, OSD_OP_GETXATTR, OSD_OP_OMAP_GET))
 
     async def _op(self, oid: str, ops, timeout: float = 20.0,
-                  snapc: tuple | None = None, snap_id: int | None = None):
+                  snapc: tuple | None = None, snap_id: int | None = None,
+                  flags: int = 0):
         if snapc is None:
             snapc = self.snapc if self.snapc[0] else None
         if snap_id is None:
@@ -142,7 +145,7 @@ class IoCtx:
                 o[0] in self._SNAP_READ_OPS for o in ops) else 0
         res, data, extra = await self.rados.objecter.op_submit(
             self.pool_id, oid, ops, timeout=timeout,
-            snapc=snapc, snap_id=snap_id)
+            snapc=snapc, snap_id=snap_id, flags=flags)
         if res < 0:
             raise ObjectOperationError(res, f"{oid}")
         return data, extra
@@ -197,16 +200,23 @@ class IoCtx:
         return extra
 
     # -- writes ------------------------------------------------------------
+    # ``full_try`` (ref: librados OPERATION_FULL_TRY): a write blocked
+    # by a FULL cluster / full pool fails fast with -ENOSPC/-EDQUOT
+    # instead of parking until the condition clears.
     async def write(self, oid: str, data: bytes, offset: int = 0,
-                    timeout: float = 20.0, snapc: tuple | None = None):
+                    timeout: float = 20.0, snapc: tuple | None = None,
+                    full_try: bool = False):
         await self._op(oid, [(OSD_OP_WRITE, offset, len(data), "",
-                              bytes(data))], timeout=timeout, snapc=snapc)
+                              bytes(data))], timeout=timeout, snapc=snapc,
+                       flags=OSD_FLAG_FULL_TRY if full_try else 0)
 
     async def write_full(self, oid: str, data: bytes,
                          timeout: float = 20.0,
-                         snapc: tuple | None = None):
+                         snapc: tuple | None = None,
+                         full_try: bool = False):
         await self._op(oid, [(OSD_OP_WRITEFULL, 0, len(data), "",
-                              bytes(data))], timeout=timeout, snapc=snapc)
+                              bytes(data))], timeout=timeout, snapc=snapc,
+                       flags=OSD_FLAG_FULL_TRY if full_try else 0)
 
     async def truncate(self, oid: str, size: int,
                        snapc: tuple | None = None):

@@ -340,6 +340,125 @@ class Thrasher:
         return {"victim": victim, "acked_writes": written,
                 "horizon_writes": writes}
 
+    async def overload_storm(self, io, writers: int = 4,
+                             write_bytes: int = 1024,
+                             prefill: int = 24,
+                             fill_margin: float = 0.5,
+                             full_timeout: float = 30.0,
+                             hold_s: float = 1.0,
+                             drain_timeout: float = 60.0) -> dict:
+        """The resource-exhaustion storm (the overload acceptance
+        shape): prefill, then shrink ``osd_capacity_bytes`` so the
+        cluster sits at ~``fill_margin`` of capacity, and keep
+        writing until the mon's fullness sweep trips the cluster FULL
+        flag. The invariant under test: concurrent writers PARK on
+        the objecter's flag wait-queue — no unhandled ENOSPC from the
+        store, no write acked and later lost. After ``hold_s`` the
+        capacity is restored; every parked write must then drain to
+        success and the cluster converge clean with all acked data
+        readable (finish with ``settle_and_verify``).
+
+        Capacity rides the SHARED cluster config dict, so every OSD
+        (statfs report) and the mon (ratios) see the change at once —
+        the runtime-shrinkable capacity knob the storm needs.
+        Returns {capacity, acked_writes, parked_at_full, errors}."""
+        cfg = self.c.cfg
+        old_cap = cfg.get("osd_capacity_bytes", 0)
+        rng = random.Random(self.seed ^ 0x0F111)
+        for i in range(prefill):
+            oid = f"ov-pre-{self.seed}-{i:04d}"
+            data = bytes([i % 256]) * write_bytes
+            # prefill rides the generous drain deadline: a slow host
+            # must not fail the storm before it even starts
+            await io.write_full(oid, data, timeout=drain_timeout)
+            self.acked[oid] = data
+        # per-OSD usage ~= total * size / n_osds; capacity chosen so
+        # each OSD starts near fill_margin of it
+        live = [o for o in self.c.osds if not o._stopped]
+        per_osd = max(o.store_used_bytes() for o in live)
+        capacity = max(int(per_osd / fill_margin), 4096)
+        cfg["osd_capacity_bytes"] = capacity
+        self._log(f"overload storm: capacity -> {capacity}B "
+                  f"(~{per_osd}B used per osd)")
+        errors: list = []
+        stop = asyncio.Event()
+        seqs = [0]
+
+        async def writer(w):
+            while not stop.is_set():
+                oid = f"ov-{self.seed}-{w}-{seqs[0]:05d}"
+                seqs[0] += 1
+                data = bytes([seqs[0] % 256]) * \
+                    rng.randint(1, write_bytes)
+                try:
+                    # generous deadline: a FULL-parked write must
+                    # outlive the storm's hold window, not time out
+                    await io.write_full(oid, data,
+                                        timeout=drain_timeout)
+                    self.acked[oid] = data
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    errors.append((oid, repr(e)))
+                await asyncio.sleep(0.01)
+        tasks = [asyncio.ensure_future(writer(w))
+                 for w in range(writers)]
+        try:
+            deadline = asyncio.get_event_loop().time() + full_timeout
+            while True:
+                status = await self.c.client.status()
+                flags = status["osdmap"].get("flags", "")
+                if "full" in flags.split(","):
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(
+                        f"FULL flag never tripped (flags={flags!r}, "
+                        f"util={status['osdmap'].get('osd_utilization')})")
+                await asyncio.sleep(0.1)
+            self._log("overload storm: cluster FULL tripped")
+            acked_at_full = len(self.acked)
+            await asyncio.sleep(hold_s)
+            # parked, not erroring: while FULL, writers must neither
+            # fail nor leak ENOSPC from BlueStoreLite — and no NEW
+            # writes complete (only ops already in flight when the
+            # flag tripped may still land)
+            assert not errors, f"writers errored under FULL: {errors}"
+            parked = sum(1 for t in tasks if not t.done())
+            assert parked == writers, \
+                f"only {parked}/{writers} writers still running"
+            grew = len(self.acked) - acked_at_full
+            assert grew <= writers, \
+                f"{grew} writes completed against a FULL cluster"
+            cfg["osd_capacity_bytes"] = old_cap
+            self._log("overload storm: capacity restored")
+            deadline = asyncio.get_event_loop().time() + drain_timeout
+            while True:
+                status = await self.c.client.status()
+                flags = status["osdmap"].get("flags", "")
+                if "full" not in flags.split(","):
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError("FULL flag never cleared")
+                await asyncio.sleep(0.1)
+            # drain: every write issued before/through FULL completes
+            stop.set()
+            done, pending = await asyncio.wait(
+                tasks, timeout=drain_timeout)
+            assert not pending, "writers failed to drain after unfull"
+            assert not errors, \
+                f"writes lost in the drain: {errors}"
+        finally:
+            stop.set()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            if cfg.get("osd_capacity_bytes") == capacity:
+                cfg["osd_capacity_bytes"] = old_cap
+        self._log(f"overload storm: drained; {len(self.acked)} acked, "
+                  f"{len(errors)} errors")
+        return {"capacity": capacity, "acked_writes": len(self.acked),
+                "parked_at_full": parked, "errors": len(errors)}
+
     async def settle_and_verify(self, io, timeout: float = 240.0,
                                 fsck_stores=None) -> dict:
         """Heal everything, revive everything, converge, verify.

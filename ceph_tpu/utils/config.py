@@ -96,6 +96,37 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
            "advertised store capacity for fullness checks (0 = "
            "unlimited; the in-memory stores have no intrinsic size)",
            min=0),
+    # overload protection (ref: global.yaml.in mon_osd_nearfull_ratio /
+    # mon_osd_full_ratio, osd.yaml.in osd_failsafe_full_ratio,
+    # osd_client_message_cap / osd_client_message_size_cap): the three
+    # fullness lines of defense plus the client-op admission throttle.
+    Option("mon_osd_nearfull_ratio", float, 0.85,
+           "per-OSD used/capacity ratio raising OSD_NEARFULL health",
+           min=0.0, max=1.0),
+    Option("mon_osd_full_ratio", float, 0.95,
+           "per-OSD ratio setting the cluster FULL flag: client "
+           "writes park (or fail -ENOSPC with FULL_TRY)",
+           min=0.0, max=1.0),
+    Option("osd_failsafe_full_ratio", float, 0.97,
+           "local statfs ratio above which the OSD rejects writes "
+           "-ENOSPC at admission — the stale-map-proof last line of "
+           "defense", min=0.0, max=1.0),
+    Option("mon_osd_reporter_lifetime", float, 600.0,
+           "seconds a failure reporter's accusation stays live; "
+           "older reports expire on mon tick so stale accusations "
+           "cannot sum to a markdown", min=0.0),
+    Option("osd_pool_default_quota_max_bytes", int, 0,
+           "default pool byte quota (0 = unlimited)", min=0),
+    Option("osd_pool_default_quota_max_objects", int, 0,
+           "default pool object quota (0 = unlimited)", min=0),
+    Option("osd_client_message_cap", int, 256,
+           "max in-flight client ops dispatched per OSD; excess ops "
+           "queue at admission", min=0),
+    Option("osd_client_message_size_cap", int, 500 << 20,
+           "max aggregate in-flight client-op bytes per OSD", min=0),
+    Option("osd_pg_op_queue_cap", int, 512,
+           "per-PG op-queue depth past which the primary sends "
+           "MOSDBackoff instead of queueing", min=1),
     # CRUSH tunables defaults (jewel profile; ref: src/crush/CrushWrapper.h
     # set_tunables_jewel).
     Option("crush_choose_total_tries", int, 50, "descent retry budget"),

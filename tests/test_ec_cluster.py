@@ -147,3 +147,68 @@ def test_ec_shard_reconstruction_on_revive():
         finally:
             await c.stop()
     run(go())
+
+
+def test_ec_write_survives_position_shuffle():
+    """A write landing in the TRANSIENT interval after an auto-out
+    remap (a surviving OSD shifted to a different acting position)
+    must stay readable — and regain full redundancy — once the
+    revived OSD shifts the positions back.
+
+    Without position-stamped shards (`_pos` attr, pos-keyed gather)
+    the shifted survivor's bytes were later misread as the shard of
+    its OLD position and the revived OSD's rebuild decoded zeros —
+    silent corruption of the tail of every affected object."""
+    async def go():
+        c, io = await _ec_cluster(n_osds=3)
+        try:
+            await io.write_full("pre", b"P" * 2000)
+            await c.kill_osd(2)
+            await c.wait_for_osd_down(2, timeout=60)
+            # wait past mon_osd_down_out_interval (2.0s in _ec_cluster)
+            # so the OUT remap lands: acting positions shuffle among
+            # the two survivors
+            deadline = asyncio.get_event_loop().time() + 30.0
+            lead = c.leader()
+            while lead.osdmon.osdmap.osd_weight[2] > 0:
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "osd.2 never auto-outed"
+                await asyncio.sleep(0.1)
+            await asyncio.sleep(0.5)        # let re-peering settle
+            # writes INSIDE the shuffled interval
+            await io.write_full("shuffled", b"S" * 2000,
+                                timeout=60.0)
+            await io.write_full("pre", b"Q" * 2000, timeout=60.0)
+            await c.revive_osd(2)           # positions shuffle back
+            await c.wait_for_clean(timeout=120)
+            assert await io.read("shuffled") == b"S" * 2000
+            assert await io.read("pre") == b"Q" * 2000
+            # redundancy restored: within a grace window every live
+            # holder's shard is stamped for its CURRENT position
+            deadline = asyncio.get_event_loop().time() + 30.0
+            while True:
+                stale = []
+                for o in c.osds:
+                    if o._stopped:
+                        continue
+                    for pgid_s, pg in o.pgs.items():
+                        if not hasattr(pg, "_stored_pos"):
+                            continue
+                        my = pg.my_shard()
+                        if my < 0:
+                            continue
+                        for oid in o.store.list_objects(pg.cid):
+                            if oid == "_pgmeta_":
+                                continue
+                            sp = pg._stored_pos(oid)
+                            if 0 <= sp != my:
+                                stale.append((o.whoami, pgid_s, oid,
+                                              sp, my))
+                if not stale:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"position-stale shards never healed: {stale}"
+                await asyncio.sleep(0.5)
+        finally:
+            await c.stop()
+    run(go())

@@ -1,0 +1,122 @@
+"""Tier-1 budget guards, enforced mechanically.
+
+The tier-1 run (`pytest -m 'not slow'`, see ROADMAP.md) lives under a
+hard wall-clock cap. Two conventions keep it there, and this module
+turns both from convention into CI:
+
+1. any test driving a Thrasher storm entry point (`thrash`,
+   `backfill_storm`, `overload_storm`) must either carry the `slow`
+   marker or pass small LITERAL budgets (a smoke variant) — a deep
+   storm slipping into tier-1 blows the cap;
+2. every pytest marker used under tests/ must be registered in
+   pytest.ini — an unregistered marker (e.g. a typo'd `slowe`)
+   silently runs the test in tier-1 instead of excluding it.
+"""
+
+import ast
+import configparser
+import pathlib
+
+TESTS = pathlib.Path(__file__).parent
+REPO = TESTS.parent
+
+# storm entry point -> {kwarg: max literal value} a NON-slow (smoke)
+# caller may pass; a bigger or non-literal budget requires `slow`
+STORM_BUDGETS = {
+    "thrash": {"steps": 20},
+    "backfill_storm": {"writes": 60, "partitions": 2},
+    "overload_storm": {"writers": 4, "prefill": 32, "hold_s": 1.0},
+}
+BUILTIN_MARKS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings",
+}
+
+
+def _mark_names(node) -> set[str]:
+    """pytest.mark.<name> attribute chains reachable from ``node``."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Attribute) and \
+                n.value.attr == "mark" and \
+                isinstance(n.value.value, ast.Name) and \
+                n.value.value.id == "pytest":
+            out.add(n.attr)
+    return out
+
+
+def _storm_calls(fn) -> list[tuple[str, dict]]:
+    """(entry point, {kwarg: literal-or-None}) calls inside ``fn``
+    (nested async helpers included — ast.walk descends)."""
+    calls = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in STORM_BUDGETS:
+            kwargs = {}
+            for kw in n.keywords:
+                kwargs[kw.arg] = kw.value.value \
+                    if isinstance(kw.value, ast.Constant) else None
+            calls.append((n.func.attr, kwargs))
+    return calls
+
+
+def _iter_test_functions():
+    for path in sorted(TESTS.glob("test_*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        module_marks = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "pytestmark"
+                    for t in stmt.targets):
+                module_marks |= _mark_names(stmt.value)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node.name.startswith("test_"):
+                marks = set(module_marks)
+                for dec in node.decorator_list:
+                    marks |= _mark_names(dec)
+                yield path, node, marks
+
+
+def test_storm_tests_are_slow_or_bounded():
+    """A storm entry point in a non-slow test must carry small literal
+    budgets; anything bigger (or computed) needs @pytest.mark.slow."""
+    violations = []
+    for path, fn, marks in _iter_test_functions():
+        if "slow" in marks:
+            continue
+        for entry, kwargs in _storm_calls(fn):
+            limits = STORM_BUDGETS[entry]
+            for arg, cap in limits.items():
+                if arg not in kwargs:
+                    continue                 # library default: bounded
+                val = kwargs[arg]
+                if val is None or val > cap:
+                    violations.append(
+                        f"{path.name}::{fn.name} calls {entry}("
+                        f"{arg}={val if val is not None else '<expr>'}"
+                        f") above the tier-1 smoke cap {cap} without "
+                        f"@pytest.mark.slow")
+    assert not violations, "\n".join(violations)
+
+
+def test_all_markers_registered_in_pytest_ini():
+    """Every pytest.mark.<name> used under tests/ must appear in
+    pytest.ini's markers section (typos would silently run in
+    tier-1)."""
+    ini = configparser.ConfigParser()
+    ini.read(REPO / "pytest.ini")
+    registered = {
+        line.strip().split(":", 1)[0].split("(", 1)[0]
+        for line in ini["pytest"].get("markers", "").splitlines()
+        if line.strip()}
+    used = set()
+    for path in sorted(TESTS.glob("test_*.py")):
+        used |= _mark_names(ast.parse(path.read_text()))
+    unregistered = used - registered - BUILTIN_MARKS
+    assert not unregistered, (
+        f"markers {sorted(unregistered)} used under tests/ but not "
+        f"registered in pytest.ini")
