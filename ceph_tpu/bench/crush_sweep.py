@@ -152,6 +152,10 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
         "seconds_100M_est": round(1e8 * per_pg + overhead, 3),
         "overhead_s": round(overhead, 4),
         "method": method,
+        # which engine served the sweep (pallas/xla/scalar): a variant
+        # silently sliding off the kernel is a visible diff in the
+        # bench trajectory, not a mystery slowdown
+        "path": mapper.mapping_path(rule, num_rep),
         "platform": jax.devices()[0].platform,
     }
 
@@ -178,7 +182,7 @@ def sweep_rate_variants(n_osds: int = 10240, n_pgs: int = 1 << 21,
         r = sweep_rate(n_osds, npg, num_rep, mapper=mapper)
         out[name] = {k: r[k] for k in
                      ("mappings_per_s", "n_pgs", "seconds_per_batch",
-                      "method", "seconds_100M_est")}
+                      "method", "seconds_100M_est", "path")}
     return out
 
 

@@ -54,6 +54,26 @@ Performance techniques (each cross-checked bit-exact vs mapper_ref):
   negated-ln table, magic-multiply exact division (no 64-bit divider on
   TPU), speculative parallel tries replacing most while_loop retry
   iterations, and static descent-depth unrolling.
+
+Mapping engine layers (round 6): this module is the bottom of a
+three-layer serving stack —
+- **Mapper** (here): batched device mapping. The fused Pallas kernel
+  (``pallas_mapper``) now serves arbitrary continuous per-item weights
+  and single-position choose_args weight-sets: the 64K-entry negln
+  fixed-point lookup decomposes into two 256-wide one-hot matmuls
+  (hi/lo byte split, same MXU trick as ``_zg_pair``), so a
+  balancer-style weight-set no longer falls off the kernel onto the
+  XLA gather path (the 34x ``choose_args`` cliff in BENCH_r05).
+  ``mapping_path(rule, width)`` reports which engine — pallas / xla /
+  scalar — serves a given shape; bench rows record it per variant.
+- **OSDMapMapping** (``osd/osdmap_mapping.py``): a full-cluster
+  PG->OSD table maintained ACROSS epochs by delta remap — an
+  incremental's affected-PG set is computed from the map diff and only
+  those seeds re-enter the pipeline (topology changes full-sweep).
+- **OSDMap epoch-keyed memo**: scalar data-path lookups (Objecter op
+  targeting, mon repair, lazy PG instantiation) are memoized per
+  epoch; any epoch bump drops the memo wholesale, so the cache can
+  never serve across ``apply_incremental``.
 """
 
 from __future__ import annotations
@@ -125,6 +145,21 @@ def _negln_table() -> np.ndarray:
 
 def _u32(v):
     return v.astype(jnp.uint32)
+
+
+@functools.lru_cache(maxsize=1)
+def _staged_const_tables():
+    """The map-INDEPENDENT device tables — negln (64K-entry straw2
+    numerator) and the zg ln-equality factorization — staged once per
+    process. Every Mapper used to re-ship both (~0.8 MiB) on
+    construction; on this platform's remote-TPU tunnel each transfer
+    pays RPC latency, and the balancer rebuilds a Mapper per map
+    mutation, so the constants were a standing tax on pack_seconds."""
+    with _enable_x64(True):
+        from ceph_tpu.crush.ln_table import ln_gap_info
+        _, zg = ln_gap_info()
+        return (jnp.asarray(_negln_table(), dtype=jnp.int64),
+                jnp.asarray(zg.reshape(256, 256), dtype=jnp.float32))
 
 
 # ---------------------------------------------------------------------------
@@ -807,40 +842,55 @@ class Mapper:
             device_weights = np.full(p.max_devices, WEIGHT_ONE,
                                      dtype=np.int64)
         with _enable_x64(True):
-            from ceph_tpu.crush.ln_table import ln_gap_info
-            _, zg = ln_gap_info()
-            self.arrays = {
-                "items": jnp.asarray(p.items, dtype=jnp.int32),
-                "weights": jnp.asarray(p.weights, dtype=jnp.int64),
-                "wm1": jnp.asarray(p.wm1, dtype=jnp.uint64),
-                "wm0": jnp.asarray(p.wm0, dtype=jnp.uint64),
-                "wsh": jnp.asarray(p.wsh, dtype=jnp.uint64),
-                "cumw": jnp.asarray(p.cumw, dtype=jnp.int64),
-                "size": jnp.asarray(p.size, dtype=jnp.int32),
-                "alg": jnp.asarray(p.alg, dtype=jnp.int32),
-                "btype": jnp.asarray(p.btype, dtype=jnp.int32),
-                "bid": jnp.asarray(p.bid, dtype=jnp.int32),
-                "device_weights": jnp.asarray(device_weights,
-                                              dtype=jnp.int64),
-                "negln": jnp.asarray(_negln_table(), dtype=jnp.int64),
-                # (B,1)/(D,1) copies: element gathers cost ~7ns/element
-                # on this platform; row gathers are ~10x cheaper
-                "size_c": jnp.asarray(p.size[:, None], dtype=jnp.int32),
-                "alg_c": jnp.asarray(p.alg[:, None], dtype=jnp.int32),
-                "btype_c": jnp.asarray(p.btype[:, None], dtype=jnp.int32),
+            # Staging discipline (round 6): each jnp.asarray is a
+            # host->device transfer, and on this platform's remote-TPU
+            # tunnel per-transfer LATENCY (not bandwidth) dominated
+            # pack_seconds — the old one-array-per-key staging paid ~17
+            # round trips per Mapper (measured 10.7 s/pack at 10k OSDs
+            # on the driver). Now: the map-independent tables ride the
+            # process-wide cache, the six (B, S) tables share ONE int64
+            # shuttle (uint64 rides as bits, items as widened int32),
+            # and the per-bucket scalar columns share one int32 array.
+            negln_dev, zg2d_dev = _staged_const_tables()
+            big64 = jnp.asarray(np.stack([
+                p.items.astype(np.int64), p.weights, p.cumw,
+                p.wm1.view(np.int64), p.wm0.view(np.int64),
+                p.wsh.view(np.int64)]))
+            meta32 = np.stack([
+                p.size, p.alg, p.btype, p.bid,
                 # one word per bucket: size | alg<<16 | btype<<20 — one
                 # row gather per descent level instead of three
-                "meta_c": jnp.asarray(
-                    (p.size.astype(np.int64)
-                     | (p.alg.astype(np.int64) << 16)
-                     | (p.btype.astype(np.int64) << 20))[:, None]
-                    .astype(np.int32)),
-                "devw_c": jnp.asarray(
-                    np.asarray(device_weights)[:, None], dtype=jnp.int64),
+                (p.size.astype(np.int64)
+                 | (p.alg.astype(np.int64) << 16)
+                 | (p.btype.astype(np.int64) << 20)).astype(np.int32),
+            ], axis=1)
+            meta_dev = jnp.asarray(meta32, dtype=jnp.int32)  # (B, 5)
+            devw_c = jnp.asarray(
+                np.asarray(device_weights)[:, None], dtype=jnp.int64)
+            _bits = jax.lax.bitcast_convert_type
+            self.arrays = {
+                "items": big64[0].astype(jnp.int32),
+                "weights": big64[1],
+                "cumw": big64[2],
+                "wm1": _bits(big64[3], jnp.uint64),
+                "wm0": _bits(big64[4], jnp.uint64),
+                "wsh": _bits(big64[5], jnp.uint64),
+                "size": meta_dev[:, 0],
+                "alg": meta_dev[:, 1],
+                "btype": meta_dev[:, 2],
+                "bid": meta_dev[:, 3],
+                "device_weights": devw_c[:, 0],
+                "negln": negln_dev,
+                # (B,1)/(D,1) copies: element gathers cost ~7ns/element
+                # on this platform; row gathers are ~10x cheaper
+                "size_c": meta_dev[:, 0:1],
+                "alg_c": meta_dev[:, 1:2],
+                "btype_c": meta_dev[:, 2:3],
+                "meta_c": meta_dev[:, 4:5],
+                "devw_c": devw_c,
                 # ln-equality pair predicate as a (256,256) one-hot-
                 # matmul table (see _zg_pair)
-                "zg2d": jnp.asarray(
-                    zg.reshape(256, 256), dtype=jnp.float32),
+                "zg2d": zg2d_dev,
             }
             if p.tree_depth_max:
                 self.arrays["tree_nodes"] = jnp.asarray(p.tree_nodes,
@@ -913,10 +963,10 @@ class Mapper:
         PERF.inc("reweights")
         _was = self._skip_is_out
         with _enable_x64(True):
-            self.arrays["device_weights"] = jnp.asarray(device_weights,
-                                                        dtype=jnp.int64)
-            self.arrays["devw_c"] = jnp.asarray(
+            devw_c = jnp.asarray(                 # one transfer, two views
                 np.asarray(device_weights)[:, None], dtype=jnp.int64)
+            self.arrays["device_weights"] = devw_c[:, 0]
+            self.arrays["devw_c"] = devw_c
         self._skip_is_out = bool(
             np.all(np.asarray(device_weights) == WEIGHT_ONE))
         self.cfg["skip_is_out"] = self._skip_is_out
@@ -1091,6 +1141,19 @@ class Mapper:
 
     def _rule_fn(self, ruleno: int, result_max: int):
         return _compiled_rule(*self._rule_key(ruleno, result_max))
+
+    def mapping_path(self, ruleno: int, result_max: int) -> str:
+        """Which engine serves this (rule, width): 'pallas' (fused
+        kernel on TPU), 'pallas-interpret' (tests), 'xla' (vectorized
+        general path), or 'scalar' (legacy-tunable spec walk). Bench
+        rows record this so a variant silently sliding off the kernel
+        is a visible diff, not a mystery slowdown."""
+        if self._scalar_reason:
+            return "scalar"
+        if self._kernel_body(ruleno, result_max) is not None:
+            return ("pallas-interpret"
+                    if self._kernel_mode == "interpret" else "pallas")
+        return "xla"
 
     def rule_is_firstn(self, ruleno: int) -> bool:
         """True when the rule's choose steps are firstn (replicated)."""

@@ -54,15 +54,32 @@ so the fallback is O(flagged), not O(block)). A single-weight-set
 choose_args map is the same machinery with substituted weights —
 position-independent, so the shared candidate table survives.
 
+CONTINUOUS weights (round 6, this PR): buckets whose slots carry more
+than MAX_CLASSES distinct weights — exactly what an upstream-style
+balancer's choose_args weight-set produces (every slot perturbed a few
+percent) — previously gated the whole map off the kernel and onto the
+~35x-slower XLA general path. The class decomposition degenerates
+cleanly: treat EVERY slot as its own class. No within-class tie
+argument (and hence no ln-gap license G) is needed at all, because a
+one-slot class has no internal tie to break: the kernel runs the exact
+fixed-point crush_ln ladder once per slot (the same 129-entry RH/LH +
+256-entry LL one-hot MXU fetches, over the slot's own 16-bit hash) and
+compares d_s = neg_s / w_s across slots in f32 with the identical
+MARGIN_ABS/MARGIN_REL flagging — ambiguous lanes (f32 gap inside the
+rounding + floor-tie envelope) recompute bit-exactly on the XLA
+fallback. Per-slot weights ride the level table as two 15-bit halves,
+so any w < 2^30 is admissible — this also covers few-class buckets
+whose weights exceed G.
+
 Eligibility (build_plan returns None otherwise; the caller keeps the
 XLA path):
 - modern tunables (chooseleaf_stable=1, no legacy local retries),
 - rule shape TAKE root / CHOOSE[LEAF]_FIRSTN / EMIT,
-- every bucket reachable from the root is straw2, non-empty, with at
-  most MAX_CLASSES distinct positive weights, each <= the ln-gap
-  license G (~2^28.5, i.e. any real disk) — continuous per-item
-  weight perturbations (upstream-balancer-style weight-sets) exceed
-  the class cap and keep the XLA path,
+- every bucket reachable from the root is straw2 and non-empty with at
+  least one positive weight; weights above the class budget or the
+  ln-gap license take the per-slot continuous draw (weights must fit
+  two 15-bit halves, i.e. < 2^30 ~ 16Ki disks of weight 1.0, and the
+  bucket at most MAX_CONT_SLOTS slots — the ladder unrolls per slot),
 - uniform hierarchy depth (all root->target->device paths equal),
 - choose_args: at most ONE weight set per bucket and no ids overrides,
 - at most MAX_REWEIGHT non-full devices (is_out then runs as a
@@ -120,9 +137,23 @@ VMEM_BUDGET = 12 << 20
 _LIVE_TEMPS = 12
 
 
-MAX_CLASSES = 4     # distinct weights per bucket the kernel carries;
-                    # real buckets mix 1-3 disk sizes (beyond that the
-                    # XLA general path is the right tool)
+MAX_CLASSES = 4     # distinct weights per bucket the class draw
+                    # carries; real buckets mix 1-3 disk sizes. Beyond
+                    # that (continuous balancer weight-sets) each slot
+                    # becomes its own class: one exact crush_ln per
+                    # slot instead of per class (see _choose_level_cont)
+MAX_CONT_WEIGHT = 1 << 30   # continuous per-slot weights must split
+                            # into two 15-bit table halves
+MAX_CONT_SLOTS = 64  # continuous levels unroll one sequential
+                     # crush_ln ladder PER SLOT (_choose_level_cont),
+                     # and the kernel replays the level per speculative
+                     # candidate — a flat 1000-disk continuous root
+                     # would emit thousands of unrolled ladders and a
+                     # minutes-long compile. Real hierarchy buckets
+                     # (hosts ~16-32 disks, racks ~tens of hosts) sit
+                     # far under this; wider continuous buckets keep
+                     # the XLA path, as all continuous shapes did
+                     # before round 6.
 # Weight-class draw comparison margin (see _choose_level_cls): lanes
 # whose top two class draws land closer than ABS + best*REL are flagged
 # to the bit-exact XLA fallback. REL covers the f32 rounding of
@@ -143,9 +174,11 @@ def _plan_lanes(sizes, rows, kmax) -> int:
     per_lane = 0
     for (S, P), R, K in zip(sizes, rows, kmax):
         extra = 0
-        if K > 1:
-            # class choose adds the crush_ln machinery per lane: the
-            # (129, N) + (256, N) ln one-hots plus ~35 (1, N) limb temps
+        if K != 1:
+            # class (K > 1) and continuous (K == 0) chooses add the
+            # crush_ln machinery per lane: the (129, N) + (256, N) ln
+            # one-hots plus ~35 (1, N) limb temps (calls are
+            # sequential, so the working set does not stack per slot)
             extra = 129 + 256 + 35
         per_lane = max(per_lane,
                        4 * (_LIVE_TEMPS * S + 2 * R + P + extra))
@@ -156,30 +189,41 @@ def _plan_lanes(sizes, rows, kmax) -> int:
 
 
 def _bucket_classes(weights, G):
-    """Per-slot weight-class ids + distinct positive class weights, or
-    None when the bucket is outside the kernel's class model (too many
-    distinct weights, a weight above the ln-gap license G, or no
-    positive weight at all — the scalar rule hands an all-zero bucket
-    to slot 0, which the class model cannot express)."""
-    cls: list[int] = []
+    """(cls per slot, class weights, raw weights) for the class draw,
+    ("cont", None, raw weights) for the per-slot continuous draw, or
+    None when the bucket fits neither model (a weight too large for
+    the two-15-bit-halves table split, or no positive weight at all —
+    the scalar rule hands an all-zero bucket to slot 0, which neither
+    draw can express).
+
+    Class draw: <= MAX_CLASSES distinct positive weights, each within
+    the ln-gap license G (the within-class argmax argument needs it).
+    Continuous draw (round 6): anything else with 0 < w < 2^30 and at
+    most MAX_CONT_SLOTS slots (the per-slot ladder unrolls at compile
+    time) — each slot is its own class, so no license applies."""
+    ws = [int(w) for w in weights]
+    if not any(w > 0 for w in ws):
+        return None
+    cls: list[int] | None = []
     cws: list[int] = []
-    for w in weights:
-        w = int(w)
+    for w in ws:
         if w <= 0:
             cls.append(-1)       # zero-weight slot: never wins
             continue
-        if w > G:
-            return None
+        if w > G or (w not in cws and len(cws) >= MAX_CLASSES):
+            cls = None           # outside the class model
+            break
         if w in cws:
             cls.append(cws.index(w))
         else:
-            if len(cws) >= MAX_CLASSES:
-                return None
             cws.append(w)
             cls.append(len(cws) - 1)
-    if not cws:
-        return None
-    return cls, cws
+    if cls is not None:
+        return cls, cws, ws
+    if len(ws) <= MAX_CONT_SLOTS and \
+            all(w < MAX_CONT_WEIGHT for w in ws):
+        return "cont", None, ws
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -199,7 +243,9 @@ class KernelPlan:                               # hash -> usable as a
     next-level row index (device id at the last level), row 2S the
     bucket size; multi-class levels (kmax[l] > 1) append [2S+1,3S+1)
     per-slot class ids and 2*K rows of class-weight halves
-    (w & 0x7FFF, w >> 15). Each logical value v is stored as TWO byte
+    (w & 0x7FFF, w >> 15); continuous levels (kmax[l] == 0) append
+    [2S+1,3S+1) per-slot weight low halves and [3S+1,4S+1) high
+    halves instead. Each logical value v is stored as TWO byte
     planes lo=(v+32768)&0xFF (rows [0,R)) and hi=(v+32768)>>8 (rows
     [R,2R)), both in [0,256) and hence EXACT in one bf16 MXU pass
     (DEFAULT precision; HIGHEST's 6 passes made this fetch the
@@ -211,7 +257,8 @@ class KernelPlan:                               # hash -> usable as a
     sizes: tuple           # (S_l, P_l) pairs, static
     rows: tuple            # logical row count R_l per level (2S+1 for
                            # uniform levels; 3S+1+2K for class levels)
-    kmax: tuple            # weight classes per level (1 = uniform draw)
+    kmax: tuple            # weight classes per level (1 = uniform
+                           # draw, 0 = per-slot continuous draw)
     l_main: int            # levels from root to the target type
     l_leaf: int            # levels from target type to devices
     numrep_arg: int        # rule's arg1 (0 = fill result_max)
@@ -357,12 +404,28 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     for li, lvl in enumerate(strata):
         S = max(m.buckets[bid].size for bid in lvl)
         P = len(lvl)
-        K = max(len(bucket_cls[bid][1]) for bid in lvl)
-        # single-class levels keep the lean uniform layout; multi-class
-        # levels append per-slot class ids and per-class weight halves
-        # (w <= G < 2^29 splits into two sub-32768 values, so the same
-        # biased byte-plane fetch stays exact)
-        R = 2 * S + 1 if K == 1 else 3 * S + 1 + 2 * K
+        # A level holding ANY continuous bucket takes the per-slot
+        # layout for all its buckets (per-slot weights express class
+        # buckets too); kmax = 0 marks it. Single-class levels keep
+        # the lean uniform layout; multi-class levels append per-slot
+        # class ids and per-class weight halves (w <= G < 2^29 splits
+        # into two sub-32768 values, so the same biased byte-plane
+        # fetch stays exact).
+        cont_l = any(bucket_cls[bid][0] == "cont" for bid in lvl)
+        if cont_l and S > MAX_CONT_SLOTS:
+            # the per-slot ladder unrolls over the LEVEL's padded
+            # width S, not each continuous bucket's own size — a wide
+            # uniform sibling sharing the stratum would recreate the
+            # compile-time cliff the cap exists to prevent
+            return None
+        K = 0 if cont_l else \
+            max(len(bucket_cls[bid][1]) for bid in lvl)
+        if cont_l:
+            R = 4 * S + 1        # + per-slot weight halves
+        elif K == 1:
+            R = 2 * S + 1
+        else:
+            R = 3 * S + 1 + 2 * K
         tbl = np.zeros((R, P), dtype=np.int64)
         for p, bid in enumerate(lvl):
             b = m.buckets[bid]
@@ -373,8 +436,14 @@ def build_plan(m: CrushMap, packed, ruleno: int,
             else:
                 tbl[S:S + b.size, p] = b.items   # device ids
             tbl[2 * S, p] = b.size
-            if K > 1:
-                cls, cws = bucket_cls[bid]
+            if cont_l:
+                ws = bucket_cls[bid][2]
+                for s, w in enumerate(ws):
+                    w = max(int(w), 0)   # dead slots draw with w=0
+                    tbl[2 * S + 1 + s, p] = w & 0x7FFF
+                    tbl[3 * S + 1 + s, p] = w >> 15
+            elif K > 1:
+                cls, cws, _ = bucket_cls[bid]
                 # zero-weight (-1) and padding slots get class K: they
                 # match no class and can never win
                 tbl[2 * S + 1:2 * S + 1 + S, p] = K
@@ -406,7 +475,7 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     zg2dT = np.ascontiguousarray(
         zg2[128:].T).astype(np.float32)             # (256 lo, 128 hi)
     rhlh = ll = None
-    if any(k > 1 for k in kmax):
+    if any(k != 1 for k in kmax):     # class (>1) or continuous (0)
         rhlh, ll = _ln_plane_tables()
     lanes = _plan_lanes(sizes, rows, kmax)
     if not lanes:
@@ -657,6 +726,57 @@ def _choose_level_cls(zg_ref, rhlh_ref, ll_ref, x_row, ids, rows_next,
     return win_id, win_next, amb
 
 
+def _choose_level_cont(rhlh_ref, ll_ref, x_row, ids, rows_next, size,
+                       wlo, whi, r):
+    """One straw2 choose over (S, N) slots with ARBITRARY per-slot
+    weights — the continuous-choose_args / many-distinct-disks case
+    that used to gate the whole map off the kernel.
+
+    Degenerate class decomposition: every slot is its own weight
+    class, so the within-class argmax argument (and its ln-gap
+    license) is vacuous — there is nothing inside a one-slot class to
+    tie-break. The kernel runs the exact fixed-point crush_ln ladder
+    (_crush_ln_neg — bit-exact vs ln_table.crush_ln) once per slot on
+    the slot's own 16-bit hash and compares d_s = neg_s / w_s across
+    slots in f32. The scalar winner is the FIRST slot attaining the
+    minimal truncated quotient (mapper.c bucket_straw2_choose keeps
+    the incumbent on draw ties), which the strict `d < best` update
+    reproduces whenever the f32 order is provably the exact order;
+    lanes whose top two draws land within MARGIN_ABS + best*MARGIN_REL
+    (covering every f32 rounding and integer floor-tie possibility —
+    the same envelope as _choose_level_cls) return amb=1 and are
+    recomputed bit-exactly by the caller's XLA fallback."""
+    S, N = ids.shape
+    xb = jnp.broadcast_to(x_row, (S, N))
+    rb = jnp.broadcast_to(jnp.asarray(r, jnp.int32), (S, N))
+    if "nohash" in _ABLATE:                          # pragma: no cover
+        u = (xb ^ ids ^ rb) & 0xFFFF
+    else:
+        u = _hash3(xb, ids, rb) & 0xFFFF             # (S, N)
+    big = jnp.float32(3.0e38)
+    best_d = jnp.full((1, N), big, dtype=jnp.float32)
+    second_d = jnp.full((1, N), big, dtype=jnp.float32)
+    win_id = jnp.zeros((1, N), dtype=jnp.int32)
+    win_next = jnp.zeros((1, N), dtype=jnp.int32)
+    for s in range(S):
+        nh, nl = _crush_ln_neg(rhlh_ref, ll_ref, u[s:s + 1, :])
+        w_f = whi[s:s + 1, :].astype(jnp.float32) * jnp.float32(32768.0) \
+            + wlo[s:s + 1, :].astype(jnp.float32)
+        neg_f = nh.astype(jnp.float32) * jnp.float32(16777216.0) \
+            + nl.astype(jnp.float32)
+        d = neg_f / jnp.maximum(w_f, jnp.float32(1.0))
+        # dead slots: past the bucket size, or w <= 0 (stored as 0)
+        d = jnp.where((jnp.int32(s) < size) & (w_f > 0), d, big)
+        new_min = d < best_d
+        second_d = jnp.where(new_min, best_d, jnp.minimum(second_d, d))
+        win_id = jnp.where(new_min, ids[s:s + 1, :], win_id)
+        win_next = jnp.where(new_min, rows_next[s:s + 1, :], win_next)
+        best_d = jnp.minimum(best_d, d)
+    margin = jnp.float32(MARGIN_ABS) + best_d * jnp.float32(MARGIN_REL)
+    amb = (second_d - best_d) <= margin              # (1, N) bool
+    return win_id, win_next, amb
+
+
 def _choose_level(zg_ref, x_row, ids, rows_next, size, r):
     """One straw2 uniform-weight choose over (S, N) candidate slots.
 
@@ -729,7 +849,7 @@ def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
     P_list = [p for _, p in plan.sizes]
     R_list = list(plan.rows)
     K_list = list(plan.kmax)
-    any_cls = any(k > 1 for k in K_list)
+    any_cls = any(k != 1 for k in K_list)    # class or continuous
     K = plan.rw_ids.shape[0]
 
     def kernel(*refs):
@@ -766,6 +886,13 @@ def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
                 if K_list[li] == 1:
                     win_id, win_next = _choose_level(
                         zg_ref, x, ids, nxt, size, jnp.int32(rr))
+                elif K_list[li] == 0:        # per-slot continuous draw
+                    win_id, win_next, amb = _choose_level_cont(
+                        rhlh_ref, ll_ref, x, ids, nxt, size,
+                        full[2 * S + 1:3 * S + 1, :],
+                        full[3 * S + 1:4 * S + 1, :],
+                        jnp.int32(rr))
+                    amb_any = amb_any | amb
                 else:
                     kk = K_list[li]
                     win_id, win_next, amb = _choose_level_cls(

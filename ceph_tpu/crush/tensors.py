@@ -100,13 +100,25 @@ def pack_map(m: CrushMap) -> PackedMap:
     tree_nodes = np.zeros((n_buckets, NT), dtype=np.int64)
     tree_num = np.zeros(n_buckets, dtype=np.int32)
     tree_depth_max = 0
-    for b in m.buckets.values():
+    # Bulk fill (round 6): one flat scatter over all (bucket, slot)
+    # pairs instead of a per-bucket python loop — at 10k OSDs the
+    # row-by-row assignment was a visible slice of pack_seconds.
+    blist = list(m.buckets.values())
+    rows_b = np.array([-1 - b.id for b in blist], dtype=np.int64)
+    sizes_b = np.array([b.size for b in blist], dtype=np.int64)
+    size[rows_b] = sizes_b
+    alg[rows_b] = [b.alg for b in blist]
+    btype[rows_b] = [b.type for b in blist]
+    if sizes_b.sum():
+        flat_rows = np.repeat(rows_b, sizes_b)
+        flat_cols = np.concatenate(
+            [np.arange(s, dtype=np.int64) for s in sizes_b])
+        items[flat_rows, flat_cols] = np.concatenate(
+            [np.asarray(b.items, dtype=np.int32) for b in blist])
+        weights[flat_rows, flat_cols] = np.concatenate(
+            [np.asarray(b.weights, dtype=np.int64) for b in blist])
+    for b in m.buckets.values():          # rare legacy algs only
         r = -1 - b.id
-        size[r] = b.size
-        alg[r] = b.alg
-        btype[r] = b.type
-        items[r, :b.size] = b.items
-        weights[r, :b.size] = b.weights
         if b.alg == ALG_STRAW:
             if b.straws is None:
                 _builder.finish_bucket(b)
@@ -123,14 +135,14 @@ def pack_map(m: CrushMap) -> PackedMap:
     wm1, wm0, wsh = magic_divide_tables(weights)
     from ceph_tpu.crush.ln_table import ln_gap_info
     G, _ = ln_gap_info()
-    uniform = np.zeros(n_buckets, dtype=np.int32)
-    for b in m.buckets.values():
-        r = -1 - b.id
-        if b.alg != ALG_STRAW2 or b.size == 0:
-            continue
-        w0 = int(b.weights[0])
-        if 0 < w0 <= G and all(int(w) == w0 for w in b.weights):
-            uniform[r] = 1
+    # uniform-shortcut flags, row-vectorized: straw2, non-empty, all
+    # live slots equal to the first weight, 0 < w <= G
+    posmask = np.arange(S)[None, :] < size[:, None]
+    first = weights[:, 0]
+    alleq = np.all(np.where(posmask, weights, first[:, None])
+                   == first[:, None], axis=1)
+    uniform = ((alg == ALG_STRAW2) & (size > 0) & (first > 0)
+               & (first <= G) & alleq).astype(np.int32)
     return PackedMap(
         items=items, weights=weights, cumw=cumw,
         wm1=wm1, wm0=wm0, wsh=wsh,
@@ -149,23 +161,31 @@ def magic_divide_tables(weights: np.ndarray):
     """Per-slot magic constants for exact ``neg // w`` (see PackedMap).
 
     Slots with w < 3 get M=0 (the kernel uses a shift for w in {1,2} and
-    masks w == 0)."""
-    flat = weights.reshape(-1)
-    m1 = np.zeros(flat.shape, dtype=np.uint64)
-    m0 = np.zeros(flat.shape, dtype=np.uint64)
-    sh = np.ones(flat.shape, dtype=np.uint64)
-    for i, wv in enumerate(flat):
+    masks w == 0).
+
+    The big-int ceil division cannot vectorize in numpy (2^(64+s) has
+    no 64-bit representation), so the python loop runs over the UNIQUE
+    weights only and fancy-indexes the results back — a continuous
+    choose_args volume at 10k OSDs has ~20k distinct values where the
+    old per-slot loop walked the full (P, B, S) volume."""
+    flat = np.asarray(weights).reshape(-1)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    um1 = np.zeros(uniq.shape, dtype=np.uint64)
+    um0 = np.zeros(uniq.shape, dtype=np.uint64)
+    ush = np.ones(uniq.shape, dtype=np.uint64)
+    for i, wv in enumerate(uniq):
         w = int(wv)
         if w < 3:
             continue
         ell = (w - 1).bit_length()
         s = max(1, ell - 15)
         M = -((-(1 << (64 + s))) // w)          # ceil(2^(64+s)/w) < 2^64
-        m1[i] = M >> 32
-        m0[i] = M & 0xFFFFFFFF
-        sh[i] = s
-    shape = weights.shape
-    return m1.reshape(shape), m0.reshape(shape), sh.reshape(shape)
+        um1[i] = M >> 32
+        um0[i] = M & 0xFFFFFFFF
+        ush[i] = s
+    shape = np.asarray(weights).shape
+    return (um1[inv].reshape(shape), um0[inv].reshape(shape),
+            ush[inv].reshape(shape))
 
 
 def pack_choose_args(m: CrushMap, key: int, packed: PackedMap):
@@ -194,20 +214,10 @@ def pack_choose_args(m: CrushMap, key: int, packed: PackedMap):
                 cw[p, r, :len(ws)] = ws[:S]
         if arg.ids:
             cids[r, :len(arg.ids)] = arg.ids[:S]
-    # magic tables: reuse the base-weight tables for every bucket and
-    # recompute only the (few) overridden rows — the python magic loop
-    # over the full (P, B, S) volume dominated Mapper construction
-    cm1 = np.repeat(packed.wm1[None], P, axis=0).copy()
-    cm0 = np.repeat(packed.wm0[None], P, axis=0).copy()
-    csh = np.repeat(packed.wsh[None], P, axis=0).copy()
-    for bid, arg in args.items():
-        r = -1 - bid
-        if not (0 <= r < B) or not arg.weight_set:
-            continue
-        om1, om0, osh = magic_divide_tables(cw[:, r, :])
-        cm1[:, r, :] = om1
-        cm0[:, r, :] = om0
-        csh[:, r, :] = osh
+    # magic tables in one unique-memoized pass over the whole volume
+    # (magic_divide_tables walks distinct weights only, so a continuous
+    # weight-set no longer pays a python loop per (P, B, S) slot)
+    cm1, cm0, csh = magic_divide_tables(cw)
     return cw, cids, cm1, cm0, csh
 
 

@@ -58,6 +58,13 @@ class Mgr:
         self.active = False
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
+        # full-cluster mapping table maintained ACROSS osd_map fetches
+        # (digest-based crush detection handles the fresh decode per
+        # fetch): the balancer's whole-pool reads and calc_pg_upmaps
+        # candidate probes iterate on the table instead of re-running
+        # the mapper every seconds_per_iteration
+        from ceph_tpu.osd.osdmap_mapping import OSDMapMapping
+        self._mapping = OSDMapMapping()
 
     # -- state access -------------------------------------------------
     async def get(self, what: str):
@@ -67,7 +74,10 @@ class Mgr:
                 {"prefix": "osd getmap"})
             if ret != 0:
                 raise RuntimeError(f"osd getmap failed: {rs}")
-            return decode_osdmap(out)
+            m = decode_osdmap(out)
+            self._mapping.update(m)      # delta remap vs last fetch
+            m.attach_mapping(self._mapping)
+            return m
         if what == "osd_dump":
             ret, _, out = await self.monc.command({"prefix": "osd dump"})
             return json.loads(out) if ret == 0 else {}

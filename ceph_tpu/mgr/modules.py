@@ -95,6 +95,11 @@ class BalancerModule(MgrModule):
                 for bid, arg in crush.choose_args.get(-1, {}).items()}
         crush.choose_args[-1] = args      # the compat weight-set id
         quantize_choose_args(crush, key=-1)
+        # placement mutated in place: bump the epoch so the attached
+        # table/memo can never serve pre-mutation rows for this object
+        # (the every-placement-mutation-bumps-epoch invariant; the
+        # authoritative epoch comes from the mon on the next fetch)
+        osdmap._dirty(crush_changed=True)
         if prev == {bid: [list(ws) for ws in arg.weight_set]
                     for bid, arg in crush.choose_args[-1].items()}:
             # already installed: pushing again every tick would churn
@@ -281,6 +286,24 @@ class PrometheusModule(MgrModule):
         flags = om.get("flags", "")
         for fname in (flags.split(",") if flags else []):
             lines.append(f'ceph_osdmap_flag{{flag="{fname}"}} 1')
+        # mapping engine (round 6): epoch-cache traffic and delta-remap
+        # volume — the counters behind the "<1s to map 100M PGs" target
+        mpc = PerfCountersCollection.instance().get("osdmap")
+        if mpc is not None:
+            md = mpc.dump()
+            lines += [
+                "# TYPE ceph_osdmap_mapping_cache_hits counter",
+                f"ceph_osdmap_mapping_cache_hits "
+                f"{md.get('mapping_cache_hits', 0)}",
+                "# TYPE ceph_osdmap_mapping_cache_misses counter",
+                f"ceph_osdmap_mapping_cache_misses "
+                f"{md.get('mapping_cache_misses', 0)}",
+                "# TYPE ceph_osdmap_remap_pgs counter",
+                f"ceph_osdmap_remap_pgs {md.get('remap_pgs', 0)}",
+                "# TYPE ceph_osdmap_remap_full_sweeps counter",
+                f"ceph_osdmap_remap_full_sweeps "
+                f"{md.get('remap_full_sweeps', 0)}",
+            ]
         # in-process perf counters (ref: prometheus module exporting
         # daemon perf counters)
         for name, counters in PerfCountersCollection.instance() \

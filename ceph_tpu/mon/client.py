@@ -45,6 +45,18 @@ class MonClient(Dispatcher):
         self.osdmap = None
         self._osdmap_waiters: list[asyncio.Future] = []
         self.map_callbacks: list = []          # async fn(osdmap)
+        # opt-in full-cluster mapping table (OSD daemons set this):
+        # delta-maintained per epoch and attached to the map so the
+        # holder's bulk advance-map placement reads come from the
+        # table instead of re-running the mapper every epoch
+        self.track_mapping = False
+        self._mapping = None
+
+    @property
+    def mapping_table(self):
+        """The maintained OSDMapMapping (None until the first tracked
+        map arrives) — the public read for status/introspection."""
+        return self._mapping
 
     # -- dispatch ----------------------------------------------------------
     async def ms_dispatch(self, msg) -> bool:
@@ -85,6 +97,14 @@ class MonClient(Dispatcher):
                         f"re-subscribing")
             asyncio.ensure_future(
                 self.subscribe("osdmap", self.osdmap.epoch + 1))
+        if self.track_mapping and self.osdmap is not None:
+            # table BEFORE waiters/callbacks: the consumers' bulk
+            # placement reads in the same wakeup should hit it
+            if self._mapping is None:
+                from ceph_tpu.osd.osdmap_mapping import OSDMapMapping
+                self._mapping = OSDMapMapping()
+            self._mapping.update(self.osdmap)
+            self.osdmap.attach_mapping(self._mapping)
         for fut in self._osdmap_waiters:
             if not fut.done():
                 fut.set_result(self.osdmap)

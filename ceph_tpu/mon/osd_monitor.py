@@ -87,6 +87,12 @@ class OSDMonitor(PaxosService):
         if last and (self.osdmap is None or self.osdmap.epoch < last):
             blob = self.store.get(PFX, f"full_{last:08x}")
             if blob is not None:
+                # NO eager OSDMapMapping here: the mon's only placement
+                # reads are scalar (`osd map`, pg repair) — the
+                # epoch-keyed memo covers them, and a per-commit table
+                # update (fresh decode -> crush digest + delta scan
+                # every epoch) measurably slowed every cluster test
+                # for a table nothing bulk-reads
                 self.osdmap = decode_osdmap(blob)
 
     def encode_full(self) -> bytes:

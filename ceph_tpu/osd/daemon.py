@@ -79,6 +79,11 @@ class OSD(Dispatcher):
         self.hb_msgr.add_dispatcher(_HBDispatcher(self))
         self.monc = MonClient(name, monmap, keyring=keyring,
                               messenger=self.msgr)
+        # maintain the full-cluster mapping table per epoch: the
+        # advance-map sweep in _on_osdmap reads every pool's placement
+        # anyway, so the (delta-updated) table replaces those mapper
+        # runs rather than adding work
+        self.monc.track_mapping = True
         self.monc.map_callbacks.append(self._on_osdmap)
         self.osdmap = None
         self.pgs: dict[str, PG] = {}
@@ -146,6 +151,25 @@ class OSD(Dispatcher):
             return 0
         self._used_cache = (now, used)
         return used
+
+    def _mapping_status(self) -> dict:
+        """Mapping-engine counters for asok ``status``: the epoch
+        cache and delta-remap traffic (osdmap), the kernel/pack
+        counters (crush_mapper), and this daemon's tracked table."""
+        from ceph_tpu.utils.perf_counters import PerfCountersCollection
+        coll = PerfCountersCollection.instance()
+        out = {}
+        for name in ("osdmap", "crush_mapper"):
+            pc = coll.get(name)
+            if pc is not None:
+                out[name] = pc.dump()
+        if self.osdmap is not None:
+            out["cache_hits"] = self.osdmap.mapping_cache_hits
+            out["cache_misses"] = self.osdmap.mapping_cache_misses
+        mt = self.monc.mapping_table
+        if mt is not None:
+            out["table_epoch"] = mt.epoch
+        return out
 
     def failsafe_full(self) -> bool:
         """The stale-map-proof last line of defense (ref: OSD
@@ -246,7 +270,8 @@ class OSD(Dispatcher):
                         "capacity_bytes": int(self.config.get(
                             "osd_capacity_bytes", 0)),
                         "failsafe_full": self.failsafe_full(),
-                        "backfill_toofull": self.backfill_toofull()}},
+                        "backfill_toofull": self.backfill_toofull()},
+                    "mapping": self._mapping_status()},
                 "osd state summary")
             self.asok.register(
                 "dump_ops_in_flight",

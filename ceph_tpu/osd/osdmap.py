@@ -6,6 +6,27 @@ pps, CRUSH, nonexistent-removal, upmap, up-filter, primary affinity,
 pg_temp — runs over an entire seed array at once, with the CRUSH step on
 the accelerator and the sparse overrides (upmap/pg_temp, typically a few
 thousand entries) as host-side scatters.
+
+Round 6 adds two serving layers above the pipeline so the data path
+stops re-entering the mapper per op:
+
+- an EPOCH-KEYED memo cache for small (scalar) lookups — Objecter op
+  targeting, mon `osd map`/repair, OSD lazy PG instantiation. Keyed
+  (pool, seed), valid for exactly one epoch: any mutation bumps
+  ``epoch`` and the next lookup drops the memo wholesale. Code paths
+  that mutate placement state WITHOUT bumping the epoch (only
+  ``calc_pg_upmaps`` mid-iteration) must bypass it (see
+  ``_pipeline_from_crush``) and bump the epoch before returning.
+- an attached :class:`~ceph_tpu.osd.osdmap_mapping.OSDMapMapping`
+  full-cluster table (``attach_mapping``) serving BULK lookups — OSD
+  advance-map, mon sweeps, the balancer — maintained across epochs by
+  delta remap instead of full recomputation.
+
+The split ``pg_to_crush_osds`` (pure CRUSH output) and
+``_pipeline_from_crush`` (everything after CRUSH) exists because the
+two halves invalidate differently: up/down/exists flips, primary
+affinity and the override dicts never change CRUSH output, so their
+delta remap replays only the cheap numpy pipeline over cached raw rows.
 """
 
 from __future__ import annotations
@@ -56,6 +77,25 @@ def flag_names(flags: int) -> str:
 
 
 _EMPTY_ROWS = np.empty(0, dtype=np.int64)
+
+# mapping-engine counters (round 6): cache traffic and delta-remap
+# volume, exported via prometheus/asok like the crush_mapper set
+from ceph_tpu.utils.perf_counters import PerfCountersBuilder as _PCB
+
+PERF = (_PCB("osdmap")
+        .add_u64_counter("mapping_cache_hits",
+                         "pg lookups served from the epoch cache/table")
+        .add_u64_counter("mapping_cache_misses",
+                         "pg lookups that entered the mapping pipeline")
+        .add_u64_counter("remap_pgs",
+                         "PGs delta-remapped by OSDMapMapping.update")
+        .add_u64_counter("remap_full_sweeps",
+                         "full-pool sweeps by OSDMapMapping.update")
+        .create_perf_counters())
+
+_PG_CACHE_MAX_BATCH = 16       # memo-cache only scalar-ish lookups;
+                               # bulk callers go to the table/pipeline
+_PG_CACHE_MAX_ENTRIES = 1 << 20
 
 
 def _index_overrides(folded: np.ndarray, pgs) -> dict[int, np.ndarray]:
@@ -153,6 +193,17 @@ class OSDMap:
         # pausewr, full, noout, nodown, noup, noin)
         self.flags = 0
         self._mappers: dict[int | None, Mapper] = {}
+        # bumped whenever the crush TREE changes (not reweights):
+        # OSDMapMapping keys its topology-fallback detection on it
+        self.crush_version = 1
+        # epoch-keyed scalar memo + optional full-cluster table (see
+        # module docstring); counters are instance-level so tests can
+        # assert on one map, and mirrored into the process-wide PERF
+        self._mapping = None
+        self._pg_cache: dict[tuple[int, int], tuple] = {}
+        self._pg_cache_epoch = self.epoch
+        self.mapping_cache_hits = 0
+        self.mapping_cache_misses = 0
 
     def test_flag(self, bit: int) -> bool:
         return bool(self.flags & bit)
@@ -190,6 +241,7 @@ class OSDMap:
         self.epoch += 1
         if crush_changed:
             self._mappers.clear()
+            self.crush_version += 1
 
     def set_max_osd(self, n: int) -> None:
         grow = n - self.max_osd
@@ -283,6 +335,7 @@ class OSDMap:
         if inc.new_crush is not None:
             self.crush = inc.new_crush
             self._mappers.clear()
+            self.crush_version += 1
         if inc.new_max_osd is not None:
             self.set_max_osd(inc.new_max_osd)
             self.epoch -= 1  # counted once below
@@ -364,16 +417,25 @@ class OSDMap:
         return pg_t(loc.pool, ps)
 
     # -- PG -> OSDs, batched ----------------------------------------------
+    def pg_to_crush_osds(self, pool_id: int,
+                         seeds) -> tuple[np.ndarray, np.ndarray]:
+        """PURE CRUSH output (no nonexistent-removal) + pps. This is
+        the half of the pipeline that only weight/topology changes can
+        invalidate — OSDMapMapping caches it per pool so up/down flips
+        and override edits replay just ``_pipeline_from_crush``."""
+        pool = self.pools[pool_id]
+        seeds = np.asarray(seeds, dtype=np.uint32)
+        pps = pool.raw_pg_to_pps(seeds, xp=np)
+        mp = self.mapper(self._choose_args_key(pool.id))
+        raw = np.asarray(mp.map_pgs(pool.crush_rule, pps, pool.size))
+        return raw, pps
+
     def pg_to_raw_osds(self, pool_id: int,
                        seeds) -> tuple[np.ndarray, np.ndarray]:
         """CRUSH output with nonexistent devices removed
         (ref: OSDMap::pg_to_raw_osds)."""
         pool = self.pools[pool_id]
-        seeds = np.asarray(seeds, dtype=np.uint32)
-        pps = pool.raw_pg_to_pps(seeds, xp=np)
-        mp = self.mapper(self._choose_args_key(pool.id))
-        raw = np.asarray(mp.map_pgs(pool.crush_rule, pps,
-                                               pool.size))
+        raw, pps = self.pg_to_crush_osds(pool_id, seeds)
         return self._remove_nonexistent(pool, raw), pps
 
     def _remove_nonexistent(self, pool: PGPool, raw: np.ndarray) -> np.ndarray:
@@ -496,15 +558,15 @@ class OSDMap:
             acting_primary[rows_of.get(pg.seed, _EMPTY_ROWS)] = p
         return acting, acting_primary
 
-    def pg_to_up_acting_osds(self, pool_id: int, seeds):
-        """The full pipeline (ref: OSDMap::_pg_to_up_acting_osds).
-
-        seeds: (N,) actual pg seeds in [0, pg_num). Returns
-        (up (N,size), up_primary (N,), acting, acting_primary).
-        """
-        pool = self.pools[pool_id]
-        seeds = np.atleast_1d(np.asarray(seeds, dtype=np.uint32))
-        raw, pps = self.pg_to_raw_osds(pool_id, seeds)
+    def _pipeline_from_crush(self, pool: PGPool, seeds: np.ndarray,
+                             craw: np.ndarray, pps: np.ndarray):
+        """Everything AFTER the CRUSH step (ref: the tail of
+        OSDMap::_pg_to_up_acting_osds): nonexistent-removal -> upmap ->
+        up-filter -> primary pick/affinity -> pg_temp/primary_temp.
+        ``craw`` is never mutated, so a caller may replay this over
+        cached raw rows (OSDMapMapping delta remap, the balancer's
+        candidate probes)."""
+        raw = self._remove_nonexistent(pool, craw)   # returns a copy
         raw = self._apply_upmap(pool, seeds, raw)
         up = self._raw_to_up(pool, raw)
         up_primary = self._pick_primary(up)
@@ -513,10 +575,95 @@ class OSDMap:
                                                      up_primary)
         return up, up_primary, acting, acting_primary
 
+    def _pg_to_up_acting_uncached(self, pool: PGPool, seeds: np.ndarray):
+        craw, pps = self.pg_to_crush_osds(pool.id, seeds)
+        return self._pipeline_from_crush(pool, seeds, craw, pps)
+
+    def attach_mapping(self, mapping) -> None:
+        """Attach an OSDMapMapping whose table (when at this map's
+        epoch) serves pg_to_up_acting_osds directly — bulk and scalar
+        — without re-entering the mapper."""
+        self._mapping = mapping
+
+    def pg_to_up_acting_osds(self, pool_id: int, seeds):
+        """The full pipeline (ref: OSDMap::_pg_to_up_acting_osds).
+
+        seeds: (N,) actual pg seeds in [0, pg_num). Returns
+        (up (N,size), up_primary (N,), acting, acting_primary).
+
+        Served, in order of preference, from (1) the attached
+        OSDMapMapping table when it is at this epoch, (2) the
+        epoch-keyed scalar memo for small batches, (3) the pipeline.
+        The cache NEVER serves across ``apply_incremental``/any epoch
+        bump — the memo is keyed to one epoch and dropped wholesale.
+        """
+        pool = self.pools[pool_id]
+        seeds = np.atleast_1d(np.asarray(seeds, dtype=np.uint32))
+        mp = self._mapping
+        if mp is not None and mp.serves(self, pool_id):
+            self.mapping_cache_hits += len(seeds)
+            PERF.inc("mapping_cache_hits", len(seeds))
+            return mp.lookup(pool_id, seeds)
+        if not len(seeds) or len(seeds) > _PG_CACHE_MAX_BATCH:
+            if len(seeds):
+                self.mapping_cache_misses += len(seeds)
+                PERF.inc("mapping_cache_misses", len(seeds))
+            return self._pg_to_up_acting_uncached(pool, seeds)
+        if self._pg_cache_epoch != self.epoch:
+            self._pg_cache.clear()
+            self._pg_cache_epoch = self.epoch
+        missing = [int(s) for s in seeds
+                   if (pool_id, int(s)) not in self._pg_cache]
+        if missing:
+            if len(self._pg_cache) > _PG_CACHE_MAX_ENTRIES:
+                self._pg_cache.clear()
+                # the flush evicted this batch's hit seeds too
+                missing = [int(s) for s in seeds]
+            self.mapping_cache_misses += len(missing)
+            PERF.inc("mapping_cache_misses", len(missing))
+            u, upp, a, actp = self._pg_to_up_acting_uncached(
+                pool, np.asarray(missing, dtype=np.uint32))
+            for i, s in enumerate(missing):
+                self._pg_cache[(pool_id, s)] = (
+                    tuple(int(o) for o in u[i]), int(upp[i]),
+                    tuple(int(o) for o in a[i]), int(actp[i]))
+        nhit = len(seeds) - len(missing)
+        if nhit:
+            self.mapping_cache_hits += nhit
+            PERF.inc("mapping_cache_hits", nhit)
+        width = max(len(self._pg_cache[(pool_id, int(s))][0])
+                    for s in seeds)
+        up = np.full((len(seeds), width), ITEM_NONE, dtype=np.int32)
+        acting = np.full((len(seeds), width), ITEM_NONE, dtype=np.int32)
+        up_primary = np.empty(len(seeds), dtype=np.int64)
+        acting_primary = np.empty(len(seeds), dtype=np.int64)
+        for i, s in enumerate(seeds):
+            cu, cupp, ca, cactp = self._pg_cache[(pool_id, int(s))]
+            up[i, :len(cu)] = cu
+            acting[i, :len(ca)] = ca
+            up_primary[i] = cupp
+            acting_primary[i] = cactp
+        return up, up_primary, acting, acting_primary
+
     def pg_to_acting_osds(self, pool_id: int, seeds):
         _, _, acting, acting_primary = self.pg_to_up_acting_osds(pool_id,
                                                                  seeds)
         return acting, acting_primary
+
+    def pg_to_acting_primary(self, pool_id: int, seed: int):
+        """Scalar (acting list, acting_primary) for one PG — the
+        data-path op-targeting shape (Objecter _calc_target, mon
+        repair/`osd map`). Served from the epoch-keyed cache, so
+        steady-state client ops never re-enter the mapper.
+
+        The acting list is POSITION-LOSSY: ITEM_NONE holes are
+        filtered out, so for EC pools list index is NOT shard id —
+        callers needing shard positions must use
+        ``pg_to_up_acting_osds`` (which keeps the placeholders)."""
+        _, _, acting, actp = self.pg_to_up_acting_osds(
+            pool_id, [int(seed)])
+        return [int(o) for o in acting[0] if o != ITEM_NONE], \
+            int(actp[0])
 
     def map_pool(self, pool_id: int):
         """All PGs of a pool in one call -> (up, up_primary, acting,
@@ -608,11 +755,32 @@ class OSDMap:
                 continue
             base_w[o] = crush_w[o] * (self.osd_weight[o] / WEIGHT_ONE)
 
-        # initial placement + per-pg bookkeeping
+        # Initial placement + per-pg bookkeeping. The balancer iterates
+        # on the MAPPING TABLE, not the mapper (round 6): the pure
+        # CRUSH output per pool is computed ONCE (or served from an
+        # attached OSDMapMapping) — pg_upmap_items edits never change
+        # CRUSH output, so every candidate-move probe below replays
+        # only the numpy post-CRUSH pipeline over the cached raw row
+        # instead of dispatching a one-lane device program (this was
+        # the whole seconds_per_iteration at 10k OSDs).
         up_by_pool: dict[int, np.ndarray] = {}
+        craw_by_pool: dict[int, np.ndarray] = {}
+        pps_by_pool: dict[int, np.ndarray] = {}
         counts = np.zeros(self.max_osd, dtype=np.int64)
         for pid in pools:
-            up, _, _, _ = self.map_pool(pid)
+            pool = pools[pid]
+            seeds = np.arange(pool.pg_num, dtype=np.uint32)
+            mtab = self._mapping
+            if mtab is not None and mtab.serves(self, pid) and \
+                    mtab.crush_raw(pid) is not None:
+                craw = mtab.crush_raw(pid)
+                pps = pool.raw_pg_to_pps(seeds, xp=np)
+            else:
+                craw, pps = self.pg_to_crush_osds(pid, seeds)
+            craw_by_pool[pid] = craw
+            pps_by_pool[pid] = pps
+            up, _, _, _ = self._pipeline_from_crush(pool, seeds, craw,
+                                                    pps)
             up_by_pool[pid] = up
             flat = up[up != ITEM_NONE]
             counts += np.bincount(flat, minlength=self.max_osd)
@@ -627,8 +795,14 @@ class OSDMap:
             return dev
 
         def remap_pg(pid, seed):
-            up, _, _, _ = self.pg_to_up_acting_osds(
-                pid, np.asarray([seed], dtype=np.uint32))
+            # post-CRUSH pipeline only — reads the MUTATED upmap dicts
+            # against the cached raw row, bit-identical to a full
+            # pg_to_up_acting_osds call (and deliberately NOT the memo
+            # cache: the epoch has not been bumped yet)
+            sarr = np.asarray([seed], dtype=np.uint32)
+            up, _, _, _ = self._pipeline_from_crush(
+                pools[pid], sarr, craw_by_pool[pid][seed:seed + 1],
+                pps_by_pool[pid][seed:seed + 1])
             return up[0]
 
         changes = 0

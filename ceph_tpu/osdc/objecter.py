@@ -187,9 +187,10 @@ class Objecter(Dispatcher):
             oid, ObjectLocator(pool=pool_id))
         seed = int(pool.raw_pg_to_pg(np.asarray([raw_pg.seed]),
                                      xp=np)[0])
-        _, _, acting, actp = osdmap.pg_to_up_acting_osds(pool_id,
-                                                         [seed])
-        return seed, int(actp[0])
+        # epoch-keyed cache: steady-state op targeting never re-enters
+        # the mapper (see OSDMap.pg_to_acting_primary)
+        _, actp = osdmap.pg_to_acting_primary(pool_id, seed)
+        return seed, actp
 
     async def pool_id(self, name: str) -> int:
         osdmap = await self.monc.wait_for_osdmap()
@@ -261,9 +262,8 @@ class Objecter(Dispatcher):
                 await self._wait_for_new_map(osdmap, deadline)
                 continue
             if seed is not None:
-                _, _, _, actp = osdmap.pg_to_up_acting_osds(
-                    pool_id, [seed])
-                pg_seed, primary = seed, int(actp[0])
+                _, actp = osdmap.pg_to_acting_primary(pool_id, seed)
+                pg_seed, primary = seed, actp
             else:
                 pg_seed, primary = self._calc_target(osdmap, pool_id,
                                                      oid)
@@ -362,9 +362,8 @@ class Objecter(Dispatcher):
             cur = self.monc.osdmap
             if cur is not None:
                 try:
-                    _, _, _, actp = cur.pg_to_up_acting_osds(
-                        pool_id, [seed])
-                    if int(actp[0]) != primary:
+                    _, actp = cur.pg_to_acting_primary(pool_id, seed)
+                    if actp != primary:
                         return
                 except KeyError:
                     return                  # pool vanished

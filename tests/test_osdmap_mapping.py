@@ -1,0 +1,363 @@
+"""OSDMapMapping delta remap: the table after ``update`` must equal a
+from-scratch sweep of the same map — for every kind of incremental in
+a randomized stream — and the cheap delta paths must actually be the
+ones taken (a delta that silently full-sweeps would pass equality and
+defeat the point)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.bench import osdmaptool
+from ceph_tpu.crush import builder
+from ceph_tpu.crush.types import WEIGHT_ONE
+from ceph_tpu.osd.osdmap import Incremental, OSDMap
+from ceph_tpu.osd.osdmap_mapping import OSDMapMapping
+from ceph_tpu.osd.types import PGPool, pg_t
+
+
+def _assert_matches_scratch(mm: OSDMapMapping, m: OSDMap):
+    assert mm.epoch == m.epoch
+    for pid, pool in m.pools.items():
+        seeds = np.arange(pool.pg_num, dtype=np.uint32)
+        craw, pps = m.pg_to_crush_osds(pid, seeds)
+        up, upp, acting, actp = m._pipeline_from_crush(
+            pool, seeds, craw, pps)
+        t = mm._pools[pid]
+        assert np.array_equal(t.craw, craw), f"pool {pid} raw"
+        assert np.array_equal(t.up, up), f"pool {pid} up"
+        assert np.array_equal(t.up_primary, upp)
+        assert np.array_equal(t.acting, acting)
+        assert np.array_equal(t.acting_primary, actp)
+
+
+def _mk(n_osds=8, pg_num=16, size=3):
+    # small on purpose: every distinct map shape pays an XLA rule
+    # compile on the tier-1 CPU run, and reweights/crush edits in
+    # these tests force recompiles — size only inflates that cost
+    m = osdmaptool.create_simple(n_osds, pg_num, size, erasure=False)
+    return m, OSDMapMapping(m)
+
+
+class TestDeltaRemap:
+    def test_state_flip_is_delta(self):
+        """up/down flips keep the raw table and sweep nothing."""
+        m, mm = _mk()
+        inc = Incremental(epoch=m.epoch + 1, new_down=[3])
+        m.apply_incremental(inc)
+        mm.update(m)
+        assert mm.last_full_sweep_pools == 0
+        assert mm.last_remap_pgs > 0       # osd.3 held some PGs
+        _assert_matches_scratch(mm, m)
+        inc = Incremental(epoch=m.epoch + 1, new_up=[3])
+        m.apply_incremental(inc)
+        mm.update(m)
+        assert mm.last_full_sweep_pools == 0
+        _assert_matches_scratch(mm, m)
+
+    def test_weight_decrease_is_delta(self):
+        """mark_out / reweight-down: affected set = PGs holding the
+        OSD in the old raw table; no full sweep. One incremental
+        carries both shapes (partial decrease + full out) — each
+        distinct weight vector pays an XLA recompile in tier-1."""
+        m, mm = _mk()
+        inc = Incremental(epoch=m.epoch + 1,
+                          new_weight={5: WEIGHT_ONE // 2, 3: 0})
+        m.apply_incremental(inc)
+        mm.update(m)
+        assert mm.last_full_sweep_pools == 0
+        _assert_matches_scratch(mm, m)
+
+    def test_weight_increase_full_sweeps_reachable_pools(self):
+        """mark_in: newly-accepting PGs are invisible to the old
+        table, so the pool full-sweeps (dirty-bucket gated)."""
+        m, mm = _mk()
+        m.apply_incremental(Incremental(epoch=m.epoch + 1,
+                                        new_weight={5: 0}))
+        mm.update(m)
+        m.apply_incremental(Incremental(epoch=m.epoch + 1,
+                                        new_weight={5: WEIGHT_ONE}))
+        mm.update(m)
+        assert mm.last_full_sweep_pools == 1
+        _assert_matches_scratch(mm, m)
+
+    def test_overrides_are_delta(self):
+        m, mm = _mk()
+        pg = pg_t(1, 4)
+        inc = Incremental(epoch=m.epoch + 1)
+        inc.new_pg_temp[pg] = [1, 2, 3]
+        inc.new_primary_temp[pg_t(1, 7)] = 2
+        inc.new_pg_upmap_items[pg_t(1, 9)] = [(0, 8)]
+        m.apply_incremental(inc)
+        mm.update(m)
+        assert mm.last_full_sweep_pools == 0
+        assert mm.last_remap_pgs == 3      # exactly the named PGs
+        _assert_matches_scratch(mm, m)
+        # removal is a delta too
+        inc = Incremental(epoch=m.epoch + 1)
+        inc.new_pg_temp[pg] = []
+        inc.old_pg_upmap_items.append(pg_t(1, 9))
+        m.apply_incremental(inc)
+        mm.update(m)
+        assert mm.last_full_sweep_pools == 0
+        _assert_matches_scratch(mm, m)
+
+    def test_primary_affinity_is_delta(self):
+        m, mm = _mk()
+        m.set_primary_affinity(2, 0)
+        mm.update(m)
+        assert mm.last_full_sweep_pools == 0
+        _assert_matches_scratch(mm, m)
+
+    @pytest.mark.slow
+    def test_crush_topology_change_full_sweeps(self):
+        # tier-1 coverage of the fallback lives in the randomized
+        # stream (its crush-edit steps assert the full-sweep counter)
+        m, mm = _mk()
+        host0 = [b.id for b in m.crush.buckets.values()
+                 if b.type == builder.TYPE_HOST][0]
+        new_osd = m.max_osd           # first id past the existing ones
+        m.insert_crush_item(new_osd, WEIGHT_ONE, host0)
+        mm.update(m)
+        assert mm.last_full_sweep_pools >= 1
+        _assert_matches_scratch(mm, m)
+        m.remove_crush_item(new_osd)
+        mm.update(m)
+        assert mm.last_full_sweep_pools >= 1
+        _assert_matches_scratch(mm, m)
+
+    def test_pool_lifecycle(self):
+        m, mm = _mk()
+        m.add_pool(PGPool(id=2, pg_num=16, size=2, crush_rule=0,
+                          name="two"))
+        mm.update(m)
+        assert 2 in mm._pools
+        _assert_matches_scratch(mm, m)
+        m.apply_incremental(Incremental(epoch=m.epoch + 1,
+                                        old_pools=[2]))
+        mm.update(m)
+        assert 2 not in mm._pools
+        _assert_matches_scratch(mm, m)
+
+    def test_fresh_decode_delta_via_digest(self):
+        """The mon decodes a NEW OSDMap object per epoch: object
+        identity breaks but the crush digest proves the tree unchanged
+        — state flips must still take the delta path."""
+        from ceph_tpu.encoding import decode_osdmap, encode_osdmap
+        m, mm = _mk()
+        m2 = decode_osdmap(encode_osdmap(m))
+        m2.apply_incremental(Incremental(epoch=m2.epoch + 1,
+                                         new_down=[1]))
+        mm.update(m2)
+        assert mm.last_full_sweep_pools == 0
+        _assert_matches_scratch(mm, m2)
+
+    def test_randomized_incremental_stream(self):
+        """The satellite ask: a random stream of weights / up-down /
+        upmap / pg_temp / affinity / crush edits — delta-remapped
+        table == from-scratch remap at EVERY epoch. 10 steps in
+        tier-1 (crush-edit steps force mapper recompiles, ~4 s each
+        on CPU); the 24-step deep stream runs under slow."""
+        self._run_stream(10)
+
+    @pytest.mark.slow
+    def test_randomized_incremental_stream_deep(self):
+        self._run_stream(24)
+
+    def _run_stream(self, n_steps: int):
+        rng = np.random.default_rng(321)
+        m, mm = _mk(n_osds=12, pg_num=32, size=3)
+        m.add_pool(PGPool(id=2, pg_num=16, size=2, crush_rule=0,
+                          name="two"))
+        mm.update(m)
+        for step in range(n_steps):
+            kind = rng.integers(0, 8)
+            inc = Incremental(epoch=m.epoch + 1)
+            o = int(rng.integers(0, 12))
+            if kind == 0:
+                inc.new_down.append(o)
+            elif kind == 1:
+                inc.new_up.append(o)
+            elif kind == 2:
+                inc.new_weight[o] = int(rng.choice(
+                    [0, WEIGHT_ONE // 3, WEIGHT_ONE // 2,
+                     WEIGHT_ONE]))
+            elif kind == 3:
+                pid = int(rng.choice([1, 2]))
+                npg = m.pools[pid].pg_num
+                pg = pg_t(pid, int(rng.integers(0, npg)))
+                if rng.integers(0, 2):
+                    inc.new_pg_temp[pg] = [int(x) for x in
+                                           rng.choice(12, size=3,
+                                                      replace=False)]
+                else:
+                    inc.new_pg_temp[pg] = []
+            elif kind == 4:
+                pid = int(rng.choice([1, 2]))
+                npg = m.pools[pid].pg_num
+                pg = pg_t(pid, int(rng.integers(0, npg)))
+                if rng.integers(0, 2):
+                    inc.new_pg_upmap_items[pg] = [(o, (o + 1) % 12)]
+                else:
+                    inc.old_pg_upmap_items.append(pg)
+            elif kind == 5:
+                inc.new_primary_affinity[o] = int(rng.choice(
+                    [0, 0x8000, 0x10000]))
+            elif kind == 6:
+                pg = pg_t(1, int(rng.integers(0, 32)))
+                inc.new_primary_temp[pg] = int(rng.integers(-1, 12))
+            else:
+                # crush edit: reweight an item inside its bucket
+                # (topology-level change -> full-sweep fallback)
+                from ceph_tpu.crush import builder as cb
+                host = [b for b in m.crush.buckets.values()
+                        if b.type == cb.TYPE_HOST][
+                    int(rng.integers(0, 3))]
+                slot = int(rng.integers(0, host.size))
+                w = int(rng.choice([WEIGHT_ONE, 2 * WEIGHT_ONE]))
+                if host.weights[slot] == w:
+                    # the edit must really change the tree (the
+                    # stream's full-sweep-counter assert relies on it)
+                    w = (2 * WEIGHT_ONE if w == WEIGHT_ONE
+                         else WEIGHT_ONE)
+                host.weights[slot] = w
+                m._dirty(crush_changed=True)
+                m.epoch -= 1               # inc below counts it
+            m.apply_incremental(inc)
+            mm.update(m)
+            if kind == 7:
+                assert mm.last_full_sweep_pools >= 1, \
+                    "crush edit must take the full-sweep fallback"
+            _assert_matches_scratch(mm, m)
+
+
+class TestEpochCache:
+    def test_scalar_memo_hits_and_epoch_invalidation(self):
+        m, _ = _mk()
+        m.mapping_cache_hits = m.mapping_cache_misses = 0
+        a1 = m.pg_to_up_acting_osds(1, [5])
+        assert m.mapping_cache_misses == 1
+        a2 = m.pg_to_up_acting_osds(1, [5])
+        assert m.mapping_cache_hits == 1
+        for x, y in zip(a1, a2):
+            assert np.array_equal(x, y)
+        # any epoch bump drops the memo
+        m.mark_down(3)
+        m.pg_to_up_acting_osds(1, [5])
+        assert m.mapping_cache_misses == 2
+
+    def test_memo_never_serves_across_incremental(self):
+        m, _ = _mk()
+        up_a, _, _, _ = m.pg_to_up_acting_osds(1, [5])
+        osd = int(up_a[0][0])
+        m.apply_incremental(Incremental(epoch=m.epoch + 1,
+                                        new_down=[osd]))
+        up_b, _, _, _ = m.pg_to_up_acting_osds(1, [5])
+        assert osd not in list(up_b[0])
+
+    def test_attached_mapping_serves_bulk(self):
+        m, mm = _mk()
+        m.attach_mapping(mm)
+        m.mapping_cache_hits = 0
+        npg = m.pools[1].pg_num
+        up, upp, acting, actp = m.map_pool(1)
+        assert m.mapping_cache_hits == npg      # every seed from table
+        seeds = np.arange(npg, dtype=np.uint32)
+        craw, pps = m.pg_to_crush_osds(1, seeds)
+        u2, up2, a2, ap2 = m._pipeline_from_crush(
+            m.pools[1], seeds, craw, pps)
+        assert np.array_equal(up, u2)
+        assert np.array_equal(actp, ap2)
+        # stale table (epoch moved, no update yet) must NOT serve
+        m.mark_down(1)
+        m.mapping_cache_hits = 0
+        m.map_pool(1)
+        assert m.mapping_cache_hits == 0
+        # after update it serves again
+        mm.update(m)
+        m.mapping_cache_hits = 0
+        m.map_pool(1)
+        assert m.mapping_cache_hits == npg
+
+    def test_lookup_returns_copies(self):
+        m, mm = _mk()
+        m.attach_mapping(mm)
+        up, _, _, _ = m.map_pool(1)
+        up[:] = -7
+        up2, _, _, _ = m.map_pool(1)
+        assert not np.array_equal(up, up2)
+
+
+class TestSteadyStateServing:
+    def test_objecter_ops_hit_epoch_cache(self):
+        """The acceptance bar: steady-state client op targeting is
+        served from the epoch-keyed cache — repeated ops against a
+        stable map must register cache HITS (no mapper re-entry per
+        op) — each OSD's tracked mapping table follows the map epoch
+        (advance-map reads come from the table), and the mgr's
+        prometheus render carries the mapping counters. One cluster
+        boot for all three asserts (tier-1 budget)."""
+        import asyncio
+
+        from ceph_tpu.cluster.vstart import Cluster
+        from ceph_tpu.mgr.modules import PrometheusModule
+
+        async def go():
+            c = await Cluster(n_mons=1, n_osds=3,
+                              mgr_modules=[PrometheusModule]).start()
+            try:
+                await c.client.pool_create("m", pg_num=8, size=3)
+                await c.wait_for_clean(timeout=90)
+                io = await c.client.open_ioctx("m")
+                await io.write_full("warm", b"x")   # misses fill memo
+                om = c.client.objecter.monc.osdmap
+                om.mapping_cache_hits = 0
+                for i in range(8):
+                    await io.write_full("warm", bytes([i]))
+                    assert await io.read("warm") == bytes([i])
+                assert om.mapping_cache_hits > 0
+                assert om is c.client.objecter.monc.osdmap, \
+                    "map changed mid-test; steady-state assert is void"
+                # every OSD's delta-maintained table is at map epoch
+                for o in c.osds:
+                    mt = o.monc.mapping_table
+                    assert mt is not None
+                    assert mt.epoch == o.osdmap.epoch
+                    # the asok "status" verb's mapping block
+                    ms = o._mapping_status()
+                    assert ms.get("table_epoch") == mt.epoch
+                    assert "osdmap" in ms      # perf counter family
+                # prometheus: dedicated mapping-engine metric rows
+                prom = next(m for m in c.mgr.modules
+                            if isinstance(m, PrometheusModule))
+                text = await prom.render()
+                assert "ceph_osdmap_mapping_cache_hits" in text
+                assert "ceph_osdmap_mapping_cache_misses" in text
+                assert "ceph_osdmap_remap_pgs" in text
+                assert "ceph_osdmap_remap_full_sweeps" in text
+            finally:
+                await c.stop()
+
+        asyncio.run(go())
+
+
+class TestBalancerOnTable:
+    def test_calc_pg_upmaps_matches_and_applies(self):
+        """The balancer's candidate probes replay the pipeline over
+        the cached raw table — results must still pass the full
+        validation (no dup osds, no holes) and actually flatten."""
+        m = osdmaptool.create_simple(16, 256, 3, erasure=False)
+        before = m.pool_utilization(1)
+        changes = m.calc_pg_upmaps(max_deviation=1,
+                                   max_iterations=50)
+        assert changes > 0
+        after = m.pool_utilization(1)
+        live = np.asarray(m.osd_weight)[:16] > 0
+        assert after[live].max() - after[live].min() <= \
+            before[live].max() - before[live].min()
+        # and the recorded upmaps survive a from-scratch remap
+        up, _, _, _ = m._pg_to_up_acting_uncached(
+            m.pools[1], np.arange(256, dtype=np.uint32))
+        for pg, pairs in m.pg_upmap_items.items():
+            row = up[pg.seed]
+            for frm, to in pairs:
+                assert frm not in row

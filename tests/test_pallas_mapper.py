@@ -71,19 +71,87 @@ class TestEligibility:
         plan = pm.build_plan(m, pack_map(m), rid, None)
         assert plan is not None and plan.kmax == (2,)
 
-    def test_too_many_weight_classes_ineligible(self):
+    def test_continuous_weights_eligible(self):
+        """Round 6 regression: a bucket with MORE than MAX_CLASSES
+        distinct weights (the continuous balancer weight-set shape)
+        now rides the kernel's per-slot draw instead of gating the
+        whole map onto the XLA path (kmax == 0 marks the level)."""
         m, root = builder.build_flat(
             8, weights=[WEIGHT_ONE + i for i in range(8)])
         rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
-        assert pm.build_plan(m, pack_map(m), rid, None) is None
+        plan = pm.build_plan(m, pack_map(m), rid, None)
+        assert plan is not None and plan.kmax == (0,)
 
-    def test_overweight_class_ineligible(self):
+    def test_continuous_choose_args_eligible(self):
+        """The headline cliff case: a single-position choose_args
+        weight-set with every slot perturbed (>4 distinct weights per
+        bucket) must yield a kernel plan."""
+        from ceph_tpu.crush.types import ChooseArg
+        m, rid = _hier(8, 8, n_racks=2)
+        rng = np.random.default_rng(11)
+        args = {}
+        for bid, b in m.buckets.items():
+            scale = rng.uniform(0.9, 1.1, size=b.size)
+            args[bid] = ChooseArg(weight_set=[[
+                max(1, int(w * s))
+                for w, s in zip(b.weights, scale)]])
+        m.choose_args[0] = args
+        plan = pm.build_plan(m, pack_map(m), rid, None,
+                             choose_args_key=0)
+        assert plan is not None and 0 in plan.kmax
+
+    def test_overweight_class_takes_continuous_draw(self):
         """A weight above the ln-gap license G voids the within-class
-        argmax argument: the kernel must decline."""
+        argmax argument — the per-slot draw (which needs no license)
+        absorbs it instead of declining the map."""
         from ceph_tpu.crush.ln_table import ln_gap_info
         G, _ = ln_gap_info()
         m, root = builder.build_flat(4, weights=[G + 1] * 4)
         rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        plan = pm.build_plan(m, pack_map(m), rid, None)
+        assert plan is not None and plan.kmax == (0,)
+
+    def test_huge_weight_ineligible(self):
+        """Weights past the two-15-bit-halves table split still
+        decline (nothing real gets here: 2^30 is ~16Ki disks)."""
+        m, root = builder.build_flat(
+            4, weights=[pm.MAX_CONT_WEIGHT + i for i in range(4)])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        assert pm.build_plan(m, pack_map(m), rid, None) is None
+
+    def test_wide_continuous_bucket_ineligible(self):
+        """A continuous bucket wider than MAX_CONT_SLOTS declines:
+        the per-slot ladder unrolls at compile time, so an unbounded
+        flat continuous root would trade the old 34x runtime cliff
+        for a compile-time one."""
+        n = pm.MAX_CONT_SLOTS + 1
+        m, root = builder.build_flat(
+            n, weights=[WEIGHT_ONE + i for i in range(n)])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        assert pm.build_plan(m, pack_map(m), rid, None) is None
+
+    def test_wide_uniform_sibling_of_continuous_ineligible(self):
+        """The ladder unrolls over the LEVEL's padded width S (the
+        stratum's max bucket size), not each continuous bucket's own
+        size: a small continuous host sharing a stratum with a wide
+        uniform host must decline, or the compile-time cliff comes
+        back through the sibling."""
+        from ceph_tpu.crush.types import CrushMap, Tunables
+        from ceph_tpu.crush.builder import (
+            DEFAULT_TYPE_NAMES, make_bucket)
+        wide = pm.MAX_CONT_SLOTS + 8
+        m = CrushMap(tunables=Tunables(),
+                     type_names=dict(DEFAULT_TYPE_NAMES))
+        m.max_devices = 8 + wide
+        cont = make_bucket(
+            m, builder.TYPE_HOST, list(range(8)),
+            [WEIGHT_ONE + 917 * i for i in range(8)], name="h-cont")
+        uni = make_bucket(
+            m, builder.TYPE_HOST, list(range(8, 8 + wide)),
+            [WEIGHT_ONE] * wide, name="h-uni")
+        root = make_bucket(m, builder.TYPE_ROOT, [cont, uni],
+                           name="root")
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
         assert pm.build_plan(m, pack_map(m), rid, None) is None
 
     def test_choose_args_single_weight_set_eligible(self):
@@ -133,9 +201,11 @@ class TestEligibility:
 
     @pytest.mark.slow
     def test_xla_fallback_when_ineligible(self):
-        """Ineligible maps silently keep the XLA path through Mapper."""
+        """Ineligible maps silently keep the XLA path through Mapper.
+        (>4 distinct weights no longer disqualifies — round 6 — so the
+        ineligible shape here is a weight past the table split.)"""
         m, root = builder.build_flat(
-            6, weights=[WEIGHT_ONE + i for i in range(6)])  # 6 classes
+            6, weights=[pm.MAX_CONT_WEIGHT + i for i in range(6)])
         rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
         mapper = Mapper(m)
         assert mapper._kernel_body(rid, 3) is None
@@ -144,6 +214,49 @@ class TestEligibility:
         for i in range(32):
             ref = mapper_ref.do_rule(m, rid, i, 3)
             assert list(out[i]) == ref + [ITEM_NONE] * (3 - len(ref))
+
+
+class TestContinuousWeights:
+    """Round 6: per-slot continuous draw — ONE tier-1 compile (the
+    choose_args map, which exercises the same _choose_level_cont
+    layout as plain continuous base weights), the flat variant and
+    the deep randomized sweep live under slow (interpret-mode kernel
+    compiles cost ~25 s each on the tier-1 CPU run)."""
+
+    @pytest.mark.slow
+    def test_flat_continuous_bit_exact(self):
+        m, root = builder.build_flat(
+            8, weights=[WEIGHT_ONE + 777 * i for i in range(8)])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        _assert_kernel_matches_ref(
+            m, rid, 3, xs=np.arange(96, dtype=np.uint32))
+
+    def test_continuous_choose_args_bit_exact(self):
+        """Single-position choose_args with EVERY slot perturbed (the
+        upstream-balancer weight-set shape) vs the scalar spec.
+        Smallest credible multi-level shape: the interpret-mode
+        compile scales with the per-slot ladder unroll (S per cont
+        level), and this is the one continuous compile tier-1 pays."""
+        from ceph_tpu.crush.types import ChooseArg
+        m, rid = _hier(4, 5, n_racks=2)
+        rng = np.random.default_rng(23)
+        args = {}
+        for bid, b in m.buckets.items():
+            scale = rng.uniform(0.9, 1.1, size=b.size)
+            args[bid] = ChooseArg(weight_set=[[
+                max(1, int(w * s))
+                for w, s in zip(b.weights, scale)]])
+        m.choose_args[0] = args
+        mapper = Mapper(m, choose_args=0)
+        assert mapper._kernel_body(rid, 3) is not None, "ineligible"
+        assert 0 in mapper._kernel_plan(rid).kmax, "not continuous"
+        xs = np.arange(64, dtype=np.uint32)
+        got = np.asarray(mapper.map_pgs(rid, xs, 3))
+        for i, x in enumerate(xs):
+            ref = mapper_ref.do_rule(m, rid, int(x), 3,
+                                     choose_args=args)
+            ref = ref + [ITEM_NONE] * (3 - len(ref))
+            assert list(got[i]) == ref, (int(x), list(got[i]), ref)
 
 
 @pytest.mark.slow
@@ -301,6 +414,64 @@ class TestBitExact:
                 ref = mapper_ref.do_rule(m, rid, int(x), numrep)
                 ref = ref + [ITEM_NONE] * (numrep - len(ref))
                 assert list(got[i]) == ref, (int(x), list(got[i]), ref)
+
+    def test_random_continuous_sweep(self):
+        """Deep randomized sweep: continuous per-item base weights AND
+        single-position choose_args weight-sets, hierarchy shapes and
+        reweights drawn at random, every lane vs the scalar spec."""
+        from ceph_tpu.crush.types import ChooseArg
+        rng = np.random.default_rng(4242)
+        for trial in range(4):
+            n_hosts = int(rng.integers(3, 7))
+            per = int(rng.integers(5, 9))       # > MAX_CLASSES slots
+            n_dev = n_hosts * per
+            weights = [int(rng.integers(WEIGHT_ONE // 4,
+                                        4 * WEIGHT_ONE))
+                       for _ in range(n_dev)]
+            m, root = builder.build_hierarchy(
+                n_hosts, per, n_racks=max(1, n_hosts // 3),
+                osd_weights=weights)
+            rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+            ca = None
+            if trial % 2:
+                args = {}
+                for bid, b in m.buckets.items():
+                    scale = rng.uniform(0.85, 1.15, size=b.size)
+                    args[bid] = ChooseArg(weight_set=[[
+                        max(1, int(w * s))
+                        for w, s in zip(b.weights, scale)]])
+                m.choose_args[0] = args
+                ca = 0
+            dw = np.full(n_dev, WEIGHT_ONE, dtype=np.int64)
+            if trial >= 2:
+                dw[int(rng.integers(0, n_dev))] = 0
+                dw[int(rng.integers(0, n_dev))] = WEIGHT_ONE // 3
+            numrep = int(rng.integers(1, 4))
+            mapper = Mapper(m, dw, choose_args=ca)
+            assert mapper._kernel_body(rid, numrep) is not None, \
+                "continuous map unexpectedly ineligible"
+            assert 0 in mapper._kernel_plan(rid).kmax
+            xs = np.arange(96, dtype=np.uint32)
+            got = np.asarray(mapper.map_pgs(rid, xs, numrep))
+            cargs = m.choose_args.get(ca) if ca is not None else None
+            for i, x in enumerate(xs):
+                ref = mapper_ref.do_rule(m, rid, int(x), numrep,
+                                         weight=list(dw),
+                                         choose_args=cargs)
+                ref = ref + [ITEM_NONE] * (numrep - len(ref))
+                assert list(got[i]) == ref, \
+                    (trial, int(x), list(got[i]), ref)
+
+    def test_continuous_forced_ambiguity_takes_fallback(
+            self, monkeypatch):
+        """Blown-up margin on the per-slot draw: every lane flags and
+        the block resolves through the XLA fallback — still exact."""
+        monkeypatch.setattr(pm, "MARGIN_ABS", 1e30)
+        m, root = builder.build_flat(
+            8, weights=[WEIGHT_ONE + 991 * i for i in range(8)])
+        rid = builder.add_simple_rule(m, root, builder.TYPE_OSD)
+        _assert_kernel_matches_ref(
+            m, rid, 3, xs=np.arange(96, dtype=np.uint32))
 
     def test_crush_ln_neg_exact(self):
         """The in-kernel crush_ln limb pipeline vs ln_table.crush_ln
