@@ -222,6 +222,32 @@ def main() -> None:
         "vs_baseline": round(enc["GiB/s"] / BASELINE_GIBS, 3),
         "detail": detail,
     }))
+    # Driver-parse line (VERDICT r5 weak #8): the full record above has
+    # grown past the driver's tail capture, leaving `parsed: null`.
+    # Emit a compact (<500 char) metric/value/unit summary as the LAST
+    # stdout line — the driver parses the tail, humans read the blob.
+    print(json.dumps(compact_summary(enc, dec, detail)))
+
+
+def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
+    out = {
+        "metric": "ec_encode_k8m3_4MiB",
+        "value": round(enc["GiB/s"], 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(enc["GiB/s"] / BASELINE_GIBS, 3),
+        "decode_GiB_s": round(dec["GiB/s"], 3),
+    }
+    if enc.get("mfu_pct") is not None:
+        out["mfu_pct"] = enc["mfu_pct"]
+    if detail.get("crush_mappings_per_s") is not None:
+        out["crush_mappings_per_s"] = detail["crush_mappings_per_s"]
+    elif "crush_error" in detail:
+        out["crush_error"] = detail["crush_error"][:120]
+    # belt-and-braces: the driver's tail capture is ~2000 chars; stay
+    # far inside it even if an error string sneaks in
+    while len(json.dumps(out)) > 500 and len(out) > 3:
+        out.pop(next(reversed(out)))
+    return out
 
 
 if __name__ == "__main__":

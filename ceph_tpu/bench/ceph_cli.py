@@ -10,6 +10,7 @@ through MonClient, mirroring the reference's command spellings:
     ... osd out <id> | osd in <id> | osd down <id>
     ... osd blocklist add|rm <entity> [expire-s] | osd blocklist ls
     ... pg repair <pgid>
+    ... fs status | fs dump | mds fail <name-or-gid>
     ... osd map <pool> <object>
     ... osd erasure-code-profile set <name> k=2 m=1 ...
     ... config set <who> <name> <value> | config get <who> [<name>]
@@ -53,8 +54,10 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
     if j in ("status", "-s", "health", "mon dump", "quorum_status",
              "osd dump", "osd tree", "osd df", "osd pool ls",
              "pg dump", "osd getmap", "osd getcrushmap",
-             "config dump", "osd new"):
+             "config dump", "osd new", "fs status", "fs dump"):
         return {"prefix": "status" if j == "-s" else j}, b""
+    if w[:2] == ["mds", "fail"]:
+        return {"prefix": "mds fail", "who": w[2]}, b""
     if w[:3] == ["osd", "pool", "create"]:
         cmd = {"prefix": "osd pool create", "pool": w[3]}
         if len(w) > 4:

@@ -9,6 +9,15 @@ are write-through) and is acked immediately; the next I/O through
 that handle re-opens to reacquire a cap, which blocks until the
 conflicting holder is done — giving one-writer-or-many-readers
 semantics across clients.
+
+HA (round 6): a client mounted without a pinned MDS address
+(``create(monmap, None, pool)``) subscribes to the **mdsmap** and
+targets whatever daemon the FSMap says holds rank 0. On failover it
+sends MClientReconnect to the successor — replaying its session and
+every live cap claim (ref: Client::send_reconnect) — and resends any
+request that never got a reply (op replay; the MDS's completed-request
+table dedups mutations that DID land before the crash). Requests
+issued while no active exists park until the ladder finishes.
 """
 
 from __future__ import annotations
@@ -17,15 +26,24 @@ import asyncio
 import json
 
 from ceph_tpu.cephfs import FSError, _norm
+from ceph_tpu.cephfs.fsmap import (
+    FSMap, STATE_ACTIVE, STATE_RECONNECT, STATE_REJOIN,
+)
 from ceph_tpu.cephfs.mds import (
     CAP_FR, CAP_FW, CAP_OP_ACK, CAP_OP_RELEASE, CAP_OP_REVOKE,
-    MClientCaps, MClientReply, MClientRequest, MClientSession,
+    MClientCaps, MClientReconnect, MClientReply, MClientRequest,
+    MClientSession, RECONNECT_ACK, RECONNECT_REQ,
     SESSION_CLOSE, SESSION_OPEN, SESSION_RENEW,
 )
+from ceph_tpu.mon.messages import MMDSMap
 from ceph_tpu.msg import Dispatcher, Messenger
+from ceph_tpu.msg.messenger import ConnectionError_
 from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("cephfs.client")
+
+# fsmap states in which the rank holder accepts MClientReconnect
+_RECONNECTABLE = (STATE_RECONNECT, STATE_REJOIN, STATE_ACTIVE)
 
 
 class FileHandle:
@@ -107,11 +125,11 @@ class CephFSClient(Dispatcher):
 
     _next_id = 0
 
-    def __init__(self, ioctx, mds_addr,
+    def __init__(self, ioctx, mds_addr=None,
                  messenger: Messenger | None = None):
         CephFSClient._next_id += 1
         self.ioctx = ioctx
-        self.mds_addr = mds_addr
+        self.mds_addr = mds_addr       # None until the fsmap names one
         self.msgr = messenger or Messenger(
             f"client.fs{CephFSClient._next_id}")
         self.msgr.add_dispatcher(self)
@@ -124,6 +142,18 @@ class CephFSClient(Dispatcher):
         self._own_rados = None          # set by create(): owned identity
         self.lease_interval = 3.0       # renew beat; the OPEN ack's
                                         # advertised lease overrides it
+        # HA state: fsmap-following mode (mds_addr resolved at runtime)
+        self._ha = mds_addr is None
+        self.fsmap: FSMap | None = None
+        self._active_event = asyncio.Event()
+        if not self._ha:
+            self._active_event.set()
+        # bumped on every (re)established MDS session; _request resends
+        # exactly once per incarnation (op replay without duplicate
+        # sends to a live-but-slow MDS)
+        self._incarnation = 0
+        self._reconnecting = False
+        self._reconnect_fut: asyncio.Future | None = None
 
     @classmethod
     async def create(cls, monmap, mds_addr, pool: str,
@@ -132,7 +162,11 @@ class CephFSClient(Dispatcher):
         entity name carries both the MDS session and the data-path ops,
         so an MDS eviction's osd blocklist actually fences this
         client's data writes (data I/O through a shared admin ioctx
-        would dodge the fence)."""
+        would dodge the fence).
+
+        ``mds_addr=None`` mounts in **HA mode**: the client subscribes
+        to the mdsmap through its own MonClient and follows rank 0's
+        holder across failovers instead of pinning one address."""
         from ceph_tpu.rados import Rados
         CephFSClient._next_id += 1
         name = f"client.fs{CephFSClient._next_id}"
@@ -141,44 +175,72 @@ class CephFSClient(Dispatcher):
         r = Rados(monmap, name=name, keyring=keyring)
         await r.connect()
         io = await r.open_ioctx(pool)
+        # warm this identity's data path up front: its first op would
+        # otherwise jit the placement pipeline mid-session and stall
+        # the shared event loop (blowing MDS beacon graces cluster-
+        # wide on an in-process cluster)
+        from ceph_tpu.rados import ObjectOperationError
+        try:
+            await io.stat(".fs_warmup")
+        except ObjectOperationError:
+            pass
         # the MDS-facing messenger matches the MDS's auth mode (the
         # MDS messenger carries no keyring); the DATA path — where the
         # blocklist fence bites — authenticates through the owned
         # Rados above. The shared identity is the entity NAME.
         cl = cls(io, mds_addr, messenger=Messenger(name))
         cl._own_rados = r
+        if cl._ha:
+            # MMDSMap publishes ride the MonClient's messenger
+            r.monc.msgr.add_dispatcher(cl)
+            await r.monc.subscribe("mdsmap", 0)
         return await cl.mount()
 
     # -- session -----------------------------------------------------------
     async def mount(self) -> "CephFSClient":
+        if self._ha:
+            await self._wait_active(timeout=30.0)
+        await self._open_session()
+        self._incarnation += 1
+        # cap-lease heartbeat (ref: Client::renew_caps): without it the
+        # MDS evicts us the moment a revoke finds our lease stale.
+        self._renew_task = asyncio.ensure_future(self._renew_loop())
+        return self
+
+    async def _wait_active(self, timeout: float) -> None:
+        try:
+            await asyncio.wait_for(self._active_event.wait(),
+                                   timeout=timeout)
+        except asyncio.TimeoutError:
+            raise FSError(-110, "no active mds") from None
+
+    async def _open_session(self) -> None:
         self._session_fut = asyncio.get_event_loop().create_future()
         await self.msgr.send_message(
             MClientSession(op=SESSION_OPEN, cseq=0), self.mds_addr,
             "mds")
         ack = await asyncio.wait_for(self._session_fut, timeout=10)
-        # cap-lease heartbeat (ref: Client::renew_caps): without it the
-        # MDS evicts us the moment a revoke finds our lease stale. The
-        # OPEN ack advertises the MDS lease (ms); renew at a third of
-        # it so a short-leased MDS never sees a live client go stale.
+        # the OPEN ack advertises the MDS lease (ms); renew at a third
+        # of it so a short-leased MDS never sees a live client go stale
         if getattr(ack, "cseq", 0):
             self.lease_interval = max(0.05, ack.cseq / 3000.0)
-        self._renew_task = asyncio.ensure_future(self._renew_loop())
-        return self
 
     async def _renew_loop(self) -> None:
         try:
             while True:
                 await asyncio.sleep(self.lease_interval)
+                if self.mds_addr is None:
+                    continue
                 try:
                     await self.msgr.send_message(
                         MClientSession(op=SESSION_RENEW, cseq=0),
                         self.mds_addr, "mds")
-                except (ConnectionError, OSError):
-                    # transient (e.g. injected socket failure): a
-                    # single missed beat must NOT end the heartbeat —
-                    # a silently dead renew loop gets a perfectly
-                    # live client evicted and blocklisted at the next
-                    # revoke
+                except (ConnectionError, OSError, ConnectionError_):
+                    # transient (e.g. injected socket failure or a
+                    # mid-failover window): a missed beat must NOT end
+                    # the heartbeat — a silently dead renew loop gets
+                    # a perfectly live client evicted and blocklisted
+                    # at the next revoke
                     continue
         except asyncio.CancelledError:
             pass
@@ -190,11 +252,18 @@ class CephFSClient(Dispatcher):
         for hs in list(self._handles.values()):   # close() mutates the
             for h in list(hs):                    # dict and the lists
                 await h.close()
-        self._session_fut = asyncio.get_event_loop().create_future()
-        await self.msgr.send_message(
-            MClientSession(op=SESSION_CLOSE, cseq=0), self.mds_addr,
-            "mds")
-        await asyncio.wait_for(self._session_fut, timeout=10)
+        try:
+            self._session_fut = \
+                asyncio.get_event_loop().create_future()
+            await self.msgr.send_message(
+                MClientSession(op=SESSION_CLOSE, cseq=0),
+                self.mds_addr, "mds")
+            await asyncio.wait_for(self._session_fut, timeout=10)
+        except (ConnectionError, OSError, ConnectionError_,
+                asyncio.TimeoutError) as e:
+            # best effort: the MDS may be mid-failover/dead; its
+            # session-table grace machinery reaps us server-side
+            log.dout(1, f"session close skipped: {e!r}")
         await self.msgr.shutdown()
         if self._own_rados is not None:
             await self._own_rados.shutdown()
@@ -212,6 +281,13 @@ class CephFSClient(Dispatcher):
                     and not self._session_fut.done():
                 self._session_fut.set_result(msg)
             return True
+        if isinstance(msg, MClientReconnect):
+            if self._reconnect_fut and not self._reconnect_fut.done():
+                self._reconnect_fut.set_result(msg)
+            return True
+        if isinstance(msg, MMDSMap):
+            self._on_fsmap(FSMap.decode(msg.fsmap))
+            return True
         if isinstance(msg, MClientCaps):
             if msg.op == CAP_OP_REVOKE:
                 # handled in a task: the ack must wait for in-flight
@@ -220,6 +296,101 @@ class CephFSClient(Dispatcher):
                 asyncio.ensure_future(self._handle_revoke(msg))
             return True
         return False
+
+    # -- failover (ref: Client::handle_mds_map + send_reconnect) ----------
+    def _on_fsmap(self, fm: FSMap) -> None:
+        if self.fsmap is not None and fm.epoch <= self.fsmap.epoch:
+            return
+        self.fsmap = fm
+        holder = fm.rank_holder(0)
+        if holder is None or holder.state not in _RECONNECTABLE:
+            # rank failed and no successor far enough up the ladder:
+            # park new requests until one appears
+            if self._incarnation:
+                self._active_event.clear()
+            return
+        addr = holder.addr()
+        if self.mds_addr is not None and \
+                (addr.host, addr.port) == (self.mds_addr.host,
+                                           self.mds_addr.port):
+            self._active_event.set()
+            return
+        if not self._incarnation:
+            # never mounted: just aim at the holder (mount() opens the
+            # session once it is active)
+            if holder.state == STATE_ACTIVE:
+                self.mds_addr = addr
+                self._active_event.set()
+            return
+        self._active_event.clear()
+        asyncio.ensure_future(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        """Re-establish the session against whatever daemon currently
+        holds rank 0: replay cap claims (MClientReconnect), or on
+        reject (session missed the window) re-mount from scratch with
+        every handle invalidated. One loop at a time; each attempt
+        re-reads the fsmap so back-to-back failovers re-aim it."""
+        if self._reconnecting:
+            return
+        self._reconnecting = True
+        try:
+            for attempt in range(120):
+                holder = self.fsmap.rank_holder(0) if self.fsmap \
+                    else None
+                if holder is None or \
+                        holder.state not in _RECONNECTABLE:
+                    await asyncio.sleep(0.1)
+                    continue
+                addr = holder.addr()
+                caps = {}
+                for path, hs in self._handles.items():
+                    live = [h for h in hs if h.valid]
+                    if not live:
+                        continue
+                    caps[path] = json.dumps({
+                        "mode": max(h.mode for h in live),
+                        "count": len(live),
+                        "cseq": max(h.cap_seq for h in live),
+                    }).encode()
+                self._reconnect_fut = \
+                    asyncio.get_event_loop().create_future()
+                try:
+                    await self.msgr.send_message(MClientReconnect(
+                        op=RECONNECT_REQ, caps=caps), addr, "mds")
+                    rep = await asyncio.wait_for(self._reconnect_fut,
+                                                 timeout=5.0)
+                except (ConnectionError, OSError, ConnectionError_,
+                        asyncio.TimeoutError):
+                    await asyncio.sleep(0.1)
+                    continue
+                self.mds_addr = addr
+                if rep.op == RECONNECT_ACK:
+                    log.dout(1, f"reconnected to mds at {addr} "
+                                f"({len(caps)} caps replayed)")
+                else:
+                    # session unknown (missed the reconnect window):
+                    # caps are dead — invalidate every handle (next
+                    # I/O reacquires) and open a fresh session
+                    log.dout(1, f"reconnect rejected by {addr}; "
+                                f"re-mounting")
+                    for hs in self._handles.values():
+                        for h in hs:
+                            h.valid = False
+                    try:
+                        await self._open_session()
+                    except (ConnectionError, OSError,
+                            ConnectionError_,
+                            asyncio.TimeoutError):
+                        await asyncio.sleep(0.1)
+                        continue
+                # wake request loops: they resend once per incarnation
+                self._incarnation += 1
+                self._active_event.set()
+                return
+            log.dout(0, "mds reconnect gave up after retries")
+        finally:
+            self._reconnecting = False
 
     async def _handle_revoke(self, msg) -> None:
         for h in self._handles.get(msg.path, []):
@@ -238,15 +409,57 @@ class CephFSClient(Dispatcher):
 
     # -- requests ----------------------------------------------------------
     async def _request(self, op: str, path: str, path2: str = "",
-                       flags: int = 0) -> MClientReply:
+                       flags: int = 0,
+                       timeout: float = 40.0) -> MClientReply:
         self._tid += 1
         tid = self._tid
-        fut = asyncio.get_event_loop().create_future()
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
         self._waiters[tid] = fut
-        await self.msgr.send_message(
-            MClientRequest(tid=tid, op=op, path=path, path2=path2,
-                           flags=flags), self.mds_addr, "mds")
-        reply = await asyncio.wait_for(fut, timeout=40)
+        msg = MClientRequest(tid=tid, op=op, path=path, path2=path2,
+                             flags=flags)
+        deadline = loop.time() + timeout
+        sent_inc = None
+        try:
+            while True:
+                if fut.done():
+                    reply = fut.result()
+                    break
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError
+                if self._ha and not self._active_event.is_set():
+                    # failover in progress: park until a successor is
+                    # reachable, then fall through to the resend check
+                    await asyncio.wait_for(self._active_event.wait(),
+                                           timeout=remaining)
+                    continue
+                if sent_inc != self._incarnation:
+                    # op replay: exactly one send per MDS incarnation —
+                    # the successor's completed-request table dedups
+                    # mutations that landed before the crash, and a
+                    # live-but-slow MDS is never spammed with
+                    # duplicates (a duplicate open would leak a cap
+                    # refcount)
+                    try:
+                        await self.msgr.send_message(
+                            msg, self.mds_addr, "mds")
+                        sent_inc = self._incarnation
+                    except (ConnectionError, OSError,
+                            ConnectionError_):
+                        if not self._ha:
+                            raise
+                        await asyncio.sleep(0.2)
+                        continue
+                try:
+                    reply = await asyncio.wait_for(
+                        asyncio.shield(fut),
+                        timeout=min(1.0, max(remaining, 0.05)))
+                    break
+                except asyncio.TimeoutError:
+                    continue
+        finally:
+            self._waiters.pop(tid, None)
         if reply.result < 0:
             raise FSError(int(reply.result),
                           reply.payload.decode(errors="replace"))

@@ -1,0 +1,151 @@
+"""FSMap: the mon-authoritative MDS cluster membership map.
+
+ref: src/mds/FSMap.h + src/include/fs_types.h (MDSMap::DaemonState) —
+the paxos-committed map the MDSMonitor maintains and every MDS/client
+subscribes to ("mdsmap"). One filesystem, one rank (rank 0): the
+failover ladder the reference runs per rank applies to it:
+
+    standby -> (standby_replay) -> replay -> reconnect -> rejoin -> active
+
+A daemon is keyed by its **gid** — a per-incarnation id, so a restarted
+daemon with the same name is a NEW entity (the reference's mds_gid_t).
+``ident`` is the RADOS entity name the incarnation's data-path ops use;
+the blocklist fence at failover targets it, so fencing a dead
+incarnation never collaterally fences its successor.
+
+``last_failure_osd_epoch`` (ref: MDSMap::last_failure_osd_epoch) is the
+osdmap epoch at which the last failed active's blocklist committed; a
+promoted standby barriers on it (Objecter.wait_for_map_on_osds) before
+touching the journal, so a fenced predecessor can never land a late
+journal write after replay began.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.encoding.denc import Decoder, Encoder
+
+# daemon states (ref: MDSMap::DaemonState, the subset this ladder uses)
+STATE_STANDBY = "standby"
+STATE_STANDBY_REPLAY = "standby_replay"
+STATE_REPLAY = "replay"
+STATE_RECONNECT = "reconnect"
+STATE_REJOIN = "rejoin"
+STATE_ACTIVE = "active"
+STATE_STOPPED = "stopped"
+
+# the rank-0 takeover ladder, in order; a beacon may only advance one
+# rung at a time (ref: MDSMonitor::prepare_beacon state checks)
+LADDER = (STATE_REPLAY, STATE_RECONNECT, STATE_REJOIN, STATE_ACTIVE)
+
+# states that hold (or are taking over) a rank — beacon-grace expiry of
+# one of these is a FAILOVER, not a standby drop
+RANK_STATES = frozenset(LADDER)
+
+
+class MDSInfo:
+    """One registered daemon incarnation (ref: MDSMap::mds_info_t)."""
+
+    def __init__(self, gid: int, name: str, ident: str = "",
+                 host: str = "", port: int = 0,
+                 state: str = STATE_STANDBY, rank: int = -1):
+        self.gid = gid
+        self.name = name
+        self.ident = ident          # RADOS entity the fence targets
+        self.host = host
+        self.port = port
+        self.state = state
+        self.rank = rank            # -1 = no rank (standby*)
+
+    def addr(self):
+        from ceph_tpu.msg import EntityAddr
+        return EntityAddr(self.host, self.port)
+
+    def dump(self) -> dict:
+        return {"gid": self.gid, "name": self.name, "ident": self.ident,
+                "addr": [self.host, self.port], "state": self.state,
+                "rank": self.rank}
+
+
+class FSMap:
+    def __init__(self):
+        self.epoch = 0
+        self.infos: dict[int, MDSInfo] = {}      # gid -> info
+        self.failed: list[int] = []              # failed ranks
+        self.stopped_gids: list[int] = []        # tombstones (bounded):
+        # a failed/removed incarnation may keep beaconing (zombie);
+        # its gid must never re-register, or a fenced daemon could
+        # climb back to a rank it can no longer write for
+        self.last_failure_osd_epoch = 0
+
+    # -- queries -----------------------------------------------------------
+    def by_name(self, name: str) -> MDSInfo | None:
+        return next((i for i in self.infos.values() if i.name == name),
+                    None)
+
+    def rank_holder(self, rank: int = 0) -> MDSInfo | None:
+        """The daemon holding (or laddering toward) ``rank``."""
+        return next((i for i in self.infos.values()
+                     if i.rank == rank and i.state in RANK_STATES),
+                    None)
+
+    def active(self) -> MDSInfo | None:
+        i = self.rank_holder(0)
+        return i if i is not None and i.state == STATE_ACTIVE else None
+
+    def standbys(self) -> list[MDSInfo]:
+        return sorted((i for i in self.infos.values()
+                       if i.state in (STATE_STANDBY,
+                                      STATE_STANDBY_REPLAY)),
+                      key=lambda i: (i.state != STATE_STANDBY_REPLAY,
+                                     i.gid))
+
+    def is_stopped(self, gid: int) -> bool:
+        return gid in self.stopped_gids
+
+    def tombstone(self, gid: int, keep: int = 64) -> None:
+        self.stopped_gids.append(gid)
+        del self.stopped_gids[:-keep]
+
+    def dump(self) -> dict:
+        holder = self.rank_holder(0)
+        return {
+            "epoch": self.epoch,
+            "ranks": [] if holder is None else [holder.dump()],
+            "standbys": [i.dump() for i in self.standbys()],
+            "failed": sorted(self.failed),
+            "stopped_gids": list(self.stopped_gids),
+            "last_failure_osd_epoch": self.last_failure_osd_epoch,
+            "states": {i.name: i.state for i in self.infos.values()},
+        }
+
+    # -- codec -------------------------------------------------------------
+    def encode(self) -> bytes:
+        e = Encoder()
+        with e.start(1):
+            e.u64(self.epoch)
+            e.map(self.infos, lambda e, k: e.u64(k),
+                  lambda e, i: (e.u64(i.gid).string(i.name)
+                                .string(i.ident).string(i.host)
+                                .u32(i.port).string(i.state)
+                                .s32(i.rank)))
+            e.list(self.failed, lambda e, v: e.s32(v))
+            e.list(self.stopped_gids, lambda e, v: e.u64(v))
+            e.u64(self.last_failure_osd_epoch)
+        return e.tobytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "FSMap":
+        def info(d: Decoder) -> MDSInfo:
+            return MDSInfo(gid=d.u64(), name=d.string(),
+                           ident=d.string(), host=d.string(),
+                           port=d.u32(), state=d.string(),
+                           rank=d.s32())
+        m = cls()
+        d = Decoder(data)
+        with d.start(1):
+            m.epoch = d.u64()
+            m.infos = d.map(lambda d: d.u64(), info)
+            m.failed = d.list(lambda d: d.s32())
+            m.stopped_gids = d.list(lambda d: d.u64())
+            m.last_failure_osd_epoch = d.u64()
+        return m

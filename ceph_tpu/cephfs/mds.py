@@ -28,20 +28,44 @@ dropped, its revoke waiters resolved) so a dead client cannot hold
 exclusivity hostage — the Session::last_cap_renew + stale-eviction
 behavior in miniature.
 
-Not rebuilt: dynamic subtree partitioning/multi-MDS, the full inode
-lock matrix.
+High availability (round 6, ref: MDSMonitor + MDSMap): an MDS started
+through :meth:`MDSDaemon.create` runs **mon-coordinated**: it owns a
+per-incarnation RADOS identity (``mds.<name>.<gid>`` — the blocklist
+fence at failover targets exactly this incarnation), beacons the
+MDSMonitor every ``mds_beacon_interval``, and climbs the failover
+ladder the FSMap assigns it:
+
+    standby -> (standby_replay) -> replay -> reconnect -> rejoin -> active
+
+Sessions live in a persistent **session table** (``.mds_sessions``
+omap, ref: SessionMap) with each session's recently completed request
+tids, so a promoted standby reconstructs who was mounted, accepts
+MClientReconnect cap claims from those clients, and dedups replayed
+mutations. Before touching the journal the new active barriers on
+``last_failure_osd_epoch`` — the osdmap epoch of its predecessor's
+blocklist — so a fenced zombie can never land a late journal write.
+
+Not rebuilt: dynamic subtree partitioning/multi-MDS (one rank), the
+full inode lock matrix, snapshots.
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 
 from ceph_tpu.cephfs import CephFSLite, FSError, _fileobj, _norm
+from ceph_tpu.cephfs.fsmap import (
+    FSMap, STATE_ACTIVE, STATE_RECONNECT, STATE_REJOIN, STATE_REPLAY,
+    STATE_STANDBY, STATE_STANDBY_REPLAY, STATE_STOPPED,
+)
+from ceph_tpu.mon.messages import MDSBeacon, MMDSMap
 from ceph_tpu.msg import Dispatcher, Messenger
 from ceph_tpu.msg.message import Message, register
 from ceph_tpu.utils.locks import KeyedLocks
 from ceph_tpu.utils.logging import get_logger
+from ceph_tpu.utils.perf_counters import PerfCountersBuilder
 
 log = get_logger("mds")
 
@@ -58,7 +82,46 @@ CAP_OP_REVOKE = 2   # mds -> client: stop using this cap, then ack
 CAP_OP_ACK = 3      # client -> mds: revoke done (writers flushed)
 CAP_OP_RELEASE = 4  # client -> mds: voluntary drop (file close)
 
+RECONNECT_REQ = 1     # client -> mds: session + cap claims
+RECONNECT_ACK = 2     # mds -> client: session restored, caps replayed
+RECONNECT_REJECT = 3  # mds -> client: unknown session; re-mount
+
 JOURNAL_OID = ".mds_journal"
+SESSIONS_OID = ".mds_sessions"   # session table (ref: SessionMap)
+
+# ops whose replay after failover must be deduplicated by (client, tid)
+# — the completed-request table the reference keeps per Session
+MUTATING_OPS = frozenset(
+    ("mkdir", "rmdir", "create", "unlink", "rename", "setattr"))
+
+# completed tids retained per session (bounds the table entry)
+COMPLETED_KEEP = 64
+
+# per-incarnation gid source: process-monotonic so a restarted daemon
+# is a NEW entity the FSMap tombstones can never confuse with its
+# predecessor (ref: mds_gid_t allocation in the mon, moved daemon-side
+# since incarnations here are in-process objects)
+_GID = itertools.count(1)
+
+# process-wide MDS failover counters (exported via `perf dump` and the
+# mgr prometheus module's generic ceph_perf rows)
+MDS_PERF = (
+    PerfCountersBuilder("mds")
+    .add_u64_counter("beacons_sent", "MDSBeacons sent to the mon")
+    .add_u64_counter("state_transitions", "failover-ladder rungs taken")
+    .add_u64_counter("takeovers", "rank takeovers begun (replay)")
+    .add_u64_counter("journal_replays", "journal replay passes")
+    .add_u64_counter("reconnect_accepted",
+                     "client sessions restored via MClientReconnect")
+    .add_u64_counter("reconnect_rejected",
+                     "reconnect claims refused (unknown session)")
+    .add_u64_counter("sessions_dropped",
+                     "recovering sessions that never reconnected")
+    .add_u64_counter("caps_replayed", "caps reinstated from claims")
+    .add_u64_counter("standby_replay_polls",
+                     "standby-replay journal/session tail polls")
+    .create_perf_counters()
+)
 
 
 @register
@@ -96,15 +159,36 @@ class MClientCaps(Message):
               ("cseq", "u64")]
 
 
+@register
+class MClientReconnect(Message):
+    """ref: MClientReconnect — a client's session + cap claims to a
+    newly promoted MDS during its reconnect window. ``caps`` maps
+    path -> JSON {mode, count, cseq}; the ack restores the session
+    with those caps reinstated, the reject means the session is
+    unknown (missed the window / never in the table) and the client
+    must re-mount from scratch."""
+    TYPE = 224
+    FIELDS = [("op", "u32"), ("caps", "map:str:blob")]
+
+
 class MDSDaemon(Dispatcher):
-    """Single-rank MDS over one metadata/data pool ioctx."""
+    """Single-rank MDS over one metadata/data pool ioctx.
+
+    Two modes: **standalone** (``MDSDaemon(ioctx)`` + ``start()`` —
+    immediately active, no mon coordination; the pre-round-6 surface,
+    still what the single-daemon tests drive) and **HA**
+    (``MDSDaemon.create(...)`` + ``start_ha()`` — beacons the
+    MDSMonitor and serves only once the FSMap promotes it)."""
 
     def __init__(self, ioctx, name: str = "a",
                  messenger: Messenger | None = None,
                  lease_timeout: float = 10.0,
-                 revoke_timeout: float = 30.0):
+                 revoke_timeout: float = 30.0,
+                 config: dict | None = None):
+        cfg = config or {}
         self.fs = CephFSLite(ioctx)
         self.ioctx = ioctx
+        self.name = name
         self.msgr = messenger or Messenger(f"mds.{name}")
         self.msgr.add_dispatcher(self)
         self.sessions: dict[str, object] = {}       # client -> conn
@@ -133,14 +217,114 @@ class MDSDaemon(Dispatcher):
         self._stopping = False
         self._journal_seq = 0
         self.addr = None
+        # journal residency (segments-of-one, batch-trimmed): a
+        # successful event stays in the journal until the trim horizon
+        # passes it, so a standby-replay follower has something real to
+        # tail; failed events are removed immediately (an op the client
+        # was told failed must never replay "successfully" later).
+        # The APPLIED WATERMARK (the "applied" journal key) records
+        # the contiguous prefix already applied: replay skips it —
+        # re-applying an applied rename/unlink against LATER namespace
+        # state is destructive (an old rename replayed after its path
+        # was recreated overwrites acked data), so only the genuine
+        # crash window (applied-but-unflushed, bounded by in-flight
+        # concurrency, same as the pre-residency design) ever replays.
+        self._resident_seqs: set[int] = set()
+        self._pending_seqs: set[int] = set()
+        self._applied_flushed = 0
+        self._trimming = False
+        self.journal_max = cfg.get("mds_journal_max_entries", 64)
+        # session table mirror + per-session completed request tids
+        # (ref: SessionMap + Session::completed_requests)
+        self._session_table: set[str] = set()
+        self._completed: dict[str, dict[int, int]] = {}
+        # -- HA state -------------------------------------------------
+        self.config = cfg
+        self.gid = next(_GID)
+        self.ident = f"mds.{name}.{self.gid}"   # RADOS entity; fence key
+        self.state = STATE_ACTIVE               # standalone default
+        self.monc = None                        # set by create()
+        self._own_rados = None
+        self.fsmap: FSMap | None = None
+        self.beacon_interval = cfg.get("mds_beacon_interval", 1.0)
+        self.reconnect_timeout = cfg.get("mds_reconnect_timeout", 2.0)
+        self.replay_interval = cfg.get("mds_replay_interval", 0.25)
+        self._beacon_seq = 0
+        self._beacon_task: asyncio.Task | None = None
+        self._tail_task: asyncio.Task | None = None
+        self._takeover_task: asyncio.Task | None = None
+        self._active_event = asyncio.Event()
+        self._replay_done = asyncio.Event()
+        self._recovering: set[str] = set()       # sessions awaiting
+        self._killed = False                     # reconnect claims
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    async def create(cls, monmap, pool: str, name: str = "a",
+                     keyring=None, config: dict | None = None
+                     ) -> "MDSDaemon":
+        """Build a mon-coordinated MDS with an OWN per-incarnation
+        RADOS identity. The identity is what the MDSMonitor blocklists
+        at failover — data-path ops through a shared admin ioctx would
+        dodge the fence, exactly like the client-side reasoning in
+        :meth:`CephFSClient.create`."""
+        from ceph_tpu.rados import Rados
+        cfg = config or {}
+        self = cls.__new__(cls)
+        gid = next(_GID)
+        ident = f"mds.{name}.{gid}"
+        if keyring is not None:
+            keyring.add(ident)
+        r = Rados(monmap, name=ident, keyring=keyring)
+        await r.connect()
+        io = await r.open_ioctx(pool)
+        # warm the data path BEFORE beaconing starts: the identity's
+        # first op jit-compiles the placement pipeline, which on an
+        # in-process cluster blocks the shared event loop for seconds
+        # — long enough to blow every daemon's beacon grace at once
+        from ceph_tpu.rados import ObjectOperationError
+        try:
+            await io.stat(".mds_warmup")
+        except ObjectOperationError:
+            pass
+        MDSDaemon.__init__(
+            self, io, name=name,
+            lease_timeout=cfg.get("mds_session_timeout", 10.0),
+            revoke_timeout=cfg.get("mds_revoke_timeout", 30.0),
+            config=cfg)
+        self.gid = gid
+        self.ident = ident
+        self._own_rados = r
+        self.monc = r.monc
+        self.state = STATE_STANDBY
+        return self
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
+        """Standalone start: immediately active (no mon coordination)."""
         # root dirfrag first (idempotent): journal replay on a fresh
         # pool needs it, and every request would ENOENT without it
         await self.fs.mount()
         await self._replay_journal()
+        await self._load_session_table()
         self.addr = await self.msgr.bind(host, port)
+        self.state = STATE_ACTIVE
+        self._active_event.set()
+        self._replay_done.set()
         log.dout(1, f"mds up at {self.addr}")
+        return self.addr
+
+    async def start_ha(self, host: str = "127.0.0.1", port: int = 0):
+        """Mon-coordinated start: bind, subscribe to the mdsmap, and
+        beacon as a standby; all serving waits for the FSMap to
+        promote this gid (ref: MDSDaemon::init + Beacon::init)."""
+        self.addr = await self.msgr.bind(host, port)
+        self.state = STATE_STANDBY
+        # MMDSMap publishes arrive on the MonClient's messenger
+        self.monc.msgr.add_dispatcher(self)
+        await self.monc.subscribe("mdsmap", 0)
+        self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+        log.dout(1, f"mds.{self.name} (gid {self.gid}) standby at "
+                    f"{self.addr}")
         return self.addr
 
     async def stop(self) -> None:
@@ -151,56 +335,295 @@ class MDSDaemon(Dispatcher):
         # gather below yields to the loop; the while drains any that
         # slipped in before the flag was observed.
         self._stopping = True
+        for t in (self._beacon_task, self._tail_task,
+                  self._takeover_task):
+            if t is not None:
+                t.cancel()
         while self._req_tasks:
             tasks = list(self._req_tasks)
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
         await self.msgr.shutdown()
+        if self._own_rados is not None:
+            await self._own_rados.shutdown()
+            self._own_rados = None
 
-    # -- journaling (ref: MDLog + EUpdate, segments of one) ---------------
+    async def kill(self) -> None:
+        """``kill -9`` analog for storms: drop everything on the floor
+        — no beacons, no session teardown, no rados shutdown (the
+        zombie keeps its identity so fencing is observable: its late
+        writes must bounce off the blocklist)."""
+        self._killed = True
+        self._stopping = True
+        for t in (self._beacon_task, self._tail_task,
+                  self._takeover_task):
+            if t is not None:
+                t.cancel()
+        for t in list(self._req_tasks):
+            t.cancel()
+        await self.msgr.shutdown()
+
+    # -- beacons + fsmap (HA) ---------------------------------------------
+    async def _beacon_loop(self) -> None:
+        try:
+            while not self._stopping and self.state != STATE_STOPPED:
+                await self._send_beacon()
+                await asyncio.sleep(self.beacon_interval)
+        except asyncio.CancelledError:
+            pass
+
+    async def _send_beacon(self) -> None:
+        if self.monc is None or self.state == STATE_STOPPED:
+            return
+        self._beacon_seq += 1
+        try:
+            await self.monc.send_report(MDSBeacon(
+                gid=self.gid, name=self.name, ident=self.ident,
+                addr_host=self.addr.host, addr_port=self.addr.port,
+                state=self.state, seq=self._beacon_seq,
+                epoch=self.fsmap.epoch if self.fsmap else 0))
+            MDS_PERF.inc("beacons_sent")
+        except Exception as e:
+            log.dout(5, f"beacon send failed: {e!r}")
+
+    def _handle_fsmap(self, fm: FSMap) -> None:
+        if self.fsmap is not None and fm.epoch <= self.fsmap.epoch:
+            return
+        self.fsmap = fm
+        me = fm.infos.get(self.gid)
+        if me is None:
+            if fm.is_stopped(self.gid) and \
+                    self.state != STATE_STOPPED:
+                # removed/fenced: stop serving. The reference respawns;
+                # here the cluster harness revives with a fresh
+                # incarnation (new gid, new identity).
+                log.dout(1, f"mds.{self.name} (gid {self.gid}) "
+                            f"removed from fsmap; stopping service")
+                self.state = STATE_STOPPED
+                self._active_event.clear()
+            return
+        if me.state == STATE_STANDBY_REPLAY and \
+                self.state == STATE_STANDBY:
+            self.state = STATE_STANDBY_REPLAY
+            MDS_PERF.inc("state_transitions")
+            self._tail_task = asyncio.ensure_future(
+                self._standby_replay_loop())
+            log.dout(1, f"mds.{self.name} -> standby_replay")
+        elif me.state == STATE_REPLAY and self.state in (
+                STATE_STANDBY, STATE_STANDBY_REPLAY):
+            if self._tail_task is not None:
+                self._tail_task.cancel()
+                self._tail_task = None
+            self.state = STATE_REPLAY
+            MDS_PERF.inc("state_transitions")
+            MDS_PERF.inc("takeovers")
+            self._takeover_task = asyncio.ensure_future(
+                self._takeover())
+
+    async def _takeover(self) -> None:
+        """replay -> reconnect -> rejoin -> active (ref: the
+        MDSDaemon rank-start sequence MDSRank::replay_start ..
+        active_start)."""
+        try:
+            # FENCE BARRIER first (ref: MDSMap::last_failure_osd_epoch
+            # + MDSRank waiting on the objecter's map): the journal
+            # must not be replayed while any OSD could still accept
+            # the fenced predecessor's writes.
+            epoch = self.fsmap.last_failure_osd_epoch \
+                if self.fsmap else 0
+            objecter = getattr(self.ioctx.rados, "objecter", None)
+            while epoch and objecter is not None and \
+                    not self._stopping:
+                try:
+                    await objecter.wait_for_map_on_osds(
+                        epoch, timeout=10.0)
+                    break
+                except Exception as e:
+                    log.dout(0, f"takeover fence barrier (epoch "
+                                f"{epoch}) not proven: {e}; retrying")
+                    await asyncio.sleep(0.2)
+            await self.fs.mount()
+            await self._replay_journal()
+            await self._load_session_table()
+            self._recovering = set(self._session_table)
+            self._replay_done.set()
+            await self._advance(STATE_RECONNECT)
+            # reconnect window (ref: MDSRank::reconnect_start): bounded
+            # wait for every session in the table to re-claim its caps
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + self.reconnect_timeout
+            while self._recovering and loop.time() < deadline:
+                await asyncio.sleep(0.02)
+            for client in sorted(self._recovering):
+                if client not in self._recovering:
+                    # a parked reconnect task landed while an earlier
+                    # straggler was being dropped (the await below
+                    # yields): that session was just restored + ACKed
+                    # — forgetting it now would silently destroy it
+                    continue
+                # missed the window: session + caps die (the client
+                # must re-mount); ref: MDSRank kills unreconnected
+                # sessions at reconnect_done
+                log.dout(1, f"session {client} never reconnected; "
+                            f"dropping")
+                MDS_PERF.inc("sessions_dropped")
+                await self._forget_session(client)
+            self._recovering.clear()
+            await self._advance(STATE_REJOIN)
+            # rejoin: cap/lock state was rebuilt from the reconnect
+            # claims themselves; nothing further to recover at this
+            # scope (no distributed subtrees)
+            await self._advance(STATE_ACTIVE)
+            self._active_event.set()
+            log.dout(1, f"mds.{self.name} active (takeover complete, "
+                        f"{len(self.sessions)} sessions)")
+        except asyncio.CancelledError:
+            pass
+        except Exception as e:
+            log.dout(0, f"mds takeover failed: {e!r}")
+
+    async def _advance(self, state: str) -> None:
+        self.state = state
+        MDS_PERF.inc("state_transitions")
+        await self._send_beacon()     # don't wait a beacon interval
+
+    async def _standby_replay_loop(self) -> None:
+        """Warm follower (ref: standby-replay tailing the active's
+        MDLog). The namespace itself lives in the RADOS dirfrags, so
+        the real warm state is the journal position and the session
+        table — tailed here so a takeover starts its replay and its
+        reconnect window without cold reads. Entries are NEVER applied
+        from this loop: applying against the shared dirfrag objects
+        would race the live active."""
+        from ceph_tpu.rados import ObjectOperationError
+        try:
+            while not self._stopping and \
+                    self.state == STATE_STANDBY_REPLAY:
+                MDS_PERF.inc("standby_replay_polls")
+                try:
+                    entries = await self.ioctx.get_omap_vals(
+                        JOURNAL_OID)
+                    seqs = [int(k) for k in entries if k.isdigit()]
+                    if seqs:
+                        self._journal_seq = max(self._journal_seq,
+                                                max(seqs))
+                except ObjectOperationError:
+                    pass                      # nothing journaled yet
+                try:
+                    table = await self.ioctx.get_omap_vals(
+                        SESSIONS_OID)
+                    self._ingest_session_table(table)
+                except ObjectOperationError:
+                    pass                      # no sessions yet
+                await asyncio.sleep(self.replay_interval)
+        except asyncio.CancelledError:
+            pass
+
+    # -- journaling (ref: MDLog + EUpdate, batch-trimmed segments) ---------
     async def _journal(self, event: dict) -> int:
         """Append-then-apply: the event lands durably in the journal
-        omap before the dirfrag mutation happens; _commit trims it
-        after. Replay applies any event still present (idempotent ops,
-        same outcome)."""
+        omap before the dirfrag mutation happens. Successful events
+        stay resident until the trim horizon passes (replay is
+        idempotent and order-converging); failed events are removed
+        immediately."""
         self._journal_seq += 1
         seq = self._journal_seq
         await self.ioctx.set_omap(JOURNAL_OID, f"{seq:016d}",
                                   json.dumps(event).encode())
+        self._pending_seqs.add(seq)
+        self._resident_seqs.add(seq)
         return seq
 
     async def _commit(self, seq: int) -> None:
+        self._pending_seqs.discard(seq)
+        self._resident_seqs.discard(seq)
         await self.ioctx.rm_omap_key(JOURNAL_OID, f"{seq:016d}")
 
     async def _journaled_apply(self, ev: dict) -> None:
-        """journal -> apply -> trim. The entry is trimmed on FAILURE
-        too: an op the client was told failed must not linger and
-        replay 'successfully' after conditions change (only a crash
-        between append and apply leaves an entry for replay)."""
+        """journal -> apply -> (lazy) trim. The entry is removed at
+        once on FAILURE: an op the client was told failed must not
+        linger and replay 'successfully' after conditions change (only
+        a crash between append and apply leaves an unapplied entry)."""
         seq = await self._journal(ev)
         try:
             await self._apply(ev)
-        finally:
+        except BaseException:
             await self._commit(seq)
+            raise
+        self._pending_seqs.discard(seq)
+        await self._flush_applied()
+        await self._maybe_trim()
+
+    def _applied_horizon(self) -> int:
+        """Largest seq with every seq <= it applied (pending = the
+        journaled-not-yet-applied set)."""
+        return (min(self._pending_seqs) - 1 if self._pending_seqs
+                else self._journal_seq)
+
+    async def _flush_applied(self) -> None:
+        """Persist the contiguous applied watermark. Monotonic guard:
+        flushes initiate in increasing order on one loop + one
+        connection, so the stored value never regresses."""
+        horizon = self._applied_horizon()
+        if horizon <= self._applied_flushed:
+            return
+        self._applied_flushed = horizon
+        # plain (non-underscore) key: the OSD's omap GET hides
+        # "_"-prefixed keys as store-internal; the digit-only filters
+        # in replay/tail skip this one
+        await self.ioctx.set_omap(JOURNAL_OID, "applied",
+                                  str(horizon).encode())
+
+    async def _maybe_trim(self) -> None:
+        """Trim applied journal entries once residency exceeds
+        ``mds_journal_max_entries`` (ref: MDLog segment trimming).
+        Horizon = just below the oldest still-pending event, so a
+        crash can only ever leave a replayable suffix."""
+        if self._trimming or \
+                len(self._resident_seqs) <= self.journal_max:
+            return
+        self._trimming = True
+        try:
+            horizon = self._applied_horizon()
+            for seq in sorted(s for s in self._resident_seqs
+                              if s <= horizon):
+                await self.ioctx.rm_omap_key(JOURNAL_OID,
+                                             f"{seq:016d}")
+                self._resident_seqs.discard(seq)
+        finally:
+            self._trimming = False
 
     async def _replay_journal(self) -> None:
         from ceph_tpu.rados import ObjectOperationError
+        MDS_PERF.inc("journal_replays")
         try:
             entries = await self.ioctx.get_omap_vals(JOURNAL_OID)
         except ObjectOperationError:
             return
-        for k in sorted(entries):
-            ev = json.loads(entries[k])
-            log.dout(1, f"mds journal replay: {ev}")
-            try:
-                await self._apply(ev)
-            except FSError as e:
-                # idempotent replay: EEXIST/ENOENT mean the mutation
-                # already landed before the crash
-                log.dout(5, f"replay skip ({e.errno}): {ev}")
+        # entries at or below the applied watermark already landed:
+        # re-applying them against the LATEST namespace (instead of
+        # the state they were appended over) is not idempotent —
+        # an old rename/unlink would clobber later acked writes
+        applied = int(entries.get("applied", b"0") or 0)
+        for k in sorted(k for k in entries if k.isdigit()):
+            seq = int(k)
+            if seq > applied:
+                ev = json.loads(entries[k])
+                log.dout(4, f"mds journal replay: {ev}")
+                try:
+                    await self._apply(ev)
+                except FSError as e:
+                    # idempotent within the crash window: EEXIST /
+                    # ENOENT mean the mutation already landed
+                    log.dout(5, f"replay skip ({e.errno}): {ev}")
             await self.ioctx.rm_omap_key(JOURNAL_OID, k)
-            self._journal_seq = max(self._journal_seq, int(k))
+            self._journal_seq = max(self._journal_seq, seq)
+        if "applied" in entries:
+            await self.ioctx.rm_omap_key(JOURNAL_OID, "applied")
+        self._applied_flushed = 0
+        self._resident_seqs.clear()
+        self._pending_seqs.clear()
 
     async def _apply(self, ev: dict) -> None:
         op = ev["op"]
@@ -224,10 +647,86 @@ class MDSDaemon(Dispatcher):
         else:                                        # pragma: no cover
             raise ValueError(f"unknown journal op {op}")
 
+    # -- session table (ref: SessionMap) ----------------------------------
+    def _ingest_session_table(self, omap: dict) -> None:
+        self._session_table = set(omap)
+        for client, blob in omap.items():
+            try:
+                ent = json.loads(blob)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                ent = {}
+            self._completed[client] = {
+                int(t): int(r)
+                for t, r in ent.get("completed", {}).items()}
+
+    async def _load_session_table(self) -> None:
+        from ceph_tpu.rados import ObjectOperationError
+        try:
+            omap = await self.ioctx.get_omap_vals(SESSIONS_OID)
+        except ObjectOperationError:
+            omap = {}
+        self._ingest_session_table(omap)
+
+    async def _save_session(self, client: str) -> None:
+        done = self._completed.get(client, {})
+        await self.ioctx.set_omap(
+            SESSIONS_OID, client,
+            json.dumps({"completed": {str(t): r for t, r in
+                                      done.items()}}).encode())
+        self._session_table.add(client)
+
+    async def _forget_session(self, client: str) -> None:
+        self.sessions.pop(client, None)
+        self._session_seen.pop(client, None)
+        self._drop_client_caps(client)
+        self._completed.pop(client, None)
+        if client in self._session_table:
+            self._session_table.discard(client)
+            try:
+                await self.ioctx.rm_omap_key(SESSIONS_OID, client)
+            except Exception as e:
+                log.dout(5, f"session table trim for {client} "
+                            f"failed: {e!r}")
+
+    async def _record_completed(self, client: str, tid: int,
+                                result: int) -> None:
+        """Persist one finished mutation's (tid, result) so a replay
+        against a successor MDS answers from the table instead of
+        re-executing (ref: Session::add_completed_request)."""
+        done = self._completed.setdefault(client, {})
+        done[tid] = result
+        while len(done) > COMPLETED_KEEP:
+            done.pop(next(iter(done)))
+        if client in self.sessions or client in self._session_table:
+            await self._save_session(client)
+
     # -- dispatch ----------------------------------------------------------
     async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MMDSMap):
+            self._handle_fsmap(FSMap.decode(msg.fsmap))
+            return True
         if isinstance(msg, MClientSession):
-            await self._handle_session(msg)
+            if self._active_event.is_set() or \
+                    msg.op != SESSION_OPEN:
+                await self._handle_session(msg)
+            else:
+                # an OPEN racing the ladder parks until active (a
+                # standby must not admit sessions — its session-table
+                # writes would race the live active's); parked in a
+                # task so the reader loop keeps draining
+                if self._stopping:
+                    return True
+                t = asyncio.ensure_future(
+                    self._session_when_active(msg))
+                self._req_tasks.add(t)
+                t.add_done_callback(self._req_task_done)
+            return True
+        if isinstance(msg, MClientReconnect):
+            if self._stopping:
+                return True
+            t = asyncio.ensure_future(self._handle_reconnect(msg))
+            self._req_tasks.add(t)
+            t.add_done_callback(self._req_task_done)
             return True
         if isinstance(msg, MClientRequest):
             # Own task, NOT awaited: the messenger's reader loop
@@ -249,19 +748,24 @@ class MDSDaemon(Dispatcher):
             return True
         return False
 
+    async def _session_when_active(self, m: MClientSession) -> None:
+        await self._active_event.wait()
+        await self._handle_session(m)
+
     async def _handle_session(self, m: MClientSession) -> None:
         now = asyncio.get_event_loop().time()
         if m.op == SESSION_OPEN:
             self.sessions[m.src] = m.conn
             self._session_seen[m.src] = now
+            # table BEFORE ack: a session the client believes open must
+            # survive into a successor's reconnect window
+            await self._save_session(m.src)
         elif m.op == SESSION_RENEW:
             if m.src not in self.sessions:
                 return                   # evicted: renewals are void
             self._session_seen[m.src] = now
         else:
-            self.sessions.pop(m.src, None)
-            self._session_seen.pop(m.src, None)
-            self._drop_client_caps(m.src)
+            await self._forget_session(m.src)
         # the OPEN ack advertises the lease (ms) so the client paces
         # its renewals off the MDS's configuration instead of a
         # hardcoded beat that could exceed a short lease
@@ -269,6 +773,40 @@ class MDSDaemon(Dispatcher):
             op=m.op,
             cseq=int(self.lease_timeout * 1000)
             if m.op == SESSION_OPEN else m.cseq))
+
+    async def _handle_reconnect(self, m: MClientReconnect) -> None:
+        """A client re-claims its session + caps from this (normally
+        freshly promoted) MDS (ref: Server::handle_client_reconnect).
+        Parked until journal replay finishes; claims from sessions not
+        in the table are refused — the client must re-mount."""
+        if not self._replay_done.is_set():
+            await self._replay_done.wait()
+        if m.src not in self._session_table:
+            MDS_PERF.inc("reconnect_rejected")
+            await m.conn.send_message(MClientReconnect(
+                op=RECONNECT_REJECT, caps={}))
+            return
+        now = asyncio.get_event_loop().time()
+        self.sessions[m.src] = m.conn
+        self._session_seen[m.src] = now
+        for path, blob in m.caps.items():
+            try:
+                claim = json.loads(blob)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            ent = self.caps.setdefault(path, {}) \
+                .setdefault(m.src, [0, 0])
+            ent[0] = max(ent[0], int(claim.get("mode", CAP_FR)))
+            ent[1] = max(ent[1], int(claim.get("count", 1)))
+            self._cap_seq = max(self._cap_seq,
+                                int(claim.get("cseq", 0)))
+            MDS_PERF.inc("caps_replayed")
+        self._recovering.discard(m.src)
+        MDS_PERF.inc("reconnect_accepted")
+        await m.conn.send_message(MClientReconnect(
+            op=RECONNECT_ACK, caps={}))
+        log.dout(1, f"session {m.src} reconnected "
+                    f"({len(m.caps)} cap claims)")
 
     def _drop_client_caps(self, client: str) -> None:
         for path in list(self.caps):
@@ -388,9 +926,7 @@ class MDSDaemon(Dispatcher):
                             if not await self._blocklist_barrier(
                                     holder, outbl):
                                 continue
-                            self.sessions.pop(holder, None)
-                            self._session_seen.pop(holder, None)
-                            self._drop_client_caps(holder)
+                            await self._forget_session(holder)
             finally:
                 # a holder that never acks must not leak its waiter
                 for key in keys:
@@ -429,6 +965,11 @@ class MDSDaemon(Dispatcher):
                         f"{t.exception()!r}")
 
     async def _handle_request(self, m: MClientRequest) -> None:
+        if not self._active_event.is_set():
+            # not (yet) the active rank: park — clients only target the
+            # FSMap's active, so this resolves as the ladder finishes
+            # (the task is cancelled if the daemon stops instead)
+            await self._active_event.wait()
         if m.src not in self.sessions:
             await m.conn.send_message(MClientReply(
                 tid=m.tid, result=-1, payload=b"no session",
@@ -437,6 +978,17 @@ class MDSDaemon(Dispatcher):
         m.path = _norm(m.path)          # caps/journal key consistently
         if m.path2:
             m.path2 = _norm(m.path2)
+        # completed-request dedup (ref: Session::have_completed_request):
+        # a mutation replayed after failover must answer from the
+        # table, not re-execute — a second rename/unlink would fail and
+        # a second create could truncate acknowledged data
+        if m.op in MUTATING_OPS:
+            done = self._completed.get(m.src)
+            if done is not None and m.tid in done:
+                await m.conn.send_message(MClientReply(
+                    tid=m.tid, result=done[m.tid],
+                    payload=b"(replayed)", cap_mode=0, cap_seq=0))
+                return
         result, payload, cap_mode, cap_seq = 0, b"", 0, 0
         try:
             if m.op in ("mkdir", "rmdir", "create", "unlink"):
@@ -500,6 +1052,11 @@ class MDSDaemon(Dispatcher):
         except asyncio.TimeoutError:
             result = -110                             # -ETIMEDOUT
             payload = b"cap revoke timed out"
+        if m.op in MUTATING_OPS and result != -110:
+            # -ETIMEDOUT stays retryable; anything else is this op's
+            # final answer and must survive a replay against a
+            # successor
+            await self._record_completed(m.src, m.tid, result)
         await m.conn.send_message(MClientReply(
             tid=m.tid, result=result, payload=payload,
             cap_mode=cap_mode, cap_seq=cap_seq))

@@ -22,6 +22,8 @@ DEFAULT_CFG = {
     "mon_osd_down_out_interval": 5.0,
     "osd_heartbeat_interval": 0.25, "osd_heartbeat_grace": 1.5,
     "osd_stats_interval": 0.3,
+    "mds_beacon_interval": 0.25, "mds_beacon_grace": 2.5,
+    "mds_reconnect_timeout": 1.5, "mds_replay_interval": 0.25,
 }
 
 
@@ -43,6 +45,8 @@ class Cluster:
         self.monmap = MonMap(fsid="vstart")
         self.mons: list[Monitor] = []
         self.osds: list[OSD] = []
+        self.mdss: list = []                 # MDSDaemons (start_fs)
+        self.fs_pool: str | None = None
         self.mgr = None
         self.mgr_modules = mgr_modules       # None = no mgr
         self.client: Rados | None = None
@@ -107,7 +111,7 @@ class Cluster:
     # -- fault injection (ref: qa/tasks/ceph_manager.py helpers) -----------
     def install_faults(self, injector) -> None:
         """Attach one FaultInjector to every daemon messenger (mons,
-        osds incl. heartbeat, mgr, client). Daemons revived later
+        osds incl. heartbeat, mds, mgr, client). Daemons revived later
         inherit it. Pass None to detach everywhere."""
         self.faults = injector
         for mon in self.mons:
@@ -115,10 +119,82 @@ class Cluster:
         for osd in self.osds:
             osd.msgr.faults = injector
             osd.hb_msgr.faults = injector
+        for mds in self.mdss:
+            mds.msgr.faults = injector
+            if mds.monc is not None:
+                mds.monc.msgr.faults = injector
         if self.mgr is not None:
             self.mgr.monc.msgr.faults = injector
         if self.client is not None:
             self.client.monc.msgr.faults = injector
+
+    # -- cephfs (ref: vstart.sh CEPH_NUM_MDS + `ceph fs new`) --------------
+    async def start_fs(self, pool: str = "cephfs", n_mds: int = 2,
+                       pg_num: int = 8,
+                       timeout: float = 60.0) -> list:
+        """Create the fs pool and boot ``n_mds`` mon-coordinated MDS
+        daemons; returns once the FSMap shows an active. With
+        ``n_mds=1`` there is no standby — the configuration the
+        session-survival regression pair uses to reproduce the
+        pre-subsystem behavior (a dead MDS is a dead filesystem)."""
+        await self.client.pool_create(pool, pg_num=pg_num)
+        await self.wait_for_clean(timeout=120)
+        self.fs_pool = pool
+        names = "abcdefgh"
+        for i in range(n_mds):
+            await self.add_mds(names[i])
+        await self.wait_for_mds_active(timeout=timeout)
+        return self.mdss
+
+    async def add_mds(self, name: str):
+        from ceph_tpu.cephfs.mds import MDSDaemon
+        assert self.fs_pool is not None, "start_fs first"
+        mds = await MDSDaemon.create(self.monmap, self.fs_pool,
+                                     name=name, keyring=self.keyring,
+                                     config=self.cfg)
+        if self.faults is not None:
+            mds.msgr.faults = self.faults
+            mds.monc.msgr.faults = self.faults
+        await mds.start_ha()
+        self.mdss.append(mds)
+        return mds
+
+    def mds_active_name(self) -> str | None:
+        """Rank 0's ACTIVE holder per the lead mon's FSMap."""
+        lead = self.leader()
+        if lead is None:
+            return None
+        info = lead.mdsmon.fsmap.active()
+        return info.name if info is not None else None
+
+    async def wait_for_mds_active(self, not_name: str | None = None,
+                                  timeout: float = 60.0) -> str:
+        """Wait until SOME daemon is active — pass ``not_name`` (the
+        failed one) to wait out a failover."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            name = self.mds_active_name()
+            if name is not None and name != not_name:
+                return name
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"no active mds (have {name!r}, excluded "
+                    f"{not_name!r})")
+            await asyncio.sleep(0.05)
+
+    async def kill_mds(self, name: str):
+        """``kill -9`` the named MDS (no beacons, no teardown); returns
+        the zombie object — its RADOS identity stays open so fencing
+        is observable."""
+        mds = next(m for m in self.mdss
+                   if m.name == name and not m._stopping)
+        await mds.kill()
+        return mds
+
+    async def revive_mds(self, name: str):
+        """Boot a FRESH incarnation under the same name (new gid, new
+        RADOS identity — the old one stays fenced/tombstoned)."""
+        return await self.add_mds(name)
 
     async def kill_mon_leader(self) -> Monitor | None:
         """Hard-stop the current lead mon (ref: the qa mon thrasher).
@@ -260,6 +336,14 @@ class Cluster:
             await self.client.shutdown()
         if self.mgr:
             await self.mgr.stop()
+        for m in self.mdss:
+            if not m._stopping:
+                await m.stop()
+            elif m._own_rados is not None:
+                # a kill()ed zombie keeps its rados open for fencing
+                # probes; reap it at cluster teardown
+                await m._own_rados.shutdown()
+                m._own_rados = None
         for o in self.osds:
             if not o._stopped:
                 await o.stop()

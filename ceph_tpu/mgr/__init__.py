@@ -1,7 +1,7 @@
 from ceph_tpu.mgr.daemon import Mgr, MgrModule
 from ceph_tpu.mgr.modules import (
-    BalancerModule, PGAutoscalerModule, PrometheusModule,
+    BalancerModule, PGAutoscalerModule, PrometheusModule, RestModule,
 )
 
 __all__ = ["Mgr", "MgrModule", "BalancerModule", "PGAutoscalerModule",
-           "PrometheusModule"]
+           "PrometheusModule", "RestModule"]

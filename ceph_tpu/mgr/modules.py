@@ -261,6 +261,20 @@ class PrometheusModule(MgrModule):
         for state, n in pg.get("states", {}).items():
             safe = state.replace("+", "_")
             lines.append(f'ceph_pg_state{{state="{safe}"}} {n}')
+        # metadata plane (round 6): per-daemon failover-ladder state
+        # plus the standby pool depth — the gauges behind the
+        # MDS_ALL_DOWN / MDS_INSUFFICIENT_STANDBY health checks
+        fsm = status.get("fsmap", {})
+        if fsm.get("states"):
+            lines.append("# TYPE ceph_mds_state gauge")
+            for nm, stt in sorted(fsm["states"].items()):
+                lines.append(
+                    f'ceph_mds_state{{name="{nm}",state="{stt}"}} 1')
+        lines += [
+            f"ceph_mds_standby_count {fsm.get('standby_count', 0)}",
+            f"ceph_mds_failed_ranks {len(fsm.get('failed', []))}",
+            f"ceph_fsmap_epoch {fsm.get('epoch', 0)}",
+        ]
         # overload protection: per-OSD utilization ratio, pool quotas,
         # fullness counts and the osdmap service flags
         lines.append("# TYPE ceph_osd_utilization gauge")
@@ -334,6 +348,74 @@ class PrometheusModule(MgrModule):
                 b"\r\n\r\n" + payload)
             await writer.drain()
         except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    async def close(self) -> None:
+        if self._server:
+            self._server.close()
+
+
+class RestModule(MgrModule):
+    """Minimal read-only HTTP status endpoint (the cheap half of the
+    mgr dashboard gap — ref: src/pybind/mgr/dashboard, scoped to two
+    read-only JSON routes; no auth, bind-local only):
+
+        GET /status  -> the full `ceph status` JSON
+        GET /health  -> just the health block
+
+    Serves a per-tick cached snapshot so a scrape storm cannot amplify
+    into mon command load."""
+
+    NAME = "rest"
+    TICK_INTERVAL = 1.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+        self._status: dict = {}
+
+    async def tick(self) -> None:
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._serve_client, "127.0.0.1",
+                self.mgr.config.get("mgr_rest_port", 0))
+            self.port = self._server.sockets[0].getsockname()[1]
+            log.dout(1, f"rest endpoint on :{self.port}")
+        self._status = await self.get("status")
+
+    async def _serve_client(self, reader, writer) -> None:
+        import json as _json
+        try:
+            request = await asyncio.wait_for(reader.readline(),
+                                             timeout=2.0)
+            while True:
+                line = await asyncio.wait_for(reader.readline(),
+                                              timeout=2.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = request.split(b" ")[1] if request.count(b" ") >= 2 \
+                else b"/"
+            code, body = b"200 OK", None
+            if path == b"/status":
+                body = self._status
+            elif path == b"/health":
+                body = self._status.get("health", {})
+            else:
+                code = b"404 Not Found"
+                body = {"error": "unknown route",
+                        "routes": ["/status", "/health"]}
+            payload = _json.dumps(body).encode()
+            writer.write(
+                b"HTTP/1.1 " + code + b"\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(payload)).encode() +
+                b"\r\n\r\n" + payload)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError,
+                IndexError):
             pass
         finally:
             writer.close()

@@ -1,0 +1,299 @@
+"""MDSMonitor: the FSMap's PaxosService.
+
+ref: src/mon/MDSMonitor.{h,cc} — owns the authoritative FSMap, turns
+MDSBeacons into state-ladder commits (MDSMonitor::prepare_beacon),
+and runs the beacon-grace tick that makes failover happen
+(MDSMonitor::tick): a silent rank holder is FENCED (its incarnation's
+RADOS identity blocklisted in the osdmap — the fourth paxos commit in
+this file composes with the OSDMonitor's) and a standby is promoted
+into the replay -> reconnect -> rejoin -> active ladder.
+
+The fencing invariant (blocklist-before-promote): the FSMap commit
+that hands the rank to a standby happens only AFTER the blocklist
+commit, and carries that commit's osdmap epoch
+(``last_failure_osd_epoch``) so the promoted daemon can barrier on the
+OSDs observing it before touching the journal. A dead active that
+wakes up later can therefore never land a journal or dirfrag write —
+the OSDs refuse its entity outright.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.cephfs.fsmap import (
+    FSMap, LADDER, RANK_STATES, STATE_ACTIVE, STATE_REPLAY,
+    STATE_STANDBY, STATE_STANDBY_REPLAY,
+)
+from ceph_tpu.mon.messages import MDSBeacon
+from ceph_tpu.mon.service import PaxosService
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("mon")
+
+PFX = "fsmap"
+
+
+class MDSMonitor(PaxosService):
+    prefix = PFX
+
+    def __init__(self, mon) -> None:
+        super().__init__(mon)
+        self.fsmap = FSMap()
+        # gid -> last beacon loop-time (leader memory, not paxos: a new
+        # leader restamps everyone on_active so a mon election never
+        # manufactures a spurious MDS failover)
+        self.last_beacon: dict[int, float] = {}
+        self.beacon_grace = mon.config.get("mds_beacon_grace", 5.0)
+        self._last_tick = 0.0
+        self._lock = asyncio.Lock()
+        self.refresh()
+
+    # -- state -------------------------------------------------------------
+    def last_epoch(self) -> int:
+        return self.store.get_u64(PFX, "last_epoch")
+
+    def refresh(self) -> None:
+        last = self.last_epoch()
+        if last and self.fsmap.epoch < last:
+            blob = self.store.get(PFX, f"full_{last:08x}")
+            if blob is not None:
+                self.fsmap = FSMap.decode(blob)
+
+    async def on_active(self) -> None:
+        now = asyncio.get_event_loop().time()
+        for gid in self.fsmap.infos:
+            self.last_beacon[gid] = now
+
+    async def _propose_change(self, build) -> tuple[bool, object]:
+        """Commit one FSMap change. ``build(clone) -> (fsmap, result)
+        | None`` mutates a CLONE under the serialization lock, so a
+        failed proposal never corrupts the in-memory map and
+        concurrent handlers never interleave epochs."""
+        async with self._lock:
+            cur = self.fsmap
+            out = build(FSMap.decode(cur.encode()))
+            if out is None:
+                return False, None
+            new, result = out
+            new.epoch = cur.epoch + 1
+            t = self.store.transaction()
+            t.set(PFX, f"full_{new.epoch:08x}", new.encode())
+            self.store.put_u64(t, PFX, "last_epoch", new.epoch)
+            ok = await self.mon.propose_txn(t)
+            return ok, result
+
+    # -- beacons -----------------------------------------------------------
+    async def handle(self, msg) -> None:
+        if isinstance(msg, MDSBeacon):
+            await self._handle_beacon(msg)
+
+    async def _handle_beacon(self, m: MDSBeacon) -> None:
+        if self.fsmap.is_stopped(m.gid):
+            # a fenced/removed incarnation keeps beaconing: it must
+            # never re-register (it cannot write past its blocklist)
+            return
+        self.last_beacon[m.gid] = asyncio.get_event_loop().time()
+        info = self.fsmap.infos.get(m.gid)
+        if info is None:
+            def build(fm: FSMap):
+                if fm.is_stopped(m.gid) or m.gid in fm.infos:
+                    return None
+                from ceph_tpu.cephfs.fsmap import MDSInfo
+                fm.infos[m.gid] = MDSInfo(
+                    gid=m.gid, name=m.name, ident=m.ident,
+                    host=m.addr_host, port=m.addr_port,
+                    state=STATE_STANDBY, rank=-1)
+                return fm, None
+            ok, _ = await self._propose_change(build)
+            if ok:
+                log.dout(1, f"mds.{m.name} (gid {m.gid}) registered "
+                            f"standby")
+            return
+        if m.state == info.state:
+            return
+        # ladder advance (ref: prepare_beacon): any FORWARD distance is
+        # accepted, not just one rung — the daemon climbs locally
+        # without waiting for each commit to publish, so back-to-back
+        # rungs (an empty reconnect window), a lost beacon, or a mon
+        # leader change can leave the map several rungs behind; a
+        # strictly-one-rung check would wedge the map short of active
+        # forever (every later beacon repeats the final state)
+        if info.state in LADDER and m.state in LADDER and \
+                LADDER.index(m.state) > LADDER.index(info.state):
+            def build(fm: FSMap):
+                i = fm.infos.get(m.gid)
+                if i is None or i.state != info.state:
+                    return None
+                i.state = m.state
+                return fm, None
+            ok, _ = await self._propose_change(build)
+            if ok:
+                log.dout(1, f"mds.{m.name} {info.state} -> {m.state}")
+
+    # -- tick --------------------------------------------------------------
+    async def tick(self) -> None:
+        now = asyncio.get_event_loop().time()
+        if self._last_tick and now - self._last_tick > \
+                self.beacon_grace:
+            # the MON itself stalled (event-loop hiccup — e.g. a first
+            # CRUSH-mapper jit compile blocks every coroutine in this
+            # in-process cluster): every beacon timestamp is equally
+            # stale evidence, so restamp instead of mass-failing the
+            # whole MDS cluster off our own clock skew
+            for gid in list(self.last_beacon):
+                self.last_beacon[gid] = now
+        self._last_tick = now
+        fm = self.fsmap
+        # beacon grace: silent daemons are removed; a silent RANK
+        # holder is a failover (fence first)
+        for gid, info in list(fm.infos.items()):
+            last = self.last_beacon.get(gid)
+            if last is None:
+                self.last_beacon[gid] = now
+                continue
+            if now - last <= self.beacon_grace:
+                continue
+            log.dout(1, f"mds.{info.name} (gid {gid}, "
+                        f"{info.state}) missed beacon grace "
+                        f"({self.beacon_grace}s)")
+            await self.fail_mds(gid)
+        fm = self.fsmap
+        # rank 0 is filled the moment any standby exists — covering
+        # the very first boot (rank never held; ref: the fs creation
+        # assigning its first MDS) and a standby registering after the
+        # rank already failed
+        if fm.rank_holder(0) is None and fm.standbys():
+            await self._promote(0)
+        # standby_replay assignment: one warm follower while an active
+        # exists (ref: MDSMonitor maybe_promote_standby / the
+        # allow_standby_replay fs flag)
+        fm = self.fsmap
+        # read live from the shared config dict so a served cluster
+        # can flip it at runtime
+        standby_replay = self.mon.config.get("mds_standby_replay",
+                                             False)
+        if standby_replay and fm.active() is not None and \
+                not any(i.state == STATE_STANDBY_REPLAY
+                        for i in fm.infos.values()):
+            cand = next((i for i in fm.standbys()
+                         if i.state == STATE_STANDBY), None)
+            if cand is not None:
+                def build(f: FSMap):
+                    i = f.infos.get(cand.gid)
+                    if i is None or i.state != STATE_STANDBY or \
+                            f.active() is None:
+                        return None
+                    i.state = STATE_STANDBY_REPLAY
+                    return f, None
+                ok, _ = await self._propose_change(build)
+                if ok:
+                    log.dout(1, f"mds.{cand.name} -> standby_replay")
+
+    async def fail_mds(self, gid: int) -> bool:
+        """Remove one incarnation; a rank holder is blocklisted FIRST
+        (the fencing invariant) and its rank marked failed. Promotion
+        happens in the same commit when a standby is available."""
+        info = self.fsmap.infos.get(gid)
+        if info is None:
+            return False
+        epoch = 0
+        if info.state in RANK_STATES and info.ident:
+            ret, rs, outbl = await self.mon.osdmon.handle_command(
+                {"prefix": "osd blocklist", "blocklistop": "add",
+                 "addr": info.ident}, b"")
+            if ret != 0:
+                # NO fence, NO failover: promoting without the fence
+                # would let the silent-but-alive daemon keep writing
+                # the journal under a rank it no longer holds. The
+                # next tick retries.
+                log.dout(0, f"blocklist of {info.ident} failed ({rs});"
+                            f" mds failover deferred")
+                return False
+            try:
+                epoch = int(json.loads(outbl).get("epoch", 0))
+            except (json.JSONDecodeError, ValueError):
+                epoch = 0
+            log.dout(1, f"fenced mds.{info.name} ({info.ident}) at "
+                        f"osdmap epoch {epoch}")
+
+        def build(fm: FSMap):
+            i = fm.infos.pop(gid, None)
+            if i is None:
+                return None
+            fm.tombstone(gid)
+            if i.state in RANK_STATES:
+                rank = max(i.rank, 0)
+                if rank not in fm.failed:
+                    fm.failed.append(rank)
+                if epoch:
+                    fm.last_failure_osd_epoch = epoch
+                # blocklist-before-promote holds: the fence committed
+                # above, so the successor may ride this same commit
+                cand = next(iter(fm.standbys()), None)
+                if cand is not None:
+                    cand.state = STATE_REPLAY
+                    cand.rank = rank
+                    fm.failed.remove(rank)
+            return fm, i
+        ok, removed = await self._propose_change(build)
+        if ok and removed is not None:
+            self.last_beacon.pop(gid, None)
+            log.dout(1, f"mds.{removed.name} (gid {gid}) removed"
+                        + (f"; rank {removed.rank} failover begun"
+                           if removed.state in RANK_STATES else ""))
+        return ok
+
+    async def _promote(self, rank: int) -> None:
+        def build(fm: FSMap):
+            if fm.rank_holder(rank) is not None:
+                return None
+            cand = next(iter(fm.standbys()), None)
+            if cand is None:
+                return None
+            cand.state = STATE_REPLAY
+            cand.rank = rank
+            if rank in fm.failed:
+                fm.failed.remove(rank)
+            return fm, cand.name
+        ok, name = await self._propose_change(build)
+        if ok and name:
+            log.dout(1, f"mds.{name} promoted to rank {rank} (replay)")
+
+    # -- commands ----------------------------------------------------------
+    def summary(self) -> dict:
+        fm = self.fsmap
+        holder = fm.rank_holder(0)
+        return {
+            "epoch": fm.epoch,
+            "up": {f"mds_{holder.rank}": holder.name}
+            if holder else {},
+            "active": holder.name
+            if holder and holder.state == STATE_ACTIVE else None,
+            "state": holder.state if holder else
+            ("failed" if fm.failed else "none"),
+            "failed": sorted(fm.failed),
+            "standby_count": len(fm.standbys()),
+            "states": {i.name: i.state for i in fm.infos.values()},
+        }
+
+    async def handle_command(self, cmd, inbl=b""):
+        prefix = cmd.get("prefix", "")
+        if prefix in ("fs status", "fs dump", "mds dump"):
+            return 0, "", json.dumps(self.fsmap.dump()).encode()
+        if prefix == "mds fail":
+            who = str(cmd.get("who", ""))
+            info = None
+            if who.isdigit() and int(who) in self.fsmap.infos:
+                info = self.fsmap.infos[int(who)]
+            else:
+                info = self.fsmap.by_name(who)
+            if info is None:
+                return -2, f"mds {who!r} not found", b""     # -ENOENT
+            ok = await self.fail_mds(info.gid)
+            if not ok:
+                return -11, f"failed to fail mds {who!r} (fence or " \
+                            f"proposal did not commit)", b""
+            return 0, f"failed mds gid {info.gid}", b""
+        return -22, f"unknown command {prefix!r}", b""

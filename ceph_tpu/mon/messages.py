@@ -142,6 +142,32 @@ class MOSDMarkMeDown(Message):
 
 
 @register
+class MDSBeacon(Message):
+    """MDS -> mon liveness + state-request beacon (ref:
+    src/messages/MMDSBeacon.h). ``state`` is the daemon's CURRENT
+    state; when it differs from the FSMap's recorded state and is a
+    legal ladder step the MDSMonitor commits it. ``ident`` is the
+    incarnation's RADOS entity name — the blocklist fence at failover
+    targets it. ``epoch`` is the fsmap epoch the daemon has observed
+    (a far-behind daemon gets a fresh publish)."""
+
+    TYPE = 147
+    FIELDS = [("gid", "u64"), ("name", "str"), ("ident", "str"),
+              ("addr_host", "str"), ("addr_port", "u32"),
+              ("state", "str"), ("seq", "u64"), ("epoch", "u64")]
+
+
+@register
+class MMDSMap(Message):
+    """FSMap publication to mdsmap subscribers (ref:
+    src/messages/MMDSMap.h): the full encoded FSMap — it is small
+    (a handful of daemons), so no incremental tier."""
+
+    TYPE = 148
+    FIELDS = [("epoch", "u64"), ("fsmap", "blob")]
+
+
+@register
 class MPGStats(Message):
     """OSD -> mon pg stat report (ref: src/messages/MPGStats.h);
     per-pg stats as an encoded blob map keyed by 'pool.seed'.

@@ -16,9 +16,10 @@ import json
 from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
-    MMonCommand, MMonCommandAck, MMonElection, MMonGetOSDMap, MMonMap,
-    MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
-    MOSDFailure, MOSDMap, MOSDMarkMeDown, MPGStats,
+    MDSBeacon, MMDSMap, MMonCommand, MMonCommandAck, MMonElection,
+    MMonGetOSDMap, MMonMap, MMonPaxos, MMonProposeForward,
+    MMonSubscribe, MOSDAlive, MOSDBoot, MOSDFailure, MOSDMap,
+    MOSDMarkMeDown, MPGStats,
 )
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.store import MonitorDBStore
@@ -107,12 +108,15 @@ class Monitor(Dispatcher):
         self.quorum: list[int] = []
         self.state = "probing"               # probing|electing|leader|peon
 
+        from ceph_tpu.mon.mds_monitor import MDSMonitor
         from ceph_tpu.mon.osd_monitor import OSDMonitor
         from ceph_tpu.mon.service import ConfigMonitor, HealthMonitor
         self.osdmon = OSDMonitor(self)
+        self.mdsmon = MDSMonitor(self)
         self.configmon = ConfigMonitor(self)
         self.healthmon = HealthMonitor(self)
-        self.services = [self.osdmon, self.configmon, self.healthmon]
+        self.services = [self.osdmon, self.mdsmon, self.configmon,
+                         self.healthmon]
 
         # subscriptions: conn -> {what: next_epoch}
         self.subs: dict[object, dict[str, int]] = {}
@@ -227,13 +231,15 @@ class Monitor(Dispatcher):
             await self._send_osdmaps(msg.conn, msg.start_epoch)
             return True
         if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure,
-                            MOSDMarkMeDown, MPGStats)):
+                            MOSDMarkMeDown, MPGStats, MDSBeacon)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:
                     await self.send_mon(self.leader_rank, msg)
                 return True
-            asyncio.ensure_future(self.osdmon.handle(msg))
+            svc = self.mdsmon if isinstance(msg, MDSBeacon) \
+                else self.osdmon
+            asyncio.ensure_future(svc.handle(msg))
             return True
         return False
 
@@ -255,18 +261,29 @@ class Monitor(Dispatcher):
         asyncio.ensure_future(self._publish_maps())
 
     async def _publish_maps(self) -> None:
-        """Push new osdmap epochs to subscribers
-        (ref: OSDMonitor::check_subs / send_incremental)."""
+        """Push new osdmap/fsmap epochs to subscribers
+        (ref: OSDMonitor::check_subs / send_incremental +
+        MDSMonitor::check_subs)."""
         cur = self.osdmon.osdmap.epoch if self.osdmon.osdmap else 0
+        fs_cur = self.mdsmon.fsmap.epoch
         for conn, subs in list(self.subs.items()):
             start = subs.get("osdmap")
-            if start is None or start > cur:
-                continue
-            try:
-                await self._send_osdmaps(conn, start)
-                subs["osdmap"] = cur + 1
-            except Exception:
-                self.subs.pop(conn, None)
+            if start is not None and start <= cur:
+                try:
+                    await self._send_osdmaps(conn, start)
+                    subs["osdmap"] = cur + 1
+                except Exception:
+                    self.subs.pop(conn, None)
+                    continue
+            fs_start = subs.get("mdsmap")
+            if fs_start is not None and fs_start <= fs_cur:
+                try:
+                    await conn.send_message(MMDSMap(
+                        epoch=fs_cur,
+                        fsmap=self.mdsmon.fsmap.encode()))
+                    subs["mdsmap"] = fs_cur + 1
+                except Exception:
+                    self.subs.pop(conn, None)
 
     async def _send_osdmaps(self, conn, start: int) -> None:
         if self.osdmon.osdmap is None:
@@ -336,6 +353,8 @@ class Monitor(Dispatcher):
                     if self.leader_rank is not None else ""}).encode()
         if prefix.startswith("config"):
             return await self.configmon.handle_command(cmd, inbl)
+        if prefix.startswith(("fs", "mds")):
+            return await self.mdsmon.handle_command(cmd, inbl)
         if prefix.startswith(("osd", "pg")):
             return await self.osdmon.handle_command(cmd, inbl)
         return -22, f"unknown command {prefix!r}", b""    # -EINVAL
@@ -380,6 +399,7 @@ class Monitor(Dispatcher):
             "quorum": self.quorum,
             "monmap": {"num_mons": len(self.monmap.mons)},
             "osdmap": osd_stat,
+            "fsmap": self.mdsmon.summary(),
             "pgmap": self.osdmon.pg_summary(),
         }
 

@@ -158,6 +158,45 @@ class HealthMonitor(PaxosService):
                         f"the slow general path; quantize the "
                         f"weight-sets (crush.builder."
                         f"quantize_choose_args)")}
+        # MDS cluster health (ref: MDSMonitor::insert_health_checks —
+        # MDS_ALL_DOWN / MDS_INSUFFICIENT_STANDBY / FS_DEGRADED).
+        # Only once a filesystem exists (a daemon ever registered or a
+        # rank failed) so non-cephfs clusters stay HEALTH_OK. getattr:
+        # unit tests drive this monitor with stub mons that carry only
+        # the osd side.
+        mdsmon = getattr(mon, "mdsmon", None)
+        fm = mdsmon.fsmap if mdsmon is not None else None
+        if fm is not None and (fm.infos or fm.failed):
+            holder = fm.rank_holder(0)
+            standbys = len(fm.standbys())
+            if holder is None and fm.failed:
+                if standbys == 0:
+                    checks["MDS_ALL_DOWN"] = {
+                        "severity": "HEALTH_ERR",
+                        "summary": f"rank(s) {sorted(fm.failed)} "
+                                   f"failed and no standby is "
+                                   f"available: filesystem offline"}
+                else:
+                    checks["FS_DEGRADED"] = {
+                        "severity": "HEALTH_WARN",
+                        "summary": f"rank(s) {sorted(fm.failed)} "
+                                   f"failed; standby promotion in "
+                                   f"progress"}
+            elif holder is not None and holder.state != "active":
+                checks["FS_DEGRADED"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"mds.{holder.name} is laddering "
+                               f"({holder.state}); metadata I/O "
+                               f"parked until active"}
+            wanted = getattr(mon, "config", {}) \
+                .get("mds_standby_count_wanted", 1)
+            if holder is not None and holder.state == "active" and \
+                    standbys < wanted:
+                checks["MDS_INSUFFICIENT_STANDBY"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"have {standbys} standby(s), want "
+                               f"{wanted}: a failed active has no "
+                               f"successor"}
         pg = mon.osdmon.pg_summary()
         if pg.get("degraded_pgs"):
             checks["PG_DEGRADED"] = {

@@ -459,6 +459,110 @@ class Thrasher:
         return {"capacity": capacity, "acked_writes": len(self.acked),
                 "parked_at_full": parked, "errors": len(errors)}
 
+    async def mds_storm(self, fs_clients, writes: int = 24,
+                        files_before_kill: int = 4,
+                        kills: int = 1,
+                        takeover_timeout: float = 30.0,
+                        fence_timeout: float = 15.0) -> dict:
+        """The metadata-plane failover storm (the MDS acceptance
+        shape): while ``fs_clients`` hammer metadata I/O (unique-file
+        writes through the MDS), ``kill -9`` the ACTIVE MDS and assert
+        the mon-coordinated ladder delivers:
+
+        1. a standby reaches ``active`` within ``takeover_timeout``;
+        2. NO writer errors — every op issued across the failover
+           completes (clients park, reconnect with cap replay, and
+           op-replay unacked mutations; the successor's completed-
+           request table dedups the ones that landed pre-crash);
+        3. every acked write is readable and bit-identical afterwards;
+        4. the fenced old incarnation's late JOURNAL write is refused
+           by the OSDs (blocklist) — the no-split-brain invariant.
+
+        Requires ``cluster.start_fs`` with at least ``kills`` + 1
+        daemons. Returns {kills, acked_writes, errors, takeover_s}.
+        """
+        c = self.c
+        assert c.mdss, "mds_storm needs cluster.start_fs() first"
+        rng = random.Random(self.seed ^ 0x3D5)
+        acked: dict[str, bytes] = {}
+        errors: list = []
+
+        async def writer(w: int, cl) -> None:
+            for i in range(writes):
+                path = f"/mds-storm-{self.seed}-{w}-{i:04d}"
+                data = bytes([(w + i) % 256]) * rng.randint(1, 512)
+                try:
+                    await asyncio.wait_for(cl.write_file(path, data),
+                                           timeout=45.0)
+                    acked[path] = data
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    errors.append((path, repr(e)))
+                await asyncio.sleep(0.01)
+        tasks = [asyncio.ensure_future(writer(w, cl))
+                 for w, cl in enumerate(fs_clients)]
+        takeover_s = []
+        zombies = []
+        try:
+            for k in range(kills):
+                deadline = asyncio.get_event_loop().time() + 30.0
+                while len(acked) < files_before_kill * (k + 1):
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise AssertionError(
+                            "writers made no progress before kill")
+                    await asyncio.sleep(0.05)
+                victim = c.mds_active_name()
+                assert victim is not None, "no active mds to kill"
+                zombies.append(await c.kill_mds(victim))
+                self._log(f"mds storm: kill -9 active mds.{victim}")
+                t0 = asyncio.get_event_loop().time()
+                newa = await c.wait_for_mds_active(
+                    not_name=victim, timeout=takeover_timeout)
+                takeover_s.append(
+                    round(asyncio.get_event_loop().time() - t0, 2))
+                self._log(f"mds storm: mds.{newa} took over "
+                          f"({takeover_s[-1]}s)")
+            done, pending = await asyncio.wait(tasks, timeout=120.0)
+            assert not pending, "writers wedged after mds failover"
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        assert not errors, \
+            f"writer ops lost across failover: {errors[:4]}"
+        # every acked write readable and intact through a survivor
+        reader = fs_clients[0]
+        for path, data in acked.items():
+            got = await reader.read_file(path)
+            assert got == data, f"acked {path} corrupted by failover"
+        # the fenced incarnations' late journal writes must bounce:
+        # probe until the blocklist map reaches the serving OSD (the
+        # promote already barriered, so this resolves fast)
+        from ceph_tpu.cephfs.mds import JOURNAL_OID
+        from ceph_tpu.rados import ObjectOperationError
+        for z in zombies:
+            deadline = asyncio.get_event_loop().time() + fence_timeout
+            while True:
+                try:
+                    # underscore-prefixed key: journal readers iterate
+                    # digit keys only, so a probe landing BEFORE the
+                    # blocklist propagates can never poison a later
+                    # replay/tail
+                    await z.ioctx.set_omap(
+                        JOURNAL_OID, "_zombie_probe", b"stale")
+                except ObjectOperationError as e:
+                    assert e.errno == -108, e    # -EBLOCKLISTED
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    (f"zombie mds.{z.name} ({z.ident}) journal write "
+                     f"was never fenced")
+                await asyncio.sleep(0.2)
+            self._log(f"mds storm: zombie {z.ident} fenced")
+        self._log(f"mds storm: {len(acked)} acked, 0 lost")
+        return {"kills": kills, "acked_writes": len(acked),
+                "errors": len(errors), "takeover_s": takeover_s}
+
     async def settle_and_verify(self, io, timeout: float = 240.0,
                                 fsck_stores=None) -> dict:
         """Heal everything, revive everything, converge, verify.

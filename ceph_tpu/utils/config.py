@@ -127,6 +127,36 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("osd_pg_op_queue_cap", int, 512,
            "per-PG op-queue depth past which the primary sends "
            "MOSDBackoff instead of queueing", min=1),
+    # MDS failover / metadata HA (ref: mds.yaml.in mds_beacon_interval,
+    # mds_beacon_grace, mds_reconnect_timeout, mds_standby_replay,
+    # mon_mds options in global.yaml.in): the MDSMonitor's beacon-grace
+    # failover machinery and the daemon's ladder pacing.
+    Option("mds_beacon_interval", float, 1.0,
+           "seconds between MDSBeacons to the mon", min=0.01),
+    Option("mds_beacon_grace", float, 5.0,
+           "silent-daemon window before the MDSMonitor fails it "
+           "(an active is blocklisted and a standby promoted)",
+           min=0.1),
+    Option("mds_reconnect_timeout", float, 2.0,
+           "reconnect-window length: how long a promoted MDS waits "
+           "for journaled sessions to re-claim their caps before "
+           "dropping the stragglers", min=0.0),
+    Option("mds_replay_interval", float, 0.25,
+           "standby-replay journal/session-table tail poll period",
+           min=0.01),
+    Option("mds_standby_replay", bool, False,
+           "keep one warm standby tailing the active's journal for "
+           "faster takeover (costs a continuous poll)"),
+    Option("mds_standby_count_wanted", int, 1,
+           "standbys below which MDS_INSUFFICIENT_STANDBY warns",
+           min=0),
+    Option("mds_journal_max_entries", int, 64,
+           "applied journal events kept resident before a batch trim "
+           "(the segment-trim analog; gives standby-replay a real "
+           "tail)", min=1),
+    Option("mds_session_timeout", float, 10.0,
+           "client cap-lease length advertised at session open",
+           min=0.1),
     # CRUSH tunables defaults (jewel profile; ref: src/crush/CrushWrapper.h
     # set_tunables_jewel).
     Option("crush_choose_total_tries", int, 50, "descent retry budget"),

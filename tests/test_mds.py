@@ -70,7 +70,12 @@ def test_mds_namespace_and_session():
 
 def test_mds_journal_replay():
     """A mutation journaled but not applied (crash between append and
-    apply) lands after MDS restart — the EUpdate replay guarantee."""
+    apply) lands after MDS restart — the EUpdate replay guarantee.
+    Applied-but-resident events (lazy batch trim) must NOT re-apply:
+    replaying an applied create+rename of an atomic-replace pattern
+    against the latest namespace would overwrite the acked target
+    with an empty file — the persisted applied watermark confines
+    replay to the genuine crash window."""
     async def go():
         c = await Cluster(n_mons=1, n_osds=3).start()
         try:
@@ -81,6 +86,12 @@ def test_mds_journal_replay():
             cl = await CephFSClient(
                 await c.client.open_ioctx("fs"), addr).mount()
             await cl.mkdir("/kept")
+            # atomic-replace pattern; all four events stay resident
+            # in the journal (journal_max=64 — nothing trims them)
+            await cl.write_file("/target", b"old")
+            await cl.write_file("/tmp.x", b"precious")
+            await cl.rename("/tmp.x", "/target")
+            assert await cl.read_file("/target") == b"precious"
             await cl.unmount()
             # simulate a crash mid-mutation: journal a mkdir the MDS
             # never applied, then restart
@@ -95,6 +106,10 @@ def test_mds_journal_replay():
                 await c.client.open_ioctx("fs"), addr2).mount()
             names = await cl2.ls("/")
             assert "lost" in names and "kept" in names
+            # the resident (already-applied) create+rename events did
+            # NOT re-apply: the acked target survives the restart
+            assert await cl2.read_file("/target") == b"precious"
+            assert "tmp.x" not in names
             # the journal is trimmed after replay
             entries = await io.get_omap_vals(JOURNAL_OID)
             assert not entries

@@ -1,24 +1,35 @@
-"""Tier-1 budget guards, enforced mechanically.
+"""Repo-convention guards, enforced mechanically.
 
 The tier-1 run (`pytest -m 'not slow'`, see ROADMAP.md) lives under a
-hard wall-clock cap. Two conventions keep it there, and this module
-turns both from convention into CI:
+hard wall-clock cap, and the wire format lives under an
+encoding-stability contract. Three conventions keep them, and this
+module turns each from convention into CI:
 
 1. any test driving a Thrasher storm entry point (`thrash`,
-   `backfill_storm`, `overload_storm`) must either carry the `slow`
-   marker or pass small LITERAL budgets (a smoke variant) — a deep
-   storm slipping into tier-1 blows the cap;
+   `backfill_storm`, `overload_storm`, `mds_storm`) must either carry
+   the `slow` marker or pass small LITERAL budgets (a smoke variant)
+   — a deep storm slipping into tier-1 blows the cap;
 2. every pytest marker used under tests/ must be registered in
    pytest.ini — an unregistered marker (e.g. a typo'd `slowe`)
-   silently runs the test in tier-1 instead of excluding it.
+   silently runs the test in tier-1 instead of excluding it;
+3. EVERY Message subclass registered anywhere in the codebase must
+   round-trip and match the committed corpus in
+   ``tests/golden/messages.json`` — not just the types the struct
+   corpus (tests/golden/encoding.json) happened to cover. A new
+   message type fails until the corpus is regenerated intentionally:
+
+       python -m tests.test_meta regen-messages
 """
 
 import ast
 import configparser
+import importlib
+import json
 import pathlib
 
 TESTS = pathlib.Path(__file__).parent
 REPO = TESTS.parent
+MSG_GOLDEN = TESTS / "golden" / "messages.json"
 
 # storm entry point -> {kwarg: max literal value} a NON-slow (smoke)
 # caller may pass; a bigger or non-literal budget requires `slow`
@@ -26,6 +37,7 @@ STORM_BUDGETS = {
     "thrash": {"steps": 20},
     "backfill_storm": {"writes": 60, "partitions": 2},
     "overload_storm": {"writers": 4, "prefill": 32, "hold_s": 1.0},
+    "mds_storm": {"writes": 24, "kills": 1},
 }
 BUILTIN_MARKS = {
     "parametrize", "skip", "skipif", "xfail", "usefixtures",
@@ -120,3 +132,91 @@ def test_all_markers_registered_in_pytest_ini():
     assert not unregistered, (
         f"markers {sorted(unregistered)} used under tests/ but not "
         f"registered in pytest.ini")
+
+
+# -- message-corpus guard --------------------------------------------------
+
+def _message_registry():
+    """Import every module under ceph_tpu/ that registers messages and
+    return the full type registry — discovery is textual (`@register`)
+    so a brand-new message module cannot dodge the guard by not being
+    imported from the tests."""
+    pkg_root = REPO / "ceph_tpu"
+    for path in sorted(pkg_root.rglob("*.py")):
+        if "@register" not in path.read_text():
+            continue
+        rel = path.relative_to(REPO).with_suffix("")
+        importlib.import_module(".".join(rel.parts))
+    from ceph_tpu.msg.message import _REGISTRY
+    # only codebase messages: other TEST modules register throwaway
+    # types into the same process-wide registry (test_messenger's
+    # MPing etc.) and must not leak into the corpus contract
+    return {code: cls for code, cls in _REGISTRY.items()
+            if cls.__module__.startswith("ceph_tpu.")}
+
+
+def _sample(codec: str, i: int):
+    """Deterministic per-field canonical value (index-seeded so two
+    fields of one message differ and byte-swaps are caught)."""
+    base, _, rest = codec.partition(":")
+    if base in ("u8", "u16", "u32", "u64"):
+        return i + 1
+    if base in ("s32", "s64"):
+        return -(i + 1)
+    if base == "f64":
+        return i + 0.5
+    if base == "bool":
+        return i % 2 == 0
+    if base == "str":
+        return f"s{i}"
+    if base == "blob":
+        return bytes([i % 256, 0x5A])
+    if base == "list":
+        return [_sample(rest, i), _sample(rest, i + 1)]
+    if base == "map":
+        k_codec, _, v_codec = rest.partition(":")
+        return {_sample(k_codec, i): _sample(v_codec, i + 1)}
+    raise ValueError(f"unknown codec {codec!r}")   # pragma: no cover
+
+
+def _canonical(cls):
+    return cls(**{name: _sample(codec, i)
+                  for i, (name, codec) in enumerate(cls.FIELDS)})
+
+
+def _message_corpus() -> dict:
+    return {f"{cls.__name__}:{code}": _canonical(cls).encode().hex()
+            for code, cls in sorted(_message_registry().items())}
+
+
+def test_every_registered_message_in_golden_corpus():
+    """Every registered Message type round-trips AND matches the
+    committed corpus (regenerate intentionally with
+    `python -m tests.test_meta regen-messages`)."""
+    from ceph_tpu.msg.message import Message
+    golden = json.loads(MSG_GOLDEN.read_text())
+    current = _message_corpus()
+    missing = current.keys() - golden.keys()
+    stale = golden.keys() - current.keys()
+    assert not missing and not stale, (
+        f"message corpus out of date (new: {sorted(missing)}, "
+        f"removed: {sorted(stale)}) — regen via "
+        f"`python -m tests.test_meta regen-messages`")
+    for key, blob_hex in current.items():
+        assert blob_hex == golden[key], (
+            f"wire encoding of {key} changed — message payloads are "
+            f"append-only (zero-filled defaults); regen the corpus "
+            f"only for intentional format changes")
+        m = Message.decode(bytes.fromhex(blob_hex))
+        cls = type(m)
+        ref = _canonical(cls)
+        for name, _ in cls.FIELDS:
+            assert getattr(m, name) == getattr(ref, name), \
+                f"{key}.{name} did not round-trip"
+
+
+if __name__ == "__main__":
+    import sys
+    if len(sys.argv) > 1 and sys.argv[1] == "regen-messages":
+        MSG_GOLDEN.write_text(json.dumps(_message_corpus(), indent=1))
+        print(f"wrote {MSG_GOLDEN}")
