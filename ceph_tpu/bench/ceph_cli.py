@@ -54,8 +54,30 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
     if j in ("status", "-s", "health", "mon dump", "quorum_status",
              "osd dump", "osd tree", "osd df", "osd pool ls",
              "pg dump", "osd getmap", "osd getcrushmap",
-             "config dump", "osd new", "fs status", "fs dump"):
+             "config dump", "osd new", "fs status", "fs dump",
+             "auth ls"):
         return {"prefix": "status" if j == "-s" else j}, b""
+    if w[:2] == ["mon", "add"]:
+        # ceph mon add <name> <host> <port> — runtime monmap growth
+        return {"prefix": "mon add", "name": w[2], "host": w[3],
+                "port": int(w[4])}, b""
+    if w[:2] == ["mon", "rm"] or w[:2] == ["mon", "remove"]:
+        return {"prefix": "mon rm", "name": w[2]}, b""
+    if w[0] == "auth":
+        # ceph auth get-or-create|get|rm|rotate <entity> / auth caps
+        # <entity> <json> — the AuthMonitor key lifecycle
+        if w[1] in ("get-or-create", "get", "rm", "del", "rotate"):
+            return {"prefix": f"auth {w[1]}", "entity": w[2]}, b""
+        if w[1] == "caps":
+            return {"prefix": "auth caps", "entity": w[2],
+                    "caps": w[3]}, b""
+    if w[0] == "log":
+        if w[1] == "last":
+            cmd = {"prefix": "log last"}
+            if len(w) > 2:
+                cmd["num"] = int(w[2])
+            return cmd, b""
+        return {"prefix": "log", "logtext": " ".join(w[1:])}, b""
     if w[:2] == ["mds", "fail"]:
         return {"prefix": "mds fail", "who": w[2]}, b""
     if w[:3] == ["osd", "pool", "create"]:

@@ -39,9 +39,25 @@ def _test_crush_map() -> CrushMap:
 def _test_pool(i: int) -> PGPool:
     if i == 0:
         return PGPool(id=1, pg_num=64, name="rbd")
+    if i == 2:
+        # mid-merge pool (v3: pg_num_pending) — the two-phase pg_num
+        # decrease barrier
+        return PGPool(id=3, pg_num=16, pgp_num=8, name="shrinking",
+                      pg_num_pending=8)
     return PGPool(id=2, pg_num=32, type=POOL_TYPE_ERASURE, size=5,
                   min_size=4, crush_rule=1, name="ecpool",
                   erasure_code_profile="k=3 m=2")
+
+
+def _test_monmap(i: int):
+    from ceph_tpu.mon.monitor import MonMap
+    mm = MonMap(fsid="dencoder")
+    mm.epoch = 3 + i
+    mm.add("a", 0, "127.0.0.1", 6789)
+    mm.add("b", 1, "127.0.0.1", 6790)
+    if i:
+        mm.add("d", 3, "10.0.0.7", 6789)   # rank 2 retired (mon rm)
+    return mm
 
 
 def _test_osdmap():
@@ -105,10 +121,21 @@ TYPES = {
         "dump": _jsonable,
     },
     "pg_pool_t": {
-        "tests": [lambda: _test_pool(0), lambda: _test_pool(1)],
+        "tests": [lambda: _test_pool(0), lambda: _test_pool(1),
+                  lambda: _test_pool(2)],
         "encode": lambda v: _enc_with(codecs._enc_pool, v),
         "decode": lambda b: codecs._dec_pool(Decoder(b)),
         "dump": _jsonable,
+    },
+    "monmap": {
+        "tests": [lambda: _test_monmap(0), lambda: _test_monmap(1)],
+        "encode": lambda v: v.encode(),
+        "decode": lambda b: __import__(
+            "ceph_tpu.mon.monitor", fromlist=["MonMap"]
+        ).MonMap.decode(b),
+        "dump": lambda v: {"fsid": v.fsid, "epoch": v.epoch,
+                           "mons": {k: list(x)
+                                    for k, x in v.mons.items()}},
     },
     "crush_map": {
         "tests": [_test_crush_map],

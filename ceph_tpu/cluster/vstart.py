@@ -196,6 +196,92 @@ class Cluster:
         RADOS identity — the old one stays fenced/tombstoned)."""
         return await self.add_mds(name)
 
+    # -- runtime monmap membership (ref: `ceph mon add/rm` +
+    # MonmapMonitor::prepare_update) ---------------------------------------
+    async def add_mon(self, name: str | None = None,
+                      timeout: float = 30.0) -> Monitor:
+        """Grow the mon cluster AT RUNTIME: bind a fresh Monitor,
+        commit it into the monmap (`ceph mon add`), and let the
+        elector re-form quorum over the new membership — the joiner
+        syncs the whole paxos store through the next collect round
+        before the quorum is writeable again."""
+        used = set(self.monmap.mons)
+        name = name or next(n for n in "abcdefghijklmnop"
+                            if n not in used)
+        assert name not in used, f"mon.{name} already exists"
+        if self.keyring is not None and \
+                f"mon.{name}" not in self.keyring.keys:
+            # provision through the AuthMonitor so the key is a
+            # committed cluster decision, not a side-channel insert
+            ret, rs, _ = await self.client.mon_command(
+                {"prefix": "auth get-or-create",
+                 "entity": f"mon.{name}"})
+            assert ret == 0, rs
+        new_rank = self.monmap.next_rank()
+        provisional = self.monmap.clone()
+        provisional.add(name, new_rank, "127.0.0.1", 0)
+        mon = Monitor(name, provisional, keyring=self.keyring,
+                      config=self.cfg)
+        addr = await mon.msgr.bind()
+        await mon.start_asok()
+        provisional.mons[name] = (new_rank, addr.host, addr.port)
+        if self.faults is not None:
+            mon.msgr.faults = self.faults
+        ret, rs, out = await self.client.mon_command(
+            {"prefix": "mon add", "name": name, "host": addr.host,
+             "port": addr.port})
+        assert ret == 0, rs
+        import json as _json
+        assigned = _json.loads(out).get("rank", new_rank)
+        assert assigned == new_rank, \
+            f"mon add assigned rank {assigned}, expected {new_rank}"
+        self.monmap.add(name, new_rank, addr.host, addr.port)
+        self.mons.append(mon)
+        mon._tick_task = asyncio.ensure_future(mon._tick_loop())
+        await mon.elector.start()
+        await self.wait_for_quorum(len(self.monmap.mons),
+                                   timeout=timeout)
+        return mon
+
+    async def rm_mon(self, name: str, timeout: float = 30.0) -> None:
+        """Shrink the mon cluster at runtime (`ceph mon rm`): the
+        committed map excludes the member (dead or alive — removing a
+        killed mon is how the map heals after a failure), survivors
+        re-elect, and a still-running removed mon retires itself."""
+        ret, rs, _ = await self.client.mon_command(
+            {"prefix": "mon rm", "name": name})
+        assert ret == 0, rs
+        self.monmap.mons.pop(name, None)
+        victim = next((m for m in self.mons if m.name == name), None)
+        if victim is not None:
+            self.mons.remove(victim)
+            if not victim._stopped:
+                await victim.stop()
+        await self.wait_for_quorum(len(self.monmap.mons),
+                                   timeout=timeout)
+
+    async def wait_for_quorum(self, n_mons: int,
+                              timeout: float = 30.0) -> dict:
+        """Until the quorum spans ``n_mons`` members AND commands are
+        served (a command round-trip proves the leader's paxos is
+        writeable again after the membership election)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        last: dict = {}
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                ret, _, out = await self.client.mon_command(
+                    {"prefix": "quorum_status"}, timeout=5.0)
+            except Exception:
+                ret = -1
+            if ret == 0:
+                import json as _json
+                last = _json.loads(out)
+                if len(last.get("quorum", [])) >= n_mons:
+                    return last
+            await asyncio.sleep(0.1)
+        raise TimeoutError(
+            f"quorum of {n_mons} not reached (last: {last})")
+
     async def kill_mon_leader(self) -> Monitor | None:
         """Hard-stop the current lead mon (ref: the qa mon thrasher).
         Returns the killed Monitor, or None when there is no leader or

@@ -137,17 +137,18 @@ def dec_pg_t(d: Decoder) -> pg_t:
 
 
 def _enc_pool(e: Encoder, p: PGPool) -> None:
-    with e.start(2):                    # v2: + quotas
+    with e.start(3):                    # v3: + pg_num_pending (merge)
         e.s64(p.id).u32(p.pg_num).u32(p.pgp_num).u8(p.type)
         e.u32(p.size).u32(p.min_size).s32(p.crush_rule).u64(p.flags)
         e.u8(p.object_hash).string(p.erasure_code_profile).string(p.name)
         e.bool(p.pg_temp_primaries_first)
         e.string(json.dumps(p.extra) if p.extra else "")
         e.u64(p.quota_bytes).u64(p.quota_objects)          # v2
+        e.u32(p.pg_num_pending)                            # v3
 
 
 def _dec_pool(d: Decoder) -> PGPool:
-    with d.start(2) as _v:
+    with d.start(3) as _v:
         p = PGPool(id=d.s64(), pg_num=d.u32(), pgp_num=d.u32(),
                    type=d.u8(), size=d.u32(), min_size=d.u32(),
                    crush_rule=d.s32(), flags=d.u64(),
@@ -159,6 +160,8 @@ def _dec_pool(d: Decoder) -> PGPool:
         if _v >= 2:
             p.quota_bytes = d.u64()
             p.quota_objects = d.u64()
+        if _v >= 3:
+            p.pg_num_pending = d.u32()
     return p
 
 

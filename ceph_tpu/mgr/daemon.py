@@ -92,6 +92,7 @@ class Mgr:
     # -- lifecycle ----------------------------------------------------
     async def start(self, active: bool = True) -> None:
         await self.monc.subscribe("osdmap", 0)
+        await self.monc.subscribe("monmap", 0)
         if active:
             await self.promote()
 

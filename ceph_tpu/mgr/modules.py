@@ -148,7 +148,15 @@ class BalancerModule(MgrModule):
 class PGAutoscalerModule(MgrModule):
     """pg_num recommendations (ref: pg_autoscaler/module.py): target
     ~rate pgs per osd split across pools, rounded to a power of two;
-    grows pg_num via `osd pool set` when under half the target."""
+    grows pg_num via `osd pool set` when under half the target.
+
+    BIDIRECTIONAL (round 6, ref: the autoscaler's threshold logic
+    shrinking over-provisioned pools): a pool whose pg_num exceeds the
+    recommendation by ``autoscaler_shrink_threshold`` (default 4x)
+    gets a pg_num DECREASE proposed — the mon runs it through the
+    pg_num_pending merge barrier. Shrinks only fire on a clean
+    cluster: stacking a merge onto recovery would serialize two
+    migrations."""
 
     NAME = "pg_autoscaler"
     TICK_INTERVAL = 5.0
@@ -158,6 +166,8 @@ class PGAutoscalerModule(MgrModule):
         self.target_per_osd = mgr.config.get(
             "mon_target_pg_per_osd", 100)
         self.max_pg_num = mgr.config.get("autoscaler_max_pg_num", 256)
+        self.shrink_threshold = mgr.config.get(
+            "autoscaler_shrink_threshold", 4)
 
     def recommend(self, n_osds: int, n_pools: int, size: int) -> int:
         if not (n_osds and n_pools and size):
@@ -187,9 +197,22 @@ class PGAutoscalerModule(MgrModule):
             # cluster is clean raise pgp_num to migrate the children
             # (ref: pg_autoscaler module + OSDMonitor pgp_num ramp).
             want = self.recommend(n_osds, len(pools), pool["size"])
+            if pool.get("pg_num_pending"):
+                continue          # merge in flight: hands off
             if want and pool["pg_num"] * 2 <= want:
                 log.dout(1, f"autoscaler: pool {pool['name']} pg_num "
                             f"{pool['pg_num']} -> {want}")
+                await self.mon_command(
+                    {"prefix": "osd pool set", "pool": pool["name"],
+                     "var": "pg_num", "val": str(want)})
+            elif want and pool["pg_num"] >= want * \
+                    self.shrink_threshold and \
+                    pool["type"] != 3 and self._all_clean(pg_dump):
+                # over-split: propose the merge (pool type 3 =
+                # erasure — the mon refuses EC merges)
+                log.dout(1, f"autoscaler: pool {pool['name']} "
+                            f"over-split; pg_num {pool['pg_num']} -> "
+                            f"{want} (merge)")
                 await self.mon_command(
                     {"prefix": "osd pool set", "pool": pool["name"],
                      "var": "pg_num", "val": str(want)})
@@ -275,6 +298,25 @@ class PrometheusModule(MgrModule):
             f"ceph_mds_failed_ranks {len(fsm.get('failed', []))}",
             f"ceph_fsmap_epoch {fsm.get('epoch', 0)}",
         ]
+        # elastic control plane (round 6): quorum depth, committed
+        # auth keys, in-flight pg merges — the gauges behind
+        # MON_DOWN / AUTH_KEY_REVOKED / PG_MERGE_PENDING
+        mm = status.get("monmap", {})
+        auth = status.get("auth", {})
+        merges = om.get("pending_merges", {})
+        lines += [
+            "# TYPE ceph_mon_quorum_count gauge",
+            f"ceph_mon_quorum_count {len(status.get('quorum', []))}",
+            f"ceph_mon_total {mm.get('num_mons', 0)}",
+            f"ceph_monmap_epoch {mm.get('epoch', 0)}",
+            "# TYPE ceph_auth_keys gauge",
+            f"ceph_auth_keys {auth.get('num_keys', 0)}",
+            f"ceph_pg_merge_pending {len(merges)}",
+        ]
+        for pname, v in sorted(merges.items()):
+            lines.append(
+                f'ceph_pg_merge_sources_ready{{pool="{pname}"}} '
+                f'{v.get("ready", 0)}')
         # overload protection: per-OSD utilization ratio, pool quotas,
         # fullness counts and the osdmap service flags
         lines.append("# TYPE ceph_osd_utilization gauge")

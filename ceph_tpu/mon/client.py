@@ -14,7 +14,8 @@ import json
 
 from ceph_tpu.encoding import decode_incremental, decode_osdmap
 from ceph_tpu.mon.messages import (
-    MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe, MOSDMap,
+    MAuthUpdate, MLog, MMDSMap, MMonCommand, MMonCommandAck, MMonMap,
+    MMonSubscribe, MOSDMap,
 )
 from ceph_tpu.mon.monitor import MonMap
 from ceph_tpu.msg import (AuthError, Dispatcher, Keyring,
@@ -42,6 +43,7 @@ class MonClient(Dispatcher):
         # + renew_subs — the round-4 deep-thrash leader-kill stall)
         self._subs: dict[str, int] = {}
         self._sub_rank: int | None = None
+        self._last_renew = 0.0
         self.osdmap = None
         self._osdmap_waiters: list[asyncio.Future] = []
         self.map_callbacks: list = []          # async fn(osdmap)
@@ -69,9 +71,68 @@ class MonClient(Dispatcher):
             await self._handle_osdmap(msg)
             return True
         if isinstance(msg, MMonMap):
-            self.monmap = MonMap.decode(msg.monmap)
+            self._handle_monmap(MonMap.decode(msg.monmap))
             return True
+        if isinstance(msg, MAuthUpdate):
+            self._handle_auth_update(msg)
+            return True
+        if isinstance(msg, MMDSMap):
+            # cursor only — the cephfs dispatchers consume the map;
+            # tracking it here keeps periodic sub renewal from
+            # re-requesting epochs already delivered
+            if "mdsmap" in self._subs:
+                self._subs["mdsmap"] = max(self._subs["mdsmap"],
+                                           msg.epoch + 1)
         return False
+
+    def _handle_monmap(self, mm: MonMap) -> None:
+        """Follow committed monmap epochs (ref: MonClient::
+        handle_monmap). Never regress to an older epoch — a lagging
+        peon can answer a subscription with a stale map. This is the
+        round-6 bugfix for the pinned-address-list bug: hunting and
+        reconnects below consume THIS map, so a fully rotated mon set
+        (every boot-time mon replaced at runtime) no longer strands
+        the client dialing dead addresses."""
+        if self.monmap.epoch and mm.epoch <= self.monmap.epoch:
+            # includes epoch-0 maps: a freshly added joiner publishes
+            # its PROVISIONAL (uncommitted, epoch 0) map until its
+            # paxos sync lands — once we follow a committed lineage,
+            # only strictly newer epochs may replace it
+            return
+        self.monmap = mm
+        ranks = self.monmap.ranks()
+        if ranks and self._cur_rank not in ranks:
+            # our session mon was removed: hunt to a live member
+            self._cur_rank = ranks[0]
+            self._sub_rank = None        # its subs died with it
+        if self._sub_rank is not None and self._sub_rank not in ranks:
+            self._sub_rank = None
+
+    def _next_rank(self, rank: int) -> int:
+        """The hunt successor of ``rank`` in the CURRENT monmap —
+        tolerant of the rank having been removed mid-hunt."""
+        ranks = self.monmap.ranks()
+        if not ranks:
+            return rank
+        if rank not in ranks:
+            return ranks[0]
+        return ranks[(ranks.index(rank) + 1) % len(ranks)]
+
+    def _handle_auth_update(self, m: MAuthUpdate) -> None:
+        """Apply a published key table to the live keyring: install/
+        rotate secrets, fence revoked entities (empty secret). The
+        keyring's observers do the session-level work."""
+        if "keyring" in self._subs:
+            self._subs["keyring"] = max(self._subs["keyring"],
+                                        m.version + 1)
+        kr = self.msgr.keyring
+        if kr is None:
+            return
+        for name, secret in m.keys.items():
+            if secret:
+                kr.set_key(name, secret)
+            else:
+                kr.revoke(name)
 
     async def _handle_osdmap(self, m: MOSDMap) -> None:
         if m.full:
@@ -128,6 +189,9 @@ class MonClient(Dispatcher):
             fut = asyncio.get_event_loop().create_future()
             self._command_waiters[tid] = fut
             try:
+                if self._cur_rank not in self.monmap.ranks():
+                    # the session mon left the monmap mid-flight
+                    self._cur_rank = self._next_rank(self._cur_rank)
                 await self.msgr.send_message(
                     MMonCommand(tid=tid, cmd=payload, inbl=inbl),
                     self.monmap.addr_of_rank(self._cur_rank),
@@ -138,24 +202,29 @@ class MonClient(Dispatcher):
                     fut, timeout=min(15.0, deadline -
                                      asyncio.get_event_loop().time()))
             except (asyncio.TimeoutError, ConnectionError, OSError,
-                    AuthError, ConnectionError_) as e:
+                    AuthError, ConnectionError_, KeyError) as e:
                 self._command_waiters.pop(tid, None)
                 last_err = str(e) or type(e).__name__
                 # hunt: try the next monitor (ref: MonClient::_reopen)
-                ranks = self.monmap.ranks()
+                # against the LATEST monmap — the boot-time rank list
+                # may have been fully rotated away by `mon add/rm`
                 tried_hunt += 1
-                self._cur_rank = ranks[(ranks.index(self._cur_rank) + 1)
-                                       % len(ranks)]
+                self._cur_rank = self._next_rank(self._cur_rank)
                 await asyncio.sleep(0.05)
                 continue
             if ret == -11:               # EAGAIN: redirect or retry
                 if rs.startswith("leader="):
                     leader = int(rs.split("=", 1)[1])
-                    if leader >= 0:
+                    if leader >= 0 and leader in self.monmap.ranks():
                         self._cur_rank = leader
                 await asyncio.sleep(0.05)
                 continue
             await self._renew_subs_if_moved()
+            # clients have no stats loop: the periodic (background,
+            # 2s-throttled) renewal rides command traffic, so a
+            # mon-side conn reset can't leave a command-active client
+            # silently unsubscribed
+            self.renew_subs()
             return ret, rs, outbl
         return -110, f"command timed out ({last_err})", b""   # -ETIMEDOUT
 
@@ -163,9 +232,11 @@ class MonClient(Dispatcher):
         """Fire-and-forget daemon report (boot/failure/pgstats) with mon
         hunting: a dead current mon rotates to the next rank instead of
         silently dropping reports (ref: MonClient::_reopen_session)."""
-        ranks = self.monmap.ranks()
-        for _ in range(len(ranks)):
+        for _ in range(max(len(self.monmap.ranks()), 1)):
             rank = self._cur_rank
+            if rank not in self.monmap.ranks():
+                self._cur_rank = self._next_rank(rank)
+                continue
             try:
                 await asyncio.wait_for(self.msgr.send_message(
                     msg, self.monmap.addr_of_rank(rank),
@@ -174,10 +245,16 @@ class MonClient(Dispatcher):
                 await self._renew_subs_if_moved()
                 return True
             except (asyncio.TimeoutError, ConnectionError, OSError,
-                    AuthError, ConnectionError_):
-                self._cur_rank = ranks[(ranks.index(rank) + 1)
-                                       % len(ranks)]
+                    AuthError, ConnectionError_, KeyError):
+                self._cur_rank = self._next_rank(rank)
         return False
+
+    async def clog(self, level: str, msg: str) -> bool:
+        """One cluster-log line to the LogMonitor (ref: LogClient) —
+        fire-and-forget like every other daemon report."""
+        import time
+        return await self.send_report(MLog(
+            name=self.name, level=level, msg=msg, stamp=time.time()))
 
     # -- maps --------------------------------------------------------------
     async def subscribe(self, what: str = "osdmap",
@@ -187,9 +264,11 @@ class MonClient(Dispatcher):
         caller (incl. the objecter's map-refresh retry loop) treats
         subscription as fire-and-forget."""
         self._subs[what] = start
-        ranks = self.monmap.ranks()
-        for _ in range(len(ranks)):
+        for _ in range(max(len(self.monmap.ranks()), 1)):
             rank = self._cur_rank
+            if rank not in self.monmap.ranks():
+                self._cur_rank = self._next_rank(rank)
+                continue
             try:
                 await asyncio.wait_for(self.msgr.send_message(
                     MMonSubscribe(what={what: str(start)}),
@@ -199,10 +278,41 @@ class MonClient(Dispatcher):
                 self._sub_rank = rank
                 return
             except (asyncio.TimeoutError, ConnectionError, OSError,
-                    AuthError, ConnectionError_):
-                self._cur_rank = ranks[(ranks.index(rank) + 1)
-                                       % len(ranks)]
+                    AuthError, ConnectionError_, KeyError):
+                self._cur_rank = self._next_rank(rank)
         self._sub_rank = None
+
+    def renew_subs(self) -> None:
+        """Periodic-renewal hook (ref: MonClient renew_subs on the
+        sub renew interval): daemons call this from their idle loops —
+        a daemon with nothing to report (the stats loop's
+        early-continue) must still keep its subscriptions alive. The
+        mon drops a conn's subscriptions on ms_handle_reset, and a
+        TCP reset the client transparently reconnected across
+        (election churn, handshake timeout) would otherwise leave it
+        silently unsubscribed and permanently stale — the round-6
+        storm wedge: an OSD pinned to a removed mon at a frozen
+        epoch, waiting forever for an up_thru grant's map that was
+        published into a dead subscription.
+
+        Runs as a BACKGROUND task (2s-throttled): the re-subscribes
+        hunt with per-attempt timeouts, and blocking a stats/beacon
+        loop on them during a partition would slow every fault test
+        for a renewal that is pure insurance."""
+        now = asyncio.get_event_loop().time()
+        if not self._subs or now - self._last_renew < 2.0:
+            return
+        self._last_renew = now
+        asyncio.ensure_future(self._renew_all_subs())
+
+    async def _renew_all_subs(self) -> None:
+        for what in list(self._subs):
+            start = self._subs[what]
+            if what == "osdmap" and self.osdmap is not None:
+                start = self.osdmap.epoch + 1
+            elif what == "monmap":
+                start = self.monmap.epoch + 1
+            await self.subscribe(what, start)   # hunts internally
 
     async def _renew_subs_if_moved(self) -> None:
         """Re-register subscriptions after mon hunting moved the
@@ -211,11 +321,7 @@ class MonClient(Dispatcher):
         RENEW (nobody holds our subs), not skip."""
         if not self._subs or self._sub_rank == self._cur_rank:
             return
-        for what in list(self._subs):
-            start = self._subs[what]
-            if what == "osdmap" and self.osdmap is not None:
-                start = self.osdmap.epoch + 1
-            await self.subscribe(what, start)   # hunts internally
+        await self._renew_all_subs()
 
     async def wait_for_osdmap(self, min_epoch: int = 1,
                               timeout: float = 10.0):

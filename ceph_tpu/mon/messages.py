@@ -77,10 +77,14 @@ class MMonSubscribe(Message):
 
 @register
 class MMonMap(Message):
-    """monmap blob: the mon addresses (ref: MMonMap)."""
+    """monmap blob: the mon addresses (ref: MMonMap). ``epoch`` (round
+    6, appended) duplicates the blob's epoch so a subscriber can gate
+    on it without decoding — the monmap is a versioned paxos artifact
+    now (MonmapMonitor) and clients FOLLOW it: a removed mon's address
+    stops being dialed, a rotated mon set doesn't strand clients."""
 
     TYPE = 123
-    FIELDS = [("monmap", "blob")]
+    FIELDS = [("monmap", "blob"), ("epoch", "u64")]
 
 
 @register
@@ -183,3 +187,43 @@ class MPGStats(Message):
     FIELDS = [("osd", "s32"), ("epoch", "u32"),
               ("stats", "map:str:blob"), ("slow_ops", "u32"),
               ("used_bytes", "u64"), ("capacity_bytes", "u64")]
+
+
+@register
+class MLog(Message):
+    """Daemon -> mon clog entry (ref: src/messages/MLog.h /
+    LogClient): one cluster-log line, paxos-ordered by the LogMonitor
+    and surfaced by `ceph log last`."""
+
+    TYPE = 149
+    FIELDS = [("name", "str"), ("level", "str"), ("msg", "str"),
+              ("stamp", "f64")]
+
+
+@register
+class MAuthUpdate(Message):
+    """AuthMonitor key publication to ``keyring`` subscribers (ref:
+    the role of cephx ticket/rotating-key distribution in MAuth /
+    MAuthReply): entity -> secret, an EMPTY secret meaning revoked.
+    The table is filtered per subscriber — daemons (mon./osd./mds./
+    mgr.) get the full table, a client only its own entry — so a
+    client subscription can never exfiltrate another entity's key."""
+
+    TYPE = 150
+    FIELDS = [("version", "u64"), ("keys", "map:str:blob")]
+
+
+@register
+class MOSDPGReadyToMerge(Message):
+    """Source-PG primary -> mon (ref: src/messages/MOSDPGReadyToMerge.h):
+    this merge-source PG (seed >= pool.pg_num_pending) is clean,
+    co-located with its fold target, and QUIESCED (new client ops are
+    backed off). The mon commits the pg_num decrease only once every
+    source of the pool has reported ready — the readiness barrier that
+    makes the fold a consistent local collection move. Re-sent every
+    stats tick while the merge is pending, so a mon leader change
+    cannot lose the barrier state."""
+
+    TYPE = 151
+    FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32"),
+              ("pending", "u32")]

@@ -16,10 +16,10 @@ import json
 from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
-    MDSBeacon, MMDSMap, MMonCommand, MMonCommandAck, MMonElection,
-    MMonGetOSDMap, MMonMap, MMonPaxos, MMonProposeForward,
-    MMonSubscribe, MOSDAlive, MOSDBoot, MOSDFailure, MOSDMap,
-    MOSDMarkMeDown, MPGStats,
+    MAuthUpdate, MDSBeacon, MLog, MMDSMap, MMonCommand, MMonCommandAck,
+    MMonElection, MMonGetOSDMap, MMonMap, MMonPaxos,
+    MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
+    MOSDFailure, MOSDMap, MOSDMarkMeDown, MOSDPGReadyToMerge, MPGStats,
 )
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.store import MonitorDBStore
@@ -30,14 +30,39 @@ log = get_logger("mon")
 
 
 class MonMap:
-    """ref: src/mon/MonMap.h — name -> (rank, addr)."""
+    """ref: src/mon/MonMap.h — name -> (rank, addr).
+
+    Round 6: the monmap is a VERSIONED paxos artifact (MonmapMonitor),
+    so it carries an epoch (v2 encoding) and membership can change at
+    runtime — `ceph mon add/rm` commits a new epoch, quorum re-forms
+    through the elector, and clients follow via the ``monmap``
+    subscription."""
 
     def __init__(self, fsid: str = "tpu-cluster"):
         self.fsid = fsid
+        self.epoch = 0
         self.mons: dict[str, tuple[int, str, int]] = {}
+        # highest rank EVER assigned in this lineage — persisted in
+        # the encoding so removal of the highest-ranked member can't
+        # recycle its rank (next_rank's never-reuse invariant)
+        self.max_rank = -1
 
     def add(self, name: str, rank: int, host: str, port: int) -> None:
         self.mons[name] = (rank, host, port)
+        self.max_rank = max(self.max_rank, rank)
+
+    def clone(self) -> "MonMap":
+        return MonMap.decode(self.encode())
+
+    def next_rank(self) -> int:
+        """Rank for a joining mon: ranks are never reused within one
+        map lineage (a removed rank stays retired — ``max_rank``
+        remembers it even after the member left the map), so peers
+        can't confuse a new member with a removed one's stale
+        messages."""
+        return max(self.max_rank,
+                   *(r for r, _, _ in self.mons.values()),
+                   -1) + 1
 
     def ranks(self) -> list[int]:
         return sorted(r for r, _, _ in self.mons.values())
@@ -63,20 +88,27 @@ class MonMap:
 
     def encode(self) -> bytes:
         e = Encoder()
-        with e.start(1):
+        with e.start(2):                    # v2: + epoch, max_rank
             e.string(self.fsid)
             e.map(self.mons, lambda e, k: e.string(k),
                   lambda e, v: e.s32(v[0]).string(v[1]).u32(v[2]))
+            e.u64(self.epoch)                              # v2
+            e.s32(self.max_rank)                           # v2
         return e.tobytes()
 
     @classmethod
     def decode(cls, data: bytes) -> "MonMap":
         d = Decoder(data)
         m = cls()
-        with d.start(1):
+        with d.start(2) as _v:
             m.fsid = d.string()
             m.mons = d.map(lambda d: d.string(),
                            lambda d: (d.s32(), d.string(), d.u32()))
+            if _v >= 2:
+                m.epoch = d.u64()
+                m.max_rank = d.s32()
+        for r, _h, _p in m.mons.values():
+            m.max_rank = max(m.max_rank, r)
         return m
 
 
@@ -108,28 +140,62 @@ class Monitor(Dispatcher):
         self.quorum: list[int] = []
         self.state = "probing"               # probing|electing|leader|peon
 
+        from ceph_tpu.mon.auth_monitor import AuthMonitor
+        from ceph_tpu.mon.log_monitor import LogMonitor
         from ceph_tpu.mon.mds_monitor import MDSMonitor
+        from ceph_tpu.mon.monmap_monitor import MonmapMonitor
         from ceph_tpu.mon.osd_monitor import OSDMonitor
         from ceph_tpu.mon.service import ConfigMonitor, HealthMonitor
         self.osdmon = OSDMonitor(self)
         self.mdsmon = MDSMonitor(self)
+        self.monmapmon = MonmapMonitor(self)
+        self.authmon = AuthMonitor(self)
+        self.logmon = LogMonitor(self)
         self.configmon = ConfigMonitor(self)
         self.healthmon = HealthMonitor(self)
-        self.services = [self.osdmon, self.mdsmon, self.configmon,
+        self.services = [self.monmapmon, self.authmon, self.logmon,
+                         self.osdmon, self.mdsmon, self.configmon,
                          self.healthmon]
 
         # subscriptions: conn -> {what: next_epoch}
         self.subs: dict[object, dict[str, int]] = {}
         self._tick_task: asyncio.Task | None = None
         self._stopped = False
+        # set when a committed monmap no longer contains this mon: the
+        # retired daemon stops electing/ticking (ref: a removed mon
+        # shutting down after MonmapMonitor::prepare_update commits)
+        self._removed = False
+        self.asok = None
+        self._asok_dir = cfg.get("admin_socket_dir")
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self, host: str = "127.0.0.1",
                     port: int = 0) -> EntityAddr:
         addr = await self.msgr.bind(host, port)
+        await self.start_asok()
         self._tick_task = asyncio.ensure_future(self._tick_loop())
         await self.elector.start()
         return addr
+
+    async def start_asok(self) -> None:
+        """Per-mon admin socket (ref: the mon's AdminSocket): `status`
+        carries the monmap-epoch and pending-merge blocks."""
+        if not self._asok_dir or self.asok is not None:
+            return
+        from ceph_tpu.utils.admin_socket import AdminSocket
+        self.asok = AdminSocket(f"{self._asok_dir}/mon.{self.name}.asok")
+        self.asok.register("status", self.get_status,
+                           "mon status incl. monmap epoch + pending "
+                           "merges")
+        self.asok.register(
+            "quorum_status", lambda: {
+                "monmap_epoch": self.monmap.epoch,
+                "quorum": self.quorum,
+                "leader": self.leader_rank,
+                "mons": {n: list(v)
+                         for n, v in self.monmap.mons.items()}},
+            "quorum membership + monmap epoch")
+        await self.asok.start()
 
     async def stop(self) -> None:
         self._stopped = True
@@ -137,14 +203,58 @@ class Monitor(Dispatcher):
             self._tick_task.cancel()
         if self.elector._timer:
             self.elector._timer.cancel()
+        if self.asok:
+            await self.asok.stop()
+            self.asok = None
         await self.msgr.shutdown()
 
     def is_leader(self) -> bool:
         return self.state == "leader"
 
     def request_election(self) -> None:
-        if not self._stopped:
+        if not self._stopped and not self._removed:
             asyncio.ensure_future(self.elector.start())
+
+    # -- monmap following (MonmapMonitor commits land here) ----------------
+    def update_monmap(self, new: MonMap) -> None:
+        """Adopt a committed monmap epoch (ref: Monitor::notify_new_
+        monmap). Membership changes re-form the quorum through the
+        existing elector; a mon that finds itself REMOVED retires —
+        it stops electing and ticking, so its address can be torn down
+        without confusing the survivors."""
+        if new.epoch <= self.monmap.epoch:
+            return
+        old_members = set(self.monmap.mons)
+        self.monmap = new
+        if self.name not in new.mons:
+            if not self._removed:
+                self._removed = True
+                self.state = "removed"
+                self.elector.electing = False
+                if self.elector._timer:
+                    self.elector._timer.cancel()
+                log.dout(1, f"mon.{self.name} removed from monmap "
+                            f"epoch {new.epoch}; retiring")
+            return
+        if self._removed:
+            # back in the map: a JOINER syncing the paxos history
+            # replays epochs that predate its own membership — the
+            # stale retire must lift when the epoch that contains us
+            # applies (also covers a genuine re-add)
+            self._removed = False
+            self.state = "probing"
+            log.dout(1, f"mon.{self.name} present in monmap epoch "
+                        f"{new.epoch}; resuming")
+        self.rank = new.rank_of_name(self.name)
+        if old_members != set(new.mons):
+            # quorum must re-form over the new membership: a removed
+            # member may hold the leadership we are deferring to, and
+            # a joiner can only sync through a fresh collect round
+            self.quorum = [r for r in self.quorum
+                           if r in new.ranks()]
+            log.dout(1, f"mon.{self.name} monmap epoch {new.epoch}: "
+                        f"members {sorted(new.mons)}; electing")
+            self.request_election()
 
     # -- election outcomes -------------------------------------------------
     async def win_election(self, epoch: int, quorum: list[int]) -> None:
@@ -173,6 +283,8 @@ class Monitor(Dispatcher):
             while not self._stopped:
                 await asyncio.sleep(self.tick_interval)
                 now = asyncio.get_event_loop().time()
+                if self._removed:
+                    continue          # retired: awaiting teardown
                 if self.is_leader():
                     await self.paxos.send_lease()
                     for svc in self.services:
@@ -231,14 +343,19 @@ class Monitor(Dispatcher):
             await self._send_osdmaps(msg.conn, msg.start_epoch)
             return True
         if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure,
-                            MOSDMarkMeDown, MPGStats, MDSBeacon)):
+                            MOSDMarkMeDown, MPGStats, MDSBeacon,
+                            MLog, MOSDPGReadyToMerge)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:
                     await self.send_mon(self.leader_rank, msg)
                 return True
-            svc = self.mdsmon if isinstance(msg, MDSBeacon) \
-                else self.osdmon
+            if isinstance(msg, MDSBeacon):
+                svc = self.mdsmon
+            elif isinstance(msg, MLog):
+                svc = self.logmon
+            else:
+                svc = self.osdmon
             asyncio.ensure_future(svc.handle(msg))
             return True
         return False
@@ -261,11 +378,14 @@ class Monitor(Dispatcher):
         asyncio.ensure_future(self._publish_maps())
 
     async def _publish_maps(self) -> None:
-        """Push new osdmap/fsmap epochs to subscribers
+        """Push new osdmap/fsmap/monmap/keyring epochs to subscribers
         (ref: OSDMonitor::check_subs / send_incremental +
-        MDSMonitor::check_subs)."""
+        MDSMonitor::check_subs + Monitor::handle_subscribe's monmap
+        send)."""
         cur = self.osdmon.osdmap.epoch if self.osdmon.osdmap else 0
         fs_cur = self.mdsmon.fsmap.epoch
+        mm_cur = self.monmap.epoch
+        auth_cur = self.authmon.version
         for conn, subs in list(self.subs.items()):
             start = subs.get("osdmap")
             if start is not None and start <= cur:
@@ -282,6 +402,26 @@ class Monitor(Dispatcher):
                         epoch=fs_cur,
                         fsmap=self.mdsmon.fsmap.encode()))
                     subs["mdsmap"] = fs_cur + 1
+                except Exception:
+                    self.subs.pop(conn, None)
+                    continue
+            mm_start = subs.get("monmap")
+            if mm_start is not None and mm_start <= mm_cur:
+                try:
+                    await conn.send_message(MMonMap(
+                        monmap=self.monmap.encode(), epoch=mm_cur))
+                    subs["monmap"] = mm_cur + 1
+                except Exception:
+                    self.subs.pop(conn, None)
+                    continue
+            a_start = subs.get("keyring")
+            if a_start is not None and a_start <= auth_cur:
+                try:
+                    await conn.send_message(MAuthUpdate(
+                        version=auth_cur,
+                        keys=self.authmon.publishable_for(
+                            conn.peer_name)))
+                    subs["keyring"] = auth_cur + 1
                 except Exception:
                     self.subs.pop(conn, None)
 
@@ -311,8 +451,13 @@ class Monitor(Dispatcher):
         for what, start in msg.what.items():
             entry[what] = int(start)
             if what == "monmap":
-                await msg.conn.send_message(
-                    MMonMap(monmap=self.monmap.encode()))
+                # immediate send (ref: Monitor::handle_subscribe
+                # sending the latest monmap synchronously) — the
+                # cursor advances so _publish_maps won't re-send
+                await msg.conn.send_message(MMonMap(
+                    monmap=self.monmap.encode(),
+                    epoch=self.monmap.epoch))
+                entry[what] = self.monmap.epoch + 1
         await self._publish_maps()
 
     # -- commands ----------------------------------------------------------
@@ -341,16 +486,30 @@ class Monitor(Dispatcher):
             return 0, "", json.dumps(self.get_status()).encode()
         if prefix == "mon dump":
             return 0, "", json.dumps({
-                "fsid": self.monmap.fsid, "quorum": self.quorum,
+                "fsid": self.monmap.fsid,
+                "epoch": self.monmap.epoch,
+                "quorum": self.quorum,
                 "leader": self.leader_rank,
                 "mons": {n: list(v) for n, v in
                          self.monmap.mons.items()}}).encode()
         if prefix == "quorum_status":
             return 0, "", json.dumps({
+                "monmap_epoch": self.monmap.epoch,
                 "quorum": self.quorum,
+                "quorum_names": [self.monmap.name_of_rank(r)
+                                 for r in self.quorum
+                                 if r in self.monmap.ranks()],
                 "quorum_leader_name":
                     self.monmap.name_of_rank(self.leader_rank)
-                    if self.leader_rank is not None else ""}).encode()
+                    if self.leader_rank is not None and
+                    self.leader_rank in self.monmap.ranks()
+                    else ""}).encode()
+        if prefix in ("mon add", "mon rm", "mon remove"):
+            return await self.monmapmon.handle_command(cmd, inbl)
+        if prefix.startswith("auth"):
+            return await self.authmon.handle_command(cmd, inbl)
+        if prefix.startswith("log"):
+            return await self.logmon.handle_command(cmd, inbl)
         if prefix.startswith("config"):
             return await self.configmon.handle_command(cmd, inbl)
         if prefix.startswith(("fs", "mds")):
@@ -358,6 +517,14 @@ class Monitor(Dispatcher):
         if prefix.startswith(("osd", "pg")):
             return await self.osdmon.handle_command(cmd, inbl)
         return -22, f"unknown command {prefix!r}", b""    # -EINVAL
+
+    def clog(self, level: str, msg: str) -> None:
+        """Append one cluster-log line through the LogMonitor (leader
+        only; fire-and-forget — the log is observability, not a
+        correctness dependency)."""
+        if self.is_leader() and not self._stopped:
+            asyncio.ensure_future(
+                self.logmon.append(f"mon.{self.name}", level, msg))
 
     def get_status(self) -> dict:
         health = self.healthmon.checks()
@@ -393,11 +560,19 @@ class Monitor(Dispatcher):
                             for p in om.pools.values()
                             if p.quota_bytes or p.quota_objects or
                             p.is_full()]}
+        if om is not None:
+            pending = self.osdmon.pending_merges()
+            if pending:
+                osd_stat["pending_merges"] = pending
         return {
             "fsid": self.monmap.fsid,
             "health": health,
             "quorum": self.quorum,
-            "monmap": {"num_mons": len(self.monmap.mons)},
+            "monmap": {"epoch": self.monmap.epoch,
+                       "num_mons": len(self.monmap.mons),
+                       "mons": sorted(self.monmap.mons)},
+            "auth": {"num_keys": self.authmon.num_keys(),
+                     "version": self.authmon.version},
             "osdmap": osd_stat,
             "fsmap": self.mdsmon.summary(),
             "pgmap": self.osdmon.pg_summary(),

@@ -23,7 +23,8 @@ from ceph_tpu.encoding import (
     encode_osdmap,
 )
 from ceph_tpu.mon.messages import (MOSDAlive, MOSDBoot, MOSDFailure,
-                                   MOSDMarkMeDown, MPGStats)
+                                   MOSDMarkMeDown, MOSDPGReadyToMerge,
+                                   MPGStats)
 from ceph_tpu.mon.service import PaxosService
 from ceph_tpu.osd.osdmap import (
     FLAG_FULL, FLAG_NAMES, FLAG_NODOWN, FLAG_NOIN, FLAG_NOOUT,
@@ -73,6 +74,18 @@ class OSDMonitor(PaxosService):
         # only an auto-set flag is auto-cleared — an operator's
         # `osd set full` stays until `osd unset full`
         self._full_auto = False
+        # merge readiness barrier (ref: OSDMonitor ready_to_merge_pgs
+        # driven by MOSDPGReadyToMerge): (pool, pg_num_pending) ->
+        # {source seed: last-report loop time}. Leader memory, not
+        # paxos — primaries re-report every stats tick while READY,
+        # so a leader change just re-accumulates, and a source that
+        # STOPPED being ready (degraded mid-barrier, or a stale
+        # report from an earlier merge cycle) ages out of the window
+        # instead of holding a sticky ready bit.
+        self._merge_ready: dict[tuple[int, int],
+                                dict[int, float]] = {}
+        self.merge_ready_window = mon.config.get(
+            "mon_merge_ready_window", 2.0)
         # serializes map mutations: concurrent handlers must not build
         # incrementals against the same base epoch
         self._inc_lock = asyncio.Lock()
@@ -160,6 +173,8 @@ class OSDMonitor(PaxosService):
             await self._handle_mark_me_down(msg)
         elif isinstance(msg, MPGStats):
             self._handle_pg_stats(msg)
+        elif isinstance(msg, MOSDPGReadyToMerge):
+            await self._handle_ready_to_merge(msg)
 
     async def _handle_alive(self, m: MOSDAlive) -> None:
         """up_thru grant (ref: OSDMonitor::prepare_alive): a primary
@@ -270,7 +285,21 @@ class OSDMonitor(PaxosService):
         log.dout(1, f"osd.{m.osd} marked down (mark-me-down)")
 
     def _handle_pg_stats(self, m: MPGStats) -> None:
+        om = self.osdmap
         for pgid, blob in m.stats.items():
+            # drop rows for PGs the map no longer has (a source
+            # primary's in-flight report racing its own merge commit
+            # would otherwise resurrect a folded seed as a ghost row)
+            if om is not None:
+                try:
+                    from ceph_tpu.osd.types import pg_t as _pg_t
+                    pg = _pg_t.parse(pgid)
+                    pool = om.pools.get(pg.pool)
+                    if pool is None or pg.seed >= pool.pg_num:
+                        self.pg_stats.pop(pgid, None)
+                        continue
+                except ValueError:
+                    pass
             try:
                 self.pg_stats[pgid] = json.loads(blob)
             except json.JSONDecodeError:
@@ -286,6 +315,100 @@ class OSDMonitor(PaxosService):
                 (getattr(m, "used_bytes", 0), cap)
         else:
             self.osd_utilization.pop(m.osd, None)
+
+    # -- pg merge (ref: OSDMonitor's pg_num_pending machinery) -------------
+    def pending_merges(self) -> dict:
+        """pool name -> {from, to, ready, sources} for every pool with
+        a pg_num decrease in flight (status/asok/health surface)."""
+        om = self.osdmap
+        if om is None:
+            return {}
+        out = {}
+        for pool in om.pools.values():
+            if not pool.pg_num_pending:
+                continue
+            ready = self._merge_ready.get(
+                (pool.id, pool.pg_num_pending), set())
+            out[pool.name] = {
+                "from": pool.pg_num, "to": pool.pg_num_pending,
+                "sources": pool.pg_num - pool.pg_num_pending,
+                "ready": len(ready)}
+        return out
+
+    async def _handle_ready_to_merge(self, m: MOSDPGReadyToMerge) -> None:
+        """One source PG reports clean+quiesced at the pending fold
+        (ref: OSDMonitor::handle_pg_ready_to_merge). The commit itself
+        happens on tick once EVERY source of the pool has reported —
+        the readiness barrier."""
+        from ceph_tpu.osd.types import pg_t as _pg_t
+        om = self.osdmap
+        if om is None:
+            return
+        try:
+            pg = _pg_t.parse(m.pgid)
+        except ValueError:
+            return
+        pool = om.pools.get(pg.pool)
+        if pool is None or not pool.pg_num_pending or \
+                m.pending != pool.pg_num_pending or \
+                not pool.is_merge_source(pg.seed):
+            return
+        self._merge_ready.setdefault(
+            (pool.id, pool.pg_num_pending), {})[pg.seed] = \
+            asyncio.get_event_loop().time()
+
+    async def _check_merge_commit(self) -> None:
+        """Commit pg_num decreases whose every source reported ready
+        WITHIN the freshness window — sources re-report every stats
+        tick only while still clean+quiesced, so a source that
+        degraded mid-barrier (or a delayed report from an earlier
+        merge cycle) ages out instead of satisfying the barrier. The
+        commit folds pg_num down and clears pg_num_pending in ONE
+        incremental, so OSDs observe a single merge transition and
+        run the deterministic local fold (PG.merge_from)."""
+        om = self.osdmap
+        if om is None:
+            return
+        # hygiene: ready-sets whose pool vanished or whose pending no
+        # longer matches must not outlive their merge
+        live = {(p.id, p.pg_num_pending) for p in om.pools.values()
+                if p.pg_num_pending}
+        for key in [k for k in self._merge_ready if k not in live]:
+            self._merge_ready.pop(key, None)
+        now = asyncio.get_event_loop().time()
+        for pool in list(om.pools.values()):
+            pending = pool.pg_num_pending
+            if not pending:
+                continue
+            stamps = self._merge_ready.get((pool.id, pending), {})
+            ready = {s for s, at in stamps.items()
+                     if now - at <= self.merge_ready_window}
+            sources = set(range(pending, pool.pg_num))
+            if not sources <= ready:
+                continue
+
+            def build(cur, pid=pool.id, pending=pending):
+                p = cur.pools.get(pid)
+                if p is None or p.pg_num_pending != pending:
+                    return None
+                import copy
+                newpool = copy.deepcopy(p)
+                newpool.pg_num = pending
+                newpool.pg_num_pending = 0
+                inc = Incremental()
+                inc.new_pools[pid] = newpool
+                return inc, None
+            ok, _ = await self._propose_change(build)
+            if ok:
+                self._merge_ready.pop((pool.id, pending), None)
+                # the folded seeds' stats rows are gone with the PGs
+                for seed in sources:
+                    self.pg_stats.pop(f"{pool.id}.{seed:x}", None)
+                self.mon.clog(
+                    "INF", f"pool '{pool.name}' pg_num merged down "
+                           f"to {pending}")
+                log.dout(1, f"pool {pool.name}: merge committed, "
+                            f"pg_num -> {pending}")
 
     async def tick(self) -> None:
         """Auto-out: down past the interval -> weight 0
@@ -322,6 +445,7 @@ class OSDMonitor(PaxosService):
             if not reps:
                 self.failure_reporters.pop(target, None)
         await self._check_fullness()
+        await self._check_merge_commit()
         if not self.down_at:
             return
         if om.test_flag(FLAG_NOOUT):
@@ -797,27 +921,59 @@ class OSDMonitor(PaxosService):
             return -22, f"unknown pool var {var!r}", b""
         rejected: dict = {}
 
+        merge_started: dict = {}
+
         def build(om):
             # guards run INSIDE build against the authoritative map a
             # proposal would actually apply to — prechecking against
             # self.osdmap races concurrent pool-set commands and could
-            # land a forbidden pg_num decrease (merge)
+            # land a conflicting pg_num transition
             # (ref: OSDMonitor::prepare_command_pool_set checks)
             pool = next((p for p in om.pools.values()
                          if p.name == name), None)
             if pool is None:
                 return None
-            if var == "pg_num" and int(val) < pool.pg_num:
-                rejected["msg"] = "pg_num decrease (merge) not supported"
+            if var in ("pg_num", "pgp_num") and pool.pg_num_pending:
+                rejected["msg"] = (
+                    f"pool '{name}' has a pg merge in flight "
+                    f"(pg_num_pending={pool.pg_num_pending}); wait "
+                    f"for it to commit")
                 return None
             if var == "pgp_num" and int(val) > pool.pg_num:
                 rejected["msg"] = "pgp_num cannot exceed pg_num"
                 return None
             import copy
             newpool = copy.deepcopy(pool)
-            setattr(newpool, var, int(val))
-            if var == "pg_num" and newpool.pgp_num > newpool.pg_num:
-                newpool.pgp_num = newpool.pg_num
+            if var == "pg_num" and int(val) < pool.pg_num:
+                # PG MERGE (ref: the pg_num_pending two-phase
+                # decrease, inverse of round-4's split): phase 1
+                # commits pg_num_pending + the pgp_num fold, so source
+                # PGs migrate onto their fold targets through normal
+                # peering; the actual pg_num decrease commits on tick
+                # once every source has quiesced and reported
+                # ready-to-merge.
+                if not self.mon.config.get("mon_allow_pg_merge",
+                                           True):
+                    rejected["msg"] = (
+                        "pg_num decrease (merge) disabled "
+                        "(mon_allow_pg_merge=false)")
+                    return None
+                if pool.is_erasure():
+                    rejected["msg"] = (
+                        "pg_num decrease on erasure pools not "
+                        "supported")
+                    return None
+                if int(val) < 1:
+                    rejected["msg"] = "pg_num must be >= 1"
+                    return None
+                newpool.pg_num_pending = int(val)
+                newpool.pgp_num = min(newpool.pgp_num, int(val))
+                merge_started["to"] = int(val)
+            else:
+                setattr(newpool, var, int(val))
+                if var == "pg_num" and \
+                        newpool.pgp_num > newpool.pg_num:
+                    newpool.pgp_num = newpool.pg_num
             inc = Incremental()
             inc.new_pools[pool.id] = newpool
             return inc, None
@@ -829,6 +985,13 @@ class OSDMonitor(PaxosService):
                        for p in self.osdmap.pools.values()):
                 return -2, f"pool '{name}' does not exist", b""
             return -11, "proposal failed", b""
+        if "to" in merge_started:
+            self.mon.clog(
+                "INF", f"pool '{name}' pg merge started: pg_num -> "
+                       f"{merge_started['to']} pending source "
+                       f"quiesce")
+            return 0, f"set pool {name} pg_num_pending to {val} " \
+                      f"(merge pending source readiness)", b""
         return 0, f"set pool {name} {var} to {val}", b""
 
     async def _cmd_pool_ls(self, cmd, inbl):
@@ -914,6 +1077,7 @@ class OSDMonitor(PaxosService):
                        "type": p.type, "size": p.size,
                        "min_size": p.min_size, "pg_num": p.pg_num,
                        "pgp_num": p.pgp_num,
+                       "pg_num_pending": p.pg_num_pending,
                        "crush_rule": p.crush_rule,
                        "quota_bytes": p.quota_bytes,
                        "quota_objects": p.quota_objects,

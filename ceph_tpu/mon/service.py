@@ -99,6 +99,36 @@ class HealthMonitor(PaxosService):
             checks["MON_DOWN"] = {
                 "severity": "HEALTH_WARN",
                 "summary": f"{len(missing)} monitors down: {missing}"}
+        # merge barrier visibility (round 6): a pool mid-merge is a
+        # deliberate degradation — new ops to source PGs park until
+        # the decrease commits
+        pending = mon.osdmon.pending_merges() \
+            if hasattr(mon.osdmon, "pending_merges") else {}
+        if pending:
+            checks["PG_MERGE_PENDING"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "; ".join(
+                    f"pool '{name}' merging pg_num {v['from']} -> "
+                    f"{v['to']} ({v['ready']}/{v['sources']} sources "
+                    f"ready)" for name, v in sorted(pending.items()))}
+        # recently revoked keys (round 6): surfaces that sessions were
+        # fenced — clears after mon_auth_revoke_warn_s so the log, not
+        # health, is the permanent record
+        authmon = getattr(mon, "authmon", None)
+        if authmon is not None and authmon.revoked:
+            import time
+            window = getattr(mon, "config", {}) \
+                .get("mon_auth_revoke_warn_s", 300.0)
+            now = time.time()
+            recent = sorted(
+                n for n, at in authmon.revoked.items()
+                if n not in authmon.keys and now - at < window)
+            if recent:
+                checks["AUTH_KEY_REVOKED"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": f"key(s) {recent} revoked recently: "
+                               f"their sessions were fenced and new "
+                               f"handshakes are refused"}
         om = mon.osdmon.osdmap
         if om is not None:
             from ceph_tpu.osd.osdmap import (

@@ -44,10 +44,20 @@ class AuthError(Exception):
 
 
 class Keyring:
-    """entity name -> secret (ref: src/auth/KeyRing.h)."""
+    """entity name -> secret (ref: src/auth/KeyRing.h).
+
+    Round 6 makes the keyring a LIVE view driven by the AuthMonitor's
+    paxos commits: ``set_key``/``revoke`` notify registered observers
+    (messengers) so a rotation re-keys live sessions in-band and a
+    revocation drops them (ref: the cephx ticket model — a rotated or
+    revoked key must change what live transport trusts, not just what
+    future handshakes read)."""
 
     def __init__(self, keys: dict[str, bytes] | None = None):
         self.keys = dict(keys or {})
+        # observers get key_rotated(name) / key_revoked(name); held as
+        # plain refs — messengers deregister on shutdown
+        self._observers: list = []
 
     @staticmethod
     def generate_key() -> bytes:
@@ -68,6 +78,37 @@ class Keyring:
         """A keyring holding only the named entities (what a daemon's
         keyring file would contain)."""
         return Keyring({n: self.get(n) for n in names})
+
+    # -- live lifecycle (AuthMonitor-driven) -------------------------------
+    def add_observer(self, obs) -> None:
+        if obs not in self._observers:
+            self._observers.append(obs)
+
+    def remove_observer(self, obs) -> None:
+        if obs in self._observers:
+            self._observers.remove(obs)
+
+    def set_key(self, name: str, key: bytes) -> None:
+        """Install/replace an entity's secret. A genuine replacement
+        (value changed) is a ROTATION: observers re-key the entity's
+        live sessions via the in-band rekey frame; a same-value set is
+        a no-op so replayed auth publishes don't churn sessions."""
+        old = self.keys.get(name)
+        self.keys[name] = key
+        if old is not None and old != key:
+            for obs in list(self._observers):
+                obs.key_rotated(name)
+
+    def revoke(self, name: str) -> bool:
+        """Remove an entity's secret and FENCE it: observers drop the
+        entity's open sessions, and without a key every future
+        handshake for it fails. Returns True when a key was actually
+        removed (dedupes replayed revocations)."""
+        if self.keys.pop(name, None) is None:
+            return False
+        for obs in list(self._observers):
+            obs.key_revoked(name)
+        return True
 
 
 def _mac(key: bytes, *parts: bytes) -> bytes:

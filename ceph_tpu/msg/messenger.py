@@ -292,6 +292,24 @@ class Connection:
         except Exception:
             pass
 
+    async def force_rekey(self) -> None:
+        """Rotate this connection's tx frame key NOW (the AuthMonitor
+        rotation hook): announce epoch+1 under the old key, then
+        switch — exactly Connection._maybe_rekey without the frame-
+        count gate. No-op outside secure mode (crc frames carry no
+        key)."""
+        if not self._secure() or self.closed:
+            return
+        async with self._send_lock:
+            new_epoch = self._tx_epoch + 1
+            try:
+                await self._send_frame(TAG_REKEY, 0,
+                                       new_epoch.to_bytes(4, "little"))
+            except ConnectionError_:
+                return               # dead conn: nothing left to rekey
+            self._tx_epoch = new_epoch
+            self._tx_frames = 0
+
     async def close(self) -> None:
         self._abort()
         if self._reader_task:
@@ -351,6 +369,10 @@ class Messenger:
         self.addr: EntityAddr | None = None
         self.throttle: Throttle | None = None
         self._accepted: set[Connection] = set()
+        # AuthMonitor lifecycle: a live keyring notifies us on
+        # rotation (re-key live sessions) and revocation (fence)
+        if keyring is not None:
+            keyring.add_observer(self)
 
     # -- setup -------------------------------------------------------------
     def add_dispatcher(self, d: Dispatcher) -> None:
@@ -383,6 +405,45 @@ class Messenger:
     def _inject_failure(self) -> bool:
         n = self.inject_socket_failures
         return bool(n) and self._rng.randrange(n) == 0
+
+    # -- key lifecycle (Keyring observer; ref: cephx ticket rotation /
+    # session killing on auth removal) ------------------------------------
+    def _conns_of(self, name: str) -> list[Connection]:
+        out = [c for c in self.conns.values() if c.peer_name == name]
+        out += [c for c in self._accepted if c.peer_name == name]
+        return out
+
+    def key_rotated(self, name: str) -> None:
+        """The entity's secret changed: bump the frame-key epoch on its
+        live sessions (in-band REKEY; new handshakes pick up the new
+        secret from the keyring automatically). Rotating OUR OWN key
+        re-keys every connection we originate."""
+        conns = list(self.conns.values()) + list(self._accepted) \
+            if name == self.name else self._conns_of(name)
+        for conn in conns:
+            asyncio.ensure_future(conn.force_rekey())
+
+    def key_revoked(self, name: str) -> None:
+        """The entity's key is GONE: fence it — drop its open sessions
+        and their replay state. Handshakes for it now fail at the
+        keyring lookup, so the entity cannot come back until a new key
+        is provisioned. Our own key revoked = we are fenced: every
+        session drops."""
+        if name == self.name:
+            victims = list(self.conns.items()) + \
+                [(None, c) for c in self._accepted]
+        else:
+            victims = [(a, c) for a, c in self.conns.items()
+                       if c.peer_name == name] + \
+                [(None, c) for c in self._accepted
+                 if c.peer_name == name]
+        for addr, conn in victims:
+            if addr is not None:
+                self.conns.pop(addr, None)
+                self._sessions.pop(addr, None)
+            asyncio.ensure_future(conn.close())
+        if name != self.name:
+            self._peer_in_seq.pop(name, None)
 
     async def bind(self, host: str = "127.0.0.1",
                    port: int = 0) -> EntityAddr:
@@ -623,6 +684,8 @@ class Messenger:
 
     # -- teardown ----------------------------------------------------------
     async def shutdown(self) -> None:
+        if self.keyring is not None:
+            self.keyring.remove_observer(self)
         if self._server:
             self._server.close()           # stop accepting first
         for conn in list(self.conns.values()) + list(self._accepted):

@@ -243,6 +243,11 @@ class OSD(Dispatcher):
         await self.msgr.bind(host, 0)
         await self.hb_msgr.bind(host, 0)
         await self.monc.subscribe("osdmap", 0)
+        # monmap following (runtime mon add/rm) + committed-keyring
+        # following (auth rotation/revocation reach the daemon)
+        await self.monc.subscribe("monmap", 0)
+        if self.msgr.keyring is not None:
+            await self.monc.subscribe("keyring", 0)
         await self.monc.wait_for_osdmap()
         await self._send_boot()
         # wait until the map shows us up
@@ -317,6 +322,9 @@ class OSD(Dispatcher):
         self._admit_task = asyncio.ensure_future(self._admit_loop())
         if self.scrub_interval > 0:
             self._scrub_task = asyncio.ensure_future(self._scrub_loop())
+        # clog the boot (ref: OSD::init's "osd.N ... boot" clog line)
+        asyncio.ensure_future(self.monc.clog(
+            "INF", f"osd.{self.whoami} booted at {self.msgr.addr}"))
         log.dout(1, f"osd.{self.whoami} booted at {self.msgr.addr}")
 
     async def stop(self, mark_down: bool = False) -> None:
@@ -375,7 +383,23 @@ class OSD(Dispatcher):
         by_pool: dict[int, list[PG]] = {}
         for pg in self.pgs.values():
             by_pool.setdefault(pg.pool.id, []).append(pg)
+        # pg merging (ref: PG::merge_from on a committed pg_num
+        # decrease — the inverse of the split below): every local PG
+        # whose seed fell off its pool's new pg_num folds its objects
+        # AND log into the stable-mod parent BEFORE anything peers at
+        # the new map. Like the split, this is store-derived and runs
+        # on every holder of source data — including an OSD that BOOTS
+        # after the decrease with stale source collections on disk
+        # (the down-during-merge case), which would otherwise strand
+        # the folded history. ONE store scan per map advance (not per
+        # pool): leftovers are empty on every epoch that didn't merge.
+        stale = self._stale_merge_collections(osdmap)
         for pool in osdmap.pools.values():
+            if stale.get(pool.id) or any(
+                    pg.pgid.seed >= pool.pg_num
+                    for pg in by_pool.get(pool.id, [])):
+                self._fold_merged_pgs(pool, by_pool,
+                                      stale.get(pool.id, []))
             seeds = np.arange(pool.pg_num, dtype=np.uint32)
             up, upp, acting, actp = osdmap.pg_to_up_acting_osds(
                 pool.id, seeds)
@@ -431,13 +455,67 @@ class OSD(Dispatcher):
                        if pg.pool.id not in osdmap.pools]:
             self.pgs.pop(pgid_s)
 
+    def _stale_merge_collections(self, osdmap) -> dict[int, list]:
+        """ONE pass over the store: pool id -> [(seed, cid)] of
+        on-disk collections whose seed fell off the pool's pg_num
+        (merge leftovers from a decrease this OSD slept through)."""
+        out: dict[int, list] = {}
+        for cid in self.store.list_collections():
+            pid_s, _, seed_s = cid.partition(".")
+            try:
+                pid, seed = int(pid_s), int(seed_s, 16)
+            except ValueError:
+                continue
+            pool = osdmap.pools.get(pid)
+            if pool is not None and seed >= pool.pg_num:
+                out.setdefault(pid, []).append((seed, cid))
+        return out
+
+    def _fold_merged_pgs(self, pool, by_pool: dict,
+                         stale: list) -> None:
+        """Fold every local merge-leftover of ``pool`` (instance or
+        stale on-disk collection with seed >= the committed pg_num)
+        into its stable-mod parent. The parent is instantiated when
+        absent — it may not even be in our acting set (we become a
+        STRAY holding merged data, and the existing notify machinery
+        announces it to the real primary)."""
+        import numpy as np
+        cls = ECPG if pool.is_erasure() else PG
+        pool_pgs = by_pool.setdefault(pool.id, [])
+        leftovers = [pg for pg in pool_pgs
+                     if pg.pgid.seed >= pool.pg_num]
+        # stale on-disk collections without an instance (booted after
+        # the merge committed)
+        have = {pg.cid for pg in pool_pgs}
+        for seed, cid in stale:
+            if cid not in have:
+                leftovers.append(cls(self, pool, pg_t(pool.id, seed)))
+        for src in leftovers:
+            parent_seed = int(pool.raw_pg_to_pg(
+                np.asarray([src.pgid.seed]), xp=np)[0])
+            parent_cid = str(pg_t(pool.id, parent_seed))
+            parent = self.pgs.get(parent_cid)
+            if parent is None:
+                parent = self.pgs[parent_cid] = cls(
+                    self, pool, pg_t.parse(parent_cid))
+                pool_pgs.append(parent)
+            parent.pool = pool
+            parent.merge_from(src)
+            self.pgs.pop(src.cid, None)
+            if src in pool_pgs:
+                pool_pgs.remove(src)
+
     # -- dispatch ----------------------------------------------------------
     def _pg_for(self, pgid_s: str, create: bool = False) -> PG | None:
         pg = self.pgs.get(pgid_s)
         if pg is None and create and self.osdmap is not None:
             pgid = pg_t.parse(pgid_s)
             pool = self.osdmap.pools.get(pgid.pool)
-            if pool is None:
+            if pool is None or pgid.seed >= pool.pg_num:
+                # merged-away seed: a stale client (or peer) still
+                # folding by the old pg_num must NOT resurrect the
+                # source PG — the -11 reply below sends it for a
+                # fresh map, which retargets the merged parent
                 return None
             cls = ECPG if pool.is_erasure() else PG
             pg = self.pgs[pgid_s] = cls(self, pool, pgid)
@@ -504,6 +582,16 @@ class OSD(Dispatcher):
                     tid=msg.tid, attempt=getattr(msg, "attempt", 0),
                     result=-28, epoch=self.osdmap.epoch
                     if self.osdmap else 0, data=b"", extra=""))
+                return True
+            if pg.merge_ready():
+                # merge-source quiesce (ref: the not-ready-to-merge op
+                # block): once a source reported ready, NEW client ops
+                # park via backoff until the pg_num decrease commits —
+                # the parked client then retargets the merged parent.
+                # This is the data-safety invariant's "parked" half;
+                # ops admitted before readiness land in the log and
+                # fold into the parent ("land in the merged parent").
+                await pg.send_backoff(msg)
                 return True
             queue_cap = int(
                 self.config.get("osd_pg_op_queue_cap", 512))
@@ -770,6 +858,10 @@ class OSD(Dispatcher):
                 await asyncio.sleep(self.stats_interval)
                 if self.osdmap is None:
                     continue
+                # keep subscriptions alive even with nothing to report
+                # (2s-throttled, background): our session mon may have
+                # died/been removed, taking the subs with it
+                self.monc.renew_subs()
                 stats = {p: json.dumps(pg.stats()).encode()
                          for p, pg in self.pgs.items()
                          if pg.is_primary()}
@@ -793,6 +885,17 @@ class OSD(Dispatcher):
                     used_bytes=used, capacity_bytes=cap))
                 self._slow_reported = slow
                 self._statfs_reported = cap
+                # merge readiness barrier: re-reported EVERY tick
+                # while the decrease is pending, so a mon leader
+                # change can't lose the barrier state
+                from ceph_tpu.mon.messages import MOSDPGReadyToMerge
+                for pg in list(self.pgs.values()):
+                    if pg.merge_ready():
+                        await self.monc.send_report(
+                            MOSDPGReadyToMerge(
+                                pgid=pg.cid, epoch=self.osdmap.epoch,
+                                from_osd=self.whoami,
+                                pending=pg.pool.pg_num_pending))
         except asyncio.CancelledError:
             pass
 

@@ -187,6 +187,11 @@ class PG:
         self.scrub_errors = 0
         self.last_scrub = 0.0
         self._scrubber = None
+        # set by merge_from: the parent absorbed a source's objects +
+        # log — the next advance() must re-peer even though the acting
+        # set may be unchanged, so replicas reconcile any divergence
+        # the folded logs carry
+        self._force_repeer = False
         self._ensure_collection()
         self._load_meta()
 
@@ -289,8 +294,10 @@ class PG:
         self.acting = acting
         self.primary = primary
         self.epoch = epoch
-        if not changed and self.role_active():
+        if not changed and self.role_active() and \
+                not self._force_repeer:
             return
+        self._force_repeer = False
         if changed:
             # interval actually ended: stop any backfill run and free
             # its reservations. NOT on mere epoch bumps — a replica
@@ -536,6 +543,13 @@ class PG:
             from ceph_tpu.mon.messages import MOSDAlive
             await self.osd.monc.send_report(MOSDAlive(
                 osd=self.osd.whoami, epoch=self.interval_start))
+            # re-want the map stream explicitly: the grant may ALREADY
+            # be committed (the mon dedupes re-requests, so no new inc
+            # will ever be published for it) with the publish lost to
+            # a dropped subscription — without this re-subscribe the
+            # retry loop below waits forever on a map that will never
+            # arrive
+            await self.osd.monc.subscribe("osdmap", om.epoch + 1)
             self.state = "peering"    # retry once the grant's map lands
             self.osd.request_repeer(self, delay=0.3)
             return
@@ -1103,6 +1117,122 @@ class PG:
                         f"{sum(len(c.entries) for c in child_logs.values())} "
                         f"log entries (pg_num -> {new_pool.pg_num})")
         return touched
+
+    # -- pg merging (round 6: the inverse of split) ------------------------
+    def is_merge_source(self) -> bool:
+        """This PG is folded away by the pool's pending pg_num
+        decrease (ref: pg_t::is_merge_source)."""
+        return self.pool.is_merge_source(self.pgid.seed)
+
+    def merge_ready(self) -> bool:
+        """Quiesce barrier (ref: PeeringState ready_to_merge): a
+        source is ready once it is CLEAN at the folded placement —
+        pgp_num dropped with the pg_num_pending commit, so clean means
+        the source already sits on its fold target's OSDs. From this
+        moment new client ops are backed off (see OSD.ms_dispatch), so
+        the store+log contents the fold will move are frozen modulo
+        already-admitted ops, every one of which lands in the log and
+        therefore in the merged parent."""
+        return self.is_merge_source() and self.is_primary() and \
+            self.state == "clean"
+
+    def _stop_tasks(self) -> None:
+        """Tear down a source PG's machinery before the fold."""
+        if self._worker:
+            self._worker.cancel()
+            self._worker = None
+            self._drain_op_queue()
+        if self._peering_task:
+            self._peering_task.cancel()
+            self._peering_task = None
+        self._cancel_backfill()
+
+    def merge_from(self, source: "PG") -> None:
+        """Fold ``source``'s collection back into this (parent) PG:
+        objects, log entries and versions move; the source collection
+        is removed (ref: PG::merge_from + PGLog merge on pg_num
+        decrease).
+
+        Runs on every OSD holding the source collection, off the SAME
+        committed map, with the same deterministic fold — so replicas
+        stay consistent, exactly like split_objects in reverse. The
+        log merge dedups by (epoch, v, oid) (crash-idempotent: a
+        crash between the parent meta persisting and the source
+        collection removal re-runs the fold with the entries already
+        present) and the parent re-peers afterwards so any divergence
+        a replica's folded log carries is reconciled by the normal
+        missing-set machinery."""
+        store = self.osd.store
+        source.release_backoffs()
+        source._stop_tasks()
+        self._clone_idx = None
+        moved = 0
+        if source.cid in store.list_collections():
+            for oid in list(store.list_objects(source.cid)):
+                if oid == PGMETA:
+                    continue
+                try:
+                    data = store.read(source.cid, oid)
+                    attrs = store.getattrs(source.cid, oid)
+                    omap = store.omap_get(source.cid, oid)
+                except StoreError:
+                    continue
+                t = Transaction()
+                t.touch(self.cid, oid)
+                if data:
+                    t.write(self.cid, oid, 0, data)
+                if attrs:
+                    t.setattrs(self.cid, oid, attrs)
+                if omap:
+                    t.omap_setkeys(self.cid, oid, omap)
+                t.remove(source.cid, oid)
+                store.queue_transaction(t)
+                moved += 1
+        # merge the source's log (same dedup discipline as
+        # split_objects' child_seen): without it a replica that held
+        # the only copy of a source write would fold a log nobody
+        # compares, and the write could be silently dropped
+        seen = {(e.version.epoch, e.version.v, e.oid)
+                for e in self.pg_log.entries}
+        folded = 0
+        for entry in source.pg_log.entries:
+            key = (entry.version.epoch, entry.version.v, entry.oid)
+            if key in seen:
+                continue
+            seen.add(key)
+            self.pg_log.entries.append(entry)
+            folded += 1
+        if folded:
+            self.pg_log.entries.sort(
+                key=lambda en: (en.version.epoch, en.version.v))
+            self.pg_log.head = self.pg_log.entries[-1].version
+        # horizon: the merged log's tail is the YOUNGER of the two —
+        # claiming the older horizon would promise log-delta recovery
+        # for history only one half retains (conservative: peers below
+        # it backfill, which is always safe)
+        if source.pg_log.tail > self.pg_log.tail:
+            self.pg_log.tail = source.pg_log.tail
+        self.last_user_version = max(self.last_user_version,
+                                     source.last_user_version,
+                                     self.pg_log.head.v)
+        # an incomplete party taints the merged watermark (upstream
+        # marks the merged PG for backfill; the readiness barrier
+        # makes this the crash-race path, not the normal one)
+        if source.last_backfill != MAX_OID:
+            self.last_backfill = min(self.last_backfill,
+                                     source.last_backfill)
+        try:
+            self.osd.store.queue_transaction(
+                self._meta_txn(Transaction()))
+            if source.cid in store.list_collections():
+                store.queue_transaction(
+                    Transaction().remove_collection(source.cid))
+        except StoreError as e:
+            log.error(f"pg {self.pgid} merge meta persist failed: {e}")
+        self._force_repeer = True
+        log.dout(1, f"pg {self.pgid} absorbed {source.pgid}: "
+                    f"{moved} objects, {folded} log entries "
+                    f"(pg_num -> {self.pool.pg_num})")
 
     # -- recovery ----------------------------------------------------------
     async def _pull(self, from_osd: int, oid: str) -> None:
@@ -2235,6 +2365,12 @@ class PG:
                "acting": self.acting, "up": self.up,
                "last_update": str(self.pg_log.head),
                "scrub_errors": self.scrub_errors}
+        if self.is_merge_source():
+            # merge progress rides MPGStats into pg dump / status
+            out["merge"] = {"pending": self.pool.pg_num_pending,
+                            "target": self.pool.merge_target(
+                                self.pgid.seed),
+                            "ready": int(self.merge_ready())}
         if self.backfill_targets or \
                 self.last_backfill != MAX_OID or \
                 self.backfill_stats["pushed"] or \

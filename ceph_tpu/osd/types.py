@@ -117,6 +117,13 @@ class PGPool:
     # FLAG_POOL_FULL_QUOTA in the next incremental.
     quota_bytes: int = 0
     quota_objects: int = 0
+    # PG merge barrier (ref: pg_pool_t::pg_num_pending): a pg_num
+    # DECREASE commits in two phases — first pg_num_pending (+ the
+    # pgp_num fold, so sources migrate onto their fold targets), then
+    # pg_num itself once every source PG has quiesced and reported
+    # ready-to-merge. 0 = no merge pending. Placement NEVER reads this
+    # field — clients keep folding by pg_num until the decrease lands.
+    pg_num_pending: int = 0
 
     def __post_init__(self) -> None:
         if self.pgp_num is None:
@@ -126,6 +133,19 @@ class PGPool:
         """Writes to this pool must park/fail (ref: pg_pool_t::has_flag
         FLAG_FULL|FLAG_FULL_QUOTA checks in Objecter::target_should_be_paused)."""
         return bool(self.flags & (FLAG_POOL_FULL | FLAG_POOL_FULL_QUOTA))
+
+    def is_merge_source(self, seed: int) -> bool:
+        """Is this PG folded away by the pending pg_num decrease?
+        (ref: pg_t::is_merge_source)"""
+        return bool(self.pg_num_pending) and seed >= self.pg_num_pending
+
+    def merge_target(self, seed: int) -> int:
+        """The parent a merge-source seed folds into at pg_num_pending
+        (ref: pg_t::get_parent under the stable-mod fold)."""
+        assert self.pg_num_pending
+        return int(ceph_stable_mod(seed, self.pg_num_pending,
+                                   calc_mask(self.pg_num_pending),
+                                   xp=None))
 
     # -- masks ------------------------------------------------------------
     @property

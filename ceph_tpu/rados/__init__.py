@@ -72,6 +72,12 @@ class Rados:
 
     async def connect(self) -> None:
         await self.monc.subscribe("osdmap", 0)
+        # follow the monmap (round 6: membership changes at runtime)
+        # and our own key lifecycle (rotation reaches us even with a
+        # private keyring file)
+        await self.monc.subscribe("monmap", 0)
+        if self.monc.msgr.keyring is not None:
+            await self.monc.subscribe("keyring", 0)
         await self.monc.wait_for_osdmap()
 
     async def shutdown(self) -> None:

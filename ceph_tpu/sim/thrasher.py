@@ -459,6 +459,155 @@ class Thrasher:
         return {"capacity": capacity, "acked_writes": len(self.acked),
                 "parked_at_full": parked, "errors": len(errors)}
 
+    async def elastic_storm(self, io, writes: int = 30,
+                            pool: str | None = None,
+                            mon_cycle: bool = True,
+                            auth_cycle: bool = True,
+                            split_merge: bool = True,
+                            phase_timeout: float = 60.0) -> dict:
+        """The elastic-control-plane storm (the round-6 acceptance
+        shape): while a background writer keeps acking unique-oid
+        writes, the cluster is grown and shrunk at RUNTIME —
+
+        1. mon membership: add a mon (quorum re-forms over 3), kill
+           the leader (re-election among the 3-member map), then
+           `mon rm` the corpse back to a 2-mon map — commands and
+           writes keep flowing throughout;
+        2. auth lifecycle: `auth get-or-create` provisions a fresh
+           client that serves I/O, `auth rotate` re-keys the admin
+           entity under live traffic, and `auth rm` fences the fresh
+           client — its open session drops and new handshakes are
+           refused while the rotated admin keeps writing;
+        3. pg topology: the loaded pool splits (pg_num up + pgp ramp)
+           and then MERGES back through the pg_num_pending readiness
+           barrier, with the writer racing the quiesce window.
+
+        ``writes`` caps the background writer's target (smoke budgets
+        per tests/test_meta.py). Finish with ``settle_and_verify`` —
+        every acked write must be readable bit-identical on a clean
+        cluster afterwards.
+        """
+        c = self.c
+        pool = pool or io.pool_name
+        results: dict = {"phases": []}
+        self._writer_task = asyncio.ensure_future(self._writer(io))
+        try:
+            if mon_cycle:
+                n0 = len(c.monmap.mons)
+                mon = await c.add_mon()
+                await c.wait_for_quorum(n0 + 1,
+                                        timeout=phase_timeout)
+                self._log(f"elastic: mon.{mon.name} added; quorum "
+                          f"{n0 + 1}")
+                killed = await c.kill_mon_leader()
+                assert killed is not None, \
+                    "no killable leader with 3 mons"
+                c.mons.remove(killed)
+                self.killed_mons += 1
+                await c.wait_for_quorum(n0, timeout=phase_timeout)
+                self._log(f"elastic: leader mon.{killed.name} killed; "
+                          f"re-elected among survivors")
+                await c.rm_mon(killed.name, timeout=phase_timeout)
+                self._log(f"elastic: mon.{killed.name} removed; "
+                          f"monmap back to {len(c.monmap.mons)}")
+                results["phases"].append("mon_cycle")
+            if auth_cycle:
+                import json as _json
+
+                from ceph_tpu.msg import Keyring as _Keyring
+                from ceph_tpu.rados import Rados as _Rados
+                ret, rs, out = await c.client.mon_command(
+                    {"prefix": "auth get-or-create",
+                     "entity": "client.elastic"})
+                assert ret == 0, rs
+                key = bytes.fromhex(_json.loads(out)["key"])
+                fresh = _Rados(c.monmap, name="client.elastic",
+                               keyring=_Keyring(
+                                   {"client.elastic": key}))
+                await fresh.connect()
+                fio = await fresh.open_ioctx(pool)
+                await fio.write_full("elastic-fresh", b"provisioned",
+                                     timeout=self.write_timeout)
+                self.acked["elastic-fresh"] = b"provisioned"
+                ret, rs, _ = await c.client.mon_command(
+                    {"prefix": "auth rotate",
+                     "entity": "client.admin"})
+                assert ret == 0, rs
+                # the admin's LIVE session must keep serving after its
+                # key rotated (re-keyed in-band, not re-authed)
+                await io.write_full("elastic-after-rotate", b"live",
+                                    timeout=self.write_timeout)
+                self.acked["elastic-after-rotate"] = b"live"
+                ret, rs, _ = await c.client.mon_command(
+                    {"prefix": "auth rm",
+                     "entity": "client.elastic"})
+                assert ret == 0, rs
+                fenced = False
+                try:
+                    await fio.write_full("elastic-after-revoke",
+                                         b"nope", timeout=4.0)
+                except Exception:
+                    fenced = True
+                assert fenced, ("revoked client.elastic still "
+                                "serves I/O")
+                await fresh.shutdown()
+                self._log("elastic: key provisioned, rotated (live "
+                          "session survived), revoked (fenced)")
+                results["phases"].append("auth_cycle")
+            if split_merge:
+                ret, _, out = await c.client.mon_command(
+                    {"prefix": "osd dump"})
+                import json as _json
+                pinfo = next(p for p in _json.loads(out)["pools"]
+                             if p["name"] == pool)
+                pg0 = pinfo["pg_num"]
+                await self._pool_set(pool, "pg_num", pg0 * 2)
+                await c.wait_for_clean(timeout=phase_timeout * 2)
+                await self._pool_set(pool, "pgp_num", pg0 * 2)
+                await c.wait_for_clean(timeout=phase_timeout * 2)
+                self._log(f"elastic: pool {pool} split "
+                          f"{pg0} -> {pg0 * 2} + migrated")
+                await self._pool_set(pool, "pg_num", pg0)
+                deadline = asyncio.get_event_loop().time() + \
+                    phase_timeout * 2
+                while True:
+                    ret, _, out = await c.client.mon_command(
+                        {"prefix": "osd dump"})
+                    pinfo = next(p for p in _json.loads(out)["pools"]
+                                 if p["name"] == pool)
+                    if pinfo["pg_num"] == pg0 and \
+                            not pinfo["pg_num_pending"]:
+                        break
+                    assert asyncio.get_event_loop().time() < \
+                        deadline, f"merge never committed: {pinfo}"
+                    await asyncio.sleep(0.2)
+                self._log(f"elastic: pool {pool} merged back to "
+                          f"{pg0} under load")
+                results["phases"].append("split_merge")
+            # let the writer reach its budget so the storm proves
+            # sustained I/O across every transition
+            deadline = asyncio.get_event_loop().time() + phase_timeout
+            while len(self.acked) < writes and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.1)
+        finally:
+            self._writer_task.cancel()
+            try:
+                await self._writer_task
+            except asyncio.CancelledError:
+                pass
+        results["acked_writes"] = len(self.acked)
+        results["failed_writes"] = self._write_errors
+        self._log(f"elastic: {len(self.acked)} acked, "
+                  f"{self._write_errors} transient failures")
+        return results
+
+    async def _pool_set(self, pool: str, var: str, val: int) -> None:
+        ret, rs, _ = await self.c.client.mon_command(
+            {"prefix": "osd pool set", "pool": pool, "var": var,
+             "val": str(val)})
+        assert ret == 0, f"pool set {var}={val}: {rs}"
+
     async def mds_storm(self, fs_clients, writes: int = 24,
                         files_before_kill: int = 4,
                         kills: int = 1,

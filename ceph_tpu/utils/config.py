@@ -157,6 +157,33 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("mds_session_timeout", float, 10.0,
            "client cap-lease length advertised at session open",
            min=0.1),
+    # elastic control plane (round 6; ref: mon.yaml.in mon options +
+    # the pg_autoscaler module's threshold): runtime monmap
+    # membership, AuthMonitor key lifecycle, LogMonitor retention and
+    # the PG merge barrier.
+    Option("mon_allow_pg_merge", bool, True,
+           "accept pg_num decreases (two-phase merge through "
+           "pg_num_pending); false reproduces the seed's "
+           "grow-only autoscaler"),
+    Option("autoscaler_shrink_threshold", int, 4,
+           "pg_autoscaler proposes a merge when pg_num exceeds the "
+           "recommendation by this factor (the over-split bar)",
+           min=2),
+    Option("mon_merge_ready_window", float, 2.0,
+           "seconds a source PG's ready-to-merge report stays live; "
+           "sources re-report every stats tick while ready, so a "
+           "degraded source ages out of the barrier", min=0.5),
+    Option("mon_log_max", int, 500,
+           "cluster-log entries the LogMonitor retains (older are "
+           "trimmed with each append)", min=10),
+    Option("mon_auth_revoke_warn_s", float, 300.0,
+           "seconds a revoked key stays in the AUTH_KEY_REVOKED "
+           "health warning (the log keeps the permanent record)",
+           min=0.0),
+    Option("mon_election_timeout", float, 0.3,
+           "election round length before victory/retry"),
+    Option("mon_lease", float, 2.0,
+           "peon lease length; expiry calls an election"),
     # CRUSH tunables defaults (jewel profile; ref: src/crush/CrushWrapper.h
     # set_tunables_jewel).
     Option("crush_choose_total_tries", int, 50, "descent retry budget"),

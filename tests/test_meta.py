@@ -38,6 +38,7 @@ STORM_BUDGETS = {
     "backfill_storm": {"writes": 60, "partitions": 2},
     "overload_storm": {"writers": 4, "prefill": 32, "hold_s": 1.0},
     "mds_storm": {"writes": 24, "kills": 1},
+    "elastic_storm": {"writes": 40},
 }
 BUILTIN_MARKS = {
     "parametrize", "skip", "skipif", "xfail", "usefixtures",

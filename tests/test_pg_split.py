@@ -77,9 +77,13 @@ def test_split_populated_pool_and_pgp_migration():
     run(go())
 
 
-def test_pg_num_decrease_rejected():
+def test_pg_num_decrease_gated_by_knob():
+    """Round 6: pg_num decreases are MERGES now (tests/test_pg_merge
+    .py) — but `mon_allow_pg_merge=false` reproduces the old
+    grow-only behavior, and pgp_num still can't exceed pg_num."""
     async def go():
-        c = await Cluster(n_mons=1, n_osds=3).start()
+        c = await Cluster(n_mons=1, n_osds=3,
+                          config={"mon_allow_pg_merge": False}).start()
         try:
             await c.client.pool_create("data", pg_num=8, size=2)
             ret, rs, _ = await c.client.mon_command(
