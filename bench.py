@@ -168,6 +168,67 @@ def mapping_engine_metric() -> dict:
             "cache_misses": m.mapping_cache_misses}
 
 
+def mds_metric() -> dict:
+    """Round-7 metadata plane: aggregate + per-rank metadata ops/s at
+    N = 1/2/4 active MDS ranks. FIXED client parallelism (4 writers,
+    each its own client + subtree) distributed round-robin across the
+    ranks, so the rows isolate rank scaling rather than client
+    scaling — per rank, mutations serialize on that rank's journal
+    object (per-object PG pipeline), which is exactly the contention
+    multi-active relieves. The number that must move: aggregate ops/s
+    increasing 1 -> 2 actives (rank-scaling regressions show here)."""
+    import asyncio
+
+    async def one(n_active: int, writers: int = 4,
+                  ops_per_writer: int = 24) -> dict:
+        from ceph_tpu.cephfs.client import CephFSClient
+        from ceph_tpu.cluster.vstart import Cluster
+        c = await Cluster(n_mons=1, n_osds=3,
+                          config={"mds_bal_interval": 0.0}).start()
+        try:
+            await c.start_fs(n_mds=n_active, max_mds=n_active,
+                             timeout=120)
+            monmap = c.client.monc.monmap
+            cl0 = await CephFSClient.create(monmap, None, "cephfs",
+                                            keyring=c.keyring)
+            for w in range(writers):
+                await cl0.mkdir(f"/d{w}")
+                if w % n_active:
+                    await c.subtree_pin(f"/d{w}", w % n_active)
+            clients = [cl0] + [
+                await CephFSClient.create(monmap, None, "cephfs",
+                                          keyring=c.keyring)
+                for _ in range(1, writers)]
+
+            async def load(w: int, cl) -> float:
+                t0 = time.perf_counter()
+                for i in range(ops_per_writer):
+                    await cl.write_file(f"/d{w}/bench-{i}",
+                                        b"x" * 64)
+                return ops_per_writer / (time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rates = await asyncio.gather(
+                *[load(w, cl) for w, cl in enumerate(clients)])
+            wall = time.perf_counter() - t0
+            per_rank: dict[str, float] = {}
+            for w, rate in enumerate(rates):
+                r = str(w % n_active)
+                per_rank[r] = round(per_rank.get(r, 0.0) + rate, 1)
+            for cl in clients:
+                await cl.unmount()
+            return {
+                "ops": writers * ops_per_writer,
+                "writers": writers,
+                "aggregate_ops_per_s": round(
+                    writers * ops_per_writer / wall, 1),
+                "per_rank_ops_per_s": per_rank,
+            }
+        finally:
+            await c.stop()
+
+    return {f"max_mds_{n}": asyncio.run(one(n)) for n in (1, 2, 4)}
+
+
 def main() -> None:
     enc, dec, stream = ec_metrics()
     detail = {
@@ -215,6 +276,10 @@ def main() -> None:
         detail["mapping_engine"] = mapping_engine_metric()
     except Exception:
         detail["mapping_engine_error"] = _short_err()
+    try:
+        detail["mds"] = mds_metric()
+    except Exception:
+        detail["mds_error"] = _short_err()
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),

@@ -11,6 +11,8 @@ through MonClient, mirroring the reference's command spellings:
     ... osd blocklist add|rm <entity> [expire-s] | osd blocklist ls
     ... pg repair <pgid>
     ... fs status | fs dump | mds fail <name-or-gid>
+    ... fs set max_mds <n> | fs subtree pin <path> <rank>
+    ... fs subtree ls
     ... osd map <pool> <object>
     ... osd erasure-code-profile set <name> k=2 m=1 ...
     ... config set <who> <name> <value> | config get <who> [<name>]
@@ -80,6 +82,15 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
         return {"prefix": "log", "logtext": " ".join(w[1:])}, b""
     if w[:2] == ["mds", "fail"]:
         return {"prefix": "mds fail", "who": w[2]}, b""
+    if w[:2] == ["fs", "set"]:
+        # ceph fs set max_mds <n> — open/retire active ranks
+        return {"prefix": "fs set", "var": w[2], "val": w[3]}, b""
+    if w[:3] == ["fs", "subtree", "pin"]:
+        # ceph fs subtree pin <path> <rank> — migrate subtree authority
+        return {"prefix": "fs subtree pin", "path": w[3],
+                "rank": int(w[4])}, b""
+    if w[:3] == ["fs", "subtree", "ls"]:
+        return {"prefix": "fs subtree ls"}, b""
     if w[:3] == ["osd", "pool", "create"]:
         cmd = {"prefix": "osd pool create", "pool": w[3]}
         if len(w) > 4:

@@ -12,12 +12,22 @@ semantics across clients.
 
 HA (round 6): a client mounted without a pinned MDS address
 (``create(monmap, None, pool)``) subscribes to the **mdsmap** and
-targets whatever daemon the FSMap says holds rank 0. On failover it
+targets whatever daemons the FSMap says hold ranks. On failover it
 sends MClientReconnect to the successor — replaying its session and
 every live cap claim (ref: Client::send_reconnect) — and resends any
 request that never got a reply (op replay; the MDS's completed-request
 table dedups mutations that DID land before the crash). Requests
 issued while no active exists park until the ladder finishes.
+
+Multi-active routing (round 7, ref: Client::choose_target_mds + the
+request-forwarding dance): every request is routed to the rank the
+FSMap's subtree map says owns its path (longest-prefix match), with
+sessions opened lazily per rank. A rank that does NOT own the path
+answers -ESTALE naming the owner; the client records the redirect as
+a routing hint (it may be ahead of its fsmap) and resends — hints are
+retired once an fsmap that agrees arrives. Failover, reconnect, and
+op-replay all run PER RANK, so one rank's takeover never stalls I/O
+the other ranks are serving.
 """
 
 from __future__ import annotations
@@ -30,7 +40,7 @@ from ceph_tpu.cephfs.fsmap import (
     FSMap, STATE_ACTIVE, STATE_RECONNECT, STATE_REJOIN,
 )
 from ceph_tpu.cephfs.mds import (
-    CAP_FR, CAP_FW, CAP_OP_ACK, CAP_OP_RELEASE, CAP_OP_REVOKE,
+    CAP_FR, CAP_FW, CAP_OP_ACK, CAP_OP_RELEASE, CAP_OP_REVOKE, ESTALE,
     MClientCaps, MClientReconnect, MClientReply, MClientRequest,
     MClientSession, RECONNECT_ACK, RECONNECT_REQ,
     SESSION_CLOSE, SESSION_OPEN, SESSION_RENEW,
@@ -42,8 +52,12 @@ from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("cephfs.client")
 
-# fsmap states in which the rank holder accepts MClientReconnect
+# fsmap states in which a rank holder accepts MClientReconnect
 _RECONNECTABLE = (STATE_RECONNECT, STATE_REJOIN, STATE_ACTIVE)
+
+# redirect chains longer than this mean the map is flapping under us;
+# surface it instead of spinning
+_MAX_REDIRECTS = 16
 
 
 class FileHandle:
@@ -129,7 +143,7 @@ class CephFSClient(Dispatcher):
                  messenger: Messenger | None = None):
         CephFSClient._next_id += 1
         self.ioctx = ioctx
-        self.mds_addr = mds_addr       # None until the fsmap names one
+        self.mds_addr = mds_addr       # pinned addr, or rank 0's (HA)
         self.msgr = messenger or Messenger(
             f"client.fs{CephFSClient._next_id}")
         self.msgr.add_dispatcher(self)
@@ -142,31 +156,50 @@ class CephFSClient(Dispatcher):
         self._own_rados = None          # set by create(): owned identity
         self.lease_interval = 3.0       # renew beat; the OPEN ack's
                                         # advertised lease overrides it
-        # HA state: fsmap-following mode (mds_addr resolved at runtime)
+        # HA state: fsmap-following mode (addresses resolved at runtime)
         self._ha = mds_addr is None
         self.fsmap: FSMap | None = None
+        # -- per-rank session state (round 7) --------------------------
+        # rank -> current target address (the rank holder's)
+        self._rank_addrs: dict[int, "object"] = {}
+        # rank -> Event set while the rank is targetable; requests for
+        # a rank mid-failover park on it (rank 0's doubles as the
+        # mount gate)
         self._active_event = asyncio.Event()
+        self._rank_events: dict[int, asyncio.Event] = {
+            0: self._active_event}
+        # ranks with an OPEN session; sessions open lazily per rank
+        self._open_ranks: set[int] = set()
+        # per-rank incarnation: bumped on every (re)established
+        # session; _request resends exactly once per (rank,
+        # incarnation) — op replay without duplicate sends to a
+        # live-but-slow MDS
+        self._rank_inc: dict[int, int] = {}
+        # redirect-learned routing hints (subtree root -> rank):
+        # a -ESTALE reply can be AHEAD of our fsmap; retired once an
+        # fsmap that agrees arrives
+        self._hints: dict[str, int] = {}
+        self._session_lock = asyncio.Lock()     # one OPEN in flight
+        self._reconnect_lock = asyncio.Lock()   # one rank reconnects
+        self._reconnecting: set[int] = set()
+        self._reconnect_fut: asyncio.Future | None = None
         if not self._ha:
             self._active_event.set()
-        # bumped on every (re)established MDS session; _request resends
-        # exactly once per incarnation (op replay without duplicate
-        # sends to a live-but-slow MDS)
-        self._incarnation = 0
-        self._reconnecting = False
-        self._reconnect_fut: asyncio.Future | None = None
+            self._rank_addrs[0] = mds_addr
 
     @classmethod
     async def create(cls, monmap, mds_addr, pool: str,
                      keyring=None) -> "CephFSClient":
         """Mount with an OWN RADOS identity — the libcephfs model: ONE
-        entity name carries both the MDS session and the data-path ops,
-        so an MDS eviction's osd blocklist actually fences this
+        entity name carries both the MDS sessions and the data-path
+        ops, so an MDS eviction's osd blocklist actually fences this
         client's data writes (data I/O through a shared admin ioctx
         would dodge the fence).
 
         ``mds_addr=None`` mounts in **HA mode**: the client subscribes
-        to the mdsmap through its own MonClient and follows rank 0's
-        holder across failovers instead of pinning one address."""
+        to the mdsmap through its own MonClient and follows every
+        rank's holder across failovers and subtree migrations instead
+        of pinning one address."""
         from ceph_tpu.rados import Rados
         CephFSClient._next_id += 1
         name = f"client.fs{CephFSClient._next_id}"
@@ -200,48 +233,79 @@ class CephFSClient(Dispatcher):
     async def mount(self) -> "CephFSClient":
         if self._ha:
             await self._wait_active(timeout=30.0)
-        await self._open_session()
-        self._incarnation += 1
+            await self._ensure_session(0, timeout=30.0)
+        else:
+            await self._open_session(0, self.mds_addr)
         # cap-lease heartbeat (ref: Client::renew_caps): without it the
         # MDS evicts us the moment a revoke finds our lease stale.
         self._renew_task = asyncio.ensure_future(self._renew_loop())
         return self
 
-    async def _wait_active(self, timeout: float) -> None:
+    async def _wait_active(self, timeout: float,
+                           rank: int = 0) -> None:
+        ev = self._rank_events.setdefault(rank, asyncio.Event())
         try:
-            await asyncio.wait_for(self._active_event.wait(),
-                                   timeout=timeout)
+            await asyncio.wait_for(ev.wait(), timeout=timeout)
         except asyncio.TimeoutError:
-            raise FSError(-110, "no active mds") from None
+            raise FSError(-110, f"no active mds for rank {rank}") \
+                from None
 
-    async def _open_session(self) -> None:
-        self._session_fut = asyncio.get_event_loop().create_future()
-        await self.msgr.send_message(
-            MClientSession(op=SESSION_OPEN, cseq=0), self.mds_addr,
-            "mds")
-        ack = await asyncio.wait_for(self._session_fut, timeout=10)
-        # the OPEN ack advertises the MDS lease (ms); renew at a third
-        # of it so a short-leased MDS never sees a live client go stale
-        if getattr(ack, "cseq", 0):
-            self.lease_interval = max(0.05, ack.cseq / 3000.0)
+    async def _open_session(self, rank: int, addr) -> None:
+        """One OPEN round-trip to ``addr``; on ack the rank is usable
+        (serialized — replies carry no tid, so one OPEN at a time)."""
+        async with self._session_lock:
+            if rank in self._open_ranks and \
+                    self._rank_addrs.get(rank) is addr:
+                return
+            self._session_fut = \
+                asyncio.get_event_loop().create_future()
+            await self.msgr.send_message(
+                MClientSession(op=SESSION_OPEN, cseq=0), addr, "mds")
+            ack = await asyncio.wait_for(self._session_fut, timeout=10)
+            # the OPEN ack advertises the MDS lease (ms); renew at a
+            # third of it so a short-leased MDS never sees a live
+            # client go stale
+            if getattr(ack, "cseq", 0):
+                self.lease_interval = max(0.05, ack.cseq / 3000.0)
+            self._rank_addrs[rank] = addr
+            self._open_ranks.add(rank)
+            self._rank_inc[rank] = self._rank_inc.get(rank, 0) + 1
+            if rank == 0:
+                self.mds_addr = addr
+            self._rank_events.setdefault(rank, asyncio.Event()).set()
+
+    async def _ensure_session(self, rank: int,
+                              timeout: float = 10.0) -> None:
+        """Open a session with ``rank`` if we don't have one (sessions
+        are lazy: only ranks the subtree map actually routes us to get
+        one — ref: Client opening sessions per chosen MDS)."""
+        if rank in self._open_ranks:
+            return
+        await self._wait_active(timeout, rank)
+        addr = self._rank_addrs.get(rank)
+        if addr is None:
+            raise FSError(-110, f"rank {rank} has no address")
+        await self._open_session(rank, addr)
 
     async def _renew_loop(self) -> None:
         try:
             while True:
                 await asyncio.sleep(self.lease_interval)
-                if self.mds_addr is None:
-                    continue
-                try:
-                    await self.msgr.send_message(
-                        MClientSession(op=SESSION_RENEW, cseq=0),
-                        self.mds_addr, "mds")
-                except (ConnectionError, OSError, ConnectionError_):
-                    # transient (e.g. injected socket failure or a
-                    # mid-failover window): a missed beat must NOT end
-                    # the heartbeat — a silently dead renew loop gets
-                    # a perfectly live client evicted and blocklisted
-                    # at the next revoke
-                    continue
+                for rank in sorted(self._open_ranks):
+                    addr = self._rank_addrs.get(rank)
+                    if addr is None:
+                        continue
+                    try:
+                        await self.msgr.send_message(
+                            MClientSession(op=SESSION_RENEW, cseq=0),
+                            addr, "mds")
+                    except (ConnectionError, OSError,
+                            ConnectionError_):
+                        # transient (injected fault or mid-failover):
+                        # a missed beat must NOT end the heartbeat — a
+                        # silently dead renew loop gets a perfectly
+                        # live client evicted at the next revoke
+                        continue
         except asyncio.CancelledError:
             pass
 
@@ -252,18 +316,25 @@ class CephFSClient(Dispatcher):
         for hs in list(self._handles.values()):   # close() mutates the
             for h in list(hs):                    # dict and the lists
                 await h.close()
-        try:
-            self._session_fut = \
-                asyncio.get_event_loop().create_future()
-            await self.msgr.send_message(
-                MClientSession(op=SESSION_CLOSE, cseq=0),
-                self.mds_addr, "mds")
-            await asyncio.wait_for(self._session_fut, timeout=10)
-        except (ConnectionError, OSError, ConnectionError_,
-                asyncio.TimeoutError) as e:
-            # best effort: the MDS may be mid-failover/dead; its
-            # session-table grace machinery reaps us server-side
-            log.dout(1, f"session close skipped: {e!r}")
+        for rank in sorted(self._open_ranks):
+            addr = self._rank_addrs.get(rank)
+            if addr is None:
+                continue
+            try:
+                async with self._session_lock:
+                    self._session_fut = \
+                        asyncio.get_event_loop().create_future()
+                    await self.msgr.send_message(
+                        MClientSession(op=SESSION_CLOSE, cseq=0),
+                        addr, "mds")
+                    await asyncio.wait_for(self._session_fut,
+                                           timeout=10)
+            except (ConnectionError, OSError, ConnectionError_,
+                    asyncio.TimeoutError) as e:
+                # best effort: the MDS may be mid-failover/dead; its
+                # session-table grace machinery reaps us server-side
+                log.dout(1, f"session close (rank {rank}) skipped: "
+                            f"{e!r}")
         await self.msgr.shutdown()
         if self._own_rados is not None:
             await self._own_rados.shutdown()
@@ -297,46 +368,77 @@ class CephFSClient(Dispatcher):
             return True
         return False
 
+    # -- routing (ref: Client::choose_target_mds) --------------------------
+    def _route(self, path: str) -> int:
+        """Owning rank for a normalized path: redirect hints overlay
+        the fsmap's subtree map (a hint can be AHEAD of the map; equal
+        or longer roots win)."""
+        if not self._ha:
+            return 0
+        fm = self.fsmap
+        best_rank, best_root = fm.subtree_owner(path) if fm is not None \
+            else (0, "/")
+        for root, rank in self._hints.items():
+            if (path == root or path.startswith(root + "/")) and \
+                    len(root) >= len(best_root):
+                best_root, best_rank = root, rank
+        return best_rank
+
     # -- failover (ref: Client::handle_mds_map + send_reconnect) ----------
     def _on_fsmap(self, fm: FSMap) -> None:
         if self.fsmap is not None and fm.epoch <= self.fsmap.epoch:
             return
         self.fsmap = fm
-        holder = fm.rank_holder(0)
-        if holder is None or holder.state not in _RECONNECTABLE:
-            # rank failed and no successor far enough up the ladder:
-            # park new requests until one appears
-            if self._incarnation:
-                self._active_event.clear()
-            return
-        addr = holder.addr()
-        if self.mds_addr is not None and \
-                (addr.host, addr.port) == (self.mds_addr.host,
-                                           self.mds_addr.port):
-            self._active_event.set()
-            return
-        if not self._incarnation:
-            # never mounted: just aim at the holder (mount() opens the
-            # session once it is active)
-            if holder.state == STATE_ACTIVE:
-                self.mds_addr = addr
-                self._active_event.set()
-            return
-        self._active_event.clear()
-        asyncio.ensure_future(self._reconnect_loop())
+        # retire hints the authoritative map has caught up with
+        for root in [r for r, rk in self._hints.items()
+                     if fm.subtree_owner(r) == (rk, r)]:
+            self._hints.pop(root, None)
+        holders = fm.rank_holders()
+        for rank in sorted(set(holders) | set(self._rank_addrs)
+                           | self._open_ranks):
+            if not self._ha:
+                break
+            info = holders.get(rank)
+            ev = self._rank_events.setdefault(rank, asyncio.Event())
+            if info is None or info.state not in _RECONNECTABLE:
+                # rank failed / mid-ladder with no reconnectable
+                # successor: park its requests until one appears
+                if rank in self._open_ranks:
+                    ev.clear()
+                continue
+            addr = info.addr()
+            cur = self._rank_addrs.get(rank)
+            if cur is not None and (addr.host, addr.port) == \
+                    (cur.host, cur.port):
+                ev.set()
+                continue
+            if rank not in self._open_ranks:
+                # no session yet: just aim (a session opens lazily the
+                # first time a request routes here)
+                if info.state == STATE_ACTIVE:
+                    self._rank_addrs[rank] = addr
+                    if rank == 0:
+                        self.mds_addr = addr
+                    ev.set()
+                continue
+            # holder changed for a rank we hold a session with:
+            # reconnect (cap replay) against the successor
+            ev.clear()
+            asyncio.ensure_future(self._reconnect_rank(rank))
 
-    async def _reconnect_loop(self) -> None:
-        """Re-establish the session against whatever daemon currently
-        holds rank 0: replay cap claims (MClientReconnect), or on
-        reject (session missed the window) re-mount from scratch with
-        every handle invalidated. One loop at a time; each attempt
-        re-reads the fsmap so back-to-back failovers re-aim it."""
-        if self._reconnecting:
+    async def _reconnect_rank(self, rank: int) -> None:
+        """Re-establish this rank's session against whatever daemon
+        now holds it: replay cap claims for the paths the rank serves
+        (MClientReconnect), or on reject (session missed the window)
+        open a fresh session with every affected handle invalidated.
+        One loop per rank at a time; each attempt re-reads the fsmap
+        so back-to-back failovers re-aim it."""
+        if rank in self._reconnecting:
             return
-        self._reconnecting = True
+        self._reconnecting.add(rank)
         try:
             for attempt in range(120):
-                holder = self.fsmap.rank_holder(0) if self.fsmap \
+                holder = self.fsmap.rank_holder(rank) if self.fsmap \
                     else None
                 if holder is None or \
                         holder.state not in _RECONNECTABLE:
@@ -345,6 +447,8 @@ class CephFSClient(Dispatcher):
                 addr = holder.addr()
                 caps = {}
                 for path, hs in self._handles.items():
+                    if self._route(path) != rank:
+                        continue
                     live = [h for h in hs if h.valid]
                     if not live:
                         continue
@@ -353,44 +457,53 @@ class CephFSClient(Dispatcher):
                         "count": len(live),
                         "cseq": max(h.cap_seq for h in live),
                     }).encode()
-                self._reconnect_fut = \
-                    asyncio.get_event_loop().create_future()
-                try:
-                    await self.msgr.send_message(MClientReconnect(
-                        op=RECONNECT_REQ, caps=caps), addr, "mds")
-                    rep = await asyncio.wait_for(self._reconnect_fut,
-                                                 timeout=5.0)
-                except (ConnectionError, OSError, ConnectionError_,
-                        asyncio.TimeoutError):
-                    await asyncio.sleep(0.1)
-                    continue
-                self.mds_addr = addr
+                async with self._reconnect_lock:
+                    self._reconnect_fut = \
+                        asyncio.get_event_loop().create_future()
+                    try:
+                        await self.msgr.send_message(MClientReconnect(
+                            op=RECONNECT_REQ, caps=caps), addr, "mds")
+                        rep = await asyncio.wait_for(
+                            self._reconnect_fut, timeout=5.0)
+                    except (ConnectionError, OSError,
+                            ConnectionError_, asyncio.TimeoutError):
+                        await asyncio.sleep(0.1)
+                        continue
+                self._rank_addrs[rank] = addr
+                if rank == 0:
+                    self.mds_addr = addr
                 if rep.op == RECONNECT_ACK:
-                    log.dout(1, f"reconnected to mds at {addr} "
-                                f"({len(caps)} caps replayed)")
+                    log.dout(1, f"reconnected to rank {rank} at "
+                                f"{addr} ({len(caps)} caps replayed)")
                 else:
                     # session unknown (missed the reconnect window):
-                    # caps are dead — invalidate every handle (next
-                    # I/O reacquires) and open a fresh session
-                    log.dout(1, f"reconnect rejected by {addr}; "
-                                f"re-mounting")
-                    for hs in self._handles.values():
+                    # this rank's caps are dead — invalidate every
+                    # affected handle (next I/O reacquires) and open a
+                    # fresh session
+                    log.dout(1, f"reconnect to rank {rank} rejected "
+                                f"by {addr}; re-opening session")
+                    for path, hs in self._handles.items():
+                        if self._route(path) != rank:
+                            continue
                         for h in hs:
                             h.valid = False
+                    self._open_ranks.discard(rank)
                     try:
-                        await self._open_session()
+                        await self._open_session(rank, addr)
                     except (ConnectionError, OSError,
                             ConnectionError_,
                             asyncio.TimeoutError):
                         await asyncio.sleep(0.1)
                         continue
                 # wake request loops: they resend once per incarnation
-                self._incarnation += 1
-                self._active_event.set()
+                self._open_ranks.add(rank)
+                self._rank_inc[rank] = self._rank_inc.get(rank, 0) + 1
+                self._rank_events.setdefault(
+                    rank, asyncio.Event()).set()
                 return
-            log.dout(0, "mds reconnect gave up after retries")
+            log.dout(0, f"rank {rank} reconnect gave up after retries")
         finally:
-            self._reconnecting = False
+            self._reconnecting.discard(rank)
 
     async def _handle_revoke(self, msg) -> None:
         for h in self._handles.get(msg.path, []):
@@ -403,9 +516,16 @@ class CephFSClient(Dispatcher):
 
     async def _send_caps(self, op: int, path: str, mode: int,
                          seq: int) -> None:
+        rank = self._route(_norm(path))
+        addr = self._rank_addrs.get(rank) or self.mds_addr
+        if addr is None:
+            # rank mid-failover with no successor yet: a RELEASE is
+            # advisory (the MDS reaps dead holders via the cap lease)
+            log.dout(5, f"cap send skipped: no addr for rank {rank}")
+            return
         await self.msgr.send_message(
             MClientCaps(op=op, path=path, mode=mode, cseq=seq),
-            self.mds_addr, "mds")
+            addr, "mds")
 
     # -- requests ----------------------------------------------------------
     async def _request(self, op: str, path: str, path2: str = "",
@@ -413,38 +533,82 @@ class CephFSClient(Dispatcher):
                        timeout: float = 40.0) -> MClientReply:
         self._tid += 1
         tid = self._tid
+        npath = _norm(path)
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
         self._waiters[tid] = fut
         msg = MClientRequest(tid=tid, op=op, path=path, path2=path2,
                              flags=flags)
         deadline = loop.time() + timeout
-        sent_inc = None
+        sent_key = None
+        redirects = 0
         try:
             while True:
                 if fut.done():
                     reply = fut.result()
+                    if self._ha and reply.result == ESTALE:
+                        # redirect: the serving rank named the owner —
+                        # record the hint, re-arm the waiter, resend
+                        # to the right rank (same tid: the redirecting
+                        # rank executed nothing)
+                        redirects += 1
+                        if redirects > _MAX_REDIRECTS:
+                            raise FSError(
+                                ESTALE, f"{op} {path}: redirect loop "
+                                        f"(map flapping?)")
+                        try:
+                            hint = json.loads(reply.payload)
+                            self._hints[str(hint["path"])] = \
+                                int(hint["rank"])
+                        except (json.JSONDecodeError, KeyError,
+                                ValueError, TypeError):
+                            pass
+                        fut = loop.create_future()
+                        self._waiters[tid] = fut
+                        sent_key = None
+                        continue
                     break
                 remaining = deadline - loop.time()
                 if remaining <= 0:
                     raise asyncio.TimeoutError
-                if self._ha and not self._active_event.is_set():
-                    # failover in progress: park until a successor is
-                    # reachable, then fall through to the resend check
-                    await asyncio.wait_for(self._active_event.wait(),
-                                           timeout=remaining)
-                    continue
-                if sent_inc != self._incarnation:
-                    # op replay: exactly one send per MDS incarnation —
-                    # the successor's completed-request table dedups
-                    # mutations that landed before the crash, and a
-                    # live-but-slow MDS is never spammed with
-                    # duplicates (a duplicate open would leak a cap
-                    # refcount)
+                rank = self._route(npath)
+                if self._ha:
+                    ev = self._rank_events.setdefault(
+                        rank, asyncio.Event())
+                    if not ev.is_set():
+                        # failover in progress: park until a successor
+                        # is reachable, then fall through to resend
+                        try:
+                            await asyncio.wait_for(
+                                ev.wait(),
+                                timeout=min(remaining, 1.0))
+                        except asyncio.TimeoutError:
+                            continue      # re-route: hint/map may have
+                        continue          # moved the path meanwhile
+                    if rank not in self._open_ranks:
+                        try:
+                            await self._ensure_session(
+                                rank, timeout=min(remaining, 10.0))
+                        except (FSError, ConnectionError, OSError,
+                                ConnectionError_,
+                                asyncio.TimeoutError):
+                            await asyncio.sleep(0.2)
+                        continue
+                    addr = self._rank_addrs.get(rank)
+                    key = (rank, self._rank_inc.get(rank, 0))
+                else:
+                    addr = self.mds_addr
+                    key = (0, 0)
+                if sent_key != key and addr is not None:
+                    # op replay: exactly one send per (rank, MDS
+                    # incarnation) — the successor's completed-request
+                    # table dedups mutations that landed before the
+                    # crash, and a live-but-slow MDS is never spammed
+                    # with duplicates (a duplicate open would leak a
+                    # cap refcount)
                     try:
-                        await self.msgr.send_message(
-                            msg, self.mds_addr, "mds")
-                        sent_inc = self._incarnation
+                        await self.msgr.send_message(msg, addr, "mds")
+                        sent_key = key
                     except (ConnectionError, OSError,
                             ConnectionError_):
                         if not self._ha:
@@ -455,7 +619,9 @@ class CephFSClient(Dispatcher):
                     reply = await asyncio.wait_for(
                         asyncio.shield(fut),
                         timeout=min(1.0, max(remaining, 0.05)))
-                    break
+                    if not (self._ha and reply.result == ESTALE):
+                        break
+                    # loop top handles the redirect bookkeeping
                 except asyncio.TimeoutError:
                     continue
         finally:

@@ -2,8 +2,11 @@
 
 ref: src/mds/FSMap.h + src/include/fs_types.h (MDSMap::DaemonState) —
 the paxos-committed map the MDSMonitor maintains and every MDS/client
-subscribes to ("mdsmap"). One filesystem, one rank (rank 0): the
-failover ladder the reference runs per rank applies to it:
+subscribes to ("mdsmap"). One filesystem, up to ``max_mds`` active
+ranks (round 7): the namespace is partitioned across ranks by the
+**subtree map** (directory subtree root -> owning rank, the persistent
+analog of the reference's subtree/dirfrag auth delegation), and the
+failover ladder runs PER RANK:
 
     standby -> (standby_replay) -> replay -> reconnect -> rejoin -> active
 
@@ -18,6 +21,15 @@ osdmap epoch at which the last failed active's blocklist committed; a
 promoted standby barriers on it (Objecter.wait_for_map_on_osds) before
 touching the journal, so a fenced predecessor can never land a late
 journal write after replay began.
+
+Subtree map + migrations (round 7, v2 encoding): ``subtrees`` maps a
+normalized subtree root to the rank that serves it; "/" is always
+present and defaults to rank 0, and resolution is longest-prefix
+(``subtree_owner``) so a deeper pin overrides its ancestors.
+``migrations`` records in-flight two-phase subtree handoffs
+({path, from, to}) — the authority flip itself is ONLY the paxos
+commit that rewrites ``subtrees``, so a migration that dies at any
+point simply never moved authority (crash-safe by construction).
 """
 
 from __future__ import annotations
@@ -33,13 +45,16 @@ STATE_REJOIN = "rejoin"
 STATE_ACTIVE = "active"
 STATE_STOPPED = "stopped"
 
-# the rank-0 takeover ladder, in order; a beacon may only advance one
-# rung at a time (ref: MDSMonitor::prepare_beacon state checks)
+# the per-rank takeover ladder, in order; a beacon may only advance
+# forward along it (ref: MDSMonitor::prepare_beacon state checks)
 LADDER = (STATE_REPLAY, STATE_RECONNECT, STATE_REJOIN, STATE_ACTIVE)
 
 # states that hold (or are taking over) a rank — beacon-grace expiry of
 # one of these is a FAILOVER, not a standby drop
 RANK_STATES = frozenset(LADDER)
+
+# hard ceiling on max_mds (ref: MAX_MDS in the reference's mon checks)
+MAX_MDS_CAP = 16
 
 
 class MDSInfo:
@@ -76,6 +91,13 @@ class FSMap:
         # its gid must never re-register, or a fenced daemon could
         # climb back to a rank it can no longer write for
         self.last_failure_osd_epoch = 0
+        # -- multi-active (v2) --------------------------------------------
+        self.max_mds = 1                         # wanted active ranks
+        self.subtrees: dict[str, int] = {"/": 0}  # subtree root -> rank
+        # in-flight two-phase handoffs: [{"path": root, "from": rank,
+        # "to": rank}]; authority flips only when the commit rewrites
+        # ``subtrees`` — until then the "from" rank stays authoritative
+        self.migrations: list[dict] = []
 
     # -- queries -----------------------------------------------------------
     def by_name(self, name: str) -> MDSInfo | None:
@@ -88,9 +110,18 @@ class FSMap:
                      if i.rank == rank and i.state in RANK_STATES),
                     None)
 
-    def active(self) -> MDSInfo | None:
-        i = self.rank_holder(0)
+    def active(self, rank: int = 0) -> MDSInfo | None:
+        i = self.rank_holder(rank)
         return i if i is not None and i.state == STATE_ACTIVE else None
+
+    def actives(self) -> dict[int, MDSInfo]:
+        """rank -> active info for every rank currently serving."""
+        return {i.rank: i for i in self.infos.values()
+                if i.state == STATE_ACTIVE and i.rank >= 0}
+
+    def rank_holders(self) -> dict[int, MDSInfo]:
+        return {i.rank: i for i in self.infos.values()
+                if i.state in RANK_STATES and i.rank >= 0}
 
     def standbys(self) -> list[MDSInfo]:
         return sorted((i for i in self.infos.values()
@@ -98,6 +129,24 @@ class FSMap:
                                       STATE_STANDBY_REPLAY)),
                       key=lambda i: (i.state != STATE_STANDBY_REPLAY,
                                      i.gid))
+
+    def subtree_owner(self, path: str) -> tuple[int, str]:
+        """(owning rank, matched subtree root) for ``path`` by
+        longest-prefix match — the routing primitive clients and the
+        per-rank ownership check share. ``path`` must be normalized
+        ("/a/b"); "/" always matches."""
+        best_root, best_rank = "/", self.subtrees.get("/", 0)
+        for root, rank in self.subtrees.items():
+            if root == "/":
+                continue
+            if (path == root or path.startswith(root + "/")) and \
+                    len(root) > len(best_root):
+                best_root, best_rank = root, rank
+        return best_rank, best_root
+
+    def migration_for(self, path: str) -> dict | None:
+        return next((m for m in self.migrations
+                     if m["path"] == path), None)
 
     def is_stopped(self, gid: int) -> bool:
         return gid in self.stopped_gids
@@ -107,21 +156,24 @@ class FSMap:
         del self.stopped_gids[:-keep]
 
     def dump(self) -> dict:
-        holder = self.rank_holder(0)
+        holders = self.rank_holders()
         return {
             "epoch": self.epoch,
-            "ranks": [] if holder is None else [holder.dump()],
+            "max_mds": self.max_mds,
+            "ranks": [holders[r].dump() for r in sorted(holders)],
             "standbys": [i.dump() for i in self.standbys()],
             "failed": sorted(self.failed),
             "stopped_gids": list(self.stopped_gids),
             "last_failure_osd_epoch": self.last_failure_osd_epoch,
+            "subtrees": dict(sorted(self.subtrees.items())),
+            "migrations": [dict(m) for m in self.migrations],
             "states": {i.name: i.state for i in self.infos.values()},
         }
 
     # -- codec -------------------------------------------------------------
     def encode(self) -> bytes:
         e = Encoder()
-        with e.start(1):
+        with e.start(2):                 # v2: + max_mds/subtrees/migrations
             e.u64(self.epoch)
             e.map(self.infos, lambda e, k: e.u64(k),
                   lambda e, i: (e.u64(i.gid).string(i.name)
@@ -131,6 +183,12 @@ class FSMap:
             e.list(self.failed, lambda e, v: e.s32(v))
             e.list(self.stopped_gids, lambda e, v: e.u64(v))
             e.u64(self.last_failure_osd_epoch)
+            e.u32(self.max_mds)                            # v2
+            e.map(self.subtrees, lambda e, k: e.string(k),  # v2
+                  lambda e, v: e.s32(v))
+            e.list(self.migrations,                        # v2
+                   lambda e, m: (e.string(m["path"])
+                                 .s32(m["from"]).s32(m["to"])))
         return e.tobytes()
 
     @classmethod
@@ -142,10 +200,18 @@ class FSMap:
                            rank=d.s32())
         m = cls()
         d = Decoder(data)
-        with d.start(1):
+        with d.start(2) as v:
             m.epoch = d.u64()
             m.infos = d.map(lambda d: d.u64(), info)
             m.failed = d.list(lambda d: d.s32())
             m.stopped_gids = d.list(lambda d: d.u64())
             m.last_failure_osd_epoch = d.u64()
+            if v >= 2:
+                m.max_mds = d.u32()
+                m.subtrees = d.map(lambda d: d.string(),
+                                   lambda d: d.s32())
+                m.migrations = d.list(
+                    lambda d: {"path": d.string(), "from": d.s32(),
+                               "to": d.s32()})
+        m.subtrees.setdefault("/", 0)     # v1 blob / invariant repair
         return m

@@ -45,8 +45,21 @@ mutations. Before touching the journal the new active barriers on
 ``last_failure_osd_epoch`` — the osdmap epoch of its predecessor's
 blocklist — so a fenced zombie can never land a late journal write.
 
-Not rebuilt: dynamic subtree partitioning/multi-MDS (one rank), the
-full inode lock matrix, snapshots.
+Multi-active (round 7, ref: the Migrator + MDBalancer + the subtree
+map): up to ``max_mds`` ranks serve disjoint namespace subtrees. Each
+rank owns a PER-RANK journal + session table (``journal_oid(rank)`` /
+``sessions_oid(rank)``), requests for a subtree another rank owns are
+redirected with -ESTALE (payload names the owner), and subtree
+authority moves between live ranks through a two-phase migration:
+freeze + drain -> journaled handoff marker -> caps/completed-table
+export (the importer persists them BEFORE acking) -> mon-committed
+subtree-map flip -> unfreeze/redirect. Authority only ever moves in
+the mon's paxos commit, so a crash on either side leaves the subtree
+where it was, and the transferred completed-request tables keep
+mutation replay exactly-once across the handoff.
+
+Not rebuilt: the full inode lock matrix, snapshots, cross-rank rename
+(-EXDEV; route both paths to one rank).
 """
 
 from __future__ import annotations
@@ -60,7 +73,7 @@ from ceph_tpu.cephfs.fsmap import (
     FSMap, STATE_ACTIVE, STATE_RECONNECT, STATE_REJOIN, STATE_REPLAY,
     STATE_STANDBY, STATE_STANDBY_REPLAY, STATE_STOPPED,
 )
-from ceph_tpu.mon.messages import MDSBeacon, MMDSMap
+from ceph_tpu.mon.messages import MDSBeacon, MMDSMap, MMDSMigrationDone
 from ceph_tpu.msg import Dispatcher, Messenger
 from ceph_tpu.msg.message import Message, register
 from ceph_tpu.utils.locks import KeyedLocks
@@ -88,6 +101,24 @@ RECONNECT_REJECT = 3  # mds -> client: unknown session; re-mount
 
 JOURNAL_OID = ".mds_journal"
 SESSIONS_OID = ".mds_sessions"   # session table (ref: SessionMap)
+
+
+def journal_oid(rank: int) -> str:
+    """Per-rank journal object (rank 0 keeps the legacy name so every
+    pre-multi-active store and test reads unchanged)."""
+    return JOURNAL_OID if rank <= 0 else f"{JOURNAL_OID}.{rank}"
+
+
+def sessions_oid(rank: int) -> str:
+    return SESSIONS_OID if rank <= 0 else f"{SESSIONS_OID}.{rank}"
+
+
+# -ESTALE: the reply code a rank answers with for a path it does not
+# own — payload carries {"rank": owner, "path": subtree_root} so the
+# client re-targets without waiting for the next fsmap publish (ref:
+# the CDIR_AUTH forward / MClientRequest forwarding upstream)
+ESTALE = -116
+EXDEV = -18      # cross-rank rename: not supported at this scope
 
 # ops whose replay after failover must be deduplicated by (client, tid)
 # — the completed-request table the reference keeps per Session
@@ -120,6 +151,12 @@ MDS_PERF = (
     .add_u64_counter("caps_replayed", "caps reinstated from claims")
     .add_u64_counter("standby_replay_polls",
                      "standby-replay journal/session tail polls")
+    .add_u64_counter("subtrees_exported",
+                     "subtree handoffs completed as the exporter")
+    .add_u64_counter("subtrees_imported",
+                     "subtree handoffs completed as the importer")
+    .add_u64_counter("redirects_sent",
+                     "-ESTALE redirects to the owning rank")
     .create_perf_counters()
 )
 
@@ -157,6 +194,31 @@ class MClientCaps(Message):
     TYPE = 223
     FIELDS = [("op", "u32"), ("path", "str"), ("mode", "u32"),
               ("cseq", "u64")]
+
+
+@register
+class MMDSExportDir(Message):
+    """Exporting rank -> importing rank: the payload half of a subtree
+    handoff (ref: MExportDir + the cap/session state MExportDirPrep
+    carries). The subtree's NAMESPACE needs no copying — dirfrags are
+    shared RADOS objects — so what moves is serving state: ``caps``
+    maps path -> JSON {holders: {client: [mode, count]}} for every cap
+    under the subtree, and ``completed`` maps client -> JSON
+    {tid: result} (the completed-request tables), which the importer
+    persists to ITS session table BEFORE acking — the durability step
+    that keeps mutation replay exactly-once across the handoff."""
+    TYPE = 225
+    FIELDS = [("path", "str"), ("from_rank", "s32"),
+              ("to_rank", "s32"), ("cap_seq", "u64"),
+              ("caps", "map:str:blob"), ("completed", "map:str:blob")]
+
+
+@register
+class MMDSExportDirAck(Message):
+    """Importing rank -> exporting rank: state merged AND persisted;
+    the exporter may report MMDSMigrationDone to the mon."""
+    TYPE = 226
+    FIELDS = [("path", "str"), ("result", "s32")]
 
 
 @register
@@ -246,6 +308,27 @@ class MDSDaemon(Dispatcher):
         self.monc = None                        # set by create()
         self._own_rados = None
         self.fsmap: FSMap | None = None
+        # -- multi-active state (round 7) ------------------------------
+        self.rank = 0                           # standalone serves rank 0
+        self.journal_oid = journal_oid(0)
+        self.sessions_oid = sessions_oid(0)
+        # cumulative op counters for the beacon's load report
+        self._op_count = 0
+        self._subtree_op_counts: dict[str, int] = {}
+        # migration path -> Event set when the freeze lifts; requests
+        # whose path falls UNDER a frozen path park on it (export in
+        # progress). NB the frozen key is the MIGRATION path, which is
+        # usually not yet a subtree-map root (first pin of /d1 while
+        # the map holds only "/") — so matching is by prefix against
+        # the request path, never via subtree_owner.
+        self._frozen: dict[str, asyncio.Event] = {}
+        # admitted request path -> in-flight count; the export drain
+        # waits until nothing under the migrating path remains
+        self._inflight_reqs: dict[str, int] = {}
+        self._exports: set[str] = set()          # roots being exported
+        self._export_acks: dict[str, asyncio.Future] = {}
+        self._export_tasks: set[asyncio.Task] = set()
+        self.migration_timeout = cfg.get("mds_migration_timeout", 10.0)
         self.beacon_interval = cfg.get("mds_beacon_interval", 1.0)
         self.reconnect_timeout = cfg.get("mds_reconnect_timeout", 2.0)
         self.replay_interval = cfg.get("mds_replay_interval", 0.25)
@@ -297,6 +380,7 @@ class MDSDaemon(Dispatcher):
         self._own_rados = r
         self.monc = r.monc
         self.state = STATE_STANDBY
+        self.rank = -1                 # no rank until the FSMap assigns
         return self
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -336,7 +420,7 @@ class MDSDaemon(Dispatcher):
         # slipped in before the flag was observed.
         self._stopping = True
         for t in (self._beacon_task, self._tail_task,
-                  self._takeover_task):
+                  self._takeover_task, *self._export_tasks):
             if t is not None:
                 t.cancel()
         while self._req_tasks:
@@ -357,7 +441,7 @@ class MDSDaemon(Dispatcher):
         self._killed = True
         self._stopping = True
         for t in (self._beacon_task, self._tail_task,
-                  self._takeover_task):
+                  self._takeover_task, *self._export_tasks):
             if t is not None:
                 t.cancel()
         for t in list(self._req_tasks):
@@ -382,7 +466,9 @@ class MDSDaemon(Dispatcher):
                 gid=self.gid, name=self.name, ident=self.ident,
                 addr_host=self.addr.host, addr_port=self.addr.port,
                 state=self.state, seq=self._beacon_seq,
-                epoch=self.fsmap.epoch if self.fsmap else 0))
+                epoch=self.fsmap.epoch if self.fsmap else 0,
+                ops=self._op_count,
+                subtree_ops=dict(self._subtree_op_counts)))
             MDS_PERF.inc("beacons_sent")
         except Exception as e:
             log.dout(5, f"beacon send failed: {e!r}")
@@ -391,6 +477,7 @@ class MDSDaemon(Dispatcher):
         if self.fsmap is not None and fm.epoch <= self.fsmap.epoch:
             return
         self.fsmap = fm
+        self._check_migrations()
         me = fm.infos.get(self.gid)
         if me is None:
             if fm.is_stopped(self.gid) and \
@@ -416,6 +503,11 @@ class MDSDaemon(Dispatcher):
                 self._tail_task.cancel()
                 self._tail_task = None
             self.state = STATE_REPLAY
+            # the rank this incarnation now serves: journal + session
+            # table are PER RANK (rank 0 keeps the legacy object names)
+            self.rank = me.rank
+            self.journal_oid = journal_oid(self.rank)
+            self.sessions_oid = sessions_oid(self.rank)
             MDS_PERF.inc("state_transitions")
             MDS_PERF.inc("takeovers")
             self._takeover_task = asyncio.ensure_future(
@@ -476,8 +568,13 @@ class MDSDaemon(Dispatcher):
             # scope (no distributed subtrees)
             await self._advance(STATE_ACTIVE)
             self._active_event.set()
+            # an in-flight migration FROM this rank (committed against
+            # the predecessor, aborted only if the mon noticed the
+            # death) restarts here with the replayed state
+            self._check_migrations()
             log.dout(1, f"mds.{self.name} active (takeover complete, "
-                        f"{len(self.sessions)} sessions)")
+                        f"rank {self.rank}, {len(self.sessions)} "
+                        f"sessions)")
         except asyncio.CancelledError:
             pass
         except Exception as e:
@@ -503,7 +600,7 @@ class MDSDaemon(Dispatcher):
                 MDS_PERF.inc("standby_replay_polls")
                 try:
                     entries = await self.ioctx.get_omap_vals(
-                        JOURNAL_OID)
+                        self.journal_oid)
                     seqs = [int(k) for k in entries if k.isdigit()]
                     if seqs:
                         self._journal_seq = max(self._journal_seq,
@@ -512,13 +609,250 @@ class MDSDaemon(Dispatcher):
                     pass                      # nothing journaled yet
                 try:
                     table = await self.ioctx.get_omap_vals(
-                        SESSIONS_OID)
+                        self.sessions_oid)
                     self._ingest_session_table(table)
                 except ObjectOperationError:
                     pass                      # no sessions yet
                 await asyncio.sleep(self.replay_interval)
         except asyncio.CancelledError:
             pass
+
+    # -- subtree migration (round 7; ref: src/mds/Migrator.{h,cc},
+    # two-phase: freeze -> journaled handoff -> import -> mon flip) -------
+    def _check_migrations(self) -> None:
+        """Spawn an export task for every in-flight migration whose
+        FROM rank is ours (idempotent — one task per subtree root)."""
+        fm = self.fsmap
+        if fm is None or self._stopping or self.state != STATE_ACTIVE:
+            return
+        for mig in fm.migrations:
+            if mig["from"] == self.rank and \
+                    mig["path"] not in self._exports:
+                t = asyncio.ensure_future(
+                    self._export_subtree(dict(mig)))
+                self._export_tasks.add(t)
+                t.add_done_callback(self._export_tasks.discard)
+
+    async def _export_subtree(self, mig: dict) -> None:
+        """Run the exporter's half of the two-phase handoff:
+
+        1. FREEZE the subtree (new requests under it park) and drain
+           the in-flight ones, so the journal + cap table are a
+           consistent snapshot;
+        2. journal the handoff marker (crash here: nothing moved —
+           the mon's intent entry survives and a successor retries);
+        3. ship caps + completed-request tables to the importer and
+           wait for its ack (the importer PERSISTS the tables before
+           acking — the exactly-once handoff durability);
+        4. report MMDSMigrationDone until the mon's commit flips the
+           subtree map (authority moves exactly here);
+        5. unfreeze: parked requests wake, re-check ownership, and
+           redirect to the new owner.
+
+        Aborts (mon dropped the intent, e.g. the importer died) just
+        unfreeze — authority never moved."""
+        path, to = mig["path"], mig["to"]
+        if path in self._exports:
+            return
+        self._exports.add(path)
+        ev = self._frozen.setdefault(path, asyncio.Event())
+        ev.clear()
+        loop = asyncio.get_event_loop()
+        try:
+            while self._inflight_under(path):
+                if self._stopping or self.fsmap is None or \
+                        self.fsmap.migration_for(path) is None:
+                    return
+                await asyncio.sleep(0.01)
+            await self._journaled_apply(
+                {"op": "export_subtree", "path": path, "to": to})
+            caps = {
+                p: json.dumps({"holders": {
+                    c: [mode, cnt]
+                    for c, (mode, cnt) in holders.items()}}).encode()
+                for p, holders in self.caps.items()
+                if p == path or p.startswith(path + "/")}
+            completed = {
+                c: json.dumps({str(t): r
+                               for t, r in tids.items()}).encode()
+                for c, tids in self._completed.items()}
+            acked = False
+            while not acked and not self._stopping:
+                fm = self.fsmap
+                if fm is None or fm.migration_for(path) is None:
+                    return                  # aborted: finally unfreezes
+                dest = fm.rank_holder(to)
+                if dest is None or dest.state != STATE_ACTIVE:
+                    await asyncio.sleep(0.05)
+                    continue
+                fut = loop.create_future()
+                self._export_acks[path] = fut
+                try:
+                    await self.msgr.send_message(MMDSExportDir(
+                        path=path, from_rank=self.rank, to_rank=to,
+                        cap_seq=self._cap_seq, caps=caps,
+                        completed=completed), dest.addr(), "mds")
+                    rep = await asyncio.wait_for(fut, timeout=2.0)
+                    acked = rep.result == 0
+                except Exception:
+                    await asyncio.sleep(0.1)
+                finally:
+                    self._export_acks.pop(path, None)
+            if not acked:
+                return
+            while not self._stopping:
+                fm = self.fsmap
+                if fm is None or fm.subtrees.get(path) == to:
+                    break
+                if fm.migration_for(path) is None:
+                    return                  # aborted after the ack
+                try:
+                    await self.monc.send_report(MMDSMigrationDone(
+                        gid=self.gid, path=path, from_rank=self.rank,
+                        to_rank=to))
+                except Exception as e:
+                    log.dout(5, f"migration-done send failed: {e!r}")
+                await asyncio.sleep(0.1)
+            # flipped: the importer is authoritative — drop the
+            # transferred caps so a stale holder entry here can never
+            # feed a grant/revoke decision again
+            for p in list(self.caps):
+                if p == path or p.startswith(path + "/"):
+                    self.caps.pop(p, None)
+            MDS_PERF.inc("subtrees_exported")
+            log.dout(1, f"mds.{self.name} (rank {self.rank}) exported "
+                        f"subtree {path} -> rank {to}")
+        except asyncio.CancelledError:
+            pass
+        finally:
+            self._exports.discard(path)
+            done_ev = self._frozen.pop(path, None)
+            if done_ev is not None:
+                done_ev.set()
+
+    async def _handle_import(self, m: MMDSExportDir) -> None:
+        """The importer's half: journal the marker, merge caps, and
+        PERSIST the merged completed-request tables before acking —
+        a client's post-migration resend of a mutation that already
+        landed on the exporter must answer from the table, not
+        re-execute (the exactly-once guarantee's durable half)."""
+        if not self._active_event.is_set():
+            await self._active_event.wait()
+        await self._journaled_apply(
+            {"op": "import_subtree", "path": m.path,
+             "from": m.from_rank})
+        for p, blob in m.caps.items():
+            try:
+                ent = json.loads(blob)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            holders = self.caps.setdefault(p, {})
+            for client, mode_cnt in ent.get("holders", {}).items():
+                cur = holders.setdefault(client, [0, 0])
+                cur[0] = max(cur[0], int(mode_cnt[0]))
+                cur[1] = max(cur[1], int(mode_cnt[1]))
+        self._cap_seq = max(self._cap_seq, m.cap_seq)
+        for client, blob in m.completed.items():
+            try:
+                tids = json.loads(blob)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            done = self._completed.setdefault(client, {})
+            for t, r in tids.items():
+                done.setdefault(int(t), int(r))
+            while len(done) > COMPLETED_KEEP:
+                done.pop(next(iter(done)))
+            await self._save_session(client)
+        MDS_PERF.inc("subtrees_imported")
+        log.dout(1, f"mds.{self.name} (rank {self.rank}) imported "
+                    f"subtree {m.path} from rank {m.from_rank}")
+        await m.conn.send_message(MMDSExportDirAck(
+            path=m.path, result=0))
+
+    @staticmethod
+    def _depth1(path: str) -> str:
+        """Load-tracking prefix for a path owned via "/": its depth-1
+        component ("/a/b/c" -> "/a") — the granularity at which the
+        rebalancer can carve load off the root subtree."""
+        if path == "/":
+            return "/"
+        return "/" + path.split("/", 2)[1]
+
+    def _frozen_event(self, *paths: str) -> asyncio.Event | None:
+        """The freeze Event covering any of ``paths``, or None.
+        Matching is frozen-path-prefix against the request path — the
+        frozen key (a migration path) need not be a subtree-map root
+        yet."""
+        for froot, ev in self._frozen.items():
+            if ev.is_set():
+                continue
+            for p in paths:
+                if p and (p == froot or p.startswith(froot + "/")):
+                    return ev
+        return None
+
+    def _inflight_under(self, path: str) -> bool:
+        return any(p == path or p.startswith(path + "/")
+                   for p in self._inflight_reqs)
+
+    async def _route_or_park(self, m: MClientRequest
+                             ) -> MClientReply | None:
+        """Ownership gate (multi-active): park while the path sits
+        under a frozen migration (export in flight), then redirect
+        with -ESTALE when this rank is not the owner. Returns the
+        reply to send, or None to serve locally — in which case the op
+        has been counted and its path(s) registered in-flight
+        (``m._admitted``; caller decrements when done). No await sits
+        between the freeze check and the registration, so the export
+        drain can never miss an admitted op."""
+        while True:
+            fm = self.fsmap
+            owner, root = fm.subtree_owner(m.path)
+            ev = self._frozen_event(m.path, m.path2)
+            if ev is not None:
+                await ev.wait()
+                continue             # ownership may have just flipped
+            if m.op == "rename" and m.path2:
+                owner2, _ = fm.subtree_owner(m.path2)
+                if owner2 != owner:
+                    return MClientReply(
+                        tid=m.tid, result=EXDEV,
+                        payload=(f"cross-rank rename not supported: "
+                                 f"{m.path} is served by rank {owner},"
+                                 f" {m.path2} by rank {owner2}; pin "
+                                 f"both under one rank").encode(),
+                        cap_mode=0, cap_seq=0)
+            if owner != self.rank:
+                MDS_PERF.inc("redirects_sent")
+                return MClientReply(
+                    tid=m.tid, result=ESTALE,
+                    payload=json.dumps(
+                        {"rank": owner, "path": root}).encode(),
+                    cap_mode=0, cap_seq=0)
+            self._op_count += 1
+            key = root if root != "/" else self._depth1(m.path)
+            counts = self._subtree_op_counts
+            if key not in counts and len(counts) >= 64:
+                # bound the beacon payload: drop the coldest prefix
+                counts.pop(min(counts, key=counts.get))
+            counts[key] = counts.get(key, 0) + 1
+            # path2 rides along for renames: a rename INTO a freezing
+            # subtree must neither slip past the park nor be missed by
+            # the export drain
+            m._admitted = [m.path] + \
+                ([m.path2] if m.op == "rename" and m.path2 else [])
+            for p in m._admitted:
+                self._inflight_reqs[p] = \
+                    self._inflight_reqs.get(p, 0) + 1
+            return None
+
+    def _inflight_done(self, paths: list) -> None:
+        for p in paths:
+            n = self._inflight_reqs.get(p, 0) - 1
+            if n <= 0:
+                self._inflight_reqs.pop(p, None)
+            else:
+                self._inflight_reqs[p] = n
 
     # -- journaling (ref: MDLog + EUpdate, batch-trimmed segments) ---------
     async def _journal(self, event: dict) -> int:
@@ -529,7 +863,7 @@ class MDSDaemon(Dispatcher):
         immediately."""
         self._journal_seq += 1
         seq = self._journal_seq
-        await self.ioctx.set_omap(JOURNAL_OID, f"{seq:016d}",
+        await self.ioctx.set_omap(self.journal_oid, f"{seq:016d}",
                                   json.dumps(event).encode())
         self._pending_seqs.add(seq)
         self._resident_seqs.add(seq)
@@ -538,7 +872,7 @@ class MDSDaemon(Dispatcher):
     async def _commit(self, seq: int) -> None:
         self._pending_seqs.discard(seq)
         self._resident_seqs.discard(seq)
-        await self.ioctx.rm_omap_key(JOURNAL_OID, f"{seq:016d}")
+        await self.ioctx.rm_omap_key(self.journal_oid, f"{seq:016d}")
 
     async def _journaled_apply(self, ev: dict) -> None:
         """journal -> apply -> (lazy) trim. The entry is removed at
@@ -572,7 +906,7 @@ class MDSDaemon(Dispatcher):
         # plain (non-underscore) key: the OSD's omap GET hides
         # "_"-prefixed keys as store-internal; the digit-only filters
         # in replay/tail skip this one
-        await self.ioctx.set_omap(JOURNAL_OID, "applied",
+        await self.ioctx.set_omap(self.journal_oid, "applied",
                                   str(horizon).encode())
 
     async def _maybe_trim(self) -> None:
@@ -588,7 +922,7 @@ class MDSDaemon(Dispatcher):
             horizon = self._applied_horizon()
             for seq in sorted(s for s in self._resident_seqs
                               if s <= horizon):
-                await self.ioctx.rm_omap_key(JOURNAL_OID,
+                await self.ioctx.rm_omap_key(self.journal_oid,
                                              f"{seq:016d}")
                 self._resident_seqs.discard(seq)
         finally:
@@ -598,7 +932,7 @@ class MDSDaemon(Dispatcher):
         from ceph_tpu.rados import ObjectOperationError
         MDS_PERF.inc("journal_replays")
         try:
-            entries = await self.ioctx.get_omap_vals(JOURNAL_OID)
+            entries = await self.ioctx.get_omap_vals(self.journal_oid)
         except ObjectOperationError:
             return
         # entries at or below the applied watermark already landed:
@@ -617,10 +951,10 @@ class MDSDaemon(Dispatcher):
                     # idempotent within the crash window: EEXIST /
                     # ENOENT mean the mutation already landed
                     log.dout(5, f"replay skip ({e.errno}): {ev}")
-            await self.ioctx.rm_omap_key(JOURNAL_OID, k)
+            await self.ioctx.rm_omap_key(self.journal_oid, k)
             self._journal_seq = max(self._journal_seq, seq)
         if "applied" in entries:
-            await self.ioctx.rm_omap_key(JOURNAL_OID, "applied")
+            await self.ioctx.rm_omap_key(self.journal_oid, "applied")
         self._applied_flushed = 0
         self._resident_seqs.clear()
         self._pending_seqs.clear()
@@ -644,6 +978,11 @@ class MDSDaemon(Dispatcher):
             await self.fs.rename(ev["path"], ev["path2"])
         elif op == "setattr":
             await self.fs.set_size(ev["path"], ev["size"])
+        elif op in ("export_subtree", "import_subtree"):
+            # handoff markers: authority lives in the mon's subtree
+            # map, not the journal — replay has nothing to do (the
+            # marker's value is the watermark ordering around it)
+            pass
         else:                                        # pragma: no cover
             raise ValueError(f"unknown journal op {op}")
 
@@ -662,7 +1001,7 @@ class MDSDaemon(Dispatcher):
     async def _load_session_table(self) -> None:
         from ceph_tpu.rados import ObjectOperationError
         try:
-            omap = await self.ioctx.get_omap_vals(SESSIONS_OID)
+            omap = await self.ioctx.get_omap_vals(self.sessions_oid)
         except ObjectOperationError:
             omap = {}
         self._ingest_session_table(omap)
@@ -670,7 +1009,7 @@ class MDSDaemon(Dispatcher):
     async def _save_session(self, client: str) -> None:
         done = self._completed.get(client, {})
         await self.ioctx.set_omap(
-            SESSIONS_OID, client,
+            self.sessions_oid, client,
             json.dumps({"completed": {str(t): r for t, r in
                                       done.items()}}).encode())
         self._session_table.add(client)
@@ -683,7 +1022,7 @@ class MDSDaemon(Dispatcher):
         if client in self._session_table:
             self._session_table.discard(client)
             try:
-                await self.ioctx.rm_omap_key(SESSIONS_OID, client)
+                await self.ioctx.rm_omap_key(self.sessions_oid, client)
             except Exception as e:
                 log.dout(5, f"session table trim for {client} "
                             f"failed: {e!r}")
@@ -745,6 +1084,18 @@ class MDSDaemon(Dispatcher):
             return True
         if isinstance(msg, MClientCaps):
             await self._handle_caps(msg)
+            return True
+        if isinstance(msg, MMDSExportDir):
+            if self._stopping:
+                return True
+            t = asyncio.ensure_future(self._handle_import(msg))
+            self._req_tasks.add(t)
+            t.add_done_callback(self._req_task_done)
+            return True
+        if isinstance(msg, MMDSExportDirAck):
+            fut = self._export_acks.get(msg.path)
+            if fut and not fut.done():
+                fut.set_result(msg)
             return True
         return False
 
@@ -970,14 +1321,32 @@ class MDSDaemon(Dispatcher):
             # FSMap's active, so this resolves as the ladder finishes
             # (the task is cancelled if the daemon stops instead)
             await self._active_event.wait()
+        m.path = _norm(m.path)          # caps/journal key consistently
+        if m.path2:
+            m.path2 = _norm(m.path2)
+        # multi-active routing (round 7): a request for a subtree this
+        # rank does not own is REDIRECTED before the session check — a
+        # client aimed at the wrong rank needs the owner's address,
+        # not a session here. Frozen subtrees park inside.
+        admitted = None
+        if self.monc is not None and self.fsmap is not None:
+            red = await self._route_or_park(m)
+            if red is not None:
+                await m.conn.send_message(red)
+                return
+            admitted = m._admitted
+        try:
+            await self._serve_request(m)
+        finally:
+            if admitted is not None:
+                self._inflight_done(admitted)
+
+    async def _serve_request(self, m: MClientRequest) -> None:
         if m.src not in self.sessions:
             await m.conn.send_message(MClientReply(
                 tid=m.tid, result=-1, payload=b"no session",
                 cap_mode=0, cap_seq=0))
             return
-        m.path = _norm(m.path)          # caps/journal key consistently
-        if m.path2:
-            m.path2 = _norm(m.path2)
         # completed-request dedup (ref: Session::have_completed_request):
         # a mutation replayed after failover must answer from the
         # table, not re-execute — a second rename/unlink would fail and

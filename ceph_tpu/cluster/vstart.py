@@ -131,20 +131,78 @@ class Cluster:
     # -- cephfs (ref: vstart.sh CEPH_NUM_MDS + `ceph fs new`) --------------
     async def start_fs(self, pool: str = "cephfs", n_mds: int = 2,
                        pg_num: int = 8,
-                       timeout: float = 60.0) -> list:
+                       timeout: float = 60.0,
+                       max_mds: int = 1) -> list:
         """Create the fs pool and boot ``n_mds`` mon-coordinated MDS
         daemons; returns once the FSMap shows an active. With
         ``n_mds=1`` there is no standby — the configuration the
         session-survival regression pair uses to reproduce the
-        pre-subsystem behavior (a dead MDS is a dead filesystem)."""
+        pre-subsystem behavior (a dead MDS is a dead filesystem).
+        ``max_mds > 1`` opens that many active ranks (multi-active;
+        daemons beyond ``max_mds`` stay standbys) and waits until all
+        of them reach active."""
         await self.client.pool_create(pool, pg_num=pg_num)
         await self.wait_for_clean(timeout=120)
         self.fs_pool = pool
         names = "abcdefgh"
         for i in range(n_mds):
             await self.add_mds(names[i])
-        await self.wait_for_mds_active(timeout=timeout)
+        if max_mds > 1:
+            await self.set_max_mds(max_mds)
+            await self.wait_for_actives(max_mds, timeout=timeout)
+        else:
+            await self.wait_for_mds_active(timeout=timeout)
         return self.mdss
+
+    async def set_max_mds(self, n: int) -> None:
+        ret, rs, _ = await self.client.mon_command(
+            {"prefix": "fs set", "var": "max_mds", "val": str(n)})
+        assert ret == 0, rs
+
+    async def wait_for_actives(self, n: int,
+                               timeout: float = 60.0) -> dict:
+        """Until ``n`` ranks are simultaneously active; returns
+        rank -> daemon name."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            lead = self.leader()
+            actives = {r: i.name for r, i in
+                       lead.mdsmon.fsmap.actives().items()} \
+                if lead is not None else {}
+            if len(actives) >= n:
+                return actives
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"only {len(actives)}/{n} active mds ranks "
+                    f"({actives})")
+            await asyncio.sleep(0.05)
+
+    async def subtree_pin(self, path: str, rank: int,
+                          timeout: float = 30.0) -> None:
+        """`fs subtree pin` + wait for the two-phase migration to
+        commit (the subtree map names ``rank`` and no migration of
+        ``path`` is in flight)."""
+        ret, rs, _ = await self.client.mon_command(
+            {"prefix": "fs subtree pin", "path": path,
+             "rank": rank})
+        assert ret == 0, rs
+        import json as _json
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            ret, _, out = await self.client.mon_command(
+                {"prefix": "fs subtree ls"})
+            assert ret == 0
+            dump = _json.loads(out)
+            from ceph_tpu.cephfs import _norm
+            p = _norm(path)
+            if dump["subtrees"].get(p) == rank and not any(
+                    m["path"] == p for m in dump["migrations"]):
+                return
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"subtree {path} -> rank {rank} never committed "
+                    f"({dump})")
+            await asyncio.sleep(0.05)
 
     async def add_mds(self, name: str):
         from ceph_tpu.cephfs.mds import MDSDaemon
@@ -159,27 +217,28 @@ class Cluster:
         self.mdss.append(mds)
         return mds
 
-    def mds_active_name(self) -> str | None:
-        """Rank 0's ACTIVE holder per the lead mon's FSMap."""
+    def mds_active_name(self, rank: int = 0) -> str | None:
+        """``rank``'s ACTIVE holder per the lead mon's FSMap."""
         lead = self.leader()
         if lead is None:
             return None
-        info = lead.mdsmon.fsmap.active()
+        info = lead.mdsmon.fsmap.active(rank)
         return info.name if info is not None else None
 
     async def wait_for_mds_active(self, not_name: str | None = None,
-                                  timeout: float = 60.0) -> str:
-        """Wait until SOME daemon is active — pass ``not_name`` (the
-        failed one) to wait out a failover."""
+                                  timeout: float = 60.0,
+                                  rank: int = 0) -> str:
+        """Wait until SOME daemon is active on ``rank`` — pass
+        ``not_name`` (the failed one) to wait out a failover."""
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
-            name = self.mds_active_name()
+            name = self.mds_active_name(rank)
             if name is not None and name != not_name:
                 return name
             if asyncio.get_event_loop().time() > deadline:
                 raise TimeoutError(
-                    f"no active mds (have {name!r}, excluded "
-                    f"{not_name!r})")
+                    f"no active mds on rank {rank} (have {name!r}, "
+                    f"excluded {not_name!r})")
             await asyncio.sleep(0.05)
 
     async def kill_mds(self, name: str):

@@ -298,6 +298,26 @@ class PrometheusModule(MgrModule):
             f"ceph_mds_failed_ranks {len(fsm.get('failed', []))}",
             f"ceph_fsmap_epoch {fsm.get('epoch', 0)}",
         ]
+        # multi-active metadata plane (round 7): rank occupancy, the
+        # subtree partition, in-flight migrations, and the per-rank
+        # op rates the rebalancer steers by
+        lines += [
+            "# TYPE ceph_mds_max_mds gauge",
+            f"ceph_mds_max_mds {fsm.get('max_mds', 1)}",
+            f"ceph_mds_active_count {len(fsm.get('actives', {}))}",
+            f"ceph_mds_subtree_migrations_pending "
+            f"{len(fsm.get('migrations', []))}",
+        ]
+        subtree_per_rank: dict[int, int] = {}
+        for _root, rk in fsm.get("subtrees", {}).items():
+            subtree_per_rank[rk] = subtree_per_rank.get(rk, 0) + 1
+        for rk, n in sorted(subtree_per_rank.items()):
+            lines.append(
+                f'ceph_mds_subtrees{{rank="{rk}"}} {n}')
+        for rk, rate in sorted(
+                fsm.get("rank_ops_rate", {}).items()):
+            lines.append(
+                f'ceph_mds_rank_ops_rate{{rank="{rk}"}} {rate}')
         # elastic control plane (round 6): quorum depth, committed
         # auth keys, in-flight pg merges — the gauges behind
         # MON_DOWN / AUTH_KEY_REVOKED / PG_MERGE_PENDING

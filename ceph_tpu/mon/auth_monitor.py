@@ -28,8 +28,19 @@ its own entry). In the in-process vstart cluster every daemon shares
 one Keyring object, so a commit fences cluster-wide instantly; the
 subscription keeps standalone (copy_for) keyrings converging too.
 
-Caps are stored and reported (`auth caps`) but enforcement is scoped
-to authentication itself — documented in mon/README.md.
+Cap ENFORCEMENT, first slice (round 7, ROADMAP elastic follow-up a):
+mon command handling checks the CALLER's stored caps before routing
+(Monitor._handle_command_msg -> :meth:`check_command_caps`). The
+policy gates MUTATIONS: a mutating mon command (anything not in the
+read-only table — `mon add/rm`, pool edits, fs/mds changes...)
+requires a ``mon`` cap granting ``w`` (or ``*``); auth KEY operations
+(get-or-create/rm/rotate/caps) require ``auth: *`` and auth reads
+``auth: r``. Entities with NO caps configured stay unrestricted (the
+boot keyring imports with empty caps — legacy admin behavior, and
+per-op OSD/MDS enforcement is still out of scope); read-only commands
+are never blocked. In-process service calls (tests, daemons driving
+handle_command directly) bypass the check — it guards the WIRE
+surface.
 """
 
 from __future__ import annotations
@@ -45,6 +56,27 @@ from ceph_tpu.utils.logging import get_logger
 log = get_logger("mon")
 
 PFX = "auth"
+
+# commands any authenticated entity may issue (observability — the
+# enforcement slice gates mutations; see the module docstring)
+READONLY_COMMANDS = frozenset((
+    "status", "health", "quorum_status", "mon dump", "log last",
+    "config get", "config dump", "osd dump", "osd tree", "osd df",
+    "osd pool ls", "osd getmap", "osd getcrushmap", "osd map",
+    "osd blocklist ls", "pg dump", "pg map", "fs status", "fs dump",
+    "fs subtree ls", "mds dump",
+))
+AUTH_READS = frozenset(("auth get", "auth ls"))
+
+
+def cap_allows(spec: str, need: str) -> bool:
+    """Does one cap spec string ("allow r", "rw", "*", "allow *")
+    grant ``need`` ("r" | "w" | "*")? ``*`` in the spec grants
+    everything; ``need="*"`` requires a literal ``*``."""
+    tokens = set("".join(t for t in spec.replace("allow", " ").split()))
+    if "*" in tokens:
+        return True
+    return need in tokens and need != "*"
 
 
 class AuthMonitor(PaxosService):
@@ -131,6 +163,33 @@ class AuthMonitor(PaxosService):
             if name not in self.keys and (is_daemon or name == peer):
                 out[name] = b""
         return out
+
+    # -- cap enforcement (first slice; see module docstring) ---------------
+    def check_command_caps(self, entity: str,
+                           cmd: dict) -> tuple[int, str]:
+        """(0, "") when ``entity`` may issue ``cmd``; (-EACCES, why)
+        otherwise. Entities without a configured cap table are
+        unrestricted (legacy boot keys); read-only commands always
+        pass."""
+        prefix = str(cmd.get("prefix", ""))
+        have = self.keys.get(entity)
+        caps = have[1] if have is not None else {}
+        if not caps:
+            return 0, ""
+        if prefix.startswith("auth"):
+            need = ("auth", "r") if prefix in AUTH_READS \
+                else ("auth", "*")
+        elif prefix in READONLY_COMMANDS:
+            return 0, ""
+        else:
+            need = ("mon", "w")
+        svc, lvl = need
+        spec = caps.get(svc, "")
+        if spec and cap_allows(spec, lvl):
+            return 0, ""
+        return -13, (f"permission denied: {entity} (caps {caps}) "
+                     f"lacks '{svc} {lvl}' required for "
+                     f"'{prefix}'")                        # -EACCES
 
     # -- commits -----------------------------------------------------------
     async def _commit(self, build) -> tuple[bool, object]:

@@ -15,6 +15,20 @@ commit, and carries that commit's osdmap epoch
 OSDs observing it before touching the journal. A dead active that
 wakes up later can therefore never land a journal or dirfrag write —
 the OSDs refuse its entity outright.
+
+Multi-active (round 7): `fs set max_mds N` opens ranks 1..N-1; the
+tick fills every open rank from the standby pool through the same
+per-rank ladder, and the subtree map partitions the namespace across
+the actives. Subtree authority moves through a two-phase migration —
+the mon commits an INTENT ({path, from, to} in FSMap.migrations), the
+exporting rank freezes + hands caps/completed-tables to the importer,
+and only the MMDSMigrationDone-driven commit that rewrites
+``subtrees`` flips authority, so a crash on either side (or the mon)
+leaves the subtree where it was. A load-based **rebalancer** on the
+tick consumes the per-rank op counters beacons carry and migrates the
+hottest subtree off an overloaded rank (ref: the MDBalancer's
+mds_load_t exchange, collapsed onto the mon since it already sees
+every beacon).
 """
 
 from __future__ import annotations
@@ -23,10 +37,10 @@ import asyncio
 import json
 
 from ceph_tpu.cephfs.fsmap import (
-    FSMap, LADDER, RANK_STATES, STATE_ACTIVE, STATE_REPLAY,
-    STATE_STANDBY, STATE_STANDBY_REPLAY,
+    FSMap, LADDER, MAX_MDS_CAP, RANK_STATES, STATE_ACTIVE,
+    STATE_REPLAY, STATE_STANDBY, STATE_STANDBY_REPLAY,
 )
-from ceph_tpu.mon.messages import MDSBeacon
+from ceph_tpu.mon.messages import MDSBeacon, MMDSMigrationDone
 from ceph_tpu.mon.service import PaxosService
 from ceph_tpu.utils.logging import get_logger
 
@@ -48,6 +62,14 @@ class MDSMonitor(PaxosService):
         self.beacon_grace = mon.config.get("mds_beacon_grace", 5.0)
         self._last_tick = 0.0
         self._lock = asyncio.Lock()
+        # -- rebalancer state (leader memory, not paxos) ---------------
+        # gid -> (loop time, cumulative ops, {prefix: cumulative ops})
+        # from the last beacon; rank_rates are the derived ops/s the
+        # rebalancer and the observability surface consume
+        self._load_samples: dict[int, tuple] = {}
+        self.rank_rates: dict[int, float] = {}
+        self.subtree_rates: dict[int, dict[str, float]] = {}
+        self._last_balance = 0.0
         self.refresh()
 
     # -- state -------------------------------------------------------------
@@ -88,6 +110,27 @@ class MDSMonitor(PaxosService):
     async def handle(self, msg) -> None:
         if isinstance(msg, MDSBeacon):
             await self._handle_beacon(msg)
+        elif isinstance(msg, MMDSMigrationDone):
+            await self._handle_migration_done(msg)
+
+    def _sample_load(self, m: MDSBeacon) -> None:
+        """Derive per-rank ops/s from the beacon's cumulative counters
+        (two-sample slope; leader memory only)."""
+        now = asyncio.get_event_loop().time()
+        prev = self._load_samples.get(m.gid)
+        self._load_samples[m.gid] = (now, m.ops, dict(m.subtree_ops))
+        info = self.fsmap.infos.get(m.gid)
+        if prev is None or info is None or info.rank < 0:
+            return
+        t0, ops0, sub0 = prev
+        dt = now - t0
+        if dt <= 0 or m.ops < ops0:       # restarted counter: resample
+            return
+        self.rank_rates[info.rank] = (m.ops - ops0) / dt
+        self.subtree_rates[info.rank] = {
+            pfx: (cnt - sub0.get(pfx, 0)) / dt
+            for pfx, cnt in m.subtree_ops.items()
+            if cnt >= sub0.get(pfx, 0)}
 
     async def _handle_beacon(self, m: MDSBeacon) -> None:
         if self.fsmap.is_stopped(m.gid):
@@ -95,6 +138,7 @@ class MDSMonitor(PaxosService):
             # never re-register (it cannot write past its blocklist)
             return
         self.last_beacon[m.gid] = asyncio.get_event_loop().time()
+        self._sample_load(m)
         info = self.fsmap.infos.get(m.gid)
         if info is None:
             def build(fm: FSMap):
@@ -132,6 +176,33 @@ class MDSMonitor(PaxosService):
             if ok:
                 log.dout(1, f"mds.{m.name} {info.state} -> {m.state}")
 
+    async def _handle_migration_done(self, m: MMDSMigrationDone) -> None:
+        """Commit the authority flip for a finished subtree handoff.
+        The flip is idempotent (the exporter re-sends Done until it
+        observes the new map) and guarded: the sender must still hold
+        the from-rank and the migration entry must still be live —
+        a handoff the mon already aborted (exporter failed mid-way)
+        must not flip late."""
+        def build(fm: FSMap):
+            mig = next((g for g in fm.migrations
+                        if g["path"] == m.path and
+                        g["from"] == m.from_rank and
+                        g["to"] == m.to_rank), None)
+            if mig is None:
+                return None
+            holder = fm.infos.get(m.gid)
+            if holder is None or holder.rank != m.from_rank:
+                return None
+            fm.migrations.remove(mig)
+            fm.subtrees[m.path] = m.to_rank
+            return fm, None
+        ok, _ = await self._propose_change(build)
+        if ok:
+            self.mon.clog("INF", f"mds: subtree {m.path} migrated "
+                                 f"rank {m.from_rank} -> {m.to_rank}")
+            log.dout(1, f"subtree {m.path} authority flipped to rank "
+                        f"{m.to_rank}")
+
     # -- tick --------------------------------------------------------------
     async def tick(self) -> None:
         now = asyncio.get_event_loop().time()
@@ -160,12 +231,17 @@ class MDSMonitor(PaxosService):
                         f"({self.beacon_grace}s)")
             await self.fail_mds(gid)
         fm = self.fsmap
-        # rank 0 is filled the moment any standby exists — covering
-        # the very first boot (rank never held; ref: the fs creation
-        # assigning its first MDS) and a standby registering after the
-        # rank already failed
-        if fm.rank_holder(0) is None and fm.standbys():
-            await self._promote(0)
+        # every rank < max_mds is filled the moment a standby exists —
+        # covering the very first boot (rank never held; ref: the fs
+        # creation assigning its first MDS), a standby registering
+        # after a rank failed, and freshly opened ranks after
+        # `fs set max_mds` raised the count
+        for rank in range(fm.max_mds):
+            fm = self.fsmap
+            if fm.rank_holder(rank) is None and fm.standbys():
+                await self._promote(rank)
+        await self._gc_migrations()
+        await self._maybe_rebalance()
         # standby_replay assignment: one warm follower while an active
         # exists (ref: MDSMonitor maybe_promote_standby / the
         # allow_standby_replay fs flag)
@@ -225,13 +301,27 @@ class MDSMonitor(PaxosService):
             fm.tombstone(gid)
             if i.state in RANK_STATES:
                 rank = max(i.rank, 0)
-                if rank not in fm.failed:
+                # a rank RETIRED past max_mds (fs set max_mds lowered
+                # it) is fenced but not a failover: it must neither
+                # enter fm.failed (a permanent spurious FS_DEGRADED —
+                # only _promote for ranks < max_mds ever clears
+                # entries) nor consume a standby (a promoted holder of
+                # a rank no client routes to would strand the pool)
+                retired = rank >= fm.max_mds
+                if not retired and rank not in fm.failed:
                     fm.failed.append(rank)
                 if epoch:
                     fm.last_failure_osd_epoch = epoch
+                # abort in-flight subtree handoffs touching this rank:
+                # authority never moved (the flip is a separate
+                # commit), so dropping the intent leaves every subtree
+                # exactly where the survivors believe it is
+                fm.migrations = [m for m in fm.migrations
+                                 if rank not in (m["from"], m["to"])]
                 # blocklist-before-promote holds: the fence committed
                 # above, so the successor may ride this same commit
-                cand = next(iter(fm.standbys()), None)
+                cand = next(iter(fm.standbys()), None) \
+                    if not retired else None
                 if cand is not None:
                     cand.state = STATE_REPLAY
                     cand.rank = rank
@@ -261,27 +351,239 @@ class MDSMonitor(PaxosService):
         if ok and name:
             log.dout(1, f"mds.{name} promoted to rank {rank} (replay)")
 
+    # -- subtree migration lifecycle ---------------------------------------
+    @staticmethod
+    def _dead_migrations(fm: FSMap) -> list[dict]:
+        """Migrations that can no longer complete: an endpoint rank has
+        no holder (its daemon failed — the fence path already dropped
+        its per-rank entries, this is the safety net for races) or was
+        retired past max_mds. Aborting = just removing the entry:
+        authority never moved, the exporter unfreezes when it sees the
+        entry gone."""
+        holders = fm.rank_holders()
+        return [m for m in fm.migrations
+                if m["from"] not in holders or m["to"] not in holders
+                or m["to"] >= fm.max_mds or m["from"] >= fm.max_mds]
+
+    async def _gc_migrations(self) -> None:
+        if not self._dead_migrations(self.fsmap):
+            return
+
+        def build(fm: FSMap):
+            dead = self._dead_migrations(fm)
+            if not dead:
+                return None
+            for m in dead:
+                fm.migrations.remove(m)
+            return fm, dead
+        ok, dead = await self._propose_change(build)
+        if ok and dead:
+            for m in dead:
+                log.dout(1, f"aborted subtree migration {m['path']} "
+                            f"rank {m['from']} -> {m['to']} (endpoint "
+                            f"gone)")
+
+    async def start_migration(self, path: str, to_rank: int
+                              ) -> tuple[int, str]:
+        """Commit the intent phase of a subtree handoff (operator pin
+        or rebalancer). Authority does NOT move here — the exporting
+        rank sees the entry in its next fsmap publish and runs the
+        freeze/export exchange."""
+        from ceph_tpu.cephfs import _norm
+        path = _norm(path)
+        fm = self.fsmap
+        if to_rank < 0 or to_rank >= fm.max_mds:
+            return -22, f"rank {to_rank} out of range (max_mds " \
+                        f"{fm.max_mds})"
+        owner, root = fm.subtree_owner(path)
+        if path == root and owner == to_rank:
+            return 0, f"subtree {path} already owned by rank {to_rank}"
+        holders = fm.rank_holders()
+        if to_rank not in holders or \
+                holders[to_rank].state != STATE_ACTIVE:
+            return -11, f"rank {to_rank} has no active holder yet"
+        if owner not in holders:
+            # nothing to hand off (owner rank has no daemon at all):
+            # direct commit — there are no caps or in-flight ops to
+            # move and no exporter to run the protocol
+            def build(f: FSMap):
+                o, _ = f.subtree_owner(path)
+                if o in f.rank_holders():
+                    return None
+                f.subtrees[path] = to_rank
+                return f, None
+            ok, _ = await self._propose_change(build)
+            return (0, f"subtree {path} assigned to rank {to_rank} "
+                       f"(previous owner had no daemon)") if ok else \
+                   (-11, "proposal failed")
+
+        def build(f: FSMap):
+            o, r = f.subtree_owner(path)
+            if r == path and o == to_rank:
+                return None
+            if f.migration_for(path) is not None:
+                return None
+            f.migrations.append(
+                {"path": path, "from": o, "to": to_rank})
+            return f, o
+        ok, frm = await self._propose_change(build)
+        if not ok:
+            if self.fsmap.migration_for(path) is not None:
+                return -11, f"a migration of {path} is already in " \
+                            f"flight"
+            return -11, "proposal failed"
+        log.dout(1, f"subtree migration {path}: rank {frm} -> "
+                    f"{to_rank} (intent committed)")
+        return 0, f"migrating subtree {path} from rank {frm} to " \
+                  f"rank {to_rank}"
+
+    async def _maybe_rebalance(self) -> None:
+        """Load-based subtree rebalancer (ref: MDBalancer, mon-side):
+        every ``mds_bal_interval`` compare per-rank op rates; when the
+        hottest active rank exceeds the coldest by
+        ``mds_bal_ratio`` (and clears ``mds_bal_min_ops``), migrate
+        its hottest non-root load prefix to the coldest rank. One
+        migration at a time — the storm of tiny migrations upstream's
+        balancer is notorious for is exactly what the interval +
+        single-flight guard prevents."""
+        cfg = self.mon.config
+        interval = cfg.get("mds_bal_interval", 10.0)
+        if not interval or interval <= 0:
+            return
+        now = asyncio.get_event_loop().time()
+        if now - self._last_balance < interval:
+            return
+        fm = self.fsmap
+        if fm.migrations or fm.max_mds < 2:
+            return
+        actives = fm.actives()
+        if len(actives) < 2:
+            return
+        rates = {r: self.rank_rates.get(r, 0.0) for r in actives}
+        hot = max(rates, key=rates.get)
+        cold = min(rates, key=rates.get)
+        min_ops = cfg.get("mds_bal_min_ops", 20.0)
+        ratio = cfg.get("mds_bal_ratio", 4.0)
+        if hot == cold or rates[hot] < min_ops or \
+                rates[hot] <= ratio * (rates[cold] + 1.0):
+            return
+        # hottest migratable prefix on the hot rank: never "/" itself
+        # (that would move everything), never a prefix it doesn't own
+        cands = {
+            pfx: rate
+            for pfx, rate in self.subtree_rates.get(hot, {}).items()
+            if pfx != "/" and fm.subtree_owner(pfx)[0] == hot}
+        if not cands:
+            return
+        victim = max(cands, key=cands.get)
+        self._last_balance = now
+        ret, rs = await self.start_migration(victim, cold)
+        if ret == 0:
+            self.mon.clog(
+                "INF", f"mds rebalancer: migrating {victim} "
+                       f"(rank {hot} at {rates[hot]:.0f} op/s, rank "
+                       f"{cold} at {rates[cold]:.0f} op/s)")
+        else:
+            log.dout(1, f"rebalancer migration refused: {rs}")
+
     # -- commands ----------------------------------------------------------
     def summary(self) -> dict:
         fm = self.fsmap
         holder = fm.rank_holder(0)
+        holders = fm.rank_holders()
         return {
             "epoch": fm.epoch,
-            "up": {f"mds_{holder.rank}": holder.name}
-            if holder else {},
+            "max_mds": fm.max_mds,
+            "up": {f"mds_{r}": holders[r].name
+                   for r in sorted(holders)},
             "active": holder.name
             if holder and holder.state == STATE_ACTIVE else None,
+            "actives": {r: i.name for r, i in
+                        sorted(fm.actives().items())},
             "state": holder.state if holder else
             ("failed" if fm.failed else "none"),
             "failed": sorted(fm.failed),
             "standby_count": len(fm.standbys()),
+            "subtrees": dict(sorted(fm.subtrees.items())),
+            "migrations": [dict(m) for m in fm.migrations],
+            "rank_ops_rate": {r: round(self.rank_rates.get(r, 0.0), 1)
+                              for r in sorted(holders)},
             "states": {i.name: i.state for i in fm.infos.values()},
         }
+
+    async def _cmd_set_max_mds(self, cmd):
+        """`fs set max_mds <n>` (ref: Filesystem::set_max_mds via
+        MDSMonitor prepare_command). Raising opens ranks the tick
+        fills from standbys. Lowering retires the top ranks: their
+        subtrees are reassigned to rank 0 in the SAME commit (clients
+        re-route immediately) and the displaced holders are then
+        fenced through the normal failover path — honest
+        simplification vs upstream's graceful journal-flush stop,
+        documented in cephfs/README.md."""
+        try:
+            n = int(cmd.get("val", cmd.get("max_mds")))
+        except (TypeError, ValueError):
+            return -22, "usage: fs set max_mds <n>", b""
+        if n < 1 or n > MAX_MDS_CAP:
+            return -22, f"max_mds must be in [1, {MAX_MDS_CAP}]", b""
+
+        def build(fm: FSMap):
+            old = fm.max_mds
+            if old == n:
+                return None
+            fm.max_mds = n
+            if n < old:
+                # reassign subtrees owned by retired ranks; drop
+                # migrations touching them (abort = no authority move)
+                for root, rank in list(fm.subtrees.items()):
+                    if rank >= n:
+                        fm.subtrees[root] = 0
+                fm.migrations = [m for m in fm.migrations
+                                 if m["from"] < n and m["to"] < n]
+                fm.failed = [r for r in fm.failed if r < n]
+            return fm, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            if self.fsmap.max_mds == n:
+                return 0, f"max_mds already {n}", b""
+            return -11, "proposal failed", b""
+        # fence holders of retired ranks (blocklist-first ladder) so a
+        # displaced active cannot keep journaling under a rank clients
+        # no longer route to
+        for gid, info in list(self.fsmap.infos.items()):
+            if info.state in RANK_STATES and info.rank >= n:
+                await self.fail_mds(gid)
+        self.mon.clog("INF", f"fs max_mds set to {n}")
+        return 0, f"max_mds set to {n}", b""
 
     async def handle_command(self, cmd, inbl=b""):
         prefix = cmd.get("prefix", "")
         if prefix in ("fs status", "fs dump", "mds dump"):
-            return 0, "", json.dumps(self.fsmap.dump()).encode()
+            out = self.fsmap.dump()
+            out["rank_ops_rate"] = {
+                str(r): round(v, 1)
+                for r, v in sorted(self.rank_rates.items())}
+            return 0, "", json.dumps(out).encode()
+        if prefix == "fs set":
+            var = str(cmd.get("var", "max_mds"))
+            if var != "max_mds":
+                return -22, f"unknown fs var {var!r}", b""
+            return await self._cmd_set_max_mds(cmd)
+        if prefix == "fs subtree pin":
+            path = str(cmd.get("path", ""))
+            try:
+                rank = int(cmd.get("rank"))
+            except (TypeError, ValueError):
+                return -22, "usage: fs subtree pin <path> <rank>", b""
+            if not path:
+                return -22, "usage: fs subtree pin <path> <rank>", b""
+            ret, rs = await self.start_migration(path, rank)
+            return ret, rs, b""
+        if prefix == "fs subtree ls":
+            return 0, "", json.dumps({
+                "subtrees": dict(sorted(self.fsmap.subtrees.items())),
+                "migrations": [dict(m) for m in
+                               self.fsmap.migrations]}).encode()
         if prefix == "mds fail":
             who = str(cmd.get("who", ""))
             info = None

@@ -153,12 +153,21 @@ class MDSBeacon(Message):
     legal ladder step the MDSMonitor commits it. ``ident`` is the
     incarnation's RADOS entity name — the blocklist fence at failover
     targets it. ``epoch`` is the fsmap epoch the daemon has observed
-    (a far-behind daemon gets a fresh publish)."""
+    (a far-behind daemon gets a fresh publish).
+
+    Round 7 (appended, zero-filled for old construction sites):
+    ``ops`` is the cumulative count of client requests this
+    incarnation has served and ``subtree_ops`` the same count keyed by
+    load-tracking prefix (the owning subtree root, or the depth-1
+    directory for paths under "/") — the per-rank load signal the
+    MDSMonitor's rebalancer consumes (ref: the mds_load_t each beacon
+    carries upstream)."""
 
     TYPE = 147
     FIELDS = [("gid", "u64"), ("name", "str"), ("ident", "str"),
               ("addr_host", "str"), ("addr_port", "u32"),
-              ("state", "str"), ("seq", "u64"), ("epoch", "u64")]
+              ("state", "str"), ("seq", "u64"), ("epoch", "u64"),
+              ("ops", "u64"), ("subtree_ops", "map:str:u64")]
 
 
 @register
@@ -211,6 +220,24 @@ class MAuthUpdate(Message):
 
     TYPE = 150
     FIELDS = [("version", "u64"), ("keys", "map:str:blob")]
+
+
+@register
+class MMDSMigrationDone(Message):
+    """Exporting MDS -> mon: the two-phase subtree handoff of ``path``
+    from rank ``from_rank`` to ``to_rank`` finished its export/import
+    exchange (caps + completed-request tables landed durably on the
+    importer, which acked). The mon answers by COMMITTING the
+    authority flip — rewriting the FSMap subtree map and clearing the
+    migration entry — which is the only point authority actually
+    moves (ref: the MExportDirFinish/subtree-map commit pairing in
+    upstream's Migrator, collapsed onto the mon's paxos commit).
+    Re-sent until the sender observes the flipped fsmap, so a lost
+    report or mon leader change cannot strand a frozen subtree."""
+
+    TYPE = 152
+    FIELDS = [("gid", "u64"), ("path", "str"), ("from_rank", "s32"),
+              ("to_rank", "s32")]
 
 
 @register

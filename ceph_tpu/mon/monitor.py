@@ -16,9 +16,9 @@ import json
 from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
-    MAuthUpdate, MDSBeacon, MLog, MMDSMap, MMonCommand, MMonCommandAck,
-    MMonElection, MMonGetOSDMap, MMonMap, MMonPaxos,
-    MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
+    MAuthUpdate, MDSBeacon, MLog, MMDSMap, MMDSMigrationDone,
+    MMonCommand, MMonCommandAck, MMonElection, MMonGetOSDMap, MMonMap,
+    MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
     MOSDFailure, MOSDMap, MOSDMarkMeDown, MOSDPGReadyToMerge, MPGStats,
 )
 from ceph_tpu.mon.paxos import Paxos
@@ -344,13 +344,14 @@ class Monitor(Dispatcher):
             return True
         if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure,
                             MOSDMarkMeDown, MPGStats, MDSBeacon,
-                            MLog, MOSDPGReadyToMerge)):
+                            MLog, MOSDPGReadyToMerge,
+                            MMDSMigrationDone)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:
                     await self.send_mon(self.leader_rank, msg)
                 return True
-            if isinstance(msg, MDSBeacon):
+            if isinstance(msg, (MDSBeacon, MMDSMigrationDone)):
                 svc = self.mdsmon
             elif isinstance(msg, MLog):
                 svc = self.logmon
@@ -474,6 +475,16 @@ class Monitor(Dispatcher):
             cmd = json.loads(msg.cmd)
         except json.JSONDecodeError:
             cmd = {"prefix": msg.cmd}
+        # cap enforcement, first slice (round 7): the CALLER's stored
+        # caps gate mutating commands at the wire entry — the peer
+        # name is the handshake-authenticated entity, so a `mon r`
+        # client cannot mutate and key ops need `auth *`
+        caller = getattr(msg.conn, "peer_name", None) or ""
+        ret, rs = self.authmon.check_command_caps(caller, cmd)
+        if ret != 0:
+            await msg.conn.send_message(MMonCommandAck(
+                tid=msg.tid, retcode=ret, rs=rs, outbl=b""))
+            return
         ret, rs, outbl = await self.handle_command(cmd, msg.inbl)
         await msg.conn.send_message(MMonCommandAck(
             tid=msg.tid, retcode=ret, rs=rs, outbl=outbl))

@@ -25,8 +25,10 @@ removed member can't be confused with a successor's.
 
 Simplification vs upstream (documented, deliberate): membership
 changes commit under the CURRENT quorum with no joint-consensus
-window; like the reference, a single membership change at a time is
-the supported operation.
+window; a single membership change at a time is the supported
+operation — and round 7 ENFORCES that: a `mon add/rm` arriving while
+one is mid-proposal (or while the quorum is re-forming) returns
+-EAGAIN with a clear message instead of racing the election.
 """
 
 from __future__ import annotations
@@ -98,8 +100,28 @@ class MonmapMonitor(PaxosService):
             return ok, result
 
     # -- commands ----------------------------------------------------------
+    def _membership_busy(self) -> str | None:
+        """Reason a membership change must be refused RIGHT NOW, or
+        None. Concurrent `mon add/rm` are serialized with an explicit
+        -EAGAIN instead of queueing on the proposal lock: the second
+        change would commit against a membership whose election hasn't
+        re-formed yet and race the first one's quorum change (ROADMAP
+        elastic follow-up d — the joint-consensus window this
+        reference deliberately lacks)."""
+        if self._lock.locked():
+            return ("a monmap membership change is already in "
+                    "progress; retry after it commits")
+        if self.mon.state == "electing":
+            return ("monmap quorum is re-forming (election in "
+                    "progress); retry")
+        return None
+
     async def handle_command(self, cmd, inbl=b""):
         prefix = cmd.get("prefix", "")
+        if prefix in ("mon add", "mon rm", "mon remove"):
+            busy = self._membership_busy()
+            if busy is not None:
+                return -11, busy, b""                      # -EAGAIN
         if prefix == "mon add":
             return await self._cmd_add(cmd)
         if prefix in ("mon rm", "mon remove"):

@@ -959,9 +959,17 @@ class OSDMonitor(PaxosService):
                         "(mon_allow_pg_merge=false)")
                     return None
                 if pool.is_erasure():
+                    rejected["ret"] = -95              # -EOPNOTSUPP
                     rejected["msg"] = (
-                        "pg_num decrease on erasure pools not "
-                        "supported")
+                        f"pool '{name}' is erasure-coded: pg merge "
+                        f"(pg_num decrease) is implemented for "
+                        f"replicated pools only — folding an EC "
+                        f"source PG would have to re-stripe every "
+                        f"object's k+m shards into the target's "
+                        f"layout, which this merge (a collection "
+                        f"fold) does not do; create a new pool with "
+                        f"the desired pg_num and migrate, or leave "
+                        f"pg_num as is (EOPNOTSUPP)")
                     return None
                 if int(val) < 1:
                     rejected["msg"] = "pg_num must be >= 1"
@@ -980,7 +988,7 @@ class OSDMonitor(PaxosService):
         ok, _ = await self._propose_change(build)
         if not ok:
             if "msg" in rejected:
-                return -22, rejected["msg"], b""
+                return rejected.get("ret", -22), rejected["msg"], b""
             if not any(p.name == name
                        for p in self.osdmap.pools.values()):
                 return -2, f"pool '{name}' does not exist", b""

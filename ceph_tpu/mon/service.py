@@ -197,9 +197,12 @@ class HealthMonitor(PaxosService):
         mdsmon = getattr(mon, "mdsmon", None)
         fm = mdsmon.fsmap if mdsmon is not None else None
         if fm is not None and (fm.infos or fm.failed):
-            holder = fm.rank_holder(0)
+            holders = fm.rank_holders()
             standbys = len(fm.standbys())
-            if holder is None and fm.failed:
+            laddering = [i for i in holders.values()
+                         if i.state != "active"]
+            if not holders and fm.failed:
+                # every rank is down (multi-rank: ALL of them)
                 if standbys == 0:
                     checks["MDS_ALL_DOWN"] = {
                         "severity": "HEALTH_ERR",
@@ -212,21 +215,37 @@ class HealthMonitor(PaxosService):
                         "summary": f"rank(s) {sorted(fm.failed)} "
                                    f"failed; standby promotion in "
                                    f"progress"}
-            elif holder is not None and holder.state != "active":
+            elif fm.failed or laddering:
+                # some (not all) ranks failed or mid-ladder: the
+                # filesystem serves degraded — only the affected
+                # subtrees park
+                parts = []
+                if fm.failed:
+                    parts.append(f"rank(s) {sorted(fm.failed)} failed")
+                for i in laddering:
+                    parts.append(f"mds.{i.name} (rank {i.rank}) is "
+                                 f"laddering ({i.state})")
                 checks["FS_DEGRADED"] = {
                     "severity": "HEALTH_WARN",
-                    "summary": f"mds.{holder.name} is laddering "
-                               f"({holder.state}); metadata I/O "
-                               f"parked until active"}
+                    "summary": "; ".join(parts) + "; affected "
+                               "subtrees' I/O parked"}
             wanted = getattr(mon, "config", {}) \
                 .get("mds_standby_count_wanted", 1)
-            if holder is not None and holder.state == "active" and \
-                    standbys < wanted:
+            all_active = holders and not laddering and not fm.failed \
+                and len(holders) >= fm.max_mds
+            if all_active and standbys < wanted:
                 checks["MDS_INSUFFICIENT_STANDBY"] = {
                     "severity": "HEALTH_WARN",
                     "summary": f"have {standbys} standby(s), want "
                                f"{wanted}: a failed active has no "
                                f"successor"}
+            if fm.migrations:
+                checks["MDS_SUBTREE_MIGRATING"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": "; ".join(
+                        f"subtree {m['path']} migrating rank "
+                        f"{m['from']} -> {m['to']} (frozen until the "
+                        f"handoff commits)" for m in fm.migrations)}
         pg = mon.osdmon.pg_summary()
         if pg.get("degraded_pgs"):
             checks["PG_DEGRADED"] = {

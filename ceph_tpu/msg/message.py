@@ -41,6 +41,8 @@ _ENC: dict[str, Callable] = {
     "list:blob": lambda e, v: e.list(v, lambda e, x: e.blob(x)),
     "map:str:str": lambda e, v: e.map(v, lambda e, k: e.string(k),
                                       lambda e, x: e.string(x)),
+    "map:str:u64": lambda e, v: e.map(v, lambda e, k: e.string(k),
+                                      lambda e, x: e.u64(x)),
     "map:str:blob": lambda e, v: e.map(v, lambda e, k: e.string(k),
                                        lambda e, x: e.blob(x)),
     "map:s32:blob": lambda e, v: e.map(v, lambda e, k: e.s32(k),
@@ -62,6 +64,8 @@ _DEC: dict[str, Callable] = {
     "list:blob": lambda d: d.list(lambda d: d.blob()),
     "map:str:str": lambda d: d.map(lambda d: d.string(),
                                    lambda d: d.string()),
+    "map:str:u64": lambda d: d.map(lambda d: d.string(),
+                                   lambda d: d.u64()),
     "map:str:blob": lambda d: d.map(lambda d: d.string(),
                                     lambda d: d.blob()),
     "map:s32:blob": lambda d: d.map(lambda d: d.s32(),

@@ -612,7 +612,10 @@ class Thrasher:
                         files_before_kill: int = 4,
                         kills: int = 1,
                         takeover_timeout: float = 30.0,
-                        fence_timeout: float = 15.0) -> dict:
+                        fence_timeout: float = 15.0,
+                        kill_rank: int = 0,
+                        writer_dirs: list | None = None,
+                        survivor_writers: list | None = None) -> dict:
         """The metadata-plane failover storm (the MDS acceptance
         shape): while ``fs_clients`` hammer metadata I/O (unique-file
         writes through the MDS), ``kill -9`` the ACTIVE MDS and assert
@@ -627,6 +630,15 @@ class Thrasher:
         4. the fenced old incarnation's late JOURNAL write is refused
            by the OSDs (blocklist) — the no-split-brain invariant.
 
+        Multi-active variant (round 7): ``kill_rank`` selects which
+        rank's active dies; ``writer_dirs`` gives each writer its own
+        base directory (pin them to ranks first via
+        ``cluster.subtree_pin``) so writers exercise DISJOINT
+        subtrees; ``survivor_writers`` lists writer indexes whose
+        subtree lives on a surviving rank — the storm then also
+        asserts those writers kept acking DURING the takeover window
+        (the surviving-ranks-keep-serving half of the acceptance).
+
         Requires ``cluster.start_fs`` with at least ``kills`` + 1
         daemons. Returns {kills, acked_writes, errors, takeover_s}.
         """
@@ -635,15 +647,18 @@ class Thrasher:
         rng = random.Random(self.seed ^ 0x3D5)
         acked: dict[str, bytes] = {}
         errors: list = []
+        prog = [0] * len(fs_clients)     # per-writer acked count
 
         async def writer(w: int, cl) -> None:
+            base = writer_dirs[w] if writer_dirs else ""
             for i in range(writes):
-                path = f"/mds-storm-{self.seed}-{w}-{i:04d}"
+                path = f"{base}/mds-storm-{self.seed}-{w}-{i:04d}"
                 data = bytes([(w + i) % 256]) * rng.randint(1, 512)
                 try:
                     await asyncio.wait_for(cl.write_file(path, data),
                                            timeout=45.0)
                     acked[path] = data
+                    prog[w] += 1
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
@@ -653,6 +668,7 @@ class Thrasher:
                  for w, cl in enumerate(fs_clients)]
         takeover_s = []
         zombies = []
+        survivor_stalls = []
         try:
             for k in range(kills):
                 deadline = asyncio.get_event_loop().time() + 30.0
@@ -661,17 +677,30 @@ class Thrasher:
                         raise AssertionError(
                             "writers made no progress before kill")
                     await asyncio.sleep(0.05)
-                victim = c.mds_active_name()
-                assert victim is not None, "no active mds to kill"
+                victim = c.mds_active_name(kill_rank)
+                assert victim is not None, \
+                    f"no active mds on rank {kill_rank} to kill"
+                prog_at_kill = list(prog)
                 zombies.append(await c.kill_mds(victim))
-                self._log(f"mds storm: kill -9 active mds.{victim}")
+                self._log(f"mds storm: kill -9 active mds.{victim} "
+                          f"(rank {kill_rank})")
                 t0 = asyncio.get_event_loop().time()
                 newa = await c.wait_for_mds_active(
-                    not_name=victim, timeout=takeover_timeout)
+                    not_name=victim, timeout=takeover_timeout,
+                    rank=kill_rank)
                 takeover_s.append(
                     round(asyncio.get_event_loop().time() - t0, 2))
-                self._log(f"mds storm: mds.{newa} took over "
-                          f"({takeover_s[-1]}s)")
+                self._log(f"mds storm: mds.{newa} took over rank "
+                          f"{kill_rank} ({takeover_s[-1]}s)")
+                for w in (survivor_writers or []):
+                    # a surviving rank's writer must have kept acking
+                    # through the takeover window (unless it already
+                    # finished its budget before the kill)
+                    if prog_at_kill[w] >= writes:
+                        continue
+                    if prog[w] <= prog_at_kill[w]:
+                        survivor_stalls.append(
+                            (k, w, prog_at_kill[w], prog[w]))
             done, pending = await asyncio.wait(tasks, timeout=120.0)
             assert not pending, "writers wedged after mds failover"
         finally:
@@ -680,6 +709,9 @@ class Thrasher:
             await asyncio.gather(*tasks, return_exceptions=True)
         assert not errors, \
             f"writer ops lost across failover: {errors[:4]}"
+        assert not survivor_stalls, \
+            (f"surviving-rank writers stalled during takeover "
+             f"(kill, writer, before, after): {survivor_stalls}")
         # every acked write readable and intact through a survivor
         reader = fs_clients[0]
         for path, data in acked.items():
@@ -688,7 +720,6 @@ class Thrasher:
         # the fenced incarnations' late journal writes must bounce:
         # probe until the blocklist map reaches the serving OSD (the
         # promote already barriered, so this resolves fast)
-        from ceph_tpu.cephfs.mds import JOURNAL_OID
         from ceph_tpu.rados import ObjectOperationError
         for z in zombies:
             deadline = asyncio.get_event_loop().time() + fence_timeout
@@ -697,9 +728,11 @@ class Thrasher:
                     # underscore-prefixed key: journal readers iterate
                     # digit keys only, so a probe landing BEFORE the
                     # blocklist propagates can never poison a later
-                    # replay/tail
+                    # replay/tail (z.journal_oid: the zombie's RANK's
+                    # journal — the object its split-brain write would
+                    # actually target)
                     await z.ioctx.set_omap(
-                        JOURNAL_OID, "_zombie_probe", b"stale")
+                        z.journal_oid, "_zombie_probe", b"stale")
                 except ObjectOperationError as e:
                     assert e.errno == -108, e    # -EBLOCKLISTED
                     break

@@ -157,6 +157,21 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("mds_session_timeout", float, 10.0,
            "client cap-lease length advertised at session open",
            min=0.1),
+    # multi-active metadata plane (round 7; ref: mds_bal_* options +
+    # the Migrator's export sizing): the mon-side load rebalancer and
+    # the two-phase subtree migration.
+    Option("mds_bal_interval", float, 10.0,
+           "seconds between rebalancer decisions on the mon tick "
+           "(0 disables the load-based subtree rebalancer)", min=0.0),
+    Option("mds_bal_ratio", float, 4.0,
+           "hottest/coldest rank op-rate ratio past which a subtree "
+           "migrates off the hot rank", min=1.0),
+    Option("mds_bal_min_ops", float, 20.0,
+           "op/s below which a rank is never considered overloaded "
+           "(don't shuffle an idle filesystem)", min=0.0),
+    Option("mds_migration_timeout", float, 10.0,
+           "exporter-side pacing bound for one subtree handoff "
+           "attempt", min=0.1),
     # elastic control plane (round 6; ref: mon.yaml.in mon options +
     # the pg_autoscaler module's threshold): runtime monmap
     # membership, AuthMonitor key lifecycle, LogMonitor retention and

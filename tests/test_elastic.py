@@ -169,6 +169,143 @@ def test_runtime_mon_membership_and_rotation():
     run(go())
 
 
+def test_concurrent_monmap_changes_serialized_with_eagain():
+    """ROADMAP elastic follow-up (d): a second `mon add/rm` while one
+    membership change is mid-proposal returns -EAGAIN with a clear
+    message instead of racing the election. Deterministic: start the
+    first command, yield until its proposal lock is held, then issue
+    the second inline."""
+    async def go():
+        c = await Cluster(n_mons=2, n_osds=3).start()
+        try:
+            lead = c.leader()
+            assert lead is not None
+            # two prebound joiners (the command requires a live addr)
+            from ceph_tpu.mon.monitor import Monitor
+            joiners = []
+            for name in ("x", "y"):
+                ret, rs, _ = await c.client.mon_command(
+                    {"prefix": "auth get-or-create",
+                     "entity": f"mon.{name}"})
+                assert ret == 0, rs
+                prov = c.monmap.clone()
+                prov.add(name, prov.next_rank(), "127.0.0.1", 0)
+                m = Monitor(name, prov, keyring=c.keyring,
+                            config=c.cfg)
+                addr = await m.msgr.bind()
+                prov.mons[name] = (prov.rank_of_name(name),
+                                   addr.host, addr.port)
+                joiners.append((m, addr))
+            t1 = asyncio.ensure_future(lead.handle_command(
+                {"prefix": "mon add", "name": "x",
+                 "host": joiners[0][1].host,
+                 "port": joiners[0][1].port}))
+            for _ in range(200):
+                if lead.monmapmon._lock.locked():
+                    break
+                await asyncio.sleep(0)
+            assert lead.monmapmon._lock.locked(), \
+                "first mon add never reached its proposal"
+            ret2, rs2, _ = await lead.handle_command(
+                {"prefix": "mon add", "name": "y",
+                 "host": joiners[1][1].host,
+                 "port": joiners[1][1].port})
+            assert ret2 == -11, (ret2, rs2)          # -EAGAIN
+            assert "in progress" in rs2, rs2
+            ret1, rs1, _ = await t1
+            assert ret1 == 0, rs1
+            # the refused change retries fine once the first settled
+            c.mons.append(joiners[0][0])
+            joiners[0][0]._tick_task = asyncio.ensure_future(
+                joiners[0][0]._tick_loop())
+            await joiners[0][0].elector.start()
+            await c.wait_for_quorum(3, timeout=60)
+            # mon rm mid-election is also refused: force electing state
+            lead2 = c.leader()
+            lead2.state = "electing"
+            ret3, rs3, _ = await lead2.handle_command(
+                {"prefix": "mon rm", "name": "y"})
+            lead2.state = "leader"
+            assert ret3 == -11 and "re-forming" in rs3, (ret3, rs3)
+            await joiners[1][0].msgr.shutdown()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_auth_cap_enforcement_first_slice():
+    """ROADMAP elastic follow-up (a), first slice: the mon checks the
+    CALLER's stored caps at the wire command entry. `mon r` can read
+    but not mutate (-EACCES), `mon rw` can run `mon rm`, key ops need
+    `auth *`, and legacy entities with no caps stay unrestricted."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            io = await _pool_io(c)
+            # provision a read-only and a rw entity
+            for ent, caps in (("client.ro", {"mon": "allow r"}),
+                              ("client.rw", {"mon": "allow rw",
+                                             "auth": "allow *"})):
+                ret, rs, out = await c.client.mon_command(
+                    {"prefix": "auth get-or-create", "entity": ent,
+                     "caps": json.dumps(caps)})
+                assert ret == 0, rs
+            keyfor = {}
+            for ent in ("client.ro", "client.rw"):
+                ret, _, out = await c.client.mon_command(
+                    {"prefix": "auth get", "entity": ent})
+                keyfor[ent] = bytes.fromhex(json.loads(out)["key"])
+            ro = Rados(c.monmap, name="client.ro",
+                       keyring=Keyring({"client.ro": keyfor["client.ro"]}))
+            await ro.connect()
+            # reads pass for allow r
+            ret, rs, out = await ro.mon_command({"prefix": "status"})
+            assert ret == 0, rs
+            ret, rs, _ = await ro.mon_command(
+                {"prefix": "mon dump"})
+            assert ret == 0, rs
+            # mutations refused: mon membership, pool edits, key ops
+            ret, rs, _ = await ro.mon_command(
+                {"prefix": "mon add", "name": "z",
+                 "host": "127.0.0.1", "port": 1})
+            assert ret == -13 and "permission denied" in rs \
+                and "mon w" in rs, (ret, rs)
+            ret, rs, _ = await ro.mon_command(
+                {"prefix": "osd pool set", "pool": "data",
+                 "var": "size", "val": "2"})
+            assert ret == -13, (ret, rs)
+            ret, rs, _ = await ro.mon_command(
+                {"prefix": "auth get-or-create",
+                 "entity": "client.sneaky"})
+            assert ret == -13 and "auth *" in rs, (ret, rs)
+            # even auth READS need an auth cap when caps are set
+            ret, rs, _ = await ro.mon_command({"prefix": "auth ls"})
+            assert ret == -13, (ret, rs)
+            await ro.shutdown()
+            # the rw entity mutates fine (ENOENT proves it got PAST
+            # the cap gate), and auth * licenses key ops
+            rw = Rados(c.monmap, name="client.rw",
+                       keyring=Keyring({"client.rw": keyfor["client.rw"]}))
+            await rw.connect()
+            ret, rs, _ = await rw.mon_command(
+                {"prefix": "mon rm", "name": "nonexistent"})
+            assert ret == -2, (ret, rs)              # past the gate
+            ret, rs, _ = await rw.mon_command(
+                {"prefix": "auth get-or-create",
+                 "entity": "client.minted"})
+            assert ret == 0, rs
+            await rw.shutdown()
+            # legacy: the admin's imported boot key has no caps ->
+            # unrestricted (the cluster's own lifecycle stays intact)
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "auth rm", "entity": "client.minted"})
+            assert ret == 0, rs
+            await io.write_full("after-enforcement", b"ok")
+        finally:
+            await c.stop()
+    run(go())
+
+
 def test_elastic_storm_smoke():
     """The acceptance storm, smoke-sized: runtime mon add -> leader
     kill -> re-election -> mon rm, key provision/rotate/revoke with

@@ -1,0 +1,388 @@
+"""Multi-active metadata plane: subtree partitioning, rank-aware
+routing, two-phase migration, and the load rebalancer.
+
+The pinned invariants (ISSUE 8):
+
+- with N >= 2 actives serving DISJOINT subtrees under concurrent
+  multi-client I/O, kill -9 one active: surviving ranks keep serving
+  (writers on them ack DURING the takeover window), the failed rank's
+  standby takes over fenced (zombie journal write bounces), and acked
+  data is bit-identical afterwards;
+- a request aimed at the wrong rank is redirected (-ESTALE naming the
+  owner) and succeeds on the resend;
+- the rebalancer migrates a hot subtree between LIVE ranks under
+  client load with the exactly-once guarantee holding across the
+  handoff (rename double-apply would surface as ENOENT).
+
+ref test model: qa/tasks/cephfs/test_exports.py (export pins) +
+mds_thrash multimds.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.cephfs.client import CephFSClient
+from ceph_tpu.cephfs.fsmap import FSMap, MDSInfo
+from ceph_tpu.cephfs.mds import MDS_PERF
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.sim.thrasher import Thrasher
+
+# fast failover pacing (the test_mds_failover settings) + a disabled
+# rebalancer so subtree placement is exactly what the test pinned
+FAST_CFG = {
+    "mds_beacon_interval": 0.2,
+    "mds_beacon_grace": 2.0,
+    "mds_reconnect_timeout": 1.0,
+    "mds_replay_interval": 0.1,
+    "mds_bal_interval": 0.0,
+}
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _subtree_map(c) -> dict:
+    ret, _, out = await c.client.mon_command(
+        {"prefix": "fs subtree ls"})
+    assert ret == 0
+    return json.loads(out)
+
+
+def test_fsmap_v2_roundtrip_and_subtree_resolution():
+    """Unit pins for the v2 FSMap: encode/decode round-trip of the
+    multi-active fields, default-construction compat, and the
+    longest-prefix ownership rule routing relies on."""
+    m = FSMap()
+    m.epoch = 7
+    m.max_mds = 3
+    m.infos[11] = MDSInfo(gid=11, name="a", ident="mds.a.11",
+                          host="h", port=9, state="active", rank=0)
+    m.infos[12] = MDSInfo(gid=12, name="b", ident="mds.b.12",
+                          host="h", port=10, state="active", rank=2)
+    m.subtrees = {"/": 0, "/a": 1, "/a/b": 2}
+    m.migrations = [{"path": "/c", "from": 0, "to": 1}]
+    m.failed = [1]
+    m.last_failure_osd_epoch = 5
+    d = FSMap.decode(m.encode())
+    assert d.max_mds == 3
+    assert d.subtrees == {"/": 0, "/a": 1, "/a/b": 2}
+    assert d.migrations == [{"path": "/c", "from": 0, "to": 1}]
+    assert d.actives() == {0: d.infos[11], 2: d.infos[12]}
+    # longest-prefix resolution: deeper pins beat ancestors, siblings
+    # fall through, "/" catches the rest
+    assert d.subtree_owner("/a/b/c.txt") == (2, "/a/b")
+    assert d.subtree_owner("/a/bb") == (1, "/a")       # not /a/b!
+    assert d.subtree_owner("/a") == (1, "/a")
+    assert d.subtree_owner("/x/y") == (0, "/")
+    # a default map (v1-era behavior) owns everything at rank 0
+    fresh = FSMap.decode(FSMap().encode())
+    assert fresh.max_mds == 1 and fresh.subtrees == {"/": 0}
+    assert fresh.subtree_owner("/anything") == (0, "/")
+
+
+def test_multi_active_disjoint_subtrees_kill_one_active():
+    """THE acceptance storm: two actives on disjoint subtrees, two
+    clients hammering them, kill -9 the rank-1 active. The rank-0
+    writer must keep acking DURING the takeover (survivor assertion
+    inside mds_storm), no writer may error, acked data stays
+    bit-identical, and the zombie's journal write is fenced."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config=FAST_CFG).start()
+        try:
+            await c.start_fs(n_mds=3, max_mds=2)
+            monmap = c.client.monc.monmap
+            cl0 = await CephFSClient.create(monmap, None, "cephfs",
+                                            keyring=c.keyring)
+            cl1 = await CephFSClient.create(monmap, None, "cephfs",
+                                            keyring=c.keyring)
+            await cl0.mkdir("/w0")
+            await cl0.mkdir("/w1")
+            # /w1 moves to rank 1 through the two-phase migration
+            # (both endpoints live); /w0 stays on rank 0 via "/"
+            await c.subtree_pin("/w1", 1)
+            sub = await _subtree_map(c)
+            assert sub["subtrees"]["/w1"] == 1 and \
+                not sub["migrations"]
+            th = Thrasher(c, seed=31)
+            res = await th.mds_storm(
+                [cl0, cl1], writes=12, files_before_kill=4,
+                kill_rank=1, writer_dirs=["/w0", "/w1"],
+                survivor_writers=[0])
+            assert res["errors"] == 0
+            assert res["acked_writes"] == 2 * 12
+            # the failed rank's successor is active; rank 0's holder
+            # never moved
+            st = json.loads((await c.client.mon_command(
+                {"prefix": "fs dump"}))[2])
+            ranks = {r["rank"]: r for r in st["ranks"]}
+            assert ranks[0]["state"] == "active"
+            assert ranks[1]["state"] == "active"
+            assert st["subtrees"]["/w1"] == 1
+            assert st["last_failure_osd_epoch"] > 0
+            # cross-check through a different client than the writers
+            probe = await CephFSClient.create(monmap, None, "cephfs",
+                                              keyring=c.keyring)
+            assert set(await probe.ls("/w1")) >= {
+                f"mds-storm-31-1-{i:04d}" for i in range(12)}
+            await cl0.unmount()
+            await cl1.unmount()
+            await probe.unmount()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_stale_client_is_redirected_to_owner_rank():
+    """-ESTALE routing: a client whose fsmap is frozen (it keeps
+    routing a migrated subtree to the old rank) gets a redirect
+    naming the owner, records the hint, resends, and succeeds —
+    plus the cross-rank rename -EXDEV guard."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config=FAST_CFG).start()
+        try:
+            await c.start_fs(n_mds=2, max_mds=2)
+            monmap = c.client.monc.monmap
+            cl = await CephFSClient.create(monmap, None, "cephfs",
+                                           keyring=c.keyring)
+            await cl.mkdir("/a")
+            await cl.write_file("/a/before.txt", b"pre-pin")
+            # freeze this client's map: it will keep routing /a to
+            # rank 0 after the migration commits
+            cl._on_fsmap = lambda fm: None
+            await c.subtree_pin("/a", 1)
+            r0 = MDS_PERF.dump().get("redirects_sent", 0)
+            await cl.write_file("/a/after.txt", b"redirected")
+            assert MDS_PERF.dump().get("redirects_sent", 0) > r0, \
+                "stale-routed request was never redirected"
+            # the hint sticks: subsequent ops go straight to rank 1
+            assert await cl.read_file("/a/after.txt") == b"redirected"
+            assert await cl.read_file("/a/before.txt") == b"pre-pin"
+            # the rank-1 daemon actually served ops for /a
+            rank1 = next(m for m in c.mdss
+                         if m.rank == 1 and not m._stopping)
+            assert rank1._subtree_op_counts.get("/a", 0) > 0
+            # cross-rank rename refused with a clear -EXDEV
+            import pytest as _pytest
+            with _pytest.raises(Exception) as ei:
+                await cl.rename("/a/after.txt", "/elsewhere.txt")
+            assert getattr(ei.value, "errno", None) == -18, ei.value
+            # same-rank rename still works
+            await cl.rename("/a/after.txt", "/a/renamed.txt")
+            assert await cl.read_file("/a/renamed.txt") == \
+                b"redirected"
+            await cl.unmount()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_rebalancer_migrates_hot_subtree_exactly_once():
+    """THE rebalancer acceptance: all load lands on /hot (rank 0 via
+    "/"); with rank 1 idle the mon's load rebalancer must migrate
+    /hot to rank 1 UNDER the load, with zero writer errors and the
+    exactly-once guarantee intact — every writer does a
+    create-then-rename pair, so a double-applied rename (a resent
+    mutation re-executed instead of answered from the transferred
+    completed-table) would surface as -ENOENT."""
+    async def go():
+        cfg = dict(FAST_CFG, mds_bal_interval=0.4,
+                   mds_bal_min_ops=5.0, mds_bal_ratio=1.2)
+        c = await Cluster(n_mons=1, n_osds=3, config=cfg).start()
+        try:
+            await c.start_fs(n_mds=2, max_mds=2)
+            monmap = c.client.monc.monmap
+            clients = [await CephFSClient.create(
+                monmap, None, "cephfs", keyring=c.keyring)
+                for _ in range(2)]
+            await clients[0].mkdir("/hot")
+            errors: list = []
+            acked: dict[str, bytes] = {}
+            stop = asyncio.Event()
+
+            async def writer(w: int, cl) -> int:
+                i = 0
+                while not stop.is_set() and i < 200:
+                    src = f"/hot/w{w}-{i:04d}.tmp"
+                    dst = f"/hot/w{w}-{i:04d}"
+                    data = bytes([(w * 7 + i) % 256]) * 128
+                    try:
+                        await asyncio.wait_for(
+                            cl.write_file(src, data), timeout=45.0)
+                        await asyncio.wait_for(
+                            cl.rename(src, dst), timeout=45.0)
+                        acked[dst] = data
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        errors.append((src, repr(e)))
+                    i += 1
+                    await asyncio.sleep(0)
+                return i
+            tasks = [asyncio.ensure_future(writer(w, cl))
+                     for w, cl in enumerate(clients)]
+            # the rebalancer must move /hot to the idle rank 1 while
+            # the writers race the freeze/handoff/flip
+            deadline = asyncio.get_event_loop().time() + 60.0
+            while True:
+                sub = await _subtree_map(c)
+                if sub["subtrees"].get("/hot") == 1 and \
+                        not sub["migrations"]:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"rebalancer never migrated /hot: {sub}"
+                await asyncio.sleep(0.2)
+            # keep writing a beat on the new owner, then stop
+            await asyncio.sleep(1.0)
+            stop.set()
+            await asyncio.wait(tasks, timeout=90.0)
+            assert not errors, \
+                (f"mutations lost/double-applied across the "
+                 f"migration: {errors[:4]}")
+            # every acked rename exactly once: dst readable
+            # bit-identical, src GONE
+            reader = clients[0]
+            listing = set(await reader.ls("/hot"))
+            for dst, data in acked.items():
+                name = dst.rsplit("/", 1)[1]
+                assert name in listing, f"lost {dst}"
+                assert f"{name}.tmp" not in listing, \
+                    f"rename of {dst} half-applied"
+                assert await reader.read_file(dst) == data, dst
+            assert len(acked) > 0
+            # rank 1 is now the one accumulating /hot ops
+            rank1 = next(m for m in c.mdss
+                         if m.rank == 1 and not m._stopping)
+            assert rank1._subtree_op_counts.get("/hot", 0) > 0
+            for cl in clients:
+                await cl.unmount()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_fs_cli_and_command_validation():
+    """Cheap surface pins: CLI spellings parse, fs set max_mds
+    validates, subtree pin validates, and fs dump carries the
+    multi-active blocks."""
+    from ceph_tpu.bench.ceph_cli import parse_command
+    assert parse_command(["fs", "set", "max_mds", "2"])[0] == \
+        {"prefix": "fs set", "var": "max_mds", "val": "2"}
+    assert parse_command(["fs", "subtree", "pin", "/a", "1"])[0] == \
+        {"prefix": "fs subtree pin", "path": "/a", "rank": 1}
+    assert parse_command(["fs", "subtree", "ls"])[0] == \
+        {"prefix": "fs subtree ls"}
+
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config=FAST_CFG).start()
+        try:
+            await c.start_fs(n_mds=3, max_mds=2)
+            for bad in ("0", "17", "x"):
+                ret, rs, _ = await c.client.mon_command(
+                    {"prefix": "fs set", "var": "max_mds",
+                     "val": bad})
+                assert ret == -22, (bad, rs)
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "fs set", "var": "nope", "val": "1"})
+            assert ret == -22
+            # pin to an out-of-range rank refused with the range named
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "fs subtree pin", "path": "/p",
+                 "rank": 9})
+            assert ret == -22 and "max_mds" in rs
+            # fs dump carries subtrees/migrations/max_mds + rank list
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "fs dump"})
+            dump = json.loads(out)
+            assert dump["max_mds"] == 2
+            assert dump["subtrees"]["/"] == 0
+            assert dump["migrations"] == []
+            assert len(dump["ranks"]) == 2
+            # status fsmap block exposes the multi-active summary
+            st = await c.client.status()
+            assert st["fsmap"]["max_mds"] == 2
+            assert set(st["fsmap"]["actives"]) == {0, 1} or \
+                set(st["fsmap"]["actives"]) == {"0", "1"}
+            # LOWERING max_mds: pin a subtree to rank 1 first, then
+            # retire it — the subtree reassigns to rank 0 in the same
+            # commit, the displaced holder is fenced WITHOUT entering
+            # fm.failed (no permanent FS_DEGRADED) and WITHOUT
+            # consuming the standby into the retired rank
+            await c.subtree_pin("/p2", 1)
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "fs set", "var": "max_mds", "val": "1"})
+            assert ret == 0, rs
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while True:
+                lead = c.leader()
+                fm = lead.mdsmon.fsmap
+                holders = fm.rank_holders()
+                if set(holders) == {0} and not fm.failed and \
+                        fm.standbys():
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    (sorted(holders), fm.failed,
+                     [i.dump() for i in fm.infos.values()])
+                await asyncio.sleep(0.1)
+            assert fm.subtrees["/p2"] == 0
+            assert fm.max_mds == 1
+            # the standby survived for a REAL rank-0 failure, and no
+            # daemon holds the retired rank
+            assert all(i.rank != 1 for i in fm.infos.values())
+        finally:
+            await c.stop()
+    run(go())
+
+
+@pytest.mark.slow
+def test_multimds_deep_double_kill_with_migration():
+    """Deep variant: 3 actives + 1 standby, pins on two subtrees,
+    kill the rank-1 AND rank-2 actives back to back under sustained
+    I/O, then migrate a subtree between the survivors."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config=FAST_CFG).start()
+        try:
+            await c.start_fs(n_mds=4, max_mds=3)
+            monmap = c.client.monc.monmap
+            clients = [await CephFSClient.create(
+                monmap, None, "cephfs", keyring=c.keyring)
+                for _ in range(3)]
+            for d, r in (("/d0", 0), ("/d1", 1), ("/d2", 2)):
+                await clients[0].mkdir(d)
+                if r:
+                    await c.subtree_pin(d, r)
+            victim1 = c.mds_active_name(1)
+            th = Thrasher(c, seed=47)
+            res = await th.mds_storm(
+                clients, writes=30, files_before_kill=5, kills=1,
+                kill_rank=1, writer_dirs=["/d0", "/d1", "/d2"],
+                survivor_writers=[0, 2])
+            assert res["errors"] == 0
+            # the first kill consumed the standby pool: revive the
+            # victim as a FRESH incarnation so rank 2's failover has a
+            # successor
+            await c.revive_mds(victim1)
+            # second kill, rank 2, fresh dirs for writers 0/1 on their
+            # existing ranks
+            await clients[0].mkdir("/d0b")
+            await clients[0].mkdir("/d1b")
+            await c.subtree_pin("/d1b", 1)
+            th2 = Thrasher(c, seed=48)
+            res2 = await th2.mds_storm(
+                clients, writes=30, files_before_kill=5, kills=1,
+                kill_rank=2, writer_dirs=["/d0b", "/d1b", "/d2"],
+                survivor_writers=[0, 1])
+            assert res2["errors"] == 0
+            # migrate /d2 between the live survivors (2 -> 0)
+            await c.subtree_pin("/d2", 0)
+            assert (await _subtree_map(c))["subtrees"]["/d2"] == 0
+            await clients[2].write_file("/d2/post.txt", b"migrated")
+            assert await clients[0].read_file("/d2/post.txt") == \
+                b"migrated"
+            for cl in clients:
+                await cl.unmount()
+        finally:
+            await c.stop()
+    run(go())

@@ -132,7 +132,11 @@ def test_split_then_merge_roundtrip_bit_identical():
             ret, rs, _ = await c.client.mon_command(
                 {"prefix": "osd pool set", "pool": "ec",
                  "var": "pg_num", "val": "2"})
-            assert ret == -22 and "erasure" in rs
+            # round 7: EC merge refusal is a self-explanatory
+            # -EOPNOTSUPP naming the replicated-only limitation
+            assert ret == -95, (ret, rs)
+            assert "erasure-coded" in rs and "replicated" in rs \
+                and "EOPNOTSUPP" in rs, rs
             ret, rs, _ = await c.client.mon_command(
                 {"prefix": "osd pool set", "pool": "data",
                  "var": "pg_num", "val": "0"})
