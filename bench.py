@@ -229,6 +229,53 @@ def mds_metric() -> dict:
     return {f"max_mds_{n}": asyncio.run(one(n)) for n in (1, 2, 4)}
 
 
+def tracing_metric() -> dict:
+    """Round-9 observability layer: ops/s on the replicated cluster
+    write path at trace_sampling_rate 0.0 vs 1.0, plus a tracing-off
+    baseline (trace_slow_keep_s=0 disables even the tail-retention
+    timing). The number that must hold: the DISABLED path
+    (sampling 0, tail tracking on — the production default) stays
+    within noise (<5%) of the off baseline; full sampling's cost is
+    reported so the layer's price is pinned in the BENCH trajectory."""
+    import asyncio
+
+    async def one(rate: float, slow_keep: float,
+                  n_ops: int = 160) -> float:
+        from ceph_tpu.cluster.vstart import Cluster
+        c = await Cluster(n_mons=1, n_osds=3, config={
+            "trace_sampling_rate": rate,
+            "trace_slow_keep_s": slow_keep}).start()
+        try:
+            await c.client.pool_create("bench", pg_num=8)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("bench")
+            for i in range(24):                      # warm the path
+                await io.write_full(f"warm-{i}", b"x" * 1024)
+            t0 = time.perf_counter()
+            for i in range(n_ops):
+                await io.write_full(f"obj-{i % 16}", b"x" * 1024)
+            return n_ops / (time.perf_counter() - t0)
+        finally:
+            await c.stop()
+
+    off = asyncio.run(one(0.0, 0.0))          # layer fully off
+    disabled = asyncio.run(one(0.0, 30.0))    # default: tail-only
+    full = asyncio.run(one(1.0, 30.0))        # every op traced
+    disabled_overhead = (off - disabled) / off * 100.0
+    full_overhead = (off - full) / off * 100.0
+    return {
+        "write_ops_per_s_tracing_off": round(off, 1),
+        "write_ops_per_s_sampling_0": round(disabled, 1),
+        "write_ops_per_s_sampling_1": round(full, 1),
+        "disabled_overhead_pct": round(disabled_overhead, 2),
+        "full_sampling_overhead_pct": round(full_overhead, 2),
+        # the assertion the satellite pins: disabled-path cost is
+        # noise (single-run cluster benches jitter a few percent, so
+        # the flag — not a hard error — records the verdict)
+        "disabled_within_noise": bool(disabled_overhead < 5.0),
+    }
+
+
 def main() -> None:
     enc, dec, stream = ec_metrics()
     detail = {
@@ -280,6 +327,10 @@ def main() -> None:
         detail["mds"] = mds_metric()
     except Exception:
         detail["mds_error"] = _short_err()
+    try:
+        detail["tracing"] = tracing_metric()
+    except Exception:
+        detail["tracing_error"] = _short_err()
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),

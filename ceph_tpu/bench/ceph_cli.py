@@ -80,6 +80,18 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
                 cmd["num"] = int(w[2])
             return cmd, b""
         return {"prefix": "log", "logtext": " ".join(w[1:])}, b""
+    if w[0] == "trace":
+        # ceph trace ls [limit] | show <trace_id> | dump — the
+        # reassembled distributed-trace views (slowest-first ls)
+        if w[1] == "ls":
+            cmd = {"prefix": "trace ls"}
+            if len(w) > 2:
+                cmd["limit"] = int(w[2])
+            return cmd, b""
+        if w[1] == "show":
+            return {"prefix": "trace show", "trace_id": int(w[2])}, b""
+        if w[1] == "dump":
+            return {"prefix": "trace dump"}, b""
     if w[:2] == ["mds", "fail"]:
         return {"prefix": "mds fail", "who": w[2]}, b""
     if w[:2] == ["fs", "set"]:

@@ -183,13 +183,19 @@ class CephFSClient(Dispatcher):
         self._reconnect_lock = asyncio.Lock()   # one rank reconnects
         self._reconnecting: set[int] = set()
         self._reconnect_fut: asyncio.Future | None = None
+        # metadata-path tracing: share the data-path objecter's tracer
+        # (one client identity, one span stream + one MTraceReport
+        # flush path)
+        self._objecter = ioctx.rados.objecter
+        self.tracer = self._objecter.tracer
         if not self._ha:
             self._active_event.set()
             self._rank_addrs[0] = mds_addr
 
     @classmethod
     async def create(cls, monmap, mds_addr, pool: str,
-                     keyring=None) -> "CephFSClient":
+                     keyring=None,
+                     config: dict | None = None) -> "CephFSClient":
         """Mount with an OWN RADOS identity — the libcephfs model: ONE
         entity name carries both the MDS sessions and the data-path
         ops, so an MDS eviction's osd blocklist actually fences this
@@ -205,7 +211,11 @@ class CephFSClient(Dispatcher):
         name = f"client.fs{CephFSClient._next_id}"
         if keyring is not None:
             keyring.add(name)
-        r = Rados(monmap, name=name, keyring=keyring)
+        # config reaches the owned objecter's tracer: without it a
+        # cluster running trace_sampling_rate>0 would never see this
+        # client's metadata/data roots (the cluster knobs only live in
+        # daemon config dicts)
+        r = Rados(monmap, name=name, keyring=keyring, config=config)
         await r.connect()
         io = await r.open_ioctx(pool)
         # warm this identity's data path up front: its first op would
@@ -539,6 +549,13 @@ class CephFSClient(Dispatcher):
         self._waiters[tid] = fut
         msg = MClientRequest(tid=tid, op=op, path=path, path2=path2,
                              flags=flags)
+        # metadata-path root span (op_class "metadata"): propagates to
+        # the serving rank; -ESTALE redirect hops are tagged so a
+        # cross-rank bounce is visible in the reassembled trace
+        span = self.tracer.start_root(
+            "mds_req", tags={"op": op, "path": npath,
+                             "op_class": "metadata"})
+        msg.set_trace(span)
         deadline = loop.time() + timeout
         sent_key = None
         redirects = 0
@@ -626,6 +643,11 @@ class CephFSClient(Dispatcher):
                     continue
         finally:
             self._waiters.pop(tid, None)
+            if span is not None:
+                if redirects:
+                    span.tag("redirects", redirects)
+                span.finish()
+            self._objecter.flush_traces()
         if reply.result < 0:
             raise FSError(int(reply.result),
                           reply.payload.decode(errors="replace"))

@@ -67,6 +67,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
+import time
 
 from ceph_tpu.cephfs import CephFSLite, FSError, _fileobj, _norm
 from ceph_tpu.cephfs.fsmap import (
@@ -157,6 +158,12 @@ MDS_PERF = (
                      "subtree handoffs completed as the importer")
     .add_u64_counter("redirects_sent",
                      "-ESTALE redirects to the owning rank")
+    # the metadata op class of the per-op-class latency histograms
+    # (read/write live on the OSD): microseconds, log2 buckets,
+    # rendered as le-bucketed series by the prometheus module
+    .add_histogram("req_latency_hist",
+                   "client metadata op latency, microseconds "
+                   "(log2 buckets)")
     .create_perf_counters()
 )
 
@@ -315,6 +322,10 @@ class MDSDaemon(Dispatcher):
         # cumulative op counters for the beacon's load report
         self._op_count = 0
         self._subtree_op_counts: dict[str, int] = {}
+        # distributed tracing: metadata-op spans continue the client's
+        # context; completed spans piggyback on the beacon
+        from ceph_tpu.utils.tracing import Tracer
+        self.tracer = Tracer(f"mds.{name}", cfg)
         # migration path -> Event set when the freeze lifts; requests
         # whose path falls UNDER a frozen path park on it (export in
         # progress). NB the frozen key is the MIGRATION path, which is
@@ -461,6 +472,9 @@ class MDSDaemon(Dispatcher):
         if self.monc is None or self.state == STATE_STOPPED:
             return
         self._beacon_seq += 1
+        # completed trace spans piggyback on the beacon (the MDS's
+        # only periodic monward report)
+        spans = self.tracer.drain_ship()
         try:
             await self.monc.send_report(MDSBeacon(
                 gid=self.gid, name=self.name, ident=self.ident,
@@ -468,7 +482,8 @@ class MDSDaemon(Dispatcher):
                 state=self.state, seq=self._beacon_seq,
                 epoch=self.fsmap.epoch if self.fsmap else 0,
                 ops=self._op_count,
-                subtree_ops=dict(self._subtree_op_counts)))
+                subtree_ops=dict(self._subtree_op_counts),
+                trace_spans=spans))
             MDS_PERF.inc("beacons_sent")
         except Exception as e:
             log.dout(5, f"beacon send failed: {e!r}")
@@ -658,6 +673,12 @@ class MDSDaemon(Dispatcher):
         ev = self._frozen.setdefault(path, asyncio.Event())
         ev.clear()
         loop = asyncio.get_event_loop()
+        # the handoff is traceable like any op: the exporter's root
+        # span context rides MMDSExportDir so the importer's merge
+        # shows up as a child in the reassembled trace
+        span = self.tracer.start_root(
+            "subtree_export",
+            tags={"path": path, "from_rank": self.rank, "to_rank": to})
         try:
             while self._inflight_under(path):
                 if self._stopping or self.fsmap is None or \
@@ -688,10 +709,13 @@ class MDSDaemon(Dispatcher):
                 fut = loop.create_future()
                 self._export_acks[path] = fut
                 try:
-                    await self.msgr.send_message(MMDSExportDir(
+                    export_msg = MMDSExportDir(
                         path=path, from_rank=self.rank, to_rank=to,
                         cap_seq=self._cap_seq, caps=caps,
-                        completed=completed), dest.addr(), "mds")
+                        completed=completed)
+                    export_msg.set_trace(span)
+                    await self.msgr.send_message(
+                        export_msg, dest.addr(), "mds")
                     rep = await asyncio.wait_for(fut, timeout=2.0)
                     acked = rep.result == 0
                 except Exception:
@@ -725,6 +749,8 @@ class MDSDaemon(Dispatcher):
         except asyncio.CancelledError:
             pass
         finally:
+            if span is not None:
+                span.finish()
             self._exports.discard(path)
             done_ev = self._frozen.pop(path, None)
             if done_ev is not None:
@@ -738,6 +764,9 @@ class MDSDaemon(Dispatcher):
         re-execute (the exactly-once guarantee's durable half)."""
         if not self._active_event.is_set():
             await self._active_event.wait()
+        span = self.tracer.from_msg(
+            "subtree_import", m, tags={"path": m.path,
+                                       "rank": self.rank})
         await self._journaled_apply(
             {"op": "import_subtree", "path": m.path,
              "from": m.from_rank})
@@ -764,6 +793,8 @@ class MDSDaemon(Dispatcher):
                 done.pop(next(iter(done)))
             await self._save_session(client)
         MDS_PERF.inc("subtrees_imported")
+        if span is not None:
+            span.finish()
         log.dout(1, f"mds.{self.name} (rank {self.rank}) imported "
                     f"subtree {m.path} from rank {m.from_rank}")
         await m.conn.send_message(MMDSExportDirAck(
@@ -1324,6 +1355,10 @@ class MDSDaemon(Dispatcher):
         m.path = _norm(m.path)          # caps/journal key consistently
         if m.path2:
             m.path2 = _norm(m.path2)
+        span = self.tracer.from_msg(
+            "mds_op", m, tags={"op": m.op, "path": m.path,
+                               "rank": self.rank})
+        t0 = time.monotonic()
         # multi-active routing (round 7): a request for a subtree this
         # rank does not own is REDIRECTED before the session check — a
         # client aimed at the wrong rank needs the owner's address,
@@ -1332,6 +1367,16 @@ class MDSDaemon(Dispatcher):
         if self.monc is not None and self.fsmap is not None:
             red = await self._route_or_park(m)
             if red is not None:
+                if span is not None:
+                    # the -ESTALE hop is a real phase of the op: keep
+                    # it in the trace so a cross-rank bounce shows up
+                    span.tag("redirect", True)
+                    try:
+                        span.tag("redirect_to", json.loads(
+                            red.payload).get("rank"))
+                    except Exception:
+                        pass
+                    span.finish()
                 await m.conn.send_message(red)
                 return
             admitted = m._admitted
@@ -1340,6 +1385,10 @@ class MDSDaemon(Dispatcher):
         finally:
             if admitted is not None:
                 self._inflight_done(admitted)
+            if span is not None:
+                span.finish()
+            MDS_PERF.hist_add("req_latency_hist",
+                              (time.monotonic() - t0) * 1e6)
 
     async def _serve_request(self, m: MClientRequest) -> None:
         if m.src not in self.sessions:

@@ -76,7 +76,8 @@ class Cluster:
             mon._tick_task = asyncio.ensure_future(mon._tick_loop())
         for mon in self.mons:
             await mon.elector.start()
-        self.client = Rados(self.monmap, keyring=self.keyring)
+        self.client = Rados(self.monmap, keyring=self.keyring,
+                            config=self.cfg)
         # wait for a working quorum via the client path
         ret, rs, _ = await self.client.mon_command({"prefix": "status"},
                                                    timeout=30.0)

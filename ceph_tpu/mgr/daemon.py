@@ -51,10 +51,12 @@ class Mgr:
         self.config = config or {}
         from ceph_tpu.mgr.modules import (
             BalancerModule, PGAutoscalerModule, PrometheusModule,
+            TracingModule,
         )
         self.modules = [cls(self) for cls in (
             modules if modules is not None else
-            [BalancerModule, PGAutoscalerModule, PrometheusModule])]
+            [BalancerModule, PGAutoscalerModule, PrometheusModule,
+             TracingModule])]
         self.active = False
         self._tasks: list[asyncio.Task] = []
         self._stopped = False

@@ -381,7 +381,11 @@ class PrometheusModule(MgrModule):
                 f"{md.get('remap_full_sweeps', 0)}",
             ]
         # in-process perf counters (ref: prometheus module exporting
-        # daemon perf counters)
+        # daemon perf counters); TYPE_HISTOGRAM counters render as
+        # real le-bucketed _bucket/_sum/_count series (round 9 — the
+        # log2 buckets existed since round 1 but nothing exported
+        # them), so tail latency is queryable without traces at all
+        hist_lines: list[str] = []
         for name, counters in PerfCountersCollection.instance() \
                 .dump().items():
             for key, val in counters.items():
@@ -389,6 +393,26 @@ class PrometheusModule(MgrModule):
                     lines.append(
                         f'ceph_perf{{daemon="{name}",counter="{key}"}}'
                         f' {val}')
+                elif isinstance(val, dict) and "log2_buckets" in val:
+                    from ceph_tpu.utils.perf_counters import \
+                        hist_cumulative
+                    lab = f'daemon="{name}",counter="{key}"'
+                    for le, cum in hist_cumulative(
+                            val["log2_buckets"]):
+                        hist_lines.append(
+                            f'ceph_perf_hist_bucket{{{lab},'
+                            f'le="{le:g}"}} {cum}')
+                    hist_lines += [
+                        f'ceph_perf_hist_bucket{{{lab},le="+Inf"}} '
+                        f'{val["count"]}',
+                        f'ceph_perf_hist_sum{{{lab}}} '
+                        f'{val["sum"]:.9g}',
+                        f'ceph_perf_hist_count{{{lab}}} '
+                        f'{val["count"]}',
+                    ]
+        if hist_lines:
+            lines.append("# TYPE ceph_perf_hist histogram")
+            lines += hist_lines
         return "\n".join(lines) + "\n"
 
     async def _serve_client(self, reader, writer) -> None:
@@ -417,6 +441,102 @@ class PrometheusModule(MgrModule):
     async def close(self) -> None:
         if self._server:
             self._server.close()
+
+
+class TracingModule(MgrModule):
+    """Distributed-trace aggregation (round 9; ref: the mgr's role as
+    the cluster's observability sink — upstream ships spans to Jaeger,
+    here they pool at the mon and the mgr reassembles). Each tick
+    pulls the mon's span feed incrementally (`trace dump` with a
+    ``since`` cursor) and folds it into a TraceIndex keyed by
+    trace_id; ``trace_ls()`` serves slowest-traces-first and
+    ``trace_show(id)`` the span tree + per-phase latency breakdown —
+    the same views `ceph trace ls/show` serve mon-side, but surviving
+    here across mon leader changes (the cursor self-heals when a new
+    leader's pool restarts at 0)."""
+
+    NAME = "tracing"
+    # modest default pull cadence (override per-cluster with
+    # mgr_tracing_interval — tests run it at 0.25 s); traces are a
+    # debugging surface, not a control loop
+    TICK_INTERVAL = 1.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        from ceph_tpu.utils.tracing import TraceIndex
+        self.index = TraceIndex(max_traces=mgr.config.get(
+            "mgr_tracing_max_traces", 512))
+        self._since = 0
+        self._gen = 0            # serving pool's generation token
+        self.spans_ingested = 0
+        self.asok = None
+
+    async def tick(self) -> None:
+        if self.asok is None and self.mgr.config.get(
+                "admin_socket_dir"):
+            from ceph_tpu.utils.admin_socket import AdminSocket
+            self.asok = AdminSocket(
+                f"{self.mgr.config['admin_socket_dir']}/"
+                f"mgr.{self.mgr.name}.asok")
+            def _safe_int(v, default=0):
+                try:
+                    return int(v)
+                except (TypeError, ValueError):
+                    return default
+            self.asok.register(
+                "trace ls",
+                lambda cmd: {"traces": self.trace_ls(
+                    _safe_int(cmd.get("limit", 20), 20))},
+                "reassembled traces, slowest first")
+            self.asok.register(
+                "trace show",
+                lambda cmd: self.trace_show(
+                    _safe_int(cmd.get("trace_id", 0))) or
+                {"error": "no such trace"},
+                "one trace: span tree + per-phase latency breakdown")
+            self.asok.register(
+                "trace status",
+                lambda: {"traces": len(self.index.traces),
+                         "spans_ingested": self.spans_ingested,
+                         "since": self._since},
+                "tracing module ingest cursor + index size")
+            await self.asok.start()
+        ret, _, out = await self.mon_command(
+            {"prefix": "trace dump", "since": self._since})
+        if ret != 0:
+            return
+        import json as _json
+        try:
+            data = _json.loads(out)
+        except _json.JSONDecodeError:
+            return
+        gen = int(data.get("gen", 0))
+        if gen != self._gen:
+            # mon leader changed (fresh pool, fresh generation token):
+            # seq comparison alone misses the case where the new pool
+            # already caught up past our cursor. A response pulled
+            # with since=0 is complete regardless of generation —
+            # adopt and ingest it; anything else was filtered by a
+            # stale cursor, so drop it and re-pull next tick.
+            self._gen = gen
+            if self._since != 0:
+                self._since = 0
+                return
+        self._since = int(data.get("seq", 0))
+        for span in data.get("spans", []):
+            self.index.add(span)
+            self.spans_ingested += 1
+
+    # -- views (the `ceph trace ls/show` payloads) ---------------------
+    def trace_ls(self, limit: int = 20) -> list[dict]:
+        return self.index.ls(limit=limit)
+
+    def trace_show(self, trace_id: int) -> dict | None:
+        return self.index.show(trace_id)
+
+    async def close(self) -> None:
+        if self.asok is not None:
+            await self.asok.stop()
 
 
 class RestModule(MgrModule):

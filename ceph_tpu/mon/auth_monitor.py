@@ -65,6 +65,7 @@ READONLY_COMMANDS = frozenset((
     "osd pool ls", "osd getmap", "osd getcrushmap", "osd map",
     "osd blocklist ls", "pg dump", "pg map", "fs status", "fs dump",
     "fs subtree ls", "mds dump",
+    "trace dump", "trace ls", "trace show",
 ))
 AUTH_READS = frozenset(("auth get", "auth ls"))
 

@@ -167,7 +167,11 @@ class MDSBeacon(Message):
     FIELDS = [("gid", "u64"), ("name", "str"), ("ident", "str"),
               ("addr_host", "str"), ("addr_port", "u32"),
               ("state", "str"), ("seq", "u64"), ("epoch", "u64"),
-              ("ops", "u64"), ("subtree_ops", "map:str:u64")]
+              ("ops", "u64"), ("subtree_ops", "map:str:u64"),
+              # round 9 (appended, zero-filled): completed trace spans
+              # piggybacked monward — each blob one JSON span dict
+              # (utils.tracing.Span.dump)
+              ("trace_spans", "list:blob")]
 
 
 @register
@@ -190,12 +194,17 @@ class MPGStats(Message):
     ``used_bytes``/``capacity_bytes`` are the daemon's statfs (ref:
     osd_stat_t::statfs riding MPGStats): the mon aggregates them into
     per-OSD utilization and derives NEARFULL/FULL state + the cluster
-    FULL flag. capacity 0 = unbounded store, fullness not tracked."""
+    FULL flag. capacity 0 = unbounded store, fullness not tracked.
+    ``trace_spans`` (round 9, appended) piggybacks the daemon's
+    completed trace spans so the mon's pool — and through it the mgr
+    TracingModule — can reassemble cross-daemon traces without a new
+    report channel."""
 
     TYPE = 145
     FIELDS = [("osd", "s32"), ("epoch", "u32"),
               ("stats", "map:str:blob"), ("slow_ops", "u32"),
-              ("used_bytes", "u64"), ("capacity_bytes", "u64")]
+              ("used_bytes", "u64"), ("capacity_bytes", "u64"),
+              ("trace_spans", "list:blob")]
 
 
 @register
@@ -238,6 +247,18 @@ class MMDSMigrationDone(Message):
     TYPE = 152
     FIELDS = [("gid", "u64"), ("path", "str"), ("from_rank", "s32"),
               ("to_rank", "s32")]
+
+
+@register
+class MTraceReport(Message):
+    """Client -> mon trace-span shipment (the piggyback gap-filler:
+    OSDs ride MPGStats and MDSes ride MDSBeacon, but a client has no
+    periodic report — the objecter flushes its tracer's ship queue
+    through this instead). Fire-and-forget, leader-forwarded like the
+    other daemon reports; each blob is one JSON span dict."""
+
+    TYPE = 153
+    FIELDS = [("daemon", "str"), ("spans", "list:blob")]
 
 
 @register

@@ -20,6 +20,7 @@ from ceph_tpu.mon.messages import (
     MMonCommand, MMonCommandAck, MMonElection, MMonGetOSDMap, MMonMap,
     MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
     MOSDFailure, MOSDMap, MOSDMarkMeDown, MOSDPGReadyToMerge, MPGStats,
+    MTraceReport,
 )
 from ceph_tpu.mon.paxos import Paxos
 from ceph_tpu.mon.store import MonitorDBStore
@@ -156,6 +157,26 @@ class Monitor(Dispatcher):
         self.services = [self.monmapmon, self.authmon, self.logmon,
                          self.osdmon, self.mdsmon, self.configmon,
                          self.healthmon]
+
+        # trace-span pool (round 9, ref: the mgr's role as trace sink
+        # upstream): spans piggybacked on MPGStats/MDSBeacon (and
+        # shipped via MTraceReport by clients) land here, IN MEMORY
+        # only — traces are observability, never a paxos artifact. The
+        # mgr TracingModule drains it via `trace dump`; `trace ls/show`
+        # serve the same reassembly directly for the CLI.
+        import collections
+        import random as _random
+        from ceph_tpu.utils.tracing import TraceIndex
+        self.trace_spans: collections.deque = collections.deque(
+            maxlen=int(cfg.get("mon_trace_buffer", 4096)))
+        self._trace_seq = 0
+        # pool generation: a fresh random token per pool instance, so
+        # a puller (the mgr TracingModule) detects a leader change
+        # even when the new pool's seq has already caught up to its
+        # old cursor — seq comparison alone cannot
+        self._trace_gen = _random.getrandbits(63) | 1
+        self.trace_index = TraceIndex(
+            max_traces=int(cfg.get("mon_trace_max_traces", 512)))
 
         # subscriptions: conn -> {what: next_epoch}
         self.subs: dict[object, dict[str, int]] = {}
@@ -345,11 +366,20 @@ class Monitor(Dispatcher):
         if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure,
                             MOSDMarkMeDown, MPGStats, MDSBeacon,
                             MLog, MOSDPGReadyToMerge,
-                            MMDSMigrationDone)):
+                            MMDSMigrationDone, MTraceReport)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:
                     await self.send_mon(self.leader_rank, msg)
+                return True
+            # trace spans ride the existing reports (MPGStats /
+            # MDSBeacon piggyback, MTraceReport for clients): pool
+            # them before the service dispatch
+            blobs = getattr(msg, "trace_spans", None) or \
+                (msg.spans if isinstance(msg, MTraceReport) else None)
+            if blobs:
+                self.ingest_trace_spans(blobs)
+            if isinstance(msg, MTraceReport):
                 return True
             if isinstance(msg, (MDSBeacon, MMDSMigrationDone)):
                 svc = self.mdsmon
@@ -360,6 +390,22 @@ class Monitor(Dispatcher):
             asyncio.ensure_future(svc.handle(msg))
             return True
         return False
+
+    # -- trace pool (round 9) ----------------------------------------------
+    def ingest_trace_spans(self, blobs) -> None:
+        """Pool shipped span blobs (JSON) for the mgr's `trace dump`
+        pull and the mon's own `trace ls/show` reassembly. Malformed
+        blobs are dropped — observability must never take a mon down."""
+        for b in blobs:
+            try:
+                span = json.loads(b)
+            except (json.JSONDecodeError, TypeError, ValueError):
+                continue
+            if not isinstance(span, dict):
+                continue
+            self._trace_seq += 1
+            self.trace_spans.append((self._trace_seq, span))
+            self.trace_index.add(span)
 
     async def _dispatch_mon_msg(self, msg) -> None:
         if isinstance(msg, MMonElection):
@@ -525,8 +571,46 @@ class Monitor(Dispatcher):
             return await self.configmon.handle_command(cmd, inbl)
         if prefix.startswith(("fs", "mds")):
             return await self.mdsmon.handle_command(cmd, inbl)
+        if prefix.startswith("trace"):
+            return self._handle_trace_command(cmd)
         if prefix.startswith(("osd", "pg")):
             return await self.osdmon.handle_command(cmd, inbl)
+        return -22, f"unknown command {prefix!r}", b""    # -EINVAL
+
+    def _handle_trace_command(self, cmd: dict) -> tuple[int, str, bytes]:
+        """`ceph trace ...` (round 9): ``dump`` is the raw span feed
+        the mgr TracingModule pulls (incremental by ``since``);
+        ``ls``/``show`` serve the reassembled per-phase view directly
+        from the mon's TraceIndex so the CLI works without a mgr."""
+        prefix = cmd.get("prefix", "")
+        if prefix == "trace dump":
+            try:
+                since = int(cmd.get("since", 0))
+            except (TypeError, ValueError):
+                return -22, "since must be an integer", b""
+            if since > self._trace_seq:
+                since = 0           # a new leader's pool restarts at 0
+            return 0, "", json.dumps({
+                "gen": self._trace_gen,
+                "seq": self._trace_seq,
+                "spans": [s for q, s in self.trace_spans
+                          if q > since]}).encode()
+        if prefix == "trace ls":
+            try:
+                limit = int(cmd.get("limit", 20))
+            except (TypeError, ValueError):
+                return -22, "limit must be an integer", b""
+            return 0, "", json.dumps({
+                "traces": self.trace_index.ls(limit=limit)}).encode()
+        if prefix == "trace show":
+            try:
+                tid = int(cmd.get("trace_id", 0))
+            except (TypeError, ValueError):
+                return -22, "trace_id must be an integer", b""
+            out = self.trace_index.show(tid)
+            if out is None:
+                return -2, f"no trace {tid}", b""         # -ENOENT
+            return 0, "", json.dumps(out).encode()
         return -22, f"unknown command {prefix!r}", b""    # -EINVAL
 
     def clog(self, level: str, msg: str) -> None:

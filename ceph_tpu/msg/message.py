@@ -113,6 +113,21 @@ class Message:
         self.seq = 0
         self.src = None          # EntityName of the sender
         self.conn = None         # Connection it arrived on
+        # distributed-trace context (ref: the trace context riding
+        # MOSDOp through src/common/tracer.cc): appended zero-filled
+        # to every frame, so every existing construction site keeps
+        # working and pre-trace blobs decode with a zeroed context.
+        # 0 = untraced.
+        self.trace_id = 0
+        self.parent_span_id = 0
+
+    def set_trace(self, span) -> None:
+        """Stamp an outgoing message with ``span``'s context so the
+        receiver's span becomes its child. No-op for None / unsampled
+        (local-only) spans — their context must not propagate."""
+        if span is not None and span.trace_id:
+            self.trace_id = span.trace_id
+            self.parent_span_id = span.span_id
 
     # -- payload ----------------------------------------------------------
     def encode_payload(self, e: Encoder) -> None:
@@ -129,6 +144,10 @@ class Message:
         e = Encoder()
         e.u16(self.TYPE).u64(self.seq)
         self.encode_payload(e)
+        # trace context rides APPENDED, after the payload: old decoders
+        # stop at their payload's end, and old blobs (no trailing pair)
+        # decode below with a zeroed context
+        e.u64(self.trace_id).u64(self.parent_span_id)
         return e.tobytes()
 
     @staticmethod
@@ -141,6 +160,9 @@ class Message:
             raise ValueError(f"unknown message type {code}")
         m = cls.decode_payload(d)
         m.seq = seq
+        if d.remaining() >= 16:           # pre-trace blob: stays zeroed
+            m.trace_id = d.u64()
+            m.parent_span_id = d.u64()
         return m
 
     def __repr__(self) -> str:

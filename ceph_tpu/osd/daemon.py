@@ -98,7 +98,26 @@ class OSD(Dispatcher):
         self._statfs_reported = 0   # last capacity sent monward
         # ref: OSD op tracking + admin socket
         self.op_tracker = OpTracker(
-            slow_op_warn_s=cfg.get("osd_op_complaint_time", 30.0))
+            history_size=cfg.get("osd_op_history_size"),
+            slow_op_warn_s=cfg.get("osd_op_complaint_time"))
+        # distributed tracing (ref: src/common/tracer.cc in the OSD):
+        # spans for sampled ops — queue/execute/repop/objectstore
+        # phases — shipped monward on the stats piggyback
+        from ceph_tpu.utils.tracing import Tracer
+        self.tracer = Tracer(name, cfg)
+        # per-op-class latency histograms (ref: the OSD's
+        # l_osd_op_r/w_latency counters, as real TYPE_HISTOGRAM log2
+        # buckets in MICROSECONDS — the prometheus module renders them
+        # as le-bucketed series)
+        self.perf = (
+            PerfCountersBuilder(name)
+            .add_histogram("op_r_latency_hist",
+                           "read op latency, microseconds "
+                           "(log2 buckets)")
+            .add_histogram("op_w_latency_hist",
+                           "write op latency, microseconds "
+                           "(log2 buckets)")
+            .create_perf_counters())
         self._slow_reported = 0     # last slow-op count sent monward
         self.asok = None
         self._asok_dir = cfg.get("admin_socket_dir")
@@ -292,6 +311,10 @@ class OSD(Dispatcher):
             self.asok.register(
                 "dump_slow_ops", self.op_tracker.dump_slow_ops,
                 "in-flight ops older than the complaint threshold")
+            self.asok.register(
+                "dump_tracing", self.tracer.dump,
+                "completed trace spans (bounded buffer + slow ring) "
+                "and the tracer's sampling/retention state")
             self.asok.register(
                 "config show", lambda: dict(self.config),
                 "daemon configuration")
@@ -608,6 +631,15 @@ class OSD(Dispatcher):
                 return True
             # admission throttle: past the cap, ops queue here (FIFO)
             # rather than dispatch (ref: osd_client_message_cap)
+            op_span = self.tracer.from_msg(
+                "osd_op", msg, tags={"osd": self.whoami,
+                                     "oid": msg.oid})
+            if op_span is not None:
+                # the op's primary-side span opens at admission; its
+                # "queue" child covers throttle + pg-queue wait and is
+                # closed by the op worker when execution starts
+                msg._span = op_span
+                msg._queue_span = op_span.child("queue")
             self._admit_queue.put_nowait(msg)
             return True
         if isinstance(msg, MOSDRepOp):
@@ -871,18 +903,22 @@ class OSD(Dispatcher):
                 # it — reported whenever a capacity is configured
                 cap = int(self.config.get("osd_capacity_bytes", 0))
                 used = self.store_used_bytes() if cap > 0 else 0
+                # trace spans ride the stats report (ref: the daemon
+                # perf/health reporting the mgr aggregates upstream)
+                spans = self.tracer.drain_ship()
                 # keep reporting until a zero count has been sent: a
                 # daemon whose slow ops drained (or whose capacity
                 # went back to unbounded) while it held no primary
                 # PGs must still clear the mon's warning/utilization
-                if not stats and not slow and not cap and \
-                        not self._slow_reported and \
+                if not stats and not slow and not cap and not spans \
+                        and not self._slow_reported and \
                         not self._statfs_reported:
                     continue
                 await self.monc.send_report(MPGStats(
                     osd=self.whoami, epoch=self.osdmap.epoch,
                     stats=stats, slow_ops=slow,
-                    used_bytes=used, capacity_bytes=cap))
+                    used_bytes=used, capacity_bytes=cap,
+                    trace_spans=spans))
                 self._slow_reported = slow
                 self._statfs_reported = cap
                 # merge readiness barrier: re-reported EVERY tick

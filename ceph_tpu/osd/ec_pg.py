@@ -576,12 +576,25 @@ class ECPG(PG):
         pending: set[int] = set()
         waiter = asyncio.get_event_loop().create_future()
         remote = []
+        # EC fan-out trace phase (ref: the repop_wait analog for
+        # MOSDECSubOpWrite): sub-writes carry this span's context so
+        # each shard's apply becomes its child
+        op_span = getattr(self, "_active_span", None)
+        sub_span = op_span.child(
+            "ec_subop_wait",
+            tags={"shards": sorted(per_osd)}) if op_span else None
         for osd_id, msg in per_osd.items():
             if osd_id == self.osd.whoami:
+                store_span = op_span.child(
+                    "objectstore_commit",
+                    tags={"osd": self.osd.whoami}) if op_span else None
                 if self._apply_sub_write(msg, local=True) == 0:
                     committed += 1
+                if store_span is not None:
+                    store_span.finish()
             else:
                 pending.add(osd_id)
+                msg.set_trace(sub_span)
                 remote.append((osd_id, msg))
         failed: set[int] = set()
         self._subop_waiters[tid] = (pending, waiter, failed)
@@ -597,6 +610,8 @@ class ECPG(PG):
                 await asyncio.wait_for(waiter, timeout=5.0)
             except asyncio.TimeoutError:
                 log.dout(1, f"pg {self.pgid} ec sub-op {tid} timed out")
+        if sub_span is not None:
+            sub_span.finish()
         remaining, _, failed = self._subop_waiters.pop(
             tid, (set(), None, set()))
         # A shard that replied with a non-zero result did NOT durably
@@ -641,7 +656,19 @@ class ECPG(PG):
         return 0
 
     def handle_ec_sub_write(self, m: MOSDECSubOpWrite) -> None:
+        span = self.osd.tracer.from_msg(
+            "ec_sub_write", m, tags={"osd": self.osd.whoami,
+                                     "oid": m.oid})
+        store_span = span.child(
+            "objectstore_commit",
+            tags={"osd": self.osd.whoami}) if span else None
         result = self._apply_sub_write(m)
+        if store_span is not None:
+            store_span.finish()
+        if span is not None:
+            if result != 0:
+                span.tag("result", result)
+            span.finish()
 
         async def _ack():
             try:

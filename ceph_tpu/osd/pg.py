@@ -35,7 +35,7 @@ from ceph_tpu.osd.messages import (
     MBackfillReserve, MOSDOp, MOSDOpReply, MOSDPGBackfill,
     MOSDPGBackfillReply, MOSDPGInfo, MOSDPGPull, MOSDPGPush,
     MOSDPGPushReply, MOSDPGQuery, MOSDPGScan, MOSDPGScanReply,
-    MOSDRepOp, MOSDRepOpReply,
+    MOSDRepOp, MOSDRepOpReply, MUTATING_OPS,
     MWatchNotify, OSD_OP_DELETE,
     OSD_OP_GETXATTR, OSD_OP_NOTIFY, OSD_OP_NOTIFY_ACK, OSD_OP_OMAP_GET,
     OSD_OP_OMAP_SET, OSD_OP_PGLS,
@@ -151,6 +151,11 @@ class PG:
         # op pipeline
         self.op_queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
+        # the op the serialized worker is executing RIGHT NOW, as its
+        # trace "execute" span (the worker is one-op-at-a-time, so an
+        # instance slot is race-free); _submit_write hangs the
+        # objectstore/repop child spans off it
+        self._active_span = None
         # asserted client backoffs (ref: PG::Backoff / backoff_map):
         # client entity -> [backoff id, conn]. Asserted while the PG
         # is not active (peering) or its op queue is saturated;
@@ -1302,11 +1307,19 @@ class PG:
                 t.omap_setkeys(self.cid, m.oid, m.omap)
         else:
             t.remove(self.cid, m.oid)
+        span = self.osd.tracer.from_msg(
+            "push_apply", m, tags={"osd": self.osd.whoami,
+                                   "oid": m.oid})
         try:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
             log.error(f"pg {self.pgid} push apply failed: {e}")
+            if span is not None:
+                span.tag("error", str(e)).finish()
             return False
+        finally:
+            if span is not None and not span.finished:
+                span.finish()
         self.my_missing.pop(m.oid, None)
         fut = self._push_waiters.get(m.oid)
         if fut and not fut.done():
@@ -1330,23 +1343,37 @@ class PG:
         peer_missing; returns True (and schedules a retry) when a LIVE
         peer's push went unacked — a down peer is left to the next map
         change."""
-        acks: list[tuple[int, str, asyncio.Future]] = []
+        acks: list[tuple[int, str, asyncio.Future, object]] = []
         for o, oid, push in sends:
             fut = asyncio.get_event_loop().create_future()
             self._push_ack_waiters[(o, oid)] = fut
+            # each push is its own (head-sampled) trace root: recovery
+            # has no client op to hang off, but its store/apply time
+            # on the target is exactly the interference perf work
+            # needs to see
+            span = self.osd.tracer.start_root(
+                "recovery_push",
+                tags={"pgid": self.cid, "oid": oid, "to_osd": o})
+            push.set_trace(span)
             try:
                 await self.osd.send_osd(o, push)
             except Exception as e:
                 log.dout(1, f"pg {self.pgid} push {oid}->{o} "
                             f"failed: {e}")
                 self._push_ack_waiters.pop((o, oid), None)
+                if span is not None:
+                    span.tag("send_failed", True).finish()
                 continue
-            acks.append((o, oid, fut))
+            acks.append((o, oid, fut, span))
         if acks:
-            await asyncio.wait([f for _, _, f in acks], timeout=5.0)
+            await asyncio.wait([f for _, _, f, _ in acks], timeout=5.0)
         incomplete = False
-        for o, oid, fut in acks:
+        for o, oid, fut, span in acks:
             self._push_ack_waiters.pop((o, oid), None)
+            if span is not None:
+                if not fut.done():
+                    span.tag("unacked", True)
+                span.finish()
             if fut.done():
                 self.peer_missing.get(o, {}).pop(oid, None)
             elif self.osd.osd_is_up(o):
@@ -1448,6 +1475,7 @@ class PG:
                 self.osd.client_throttle.release(cost)
 
     async def _op_worker(self) -> None:
+        import time as _time
         try:
             while True:
                 m = await self.op_queue.get()
@@ -1459,6 +1487,16 @@ class PG:
                     while not self.role_active():
                         await asyncio.sleep(0.05)
                 tracked.mark_event("started")
+                # trace phases: "queue" (admission -> here) closes,
+                # "execute" opens; _submit_write hangs the repop/store
+                # children off self._active_span
+                op_span = getattr(m, "_span", None)
+                qspan = getattr(m, "_queue_span", None)
+                if qspan is not None:
+                    qspan.finish()
+                self._active_span = op_span.child("execute") \
+                    if op_span is not None else None
+                t0 = _time.monotonic()
                 try:
                     await self._execute(m)
                 except Exception as e:
@@ -1466,6 +1504,19 @@ class PG:
                     await self._reply(m, -5, b"", {})       # -EIO
                 finally:
                     tracked.finish()
+                    if self._active_span is not None:
+                        self._active_span.finish()
+                        self._active_span = None
+                    if op_span is not None:
+                        op_span.finish()
+                    # per-op-class latency histogram (µs, log2
+                    # buckets) — queryable tail latency even with
+                    # tracing sampled out
+                    cls_key = "op_w_latency_hist" if any(
+                        c in MUTATING_OPS for c in m.op_codes) \
+                        else "op_r_latency_hist"
+                    self.osd.perf.hist_add(
+                        cls_key, (_time.monotonic() - t0) * 1e6)
                     cost = getattr(m, "_throttle_cost", None)
                     if cost is not None:
                         self.osd.client_throttle.release(cost)
@@ -1753,17 +1804,30 @@ class PG:
             waiter = asyncio.get_event_loop().create_future()
             self._repop_waiters[tid] = [set(replicas), waiter, reqid,
                                         False]
+        op_span = self._active_span
+        store_span = op_span.child(
+            "objectstore_commit",
+            tags={"osd": self.osd.whoami}) if op_span else None
         try:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
             log.error(f"pg {self.pgid} local commit failed: {e}")
             self._repop_waiters.pop(tid, None)
             return -5, False, waiter
+        finally:
+            if store_span is not None:
+                store_span.finish()
+        repop_span = op_span.child(
+            "repop_wait",
+            tags={"replicas": sorted(replicas)}) \
+            if op_span and replicas else None
         for o in replicas:
-            await self.osd.send_osd(o, MOSDRepOp(
+            rep = MOSDRepOp(
                 tid=tid, epoch=self.epoch, pgid=self.cid,
                 txn=txn_blob, log_entry=entry.encode(),
-                extra_log=[e.encode() for e in extra_entries]))
+                extra_log=[e.encode() for e in extra_entries])
+            rep.set_trace(repop_span)
+            await self.osd.send_osd(o, rep)
         if waiter is not None:
             # asyncio.wait (NOT wait_for): wait_for CANCELS the future
             # on timeout, which would make it impossible for a late
@@ -1773,6 +1837,10 @@ class PG:
             done, _ = await asyncio.wait(
                 [waiter],
                 timeout=self.osd.config.get("osd_repop_timeout", 5.0))
+            if repop_span is not None:
+                if not done:
+                    repop_span.tag("timed_out", True)
+                repop_span.finish()
             if not done:
                 # A replica never confirmed: the client MUST NOT see
                 # success, or a subsequent primary failure could lose an
@@ -1804,13 +1872,26 @@ class PG:
         ReplicatedBackend::do_repop)."""
         self._clone_idx = None      # the txn may create/trim clones; a
         # later re-promotion to primary must not serve a stale index
+        span = self.osd.tracer.from_msg(
+            "repop_apply", m, tags={"osd": self.osd.whoami,
+                                    "pgid": self.cid})
         entry = LogEntry.decode(m.log_entry)
         t = Transaction.decode(m.txn)
+        store_span = span.child(
+            "objectstore_commit",
+            tags={"osd": self.osd.whoami}) if span else None
         try:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
             log.error(f"pg {self.pgid} repop apply failed: {e}")
+            if span is not None:
+                span.tag("error", str(e)).finish()
             return
+        finally:
+            if store_span is not None:
+                store_span.finish()
+        if span is not None:
+            span.finish()
         self.pg_log.append(entry)
         for blob in getattr(m, "extra_log", None) or []:
             e2 = LogEntry.decode(blob)
@@ -1908,6 +1989,10 @@ class PG:
             len(push.data))
         fut = asyncio.get_event_loop().create_future()
         self._push_ack_waiters[(target, oid)] = fut
+        span = self.osd.tracer.start_root(
+            "backfill_push",
+            tags={"pgid": self.cid, "oid": oid, "to_osd": target})
+        push.set_trace(span)
         try:
             await self.osd.send_osd(target, push)
             await asyncio.wait([fut], timeout=5.0)
@@ -1919,6 +2004,10 @@ class PG:
         finally:
             release()
             self._push_ack_waiters.pop((target, oid), None)
+            if span is not None:
+                if not fut.done():
+                    span.tag("unacked", True)
+                span.finish()
 
     async def _scan_peer(self, osd_id: int, begin: str, end: str,
                          limit: int = 0):

@@ -55,10 +55,19 @@ class ObjectOperationError(Exception):
 class Objecter(Dispatcher):
     def __init__(self, monc: MonClient, op_timeout: float = 20.0,
                  max_attempts: int = 50,
-                 slow_op_warn_s: float = 5.0):
+                 slow_op_warn_s: float = 5.0,
+                 config: dict | None = None):
         self.monc = monc
         self.msgr = monc.msgr
         self.msgr.add_dispatcher(self)
+        # distributed tracing (ref: the Objecter starting the op's
+        # root span in src/osdc/Objecter.cc under jaeger): the client
+        # is where head-based sampling is decided — a sampled root's
+        # context rides every MOSDOp hop of the op
+        from ceph_tpu.utils.tracing import Tracer
+        self.tracer = Tracer("client", config)
+        self._trace_flush_at = 0.0
+        self._trace_flush_later: object | None = None
         # default per-op deadline and resend cap (ref: objecter's
         # rados_osd_op_timeout): thrashed ops fail cleanly, not hang
         self.op_timeout = op_timeout
@@ -227,19 +236,59 @@ class Objecter(Dispatcher):
         tracked = self.op_tracker.create(
             f"osd_op(client tid {tid} pool {pool_id} {oid!r} "
             f"{len(ops)} ops)")
+        has_write = any(o[0] in MUTATING_OPS for o in ops)
+        span = self.tracer.start_root(
+            "client_op",
+            tags={"oid": oid, "pool": pool_id, "tid": tid,
+                  "op_class": "write" if has_write else "read"})
         try:
             return await self._op_submit_inner(
                 pool_id, oid, ops, deadline, tid, seed, snapc,
-                snap_id, tracked, flags)
+                snap_id, tracked, flags, span, has_write)
         finally:
             tracked.finish()
+            if span is not None:
+                span.finish()
+            self.flush_traces()
+
+    def flush_traces(self, force: bool = False) -> None:
+        """Ship buffered spans monward via MTraceReport — the client's
+        stand-in for the stats/beacon piggyback (fire-and-forget,
+        rate-limited)."""
+        if not self.tracer.ship_pending():
+            return
+        loop = asyncio.get_event_loop()
+        if not force and loop.time() - self._trace_flush_at < 0.25:
+            # rate-limited: arm ONE trailing flush so the last spans
+            # of a burst still ship (an idle client never flushes
+            # otherwise)
+            self._arm_trailing_flush(loop)
+            return
+        self._trace_flush_at = loop.time()
+        from ceph_tpu.mon.messages import MTraceReport
+        blobs = self.tracer.drain_ship()
+        asyncio.ensure_future(self.monc.send_report(
+            MTraceReport(daemon=self.monc.name, spans=blobs)))
+        if self.tracer.ship_pending():
+            # a burst bigger than one drain batch: re-arm so the
+            # remainder ships even if the client goes idle
+            self._arm_trailing_flush(loop)
+
+    def _arm_trailing_flush(self, loop) -> None:
+        if self._trace_flush_later is not None:
+            return
+        def _later():
+            self._trace_flush_later = None
+            self.flush_traces(force=True)
+        self._trace_flush_later = loop.call_later(0.3, _later)
 
     async def _op_submit_inner(self, pool_id, oid, ops, deadline, tid,
                                seed, snapc, snap_id, tracked,
-                               flags=0):
+                               flags=0, span=None, has_write=None):
         loop = asyncio.get_event_loop()
         attempt = 0
-        has_write = any(o[0] in MUTATING_OPS for o in ops)
+        if has_write is None:
+            has_write = any(o[0] in MUTATING_OPS for o in ops)
         while True:
             if loop.time() > deadline:
                 tracked.mark_event("timed out")
@@ -288,12 +337,13 @@ class Objecter(Dispatcher):
             try:
                 tracked.mark_event(
                     f"sent to osd.{primary} (attempt {attempt})")
+                op_msg = make_osd_op(tid, osdmap.epoch, pool_id,
+                                     pg_seed, oid, ops,
+                                     attempt=attempt, snapc=snapc,
+                                     snap_id=snap_id, flags=flags)
+                op_msg.set_trace(span)
                 await self.msgr.send_message(
-                    make_osd_op(tid, osdmap.epoch, pool_id, pg_seed,
-                                oid, ops, attempt=attempt,
-                                snapc=snapc, snap_id=snap_id,
-                                flags=flags),
-                    EntityAddr(host, port), f"osd.{primary}")
+                    op_msg, EntityAddr(host, port), f"osd.{primary}")
                 reply = await asyncio.wait_for(
                     fut, timeout=min(5.0 + attempt,
                                      deadline - loop.time()))

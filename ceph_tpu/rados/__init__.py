@@ -62,9 +62,10 @@ class Rados:
     """ref: librados::Rados."""
 
     def __init__(self, monmap: MonMap, name: str = "client.admin",
-                 keyring: Keyring | None = None):
+                 keyring: Keyring | None = None,
+                 config: dict | None = None):
         self.monc = MonClient(name, monmap, keyring=keyring)
-        self.objecter = Objecter(self.monc)
+        self.objecter = Objecter(self.monc, config=config)
         # cookie -> (ioctx, oid, callback)
         self._watches: dict[int, tuple] = {}
         self._cookie_gen = itertools.count(1)

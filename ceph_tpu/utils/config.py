@@ -208,6 +208,29 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("crush_chooseleaf_descend_once", int, 1, "retry descent not leaf"),
     Option("crush_chooseleaf_vary_r", int, 1, "vary r on leaf recursion"),
     Option("crush_chooseleaf_stable", int, 1, "stable leaf mapping"),
+    # op tracking + distributed tracing (ref: osd.yaml.in
+    # osd_op_history_size / osd_op_complaint_time; the jaeger_tracing
+    # options the reference gates src/common/tracer.cc behind). The
+    # trace_* knobs are read live by every Tracer, so a runtime
+    # override applies from the next op on.
+    Option("osd_op_history_size", int, 20,
+           "completed ops retained per OpTracker for "
+           "dump_historic_ops", min=0),
+    Option("osd_op_complaint_time", float, 30.0,
+           "op age (monotonic seconds) past which an in-flight op "
+           "counts as slow (SLOW_OPS)", min=0.0),
+    Option("trace_sampling_rate", float, 0.0,
+           "head-based sampling probability for distributed op "
+           "traces: a sampled root's context propagates across every "
+           "message hop of the op", min=0.0, max=1.0),
+    Option("trace_slow_keep_s", float, 30.0,
+           "tail-based retention: an UNSAMPLED op slower than this is "
+           "kept anyway (local root span only), so SLOW_OPS stays "
+           "drill-downable at sampling 0; <= 0 disables even the "
+           "local timing (the fully-off path)"),
+    Option("trace_buffer_size", int, 256,
+           "completed spans retained per daemon for dump_tracing",
+           min=8),
     # TPU execution knobs (no Ceph analog).
     Option("tpu_ec_backend", str, "auto",
            "GF kernel: bitmatmul (MXU) | lut (VPU) | auto",

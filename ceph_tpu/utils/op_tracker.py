@@ -5,6 +5,13 @@ record with timestamped lifecycle events; in-flight ops and a bounded
 history are dumpable via the admin socket (``dump_ops_in_flight`` /
 ``dump_historic_ops``), and ops older than the warn threshold are
 counted as slow (ref: OpTracker::check_ops_in_flight).
+
+Ages and event offsets are measured on ``time.monotonic()`` — a
+wall-clock jump (NTP step, suspend) must not age every in-flight op
+into a SLOW_OPS storm or make durations run backwards. One wall-clock
+``initiated_at`` stamp is kept for display only. Defaults for the
+history depth and the complaint threshold come from the registered
+``osd_op_history_size`` / ``osd_op_complaint_time`` config options.
 """
 
 from __future__ import annotations
@@ -13,16 +20,25 @@ import time
 from collections import deque
 
 
+def _opt_default(name: str, fallback):
+    try:
+        from ceph_tpu.utils.config import global_config
+        return global_config().get(name)
+    except Exception:
+        return fallback
+
+
 class TrackedOp:
     def __init__(self, tracker: "OpTracker", desc: str):
         self._tracker = tracker
         self.desc = desc
-        self.start = time.time()
+        self.initiated_at = time.time()      # wall clock, display only
+        self.start = time.monotonic()        # all durations hang off this
         self.events: list[tuple[float, str]] = [(self.start, "queued")]
         self.done = False
 
     def mark_event(self, name: str) -> None:
-        self.events.append((time.time(), name))
+        self.events.append((time.monotonic(), name))
 
     def finish(self) -> None:
         if not self.done:
@@ -32,13 +48,13 @@ class TrackedOp:
 
     @property
     def duration(self) -> float:
-        end = self.events[-1][0] if self.done else time.time()
+        end = self.events[-1][0] if self.done else time.monotonic()
         return end - self.start
 
     def dump(self) -> dict:
         return {
             "description": self.desc,
-            "initiated_at": self.start,
+            "initiated_at": self.initiated_at,
             "age": round(self.duration, 6),
             "events": [{"time": round(t - self.start, 6), "event": e}
                        for t, e in self.events],
@@ -48,8 +64,13 @@ class TrackedOp:
 class OpTracker:
     """ref: OpTracker — per-daemon registry."""
 
-    def __init__(self, history_size: int = 20,
-                 slow_op_warn_s: float = 30.0):
+    def __init__(self, history_size: int | None = None,
+                 slow_op_warn_s: float | None = None):
+        if history_size is None:
+            history_size = int(_opt_default("osd_op_history_size", 20))
+        if slow_op_warn_s is None:
+            slow_op_warn_s = float(
+                _opt_default("osd_op_complaint_time", 30.0))
         self.inflight: dict[int, TrackedOp] = {}
         self.history: deque[TrackedOp] = deque(maxlen=history_size)
         self.slow_op_warn_s = slow_op_warn_s

@@ -108,6 +108,26 @@ class PerfCounters:
         return json.dumps({self.name: self.dump()}, indent=2)
 
 
+def hist_cumulative(buckets: list) -> list[tuple[float, int]]:
+    """Render log2 buckets as cumulative prometheus-style ``le``
+    pairs: bucket i counts values v with int(v).bit_length() == i,
+    i.e. v < 2**i — so the cumulative count through bucket i is the
+    count of observations <= (2**i - 1), and 2**i is a valid inclusive
+    upper bound. Returns [(le, cumulative_count), ...] up to the
+    highest non-empty bucket (always at least one pair), monotone by
+    construction."""
+    top = 0
+    for i, b in enumerate(buckets):
+        if b:
+            top = i
+    out: list[tuple[float, int]] = []
+    run = 0
+    for i in range(top + 1):
+        run += int(buckets[i])
+        out.append((float(2 ** i), run))
+    return out
+
+
 class PerfCountersCollection:
     """Process-wide registry of PerfCounters instances, the analog of
     CephContext's collection behind ``perf dump``

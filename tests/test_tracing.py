@@ -1,0 +1,418 @@
+"""End-to-end distributed op tracing (round 9).
+
+Acceptance surface:
+
+- a single replicated-pool client write at ``trace_sampling_rate=1.0``
+  yields ONE mgr-reassembled trace containing client, primary,
+  >=2 replica, and objectstore-commit spans with correct parent links
+  and non-overlapping phase durations summing ~= the client-observed
+  latency;
+- an artificially delayed op BELOW the sampling rate is still
+  retained via the slow-op tail path (``trace_slow_keep_s``);
+- ``PrometheusModule.render`` emits the per-op-class latency
+  histograms as valid exposition-format ``le``-bucketed series with
+  monotone cumulative buckets (pinned in tests/test_meta.py's parser
+  guard; exercised against a LIVE cluster here);
+- a storm smoke proves tracing survives kill/revive.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.mgr.modules import TracingModule
+from ceph_tpu.sim import faults as F
+from ceph_tpu.utils.tracing import Tracer, TraceIndex
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# -- unit: sampling + tail retention semantics -----------------------------
+
+def test_tracer_head_sampling_and_propagation():
+    t = Tracer("client", {"trace_sampling_rate": 1.0})
+    root = t.start_root("client_op", tags={"oid": "o"})
+    assert root is not None and root.trace_id != 0
+    child = root.child("queue")
+    assert child.trace_id == root.trace_id
+    assert child.parent_span_id == root.span_id
+    child.finish()
+    root.finish()
+    assert t.ship_pending() == 2
+    # context rides a message; the receiver's span links to the sender
+    from ceph_tpu.osd.messages import MOSDOp
+    m = MOSDOp(tid=1, oid="o")
+    m.set_trace(root)
+    rx = Tracer("osd.0", {})
+    span = rx.from_msg("osd_op", m)
+    assert span is not None and span.trace_id == root.trace_id
+    assert span.parent_span_id == root.span_id
+
+
+def test_tracer_tail_retention_and_off_path():
+    # unsampled but slow: retained with a post-hoc trace id
+    t = Tracer("client", {"trace_sampling_rate": 0.0,
+                          "trace_slow_keep_s": 0.01})
+    slow = t.start_root("client_op")
+    assert slow is not None and slow.trace_id == 0   # local-only
+    time.sleep(0.02)
+    slow.finish()
+    d = t.dump()
+    assert slow.trace_id != 0
+    assert d["slow_spans"] and \
+        d["slow_spans"][0]["tags"]["tail_sampled"]
+    # unsampled and fast: dropped
+    fast = t.start_root("client_op")
+    fast.finish()
+    assert len(t.dump()["spans"]) == 1
+    # fully off (slow_keep <= 0): no span objects at all
+    off = Tracer("client", {"trace_sampling_rate": 0.0,
+                            "trace_slow_keep_s": 0.0})
+    assert off.start_root("client_op") is None
+    # unsampled context never propagates
+    from ceph_tpu.osd.messages import MOSDOp
+    m = MOSDOp(tid=1, oid="o")
+    m.set_trace(t.start_root("client_op"))
+    assert m.trace_id == 0
+
+
+def test_trace_index_survives_malformed_spans():
+    """Span blobs arrive from arbitrary clients (MTraceReport is an
+    uncapped report): a mistyped field must drop at add(), never
+    poison ls()/show() for every later caller."""
+    idx = TraceIndex()
+    idx.add({"trace_id": 1, "span_id": 2, "start": "not-a-float"})
+    idx.add({"trace_id": 5, "span_id": 7, "parent_span_id": 9})
+    idx.add({"trace_id": "x", "span_id": 1})
+    idx.add({"trace_id": 3, "span_id": 4, "parent_span_id": 0,
+             "name": "ok", "service": "client", "start": 1.0,
+             "duration": 0.5, "tags": "not-a-dict"})
+    rows = idx.ls()          # must not raise
+    assert [r["trace_id"] for r in rows if r["root"] == "ok"]
+    missing_fields = idx.show(5)
+    if missing_fields is not None:       # kept with defaults is fine
+        assert missing_fields["duration"] >= 0.0
+    ok = idx.show(3)
+    assert ok["tree"][0]["tags"] == {}
+
+
+def test_trace_index_per_trace_span_cap_and_deep_chain():
+    """One hostile trace_id cannot grow the index without bound, and
+    a parent chain deeper than the serve cap must not drive show()'s
+    recursion toward the interpreter limit."""
+    idx = TraceIndex()
+    for i in range(TraceIndex.MAX_SPANS_PER_TRACE + 50):
+        idx.add({"trace_id": 1, "span_id": i + 1,
+                 "parent_span_id": i, "name": "chain",
+                 "service": "evil", "start": float(i),
+                 "duration": 0.0, "tags": {}})
+    ent = idx.traces[1]
+    assert len(ent["spans"]) == TraceIndex.MAX_SPANS_PER_TRACE
+    show = idx.show(1)          # must not raise RecursionError
+    depth = 0
+    node = show["tree"][0]
+    while node["children"]:
+        node = node["children"][0]
+        depth += 1
+    assert depth <= TraceIndex.MAX_TREE_DEPTH + 1
+
+
+def test_trace_index_bounds_and_slowest_first():
+    idx = TraceIndex(max_traces=4)
+    for i in range(8):
+        idx.add({"trace_id": i + 1, "span_id": 100 + i,
+                 "parent_span_id": 0, "name": "client_op",
+                 "service": "client", "start": float(i),
+                 "duration": float(i) / 100.0, "tags": {}})
+    assert len(idx.traces) == 4                  # oldest evicted
+    rows = idx.ls()
+    durs = [r["duration"] for r in rows]
+    assert durs == sorted(durs, reverse=True)    # slowest first
+
+
+# -- the acceptance trace: one replicated write, fully decomposed ----------
+
+def _flatten(node, out):
+    out.append(node)
+    for c in node["children"]:
+        _flatten(c, out)
+
+
+def _find(nodes, name):
+    return [n for n in nodes if n["name"] == name]
+
+
+def test_replicated_write_trace_reassembly(tmp_path):
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=3,
+            config={"trace_sampling_rate": 1.0,
+                    "mgr_tracing_interval": 0.25,
+                    "admin_socket_dir": str(tmp_path)},
+            mgr_modules=[TracingModule]).start()
+        try:
+            await c.client.pool_create("t", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            # warm the connection path: the FIRST write pays messenger
+            # connect + auth handshakes, which are client-side time no
+            # OSD phase can account for
+            await io.write_full("warm-obj", b"w" * 4096)
+            t0 = time.monotonic()
+            await io.write_full("traced-obj", b"x" * 4096)
+            observed = time.monotonic() - t0
+            mod = c.mgr.modules[0]
+            trace = None
+            deadline = asyncio.get_event_loop().time() + 20
+            while trace is None:
+                for row in mod.trace_ls(limit=10):
+                    cand = mod.trace_show(row["trace_id"])
+                    if row["root"] == "client_op" and \
+                            row["num_spans"] >= 6 and \
+                            cand["tree"][0]["tags"].get("oid") == \
+                            "traced-obj":
+                        trace = cand
+                        break
+                if trace is None:
+                    assert asyncio.get_event_loop().time() < \
+                        deadline, (
+                        "mgr never reassembled the write trace: "
+                        f"{mod.trace_ls(limit=10)}")
+                    await asyncio.sleep(0.1)
+
+            spans: list[dict] = []
+            assert len(trace["tree"]) == 1, trace
+            _flatten(trace["tree"][0], spans)
+            root = trace["tree"][0]
+            assert root["name"] == "client_op" and \
+                root["service"] == "client"
+            # primary: one osd_op child with queue + execute phases
+            (osd_op,) = _find(root["children"], "osd_op")
+            primary_svc = osd_op["service"]
+            assert primary_svc.startswith("osd.")
+            (queue,) = _find(osd_op["children"], "queue")
+            (execute,) = _find(osd_op["children"], "execute")
+            # execute decomposes into local store commit + repop wait
+            (local_commit,) = _find(execute["children"],
+                                    "objectstore_commit")
+            assert local_commit["service"] == primary_svc
+            (repop_wait,) = _find(execute["children"], "repop_wait")
+            # >= 2 replica apply spans from DISTINCT non-primary osds,
+            # each with its own objectstore commit
+            applies = _find(repop_wait["children"], "repop_apply")
+            svcs = {a["service"] for a in applies}
+            assert len(applies) >= 2 and len(svcs) >= 2, applies
+            assert primary_svc not in svcs
+            for a in applies:
+                assert _find(a["children"], "objectstore_commit"), a
+            commits = _find(spans, "objectstore_commit")
+            assert len(commits) >= 3        # primary + both replicas
+            # phase durations: non-overlapping children sum to ~= the
+            # parent, and the primary's phases fit inside the
+            # client-observed latency
+            assert queue["duration"] + execute["duration"] <= \
+                osd_op["duration"] + 0.010
+            assert osd_op["duration"] <= root["duration"] + 0.005
+            assert root["duration"] <= observed + 0.005
+            phase_sum = queue["duration"] + execute["duration"]
+            assert observed - phase_sum < 1.0, (
+                "client latency unaccounted for: "
+                f"{observed} vs phases {phase_sum}")
+            for a in applies:
+                assert a["duration"] <= repop_wait["duration"] + 0.010
+            assert trace["phases"]["objectstore_commit"] >= 0.0
+
+            # -- `ceph trace ls/show` (the mon-side CLI view) ---------
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "trace ls"})
+            assert ret == 0
+            rows = json.loads(out)["traces"]
+            durs = [r["duration"] for r in rows]
+            assert durs == sorted(durs, reverse=True)
+            tid = rows[0]["trace_id"]
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "trace show", "trace_id": tid})
+            assert ret == 0 and json.loads(out)["trace_id"] == tid
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "trace show", "trace_id": 424242})
+            assert ret == -2, rs
+
+            # -- asok surfaces: dump_tracing + perf histogram dump ----
+            from ceph_tpu.utils.admin_socket import daemon_command
+            dump = await daemon_command(
+                f"{tmp_path}/osd.{c.osds[0].whoami}.asok",
+                "dump_tracing")
+            assert dump["sampling_rate"] == 1.0
+            assert dump["buffered"] >= 1 or dump["pending_ship"] >= 0
+            hist = await daemon_command(
+                f"{tmp_path}/osd.{c.osds[0].whoami}.asok",
+                "perf histogram dump")
+            assert any(
+                counters.get("op_w_latency_hist", {}).get("count", 0)
+                > 0 and counters["op_w_latency_hist"]["buckets"]
+                for name, counters in hist.items()
+                if name.startswith("osd.")), hist
+
+            # -- live prometheus render carries the histogram series --
+            from ceph_tpu.mgr.modules import PrometheusModule
+            prom = PrometheusModule(c.mgr)
+            text = await prom.render()
+            assert "ceph_perf_hist_bucket{" in text
+            assert 'counter="op_w_latency_hist"' in text
+        finally:
+            await c.stop()
+    run(go())
+
+
+# -- tail path: a delayed op below the sampling rate is still kept ---------
+
+def test_slow_op_retained_below_sampling_rate():
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=3,
+            config={"trace_sampling_rate": 0.0,
+                    "trace_slow_keep_s": 0.2}).start()
+        try:
+            await c.client.pool_create("t", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            await io.write_full("fast-obj", b"y")     # under threshold
+            inj = F.FaultInjector(seed=5)
+            c.install_faults(inj)
+            inj.install("lag",
+                        [F.delay("client.*", "osd.*", 0.35)])
+            t0 = time.monotonic()
+            await io.write_full("slow-obj", b"z" * 128)
+            assert time.monotonic() - t0 >= 0.2
+            inj.clear("lag")
+            lead = c.leader()
+            deadline = asyncio.get_event_loop().time() + 10
+            tail = []
+            while not tail:
+                tail = [s for _, s in lead.trace_spans
+                        if s.get("tags", {}).get("tail_sampled")]
+                if not tail:
+                    assert asyncio.get_event_loop().time() < \
+                        deadline, list(lead.trace_spans)
+                    await asyncio.sleep(0.1)
+            assert tail[0]["name"] == "client_op"
+            assert tail[0]["duration"] >= 0.2
+            assert tail[0]["tags"].get("slow")
+        finally:
+            await c.stop()
+    run(go())
+
+
+# -- metadata path: client -> MDS spans reassemble -------------------------
+
+def test_metadata_op_trace_reassembly():
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=3,
+            config={"trace_sampling_rate": 1.0}).start()
+        try:
+            await c.start_fs(pool="cephfs", n_mds=1, timeout=120)
+            from ceph_tpu.cephfs.client import CephFSClient
+            # config threads through to the owned objecter's tracer —
+            # without it the cluster's sampling knob never reaches
+            # this client and no metadata root is ever created
+            cl = await CephFSClient.create(
+                c.client.monc.monmap, None, "cephfs",
+                keyring=c.keyring, config=c.cfg)
+            await cl.mkdir("/traced")
+            await cl.unmount()
+            lead = c.leader()
+            deadline = asyncio.get_event_loop().time() + 15
+            found = None
+            while found is None:
+                for row in lead.trace_index.ls(limit=20):
+                    if row["root"] == "mds_req" and any(
+                            s.startswith("mds.")
+                            for s in row["services"]):
+                        found = lead.trace_index.show(
+                            row["trace_id"])
+                        break
+                if found is None:
+                    assert asyncio.get_event_loop().time() < \
+                        deadline, lead.trace_index.ls(limit=20)
+                    await asyncio.sleep(0.1)
+            root = found["tree"][0]
+            assert root["name"] == "mds_req" and \
+                root["service"] == "client"
+            (mds_op,) = [n for n in root["children"]
+                         if n["name"] == "mds_op"]
+            assert mds_op["service"].startswith("mds.")
+            assert mds_op["tags"]["op"] in ("mkdir",)
+            assert mds_op["duration"] <= root["duration"] + 0.010
+        finally:
+            await c.stop()
+    run(go())
+
+
+# -- storm smoke: tracing survives kill/revive -----------------------------
+
+def test_tracing_survives_thrash_smoke():
+    from ceph_tpu.sim.thrasher import Thrasher
+
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=4,
+            config={"trace_sampling_rate": 1.0,
+                    "mon_osd_down_out_interval": 600.0}).start()
+        try:
+            await c.client.pool_create("t", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            th = Thrasher(c, seed=77, min_live_osds=3)
+            await th.thrash(io, steps=12)
+            summary = await th.settle_and_verify(io, timeout=300)
+            assert summary["acked_writes"] > 0
+            # spans flowed through the storm and the pool survived the
+            # kill/revive churn: slowest-first listing still serves
+            lead = c.leader()
+            assert lead is not None and len(lead.trace_spans) > 0
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "trace ls", "limit": 5})
+            assert ret == 0
+            rows = json.loads(out)["traces"]
+            assert rows, "no reassembled traces after the storm"
+            durs = [r["duration"] for r in rows]
+            assert durs == sorted(durs, reverse=True)
+        finally:
+            await c.stop()
+    run(go())
+
+
+# -- OpTracker monotonic satellite ----------------------------------------
+
+def test_op_tracker_monotonic_and_config_knobs():
+    from ceph_tpu.utils.config import Config
+    from ceph_tpu.utils.op_tracker import OpTracker
+
+    cfg = Config()
+    assert cfg.get("osd_op_history_size") == 20
+    assert cfg.get("osd_op_complaint_time") == 30.0
+    t = OpTracker()
+    assert t.history.maxlen == 20 and t.slow_op_warn_s == 30.0
+    op = t.create("probe")
+    # the age base is monotonic, not wall: a wall-clock jump cannot
+    # corrupt it (initiated_at stays wall for display)
+    assert abs(op.initiated_at - time.time()) < 5.0
+    assert op.start <= time.monotonic()
+    op.mark_event("phase")
+    op.finish()
+    d = op.dump()
+    assert d["age"] >= 0 and d["events"][0]["time"] == 0.0
+    assert all(e["time"] >= 0 for e in d["events"])
+    t2 = OpTracker(history_size=3, slow_op_warn_s=0.0)
+    for i in range(5):
+        t2.create(f"op{i}").finish()
+    assert len(t2.history) == 3
