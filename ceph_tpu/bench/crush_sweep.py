@@ -114,6 +114,11 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
     """Measure mappings/s via the two-size slope method."""
     if mapper is None:
         mapper = Mapper(canonical_map(n_osds), block=block)
+    # capture the engine the built plan PROMISES before anything runs:
+    # a mid-run kernel compile/exec failure silently degrades the
+    # Mapper to the XLA path (by design — correctness first), and the
+    # PR 4 choose_args regression hid behind exactly that silence
+    expected_path = mapper.mapping_path(rule, num_rep)
     # quantize both sizes to DISTINCT block counts: the per-block program
     # does full-block work regardless of the tail mask, so sizes that
     # round to the same block count would make the slope pure noise
@@ -141,7 +146,11 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
         overhead = 0.0
     rate = 1.0 / per_pg
     import jax
-    return {
+    # which engine ACTUALLY served the sweep (pallas/xla/scalar): a
+    # variant silently sliding off the kernel is a visible diff in the
+    # bench trajectory, not a mystery slowdown
+    actual_path = mapper.last_map_path or expected_path
+    out = {
         "metric": "crush_mappings_per_s",
         "mappings_per_s": round(rate, 1),
         "n_pgs": n_hi,
@@ -152,12 +161,20 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
         "seconds_100M_est": round(1e8 * per_pg + overhead, 3),
         "overhead_s": round(overhead, 4),
         "method": method,
-        # which engine served the sweep (pallas/xla/scalar): a variant
-        # silently sliding off the kernel is a visible diff in the
-        # bench trajectory, not a mystery slowdown
-        "path": mapper.mapping_path(rule, num_rep),
+        "path": actual_path,
         "platform": jax.devices()[0].platform,
     }
+    if actual_path.replace("+sharded", "") != expected_path:
+        # LOUD: the plan promised one engine and the run executed
+        # another (kernel compile/exec failure degraded mid-run) —
+        # record the diff so the regression cannot hide behind the
+        # always-correct fallback's numbers
+        out["path_expected_vs_actual"] = \
+            f"{expected_path}->{actual_path}"
+        log.dout(0, "CRUSH bench path regression: plan promised "
+                    f"{expected_path} but the run executed "
+                    f"{actual_path}")
+    return out
 
 
 def sweep_rate_variants(n_osds: int = 10240, n_pgs: int = 1 << 21,
@@ -182,8 +199,20 @@ def sweep_rate_variants(n_osds: int = 10240, n_pgs: int = 1 << 21,
         r = sweep_rate(n_osds, npg, num_rep, mapper=mapper)
         out[name] = {k: r[k] for k in
                      ("mappings_per_s", "n_pgs", "seconds_per_batch",
-                      "method", "seconds_100M_est", "path")}
+                      "method", "seconds_100M_est", "path",
+                      "path_expected_vs_actual")
+                     if k in r}
     return out
+
+
+def path_regressions(variants: dict) -> list[str]:
+    """['choose_args: pallas->xla', ...] for every variant row whose
+    built kernel plan silently fell back — bench.py surfaces this in
+    the driver-parsed compact summary, so the regression is loud."""
+    return [f"{name}: {row['path_expected_vs_actual']}"
+            for name, row in sorted(variants.items())
+            if isinstance(row, dict)
+            and "path_expected_vs_actual" in row]
 
 
 @cli_main

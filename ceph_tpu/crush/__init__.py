@@ -13,6 +13,9 @@ TPU-native rebuild of the CRUSH placement stack
 - ``tensors``   pack a CrushMap into padded device arrays.
 - ``mapper``    the vectorized rule VM: vmap over PG ids, masked retries,
                 fixed-depth descent — the TPU hot path.
+- ``sharded_sweep`` the mapping sweep SPMD over a device mesh (round 10):
+                PG batch sharded, map tensors replicated, zero collectives
+                on the hot path — see ceph_tpu/crush/README.md.
 - ``tester``    crushtool --test engine (ref: src/crush/CrushTester.cc).
 
 Provenance: the reference tree was unavailable (SURVEY.md warning); semantics

@@ -55,8 +55,8 @@ Performance techniques (each cross-checked bit-exact vs mapper_ref):
   TPU), speculative parallel tries replacing most while_loop retry
   iterations, and static descent-depth unrolling.
 
-Mapping engine layers (round 6): this module is the bottom of a
-three-layer serving stack —
+Mapping engine layers (round 6, mesh layer round 10): this module is
+the bottom of the serving stack —
 - **Mapper** (here): batched device mapping. The fused Pallas kernel
   (``pallas_mapper``) now serves arbitrary continuous per-item weights
   and single-position choose_args weight-sets: the 64K-entry negln
@@ -65,7 +65,16 @@ three-layer serving stack —
   balancer-style weight-set no longer falls off the kernel onto the
   XLA gather path (the 34x ``choose_args`` cliff in BENCH_r05).
   ``mapping_path(rule, width)`` reports which engine — pallas / xla /
-  scalar — serves a given shape; bench rows record it per variant.
+  scalar — serves a given shape; bench rows record it per variant
+  (and diff it against ``last_map_path``, the engine that actually
+  ran, so a silent mid-run kernel degrade is a visible fact).
+- **sharded sweep** (``crush/sharded_sweep.py``, round 10): the same
+  per-lane programs SPMD over a device mesh — the PG batch sharded on
+  the mesh axis, map tensors replicated, zero collectives on the hot
+  path (one (max_devices,) psum closes the aggregated sweep).
+  ``Mapper(mesh=...)``/``attach_mesh`` route batches of at least
+  ``mesh_min_batch`` lanes through it; bit-exact vs the single-device
+  path lane for lane, including kernel ambiguity-fallback lanes.
 - **OSDMapMapping** (``osd/osdmap_mapping.py``): a full-cluster
   PG->OSD table maintained ACROSS epochs by delta remap — an
   incremental's affected-PG set is computed from the map diff and only
@@ -817,7 +826,8 @@ class Mapper:
     def __init__(self, crush_map: CrushMap,
                  device_weights: np.ndarray | None = None,
                  block: int | None = None,
-                 choose_args: int | None = None):
+                 choose_args: int | None = None,
+                 mesh=None, mesh_min_batch: int | None = None):
         _t0 = time.perf_counter()
         self.map = crush_map
         self.packed: PackedMap = pack_map(crush_map)
@@ -954,8 +964,34 @@ class Mapper:
             block = max(1 << 14, min(1 << 20, budget // per_lane))
             block = 1 << (block.bit_length() - 1)       # power of two
         self.block = block
+        # Multi-chip (round 10): with a mesh attached, sweep/map_pgs
+        # batches of at least mesh_min_batch lanes route through
+        # crush.sharded_sweep (PG batch sharded over the mesh axis,
+        # map tensors replicated, zero collectives on the hot path).
+        self.mesh = mesh
+        if mesh_min_batch is None:
+            from ceph_tpu.crush.sharded_sweep import MESH_MIN_BATCH
+            mesh_min_batch = MESH_MIN_BATCH
+        self.mesh_min_batch = mesh_min_batch
+        # Which engine the LAST map_pgs/sweep actually executed on
+        # ('pallas'/'pallas-interpret'/'xla'/'scalar', '+sharded'
+        # suffix on the mesh path) — bench rows diff this against
+        # mapping_path()'s prediction so a silent mid-run kernel
+        # degrade is a recorded fact, not a mystery slowdown.
+        self.last_map_path: str | None = None
         PERF.inc("packs")
         PERF.tinc("pack_seconds", time.perf_counter() - _t0)
+
+    def attach_mesh(self, mesh, mesh_min_batch: int | None = None):
+        """Route big sweeps through the mesh-sharded path (round 10)."""
+        self.mesh = mesh
+        if mesh_min_batch is not None:
+            self.mesh_min_batch = mesh_min_batch
+
+    def _use_mesh(self, n: int) -> bool:
+        return (self.mesh is not None and not self._scalar_reason
+                and self.mesh.devices.size > 1
+                and n >= self.mesh_min_batch)
 
     def set_device_weights(self, device_weights: np.ndarray) -> None:
         """Update reweights (is_out vector). No recompile unless the
@@ -976,6 +1012,10 @@ class Mapper:
         self._kernel_plans.clear()
         self._kernel_bodies.clear()
         self._kernel_fns.clear()
+        # compiled shard programs close over the kernel bodies just
+        # dropped — without this they pin the retired plans for the
+        # Mapper's lifetime (crush/sharded_sweep._shard_fn)
+        self.__dict__.pop("_sharded_fns", None)
 
     # -- fused Pallas kernel path (round 4) --------------------------------
     def _disable_kernel(self, where: str, exc: Exception) -> None:
@@ -996,6 +1036,7 @@ class Mapper:
         self._kernel_plans.clear()
         self._kernel_bodies.clear()
         self._kernel_fns.clear()
+        self.__dict__.pop("_sharded_fns", None)   # see set_device_weights
 
     def _kernel_plan(self, ruleno: int):
         if ruleno not in self._kernel_plans:
@@ -1197,7 +1238,10 @@ class Mapper:
         chunks so straw2 temps stay bounded at any N."""
         if self._scalar_reason:
             PERF.inc("pgs_mapped", len(xs))
+            self.last_map_path = "scalar"
             return self._scalar_map(ruleno, xs, result_max)
+        if self._use_mesh(len(xs)):
+            return self._sharded_map_pgs(ruleno, xs, result_max)
         kb = self._kernel_body(ruleno, result_max)
         if kb is not None:
             key = (ruleno, result_max)
@@ -1243,8 +1287,30 @@ class Mapper:
                 raise                        # XLA path: a real error
             self._disable_kernel("map_pgs", e)
             return self.map_pgs(ruleno, xs, result_max)
+        self.last_map_path = self.mapping_path(ruleno, result_max)
         PERF.inc("pgs_mapped", int(n))       # success only: the failed
         return out                           # attempt must not double-count
+
+    def _sharded_map_pgs(self, ruleno: int, xs, result_max: int):
+        """map_pgs over the attached mesh (crush.sharded_sweep), with
+        the same kernel-failure degrade discipline as the local path."""
+        from ceph_tpu.crush import sharded_sweep as _ss
+        kb = self._kernel_body(ruleno, result_max)
+        try:
+            out = _ss.sharded_map_pgs(self.mesh, self, ruleno, xs,
+                                      result_max)
+            if kb is not None and out.shape[0]:
+                with _enable_x64(True):      # x64: the getitem traces
+                    np.asarray(out[0])       # force execution: a run-
+                # time kernel failure must surface inside this try
+        except Exception as e:
+            if kb is None:
+                raise                        # XLA path: a real error
+            self._disable_kernel("sharded_map_pgs", e)
+            return self._sharded_map_pgs(ruleno, xs, result_max)
+        # (last_map_path is set by sharded_map_pgs itself — one site)
+        PERF.inc("pgs_mapped", len(xs))
+        return out
 
     def sweep(self, ruleno: int, start_x: int, n: int, result_max: int,
               device_counts_size: int | None = None):
@@ -1260,8 +1326,12 @@ class Mapper:
         bad int64 scalar. Nothing of O(n) touches the host.
         """
         nd_ = device_counts_size or self.packed.max_devices
+        if not self._scalar_reason and self._use_mesh(n) and \
+                device_counts_size is None:
+            return self._sharded_sweep(ruleno, start_x, n, result_max)
         if self._scalar_reason:    # legacy fallback: host aggregation
             PERF.inc("pgs_mapped", int(n))
+            self.last_map_path = "scalar"
             out = self._scalar_map(
                 ruleno, np.arange(start_x, start_x + n, dtype=np.uint32),
                 result_max)
@@ -1300,9 +1370,32 @@ class Mapper:
             self._disable_kernel("sweep", e)
             return self.sweep(ruleno, start_x, n, result_max,
                               device_counts_size)
+        self.last_map_path = self.mapping_path(ruleno, result_max)
         PERF.inc("pgs_mapped", int(n))       # success only (no double
         PERF.inc("sweep_blocks", int(nblocks))   # count via the retry)
         return counts[:nd], bad
+
+    def _sharded_sweep(self, ruleno: int, start_x: int, n: int,
+                       result_max: int):
+        """Aggregated sweep over the attached mesh, with the same
+        kernel-failure degrade discipline as the local path."""
+        from ceph_tpu.crush import sharded_sweep as _ss
+        kb = self._kernel_body(ruleno, result_max)
+        try:
+            counts, bad = _ss.sharded_sweep(self.mesh, self, ruleno,
+                                            start_x, n, result_max)
+            if kb is not None:
+                with _enable_x64(True):      # x64: counts is int64 and
+                    np.asarray(counts[0])    # the getitem traces; force
+                # execution (see sweep)
+        except Exception as e:
+            if kb is None:
+                raise                        # XLA path: a real error
+            self._disable_kernel("sharded_sweep", e)
+            return self._sharded_sweep(ruleno, start_x, n, result_max)
+        # (last_map_path is set by sharded_sweep itself — one site)
+        PERF.inc("pgs_mapped", int(n))
+        return counts, bad
 
 
 def _tunables_key(t):

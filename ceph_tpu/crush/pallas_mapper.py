@@ -54,22 +54,47 @@ so the fallback is O(flagged), not O(block)). A single-weight-set
 choose_args map is the same machinery with substituted weights —
 position-independent, so the shared candidate table survives.
 
-CONTINUOUS weights (round 6, this PR): buckets whose slots carry more
-than MAX_CLASSES distinct weights — exactly what an upstream-style
+CONTINUOUS weights (round 6): buckets whose slots carry more than
+MAX_CLASSES distinct weights — exactly what an upstream-style
 balancer's choose_args weight-set produces (every slot perturbed a few
 percent) — previously gated the whole map off the kernel and onto the
 ~35x-slower XLA general path. The class decomposition degenerates
 cleanly: treat EVERY slot as its own class. No within-class tie
 argument (and hence no ln-gap license G) is needed at all, because a
-one-slot class has no internal tie to break: the kernel runs the exact
-fixed-point crush_ln ladder once per slot (the same 129-entry RH/LH +
-256-entry LL one-hot MXU fetches, over the slot's own 16-bit hash) and
-compares d_s = neg_s / w_s across slots in f32 with the identical
-MARGIN_ABS/MARGIN_REL flagging — ambiguous lanes (f32 gap inside the
-rounding + floor-tie envelope) recompute bit-exactly on the XLA
-fallback. Per-slot weights ride the level table as two 15-bit halves,
-so any w < 2^30 is admissible — this also covers few-class buckets
-whose weights exceed G.
+one-slot class has no internal tie to break. Per-slot weights ride the
+level table as two 15-bit halves, so any w < 2^30 is admissible — this
+also covers few-class buckets whose weights exceed G.
+
+TWO-PHASE pre-selection (round 10, this PR): round 6 ran the exact
+fixed-point crush_ln ladder once PER SLOT, sequentially — a 3-level
+choose_args map replayed ~(20+32+16) ladders per candidate r, which
+both dominated runtime (each ladder is two one-hot MXU fetches plus a
+byte-carry walk) and blew the compile up linearly in bucket width
+(MAX_CONT_SLOTS existed to cap exactly that). The reformulation does
+ONE fused pass instead:
+
+- phase 1 scores ALL slots at once with a pure-f32 approximation of
+  the draw: d~_s = 2^44*(16 - log2(u_s+1)) / w_s, the log2 evaluated
+  by exact exponent/mantissa extraction plus a degree-7 polynomial
+  (elementwise over the whole (S, N) plane — no per-slot unroll, no
+  table fetch). The approximation's error against the exact crush_ln
+  staircase is bounded by ERR_Z over the entire 16-bit domain
+  (exhaustively verified, not estimated; the staircase's own
+  quantization ~4.4e-5 dominates the polynomial's 8e-7);
+- phase 2 runs the exact crush_ln ladder on just the TOP-2 phase-1
+  candidates (two ladders per level, independent of S) and decides
+  the winner by exact-f32 comparison under the usual
+  MARGIN_ABS/MARGIN_REL envelope.
+
+Soundness: a lane is flagged to the bit-exact XLA fallback when (a)
+the top-2 exact draws land inside the margin (floor ties / f32
+rounding — the round-6 envelope, unchanged), or (b) ANY third slot's
+phase-1 score minus its proven error bound reaches the winner's exact
+draw plus the margin — if the exact winner were outside the phase-1
+top-2, its own lower bound would trip (b), so no unflagged lane can
+misrank. Because (b) requires THREE draws inside a ~1e-4-relative
+window, its rate is quadratically suppressed (~1e-6/choose measured),
+the same order as the round-6 floor-tie flags.
 
 Eligibility (build_plan returns None otherwise; the caller keeps the
 XLA path):
@@ -144,16 +169,16 @@ MAX_CLASSES = 4     # distinct weights per bucket the class draw
                     # slot instead of per class (see _choose_level_cont)
 MAX_CONT_WEIGHT = 1 << 30   # continuous per-slot weights must split
                             # into two 15-bit table halves
-MAX_CONT_SLOTS = 64  # continuous levels unroll one sequential
-                     # crush_ln ladder PER SLOT (_choose_level_cont),
-                     # and the kernel replays the level per speculative
-                     # candidate — a flat 1000-disk continuous root
-                     # would emit thousands of unrolled ladders and a
-                     # minutes-long compile. Real hierarchy buckets
-                     # (hosts ~16-32 disks, racks ~tens of hosts) sit
-                     # far under this; wider continuous buckets keep
-                     # the XLA path, as all continuous shapes did
-                     # before round 6.
+MAX_CONT_SLOTS = 512  # round 10: the two-phase choose runs exactly TWO
+                      # crush_ln ladders per level regardless of S (the
+                      # round-6 per-slot unroll that capped this at 64
+                      # is gone), so the cap now only bounds the level
+                      # table's one-hot fetch (R = 4S+1 rows) and the
+                      # (S, N) phase-1 temps — both linear in S and
+                      # modeled by _plan_lanes, which narrows the lane
+                      # count (and below MIN_LANES declines the plan)
+                      # before this cap ever binds. Wider continuous
+                      # buckets keep the XLA path.
 # Weight-class draw comparison margin (see _choose_level_cls): lanes
 # whose top two class draws land closer than ABS + best*REL are flagged
 # to the bit-exact XLA fallback. REL covers the f32 rounding of
@@ -167,6 +192,22 @@ MAX_CONT_SLOTS = 64  # continuous levels unroll one sequential
 MARGIN_ABS = 1.25
 MARGIN_REL = 2.0 ** -21
 
+# Two-phase continuous choose (round 10): phase-1 approximate scorer.
+# _LOG2_POLY approximates log2(1 + t) on [0, 1) (degree-7 Chebyshev
+# fit, max error 8.1e-7 in exact arithmetic); ERR_Z bounds
+# |z_f32(u) - (2^48 - crush_ln(u))/2^44| over the ENTIRE 16-bit hash
+# domain with the kernel's exact f32 op order — measured 4.43e-5
+# (dominated by crush_ln's own index2 staircase quantization, not the
+# polynomial), carried at 2.2x safety and asserted exhaustively by
+# tests/test_pallas_mapper.py::test_approx_z_error_bound. REL_SLOP
+# covers every relative-rounding contribution of the phase-1 score
+# (w's f32 representation at w >= 2^24, the divide, fma/assoc
+# differences between platforms) at ~16x safety.
+_LOG2_POLY = (8.1214063e-07, 1.4426336, -0.72020257, 0.47172138,
+              -0.32148254, 0.18865165, -0.075920321, 0.014598490)
+ERR_Z = 1e-4
+REL_SLOP = 2.0 ** -20
+
 
 def _plan_lanes(sizes, rows, kmax) -> int:
     """Widest power-of-two lane count whose VMEM model fits the budget,
@@ -174,14 +215,20 @@ def _plan_lanes(sizes, rows, kmax) -> int:
     per_lane = 0
     for (S, P), R, K in zip(sizes, rows, kmax):
         extra = 0
+        temps = _LIVE_TEMPS
         if K != 1:
             # class (K > 1) and continuous (K == 0) chooses add the
             # crush_ln machinery per lane: the (129, N) + (256, N) ln
             # one-hots plus ~35 (1, N) limb temps (calls are
             # sequential, so the working set does not stack per slot)
             extra = 129 + 256 + 35
+        if K == 0:
+            # two-phase phase 1 holds ~8 extra S-wide f32/i32 planes
+            # live at once (hash, mantissa, score, error envelope,
+            # top-2 masks) on top of the shared choose temps
+            temps += 8
         per_lane = max(per_lane,
-                       4 * (_LIVE_TEMPS * S + 2 * R + P + extra))
+                       4 * (temps * S + 2 * R + P + extra))
     lanes = min(LANES, VMEM_BUDGET // max(per_lane, 1))
     if lanes < MIN_LANES:
         return 0
@@ -198,9 +245,10 @@ def _bucket_classes(weights, G):
 
     Class draw: <= MAX_CLASSES distinct positive weights, each within
     the ln-gap license G (the within-class argmax argument needs it).
-    Continuous draw (round 6): anything else with 0 < w < 2^30 and at
-    most MAX_CONT_SLOTS slots (the per-slot ladder unrolls at compile
-    time) — each slot is its own class, so no license applies."""
+    Continuous draw (round 6, two-phase since round 10): anything else
+    with 0 < w < 2^30 and at most MAX_CONT_SLOTS slots (bounding the
+    level table's fetch width) — each slot is its own class, so no
+    license applies."""
     ws = [int(w) for w in weights]
     if not any(w > 0 for w in ws):
         return None
@@ -413,10 +461,11 @@ def build_plan(m: CrushMap, packed, ruleno: int,
         # fetch stays exact).
         cont_l = any(bucket_cls[bid][0] == "cont" for bid in lvl)
         if cont_l and S > MAX_CONT_SLOTS:
-            # the per-slot ladder unrolls over the LEVEL's padded
-            # width S, not each continuous bucket's own size — a wide
-            # uniform sibling sharing the stratum would recreate the
-            # compile-time cliff the cap exists to prevent
+            # the continuous layout's table rows (4S+1) and phase-1
+            # temps scale with the LEVEL's padded width S, not each
+            # continuous bucket's own size — a wide uniform sibling
+            # sharing the stratum widens the whole level, so the cap
+            # applies to S
             return None
         K = 0 if cont_l else \
             max(len(bucket_cls[bid][1]) for bid in lvl)
@@ -726,26 +775,62 @@ def _choose_level_cls(zg_ref, rhlh_ref, ll_ref, x_row, ids, rows_next,
     return win_id, win_next, amb
 
 
+def _approx_z(u):
+    """(S, N) int32 hash -> (S, N) f32 ~ (2^48 - crush_ln(u)) / 2^44.
+
+    Phase-1 scorer: exact exponent/mantissa split of y = u+1 (the
+    bit-length ladder mirrors _crush_ln_neg's normalize; t = y*2^-e - 1
+    is EXACT in f32 because y*2^(16-e) is an integer < 2^17), then a
+    degree-7 polynomial for log2(1+t) in Horner form. Pure elementwise
+    f32 over the whole slot plane — no table fetch, no per-slot unroll.
+    |result - exact| <= ERR_Z over the entire 16-bit domain (verified
+    exhaustively; crush_ln's own index2 staircase dominates)."""
+    y = u + jnp.int32(1)                             # [1, 0x10000]
+    nb = jnp.zeros_like(y)
+    v = y
+    for b in (16, 8, 4, 2, 1):                       # floor(log2(y))
+        big = v >= jnp.int32(1 << b)
+        nb = jnp.where(big, nb + jnp.int32(b), nb)
+        v = jnp.where(big, _srl(v, b), v)
+    pow2 = jnp.int32(1) << (jnp.int32(16) - nb)
+    t = (y.astype(jnp.float32) * pow2.astype(jnp.float32)
+         ) * jnp.float32(2.0 ** -16) - jnp.float32(1.0)   # [0, 1)
+    acc = jnp.full(t.shape, _LOG2_POLY[-1], dtype=jnp.float32)
+    for c in _LOG2_POLY[-2::-1]:
+        acc = acc * t + jnp.float32(c)
+    return (jnp.float32(16.0) - nb.astype(jnp.float32)) - acc
+
+
 def _choose_level_cont(rhlh_ref, ll_ref, x_row, ids, rows_next, size,
                        wlo, whi, r):
-    """One straw2 choose over (S, N) slots with ARBITRARY per-slot
-    weights — the continuous-choose_args / many-distinct-disks case
-    that used to gate the whole map off the kernel.
+    """Two-phase straw2 choose over (S, N) slots with ARBITRARY
+    per-slot weights — the continuous-choose_args / many-distinct-
+    disks case that used to gate the whole map off the kernel.
 
-    Degenerate class decomposition: every slot is its own weight
-    class, so the within-class argmax argument (and its ln-gap
-    license) is vacuous — there is nothing inside a one-slot class to
-    tie-break. The kernel runs the exact fixed-point crush_ln ladder
-    (_crush_ln_neg — bit-exact vs ln_table.crush_ln) once per slot on
-    the slot's own 16-bit hash and compares d_s = neg_s / w_s across
-    slots in f32. The scalar winner is the FIRST slot attaining the
-    minimal truncated quotient (mapper.c bucket_straw2_choose keeps
-    the incumbent on draw ties), which the strict `d < best` update
-    reproduces whenever the f32 order is provably the exact order;
-    lanes whose top two draws land within MARGIN_ABS + best*MARGIN_REL
-    (covering every f32 rounding and integer floor-tie possibility —
-    the same envelope as _choose_level_cls) return amb=1 and are
-    recomputed bit-exactly by the caller's XLA fallback."""
+    Every slot is its own weight class (the degenerate class
+    decomposition — no within-class tie to break, so no ln-gap
+    license applies). Round 6 ran the exact crush_ln ladder once per
+    slot, sequentially; this version (round 10):
+
+    - phase 1 scores ALL slots in one fused elementwise pass with the
+      _approx_z f32 approximation (proven |err| <= ERR_Z over the full
+      hash domain) and selects the top-2 candidates plus a lower
+      envelope over every remaining slot;
+    - phase 2 runs the exact fixed-point ladder (_crush_ln_neg —
+      bit-exact vs ln_table.crush_ln) on JUST those two candidates and
+      compares their exact draws in f32.
+
+    The scalar winner is the FIRST slot attaining the minimal
+    truncated quotient (mapper.c bucket_straw2_choose keeps the
+    incumbent on draw ties); strict exact-f32 comparison reproduces it
+    whenever the gap clears MARGIN_ABS + best*MARGIN_REL (the round-6
+    envelope covering f32 rounding and integer floor ties). amb=1 —
+    recompute bit-exactly on the caller's XLA fallback — when (a) the
+    top-2 exact draws land inside that margin, or (b) any third slot's
+    phase-1 score minus its error bound reaches the winner's exact
+    draw plus the margin: if the exact winner were outside the phase-1
+    top-2, its own lower bound would trip (b), so no unflagged lane
+    can misrank."""
     S, N = ids.shape
     xb = jnp.broadcast_to(x_row, (S, N))
     rb = jnp.broadcast_to(jnp.asarray(r, jnp.int32), (S, N))
@@ -754,26 +839,61 @@ def _choose_level_cont(rhlh_ref, ll_ref, x_row, ids, rows_next, size,
     else:
         u = _hash3(xb, ids, rb) & 0xFFFF             # (S, N)
     big = jnp.float32(3.0e38)
-    best_d = jnp.full((1, N), big, dtype=jnp.float32)
-    second_d = jnp.full((1, N), big, dtype=jnp.float32)
-    win_id = jnp.zeros((1, N), dtype=jnp.int32)
-    win_next = jnp.zeros((1, N), dtype=jnp.int32)
-    for s in range(S):
-        nh, nl = _crush_ln_neg(rhlh_ref, ll_ref, u[s:s + 1, :])
-        w_f = whi[s:s + 1, :].astype(jnp.float32) * jnp.float32(32768.0) \
-            + wlo[s:s + 1, :].astype(jnp.float32)
+    slot = jax.lax.broadcasted_iota(jnp.int32, (S, N), 0)
+    w_f = whi.astype(jnp.float32) * jnp.float32(32768.0) \
+        + wlo.astype(jnp.float32)                    # (S, N)
+    live = (slot < size) & (w_f > 0)   # dead: past size, or w <= 0
+    # phase 1: fused approximate scoring of every slot at once
+    d_a = (_approx_z(u) * jnp.float32(2.0 ** 44)) \
+        / jnp.maximum(w_f, jnp.float32(1.0))
+    d_a = jnp.where(live, d_a, big)
+    err = jnp.float32(ERR_Z * 2.0 ** 44) \
+        / jnp.maximum(w_f, jnp.float32(1.0)) \
+        + d_a * jnp.float32(REL_SLOP)
+    b1 = jnp.min(d_a, axis=0, keepdims=True)         # (1, N)
+    k1 = jnp.min(jnp.where(d_a == b1, slot, jnp.int32(S)),
+                 axis=0, keepdims=True)
+    m1 = slot == k1
+    d_a2 = jnp.where(m1, big, d_a)
+    b2 = jnp.min(d_a2, axis=0, keepdims=True)
+    k2 = jnp.min(jnp.where(d_a2 == b2, slot, jnp.int32(S)),
+                 axis=0, keepdims=True)
+    # no second LIVE candidate (single-live-slot bucket): b2 stays at
+    # `big` and k2 would collapse onto slot 0 — possibly k1 itself,
+    # making d2==d1 flag every lane. Mask m2 off instead: the lone
+    # candidate is trivially unambiguous.
+    m2 = (slot == k2) & (b2 < big)
+    # lower envelope over every slot OUTSIDE the top-2: if any could
+    # still beat the winner once its proven error is granted, flag
+    low3 = jnp.min(jnp.where(live & ~m1 & ~m2, d_a - err, big),
+                   axis=0, keepdims=True)
+
+    # phase 2: the exact ladder on just the two candidates
+    def _cand(m):
+        mi = m.astype(jnp.int32)
+        uu = jnp.sum(mi * u, axis=0, keepdims=True, dtype=jnp.int32)
+        ww = jnp.sum(m.astype(jnp.float32) * w_f, axis=0,
+                     keepdims=True)
+        ii = jnp.sum(mi * ids, axis=0, keepdims=True, dtype=jnp.int32)
+        nn = jnp.sum(mi * rows_next, axis=0, keepdims=True,
+                     dtype=jnp.int32)
+        alive = jnp.sum(mi * live.astype(jnp.int32), axis=0,
+                        keepdims=True, dtype=jnp.int32) > 0
+        nh, nl = _crush_ln_neg(rhlh_ref, ll_ref, uu)
         neg_f = nh.astype(jnp.float32) * jnp.float32(16777216.0) \
             + nl.astype(jnp.float32)
-        d = neg_f / jnp.maximum(w_f, jnp.float32(1.0))
-        # dead slots: past the bucket size, or w <= 0 (stored as 0)
-        d = jnp.where((jnp.int32(s) < size) & (w_f > 0), d, big)
-        new_min = d < best_d
-        second_d = jnp.where(new_min, best_d, jnp.minimum(second_d, d))
-        win_id = jnp.where(new_min, ids[s:s + 1, :], win_id)
-        win_next = jnp.where(new_min, rows_next[s:s + 1, :], win_next)
-        best_d = jnp.minimum(best_d, d)
-    margin = jnp.float32(MARGIN_ABS) + best_d * jnp.float32(MARGIN_REL)
-    amb = (second_d - best_d) <= margin              # (1, N) bool
+        d = neg_f / jnp.maximum(ww, jnp.float32(1.0))
+        return ii, nn, jnp.where(alive, d, big)
+
+    i1, n1, d1 = _cand(m1)
+    i2, n2, d2 = _cand(m2)
+    best = jnp.minimum(d1, d2)
+    take2 = d2 < d1
+    win_id = jnp.where(take2, i2, i1)
+    win_next = jnp.where(take2, n2, n1)
+    margin = jnp.float32(MARGIN_ABS) + best * jnp.float32(MARGIN_REL)
+    amb = (jnp.maximum(d1, d2) - best) <= margin
+    amb = amb | (low3 <= best + margin)
     return win_id, win_next, amb
 
 

@@ -379,6 +379,9 @@ class PrometheusModule(MgrModule):
                 "# TYPE ceph_osdmap_remap_full_sweeps counter",
                 f"ceph_osdmap_remap_full_sweeps "
                 f"{md.get('remap_full_sweeps', 0)}",
+                "# TYPE ceph_osdmap_remap_sharded_sweeps counter",
+                f"ceph_osdmap_remap_sharded_sweeps "
+                f"{md.get('remap_sharded_sweeps', 0)}",
             ]
         # in-process perf counters (ref: prometheus module exporting
         # daemon perf counters); TYPE_HISTOGRAM counters render as

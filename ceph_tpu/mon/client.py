@@ -53,6 +53,11 @@ class MonClient(Dispatcher):
         # table instead of re-running the mapper every epoch
         self.track_mapping = False
         self._mapping = None
+        # optional extras for the tracked table: a device mesh (full
+        # sweeps go mesh-sharded) and a Tracer (crush_sweep spans) —
+        # the owning daemon sets these before the first tracked map
+        self.mapping_mesh = None
+        self.mapping_tracer = None
 
     @property
     def mapping_table(self):
@@ -163,7 +168,9 @@ class MonClient(Dispatcher):
             # placement reads in the same wakeup should hit it
             if self._mapping is None:
                 from ceph_tpu.osd.osdmap_mapping import OSDMapMapping
-                self._mapping = OSDMapMapping()
+                self._mapping = OSDMapMapping(
+                    mesh=self.mapping_mesh,
+                    tracer=self.mapping_tracer)
             self._mapping.update(self.osdmap)
             self.osdmap.attach_mapping(self._mapping)
         for fut in self._osdmap_waiters:

@@ -105,6 +105,10 @@ class OSD(Dispatcher):
         # phases — shipped monward on the stats piggyback
         from ceph_tpu.utils.tracing import Tracer
         self.tracer = Tracer(name, cfg)
+        # bulk mapping sweeps in the tracked table emit crush_sweep
+        # spans (n_pgs/path/n_devices) through the daemon's tracer, so
+        # advance-map sweep cost is drill-downable in `trace show`
+        self.monc.mapping_tracer = self.tracer
         # per-op-class latency histograms (ref: the OSD's
         # l_osd_op_r/w_latency counters, as real TYPE_HISTOGRAM log2
         # buckets in MICROSECONDS — the prometheus module renders them

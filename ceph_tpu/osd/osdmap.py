@@ -91,6 +91,9 @@ PERF = (_PCB("osdmap")
                          "PGs delta-remapped by OSDMapMapping.update")
         .add_u64_counter("remap_full_sweeps",
                          "full-pool sweeps by OSDMapMapping.update")
+        .add_u64_counter("remap_sharded_sweeps",
+                         "full-pool sweeps served by the mesh-sharded "
+                         "sweep (crush.sharded_sweep)")
         .create_perf_counters())
 
 _PG_CACHE_MAX_BATCH = 16       # memo-cache only scalar-ish lookups;
@@ -200,6 +203,11 @@ class OSDMap:
         # module docstring); counters are instance-level so tests can
         # assert on one map, and mirrored into the process-wide PERF
         self._mapping = None
+        # optional device mesh (round 10): bulk sweeps route through
+        # crush.sharded_sweep — set via attach_mesh, re-attached by an
+        # OSDMapMapping(mesh=...) on every update
+        self._mesh = None
+        self._mesh_min_batch = None
         self._pg_cache: dict[tuple[int, int], tuple] = {}
         self._pg_cache_epoch = self.epoch
         self.mapping_cache_hits = 0
@@ -396,14 +404,32 @@ class OSDMap:
             return -1
         return None
 
+    def attach_mesh(self, mesh, mesh_min_batch: int | None = None):
+        """Route bulk mapping sweeps over a device mesh (round 10):
+        existing and future Mappers of this map get the mesh attached
+        (crush.sharded_sweep serves batches >= mesh_min_batch)."""
+        self._mesh = mesh
+        self._mesh_min_batch = mesh_min_batch
+        for mp in self._mappers.values():
+            mp.attach_mesh(mesh, mesh_min_batch)
+
     def mapper(self, choose_args_key: int | None = None) -> Mapper:
         mp = self._mappers.get(choose_args_key)
         if mp is None:
             mp = Mapper(self.crush,
                         device_weights=self._device_weights(),
-                        choose_args=choose_args_key)
+                        choose_args=choose_args_key,
+                        mesh=self._mesh,
+                        mesh_min_batch=self._mesh_min_batch)
             self._mappers[choose_args_key] = mp
         return mp
+
+    def serving_mapper(self, pool_id: int) -> Mapper:
+        """THE Mapper pg_to_crush_osds uses for this pool — the single
+        authoritative selection site, so callers reading post-sweep
+        state (last_map_path for the remap_sharded_sweeps counter and
+        crush_sweep span tags) cannot drift from the sweep itself."""
+        return self.mapper(self._choose_args_key(pool_id))
 
     # -- object -> PG ------------------------------------------------------
     def object_locator_to_pg(self, name: str, loc: ObjectLocator) -> pg_t:
@@ -426,7 +452,7 @@ class OSDMap:
         pool = self.pools[pool_id]
         seeds = np.asarray(seeds, dtype=np.uint32)
         pps = pool.raw_pg_to_pps(seeds, xp=np)
-        mp = self.mapper(self._choose_args_key(pool.id))
+        mp = self.serving_mapper(pool.id)
         raw = np.asarray(mp.map_pgs(pool.crush_rule, pps, pool.size))
         return raw, pps
 

@@ -82,8 +82,19 @@ class OSDMapMapping:
     """Per-pool pg->(raw CRUSH, up, up_primary, acting, acting_primary)
     arrays at one epoch, plus the snapshots the delta diff needs."""
 
-    def __init__(self, osdmap: OSDMap | None = None):
+    def __init__(self, osdmap: OSDMap | None = None, mesh=None,
+                 mesh_min_batch: int | None = None, tracer=None):
         self.epoch = -1
+        # optional device mesh (round 10): attached to every map this
+        # table updates against, so full-pool sweeps — the expensive
+        # crush-topology-change fallback — run the mesh-sharded sweep
+        # (crush.sharded_sweep) instead of one chip
+        self.mesh = mesh
+        self.mesh_min_batch = mesh_min_batch
+        # optional utils.tracing.Tracer: bulk sweeps emit a
+        # `crush_sweep` span (n_pgs/path/n_devices tags) so sweep cost
+        # shows up in `trace show` instead of as opaque mapper time
+        self.tracer = tracer
         self._pools: dict[int, _PoolTable] = {}
         self._osd_weight = None
         self._osd_state = None
@@ -102,6 +113,7 @@ class OSDMapMapping:
         # last-update stats (bench/tests/asok)
         self.last_remap_pgs = 0
         self.last_full_sweep_pools = 0
+        self.last_sharded_sweeps = 0
         if osdmap is not None:
             self.update(osdmap)
 
@@ -146,8 +158,32 @@ class OSDMapMapping:
     def _sweep_pool(self, osdmap: OSDMap, pid: int) -> None:
         pool = osdmap.pools[pid]
         seeds = np.arange(pool.pg_num, dtype=np.uint32)
-        craw, pps = osdmap.pg_to_crush_osds(pid, seeds)
+        span = self.tracer.start_root(
+            "crush_sweep", tags={
+                "n_pgs": int(pool.pg_num), "pool": int(pid),
+                "n_devices": int(self.mesh.devices.size)
+                if self.mesh is not None else 1,
+            }) if self.tracer is not None else None
+        mp = None
+        ok = False
+        try:
+            mp = osdmap.serving_mapper(pool.id)
+            craw, pps = osdmap.pg_to_crush_osds(pid, seeds)
+            ok = True
+        finally:
+            # even a failed sweep must land in the trace buffer — it
+            # is exactly the one an operator will want to drill into.
+            # Tag the engine only on success: on failure last_map_path
+            # is a stale value from some earlier sweep.
+            if span is not None:
+                span.tag("path", (mp.last_map_path or "?")
+                         if ok else "error")
+                span.finish()
         craw = np.array(craw)    # writable: delta remap patches rows
+        path = mp.last_map_path
+        if path is not None and path.endswith("+sharded"):
+            PERF.inc("remap_sharded_sweeps")
+            self.last_sharded_sweeps += 1
         up, upp, acting, actp = osdmap._pipeline_from_crush(
             pool, seeds, craw, pps)
         t = _PoolTable()
@@ -233,6 +269,11 @@ class OSDMapMapping:
         diff allows it, full (per-pool) sweep fallback otherwise."""
         self.last_remap_pgs = 0
         self.last_full_sweep_pools = 0
+        self.last_sharded_sweeps = 0
+        if self.mesh is not None:
+            # decode-fresh maps (the mgr per fetch) never carry the
+            # mesh themselves; the table re-attaches every update
+            osdmap.attach_mesh(self.mesh, self.mesh_min_batch)
         if self.epoch == osdmap.epoch and self._osd_weight is not None:
             # Same epoch as the last update: every placement mutation
             # bumps the epoch (OSDMap._dirty — the invariant the

@@ -12,8 +12,6 @@ from __future__ import annotations
 import functools
 
 import jax
-from ceph_tpu.utils.platform import enable_x64 as _enable_x64
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ceph_tpu.gf import ops
@@ -45,79 +43,20 @@ def sharded_encode(mesh: Mesh, bitmatrix: jax.Array, lo: jax.Array,
 sharded_decode = sharded_encode
 
 
-@functools.lru_cache(maxsize=64)
-def _compiled_sharded_sweep(rule_key, firstn, nd, mesh, block, local_n,
-                            result_max):
-    """Compiled shard_map sweep step (bounded cache, mirroring the
-    single-device _compiled_sweep's lru discipline)."""
-    from ceph_tpu.crush.mapper import ITEM_NONE, _rule_body
-
-    fn_body = _rule_body(*rule_key)
-    axis = mesh.axis_names[0]
-
-    def local(arrs, start_x):
-        # per-shard iota: nothing of O(n) is ever materialized globally
-        base = start_x + (jax.lax.axis_index(axis) *
-                          jnp.uint32(local_n))
-        counts = jnp.zeros(nd + 1, dtype=jnp.int64)
-        bad = jnp.int64(0)
-        for lo in range(0, local_n, block):      # static tile loop
-            width = min(block, local_n - lo)
-            xs = base + jnp.uint32(lo) + jnp.arange(block,
-                                                    dtype=jnp.uint32)
-            w = fn_body(arrs, xs)                # (block, rmax)
-            live = w != ITEM_NONE
-            if width < block:
-                live = live & (jnp.arange(block) < width)[:, None]
-            flat = jnp.where(live, w, nd)
-            counts = counts.at[flat.reshape(-1)].add(jnp.int64(1))
-            if firstn:
-                short = live.sum(axis=1) < result_max
-                if width < block:
-                    short = short & (jnp.arange(block) < width)
-                bad = bad + short.sum(dtype=jnp.int64)
-        return (jax.lax.psum(counts[:nd], axis),
-                jax.lax.psum(bad, axis))
-
-    # check_vma off: the rule VM's while_loop carries start from
-    # unvarying constants, which the varying-manual-axes checker
-    # rejects even though the computation is correctly per-shard
-    from ceph_tpu.utils.platform import shard_map as _shard_map
-    return jax.jit(_shard_map(
-        local, mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(P(), P()),
-        check_vma=False))
-
-
 def sharded_crush_sweep(mesh: Mesh, mapper, ruleno: int, start_x: int,
                         n: int, result_max: int):
     """Aggregated CRUSH sweep with the PG range sharded over the mesh.
 
-    Multi-chip analog of Mapper.sweep: each device maps its local PG
-    range in mapper.block-sized tiles (bounding the straw2 int64 temps
-    exactly like the single-device path — pure SPMD, the packed map
-    tensors replicated, the x axis sharded) and accumulates local
-    per-device placement counts; ONE ``psum`` over ICI merges the count
-    vectors. This is the whole communication cost of scaling CRUSH: a
-    (max_devices,) reduction per sweep (SURVEY.md §5.8 — map
-    distribution is the only shared state).
-
-    n must divide evenly by the mesh size (caller pads). Returns
-    (counts (max_devices,), bad) replicated on every device.
+    Round 10 promoted the embryonic implementation that lived here
+    into the first-class ``ceph_tpu.crush.sharded_sweep`` module
+    (kernel-body aware, padding for arbitrary n, plus the full-table
+    ``sharded_map_pgs``); this wrapper keeps the original strict
+    contract — n must divide evenly by the mesh size — for existing
+    callers. New code should use ``crush.sharded_sweep`` directly or
+    attach the mesh to the Mapper (``Mapper(mesh=...)``).
     """
-    if getattr(mapper, "_scalar_reason", None):
-        raise ValueError(
-            f"map uses legacy tunables ({mapper._scalar_reason}); the "
-            f"scalar fallback cannot shard — use Mapper.sweep")
     ndev = mesh.devices.size
     if n % ndev:
         raise ValueError(f"n={n} must divide by {ndev} devices")
-    local_n = n // ndev
-    block = min(mapper.block, local_n)
-    fn = _compiled_sharded_sweep(
-        mapper._rule_key(ruleno, result_max),
-        mapper.rule_is_firstn(ruleno), mapper.packed.max_devices,
-        mesh, block, local_n, result_max)
-    with _enable_x64(True):
-        return fn(mapper.arrays, jnp.uint32(start_x))
+    from ceph_tpu.crush.sharded_sweep import sharded_sweep
+    return sharded_sweep(mesh, mapper, ruleno, start_x, n, result_max)

@@ -47,3 +47,32 @@ def test_json_output_parses(capsys):
     float(secs), float(mbs)
     detail = json.loads(lines[1])
     assert detail["plugin"] == "jax"
+
+
+def test_sweep_rate_records_path_regression():
+    """A run whose built plan promised the kernel but executed another
+    engine must record path_expected_vs_actual (the PR 4 choose_args
+    regression hid behind exactly this silence)."""
+    from ceph_tpu.bench.crush_sweep import (canonical_map,
+                                            path_regressions,
+                                            sweep_rate)
+    from ceph_tpu.crush.mapper import Mapper
+
+    mp = Mapper(canonical_map(64), block=1 << 10)
+    real = mp.mapping_path
+    state = {"first": True}
+
+    def fake(rule, width):
+        # the pre-run prediction says pallas; every later read (and
+        # the run itself, on CPU) is the xla path — the mid-run
+        # degrade shape
+        if state["first"]:
+            state["first"] = False
+            return "pallas"
+        return real(rule, width)
+
+    mp.mapping_path = fake
+    r = sweep_rate(n_osds=64, n_pgs=1 << 12, num_rep=3, mapper=mp)
+    assert r["path"] == "xla"
+    assert r["path_expected_vs_actual"] == "pallas->xla"
+    assert path_regressions({"v": r}) == ["v: pallas->xla"]

@@ -386,6 +386,52 @@ def test_every_asok_command_has_docstring():
     assert not violations, "\n".join(violations)
 
 
+# -- pod-scale bench record guards (round 10) ------------------------------
+
+def test_crush_multichip_bench_schema():
+    """The crush_multichip bench section must report a MEASURED wall —
+    `measured: true`, an explicit `n_devices`, and `seconds_100M` (NOT
+    the `_est` suffix the single-chip rows carry: that suffix marks a
+    linearity extrapolation, which is exactly what the pod row exists
+    to retire). Runs the real section function on the 8-virtual-device
+    CPU mesh at a smoke size, so schema drift fails here before the
+    driver's record goes stale."""
+    from ceph_tpu.bench.crush_sweep import canonical_map
+    from ceph_tpu.bench.multichip import measured_sweep
+    from ceph_tpu.crush.mapper import Mapper
+    from ceph_tpu.parallel import local_mesh
+
+    n = 1 << 12
+    rec = measured_sweep(local_mesh(),
+                         Mapper(canonical_map(64), block=1 << 10),
+                         n, 3, reps=1)
+    assert rec["measured"] is True
+    assert rec["n_devices"] == 8
+    assert "seconds_100M" in rec and rec["seconds_100M"] > 0
+    assert "seconds_100M_est" not in rec
+    assert rec["extrapolated"] is True      # smoke size < 100M says so
+    assert rec["path"].endswith("+sharded")
+    assert rec["placements"] == 3 * n
+    assert json.loads(json.dumps(rec)) == rec   # JSON-clean
+
+
+def test_multichip_records_schema_roundtrip():
+    """Every committed MULTICHIP_r*.json must parse, carry the driver
+    schema, and survive a JSON round-trip — the r06 record additionally
+    ships the measured crush_multichip row in its tail, so a schema
+    break here would silently orphan the pod-scale evidence."""
+    recs = sorted(REPO.glob("MULTICHIP_r*.json"))
+    assert recs, "no MULTICHIP records committed"
+    for p in recs:
+        rec = json.loads(p.read_text())
+        assert {"n_devices", "rc", "ok", "skipped",
+                "tail"} <= rec.keys(), p.name
+        assert isinstance(rec["n_devices"], int), p.name
+        assert isinstance(rec["ok"], bool), p.name
+        assert isinstance(rec["tail"], str), p.name
+        assert json.loads(json.dumps(rec)) == rec, p.name
+
+
 if __name__ == "__main__":
     import sys
     if len(sys.argv) > 1 and sys.argv[1] == "regen-messages":

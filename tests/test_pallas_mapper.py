@@ -231,6 +231,45 @@ class TestContinuousWeights:
         _assert_kernel_matches_ref(
             m, rid, 3, xs=np.arange(96, dtype=np.uint32))
 
+    @pytest.mark.slow
+    def test_single_live_slot_bucket_not_flagged(self):
+        """Round-10 two-phase regression (slow: two interpret-mode
+        kernel compiles; a flag-RATE pin, not a correctness gate — the
+        tier-1 bit-exact suites cover single-slot buckets' results):
+        a bucket with a SINGLE live
+        slot at a continuous level has no second candidate — that must
+        read as trivially unambiguous, not as d2==d1 flagging every
+        lane that descends into it to the fallback (the lone-candidate
+        k2 used to collapse onto k1)."""
+        import numpy as np
+        from ceph_tpu.crush.builder import (DEFAULT_TYPE_NAMES,
+                                            make_bucket)
+        from ceph_tpu.crush.types import CrushMap, Tunables
+        m = CrushMap(tunables=Tunables(),
+                     type_names=dict(DEFAULT_TYPE_NAMES))
+        m.max_devices = 9
+        cont = make_bucket(
+            m, builder.TYPE_HOST, [0, 1, 2, 3, 4],
+            [WEIGHT_ONE + 917 * i for i in range(5)], name="h-cont")
+        singles = [make_bucket(m, builder.TYPE_HOST, [5 + i],
+                               [WEIGHT_ONE], name=f"h-one{i}")
+                   for i in range(4)]
+        root = make_bucket(m, builder.TYPE_ROOT, [cont] + singles,
+                           name="root")
+        rid = builder.add_simple_rule(m, root, builder.TYPE_HOST)
+        mapper = Mapper(m)
+        plan = mapper._kernel_plan(rid)
+        assert plan is not None and 0 in plan.kmax
+        xs = jnp.asarray(np.arange(plan.lanes, dtype=np.int32))
+        # numrep=1: no slot collisions are possible, so every flag
+        # would be an ambiguity flag — ~83% of lanes send at least one
+        # of the 3 candidates into a single-disk host, and none may
+        # flag for that reason alone
+        _, bad = pm._run_kernel(plan, xs, 1, interpret=True)
+        assert np.asarray(bad).mean() < 0.02, np.asarray(bad).mean()
+        _assert_kernel_matches_ref(m, rid, 2,
+                                   xs=np.arange(64, dtype=np.uint32))
+
     def test_continuous_choose_args_bit_exact(self):
         """Single-position choose_args with EVERY slot perturbed (the
         upstream-balancer weight-set shape) vs the scalar spec.
@@ -538,6 +577,24 @@ class TestKernelInternals:
             jnp.asarray(b.astype(np.int32)).reshape(2, -1))
         ).reshape(-1).astype(np.uint32).astype(np.int64)
         assert np.array_equal(want2, got2)
+
+    def test_approx_z_error_bound(self):
+        """The two-phase phase-1 scorer's PROVEN envelope: the claimed
+        ERR_Z bound on |_approx_z(u) - (2^48 - crush_ln(u))/2^44| must
+        hold over the ENTIRE 16-bit hash domain — this is the fact that
+        licenses flagging (not recomputing) third-slot candidates. The
+        assert keeps real safety headroom (measured max ~4.43e-5,
+        dominated by crush_ln's index2 staircase, vs ERR_Z = 1e-4) so a
+        platform fma/assoc wobble cannot silently eat the margin."""
+        import jax
+        from ceph_tpu.crush.ln_table import crush_ln
+        u = np.arange(0x10000, dtype=np.int64)
+        z_exact = ((1 << 48) - crush_ln(u)).astype(np.float64) / 2.0**44
+        got = np.asarray(jax.jit(pm._approx_z)(
+            jnp.asarray(u, dtype=jnp.int32).reshape(4, -1)))
+        err = np.abs(got.reshape(-1).astype(np.float64) - z_exact)
+        assert err.max() <= pm.ERR_Z * 0.6, \
+            (err.max(), int(err.argmax()))
 
     def test_zg_flag_table(self):
         from ceph_tpu.crush.ln_table import ln_gap_info
