@@ -136,6 +136,20 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
             if len(w) > 4:
                 cmd["expire"] = float(w[4])
         return cmd, b""
+    if w[:2] == ["osd", "slow"]:
+        # ceph osd slow ls — confirmed slow OSDs + score table
+        return {"prefix": "osd slow ls"}, b""
+    if w[:2] == ["osd", "client-profile"]:
+        # ceph osd client-profile set <entity> <res> <weight> <limit>
+        #                          | rm <entity> | ls
+        cmd = {"prefix": "osd client-profile", "op": w[2]}
+        if w[2] in ("set", "rm"):
+            cmd["entity"] = w[3]
+        if w[2] == "set":
+            cmd["reservation"] = float(w[4])
+            cmd["weight"] = float(w[5])
+            cmd["limit"] = float(w[6])
+        return cmd, b""
     if w[:2] == ["pg", "repair"]:
         # ceph pg repair <pgid> — rewrite digest-mismatched replicas
         # from the authoritative copy (mon messages the acting primary)

@@ -137,7 +137,7 @@ def dec_pg_t(d: Decoder) -> pg_t:
 
 
 def _enc_pool(e: Encoder, p: PGPool) -> None:
-    with e.start(3):                    # v3: + pg_num_pending (merge)
+    with e.start(4):                    # v4: + qos_* (op scheduler)
         e.s64(p.id).u32(p.pg_num).u32(p.pgp_num).u8(p.type)
         e.u32(p.size).u32(p.min_size).s32(p.crush_rule).u64(p.flags)
         e.u8(p.object_hash).string(p.erasure_code_profile).string(p.name)
@@ -145,10 +145,12 @@ def _enc_pool(e: Encoder, p: PGPool) -> None:
         e.string(json.dumps(p.extra) if p.extra else "")
         e.u64(p.quota_bytes).u64(p.quota_objects)          # v2
         e.u32(p.pg_num_pending)                            # v3
+        e.f64(p.qos_reservation).f64(p.qos_weight)         # v4
+        e.f64(p.qos_limit)                                 # v4
 
 
 def _dec_pool(d: Decoder) -> PGPool:
-    with d.start(3) as _v:
+    with d.start(4) as _v:
         p = PGPool(id=d.s64(), pg_num=d.u32(), pgp_num=d.u32(),
                    type=d.u8(), size=d.u32(), min_size=d.u32(),
                    crush_rule=d.s32(), flags=d.u64(),
@@ -162,6 +164,10 @@ def _dec_pool(d: Decoder) -> PGPool:
             p.quota_objects = d.u64()
         if _v >= 3:
             p.pg_num_pending = d.u32()
+        if _v >= 4:
+            p.qos_reservation = d.f64()
+            p.qos_weight = d.f64()
+            p.qos_limit = d.f64()
     return p
 
 
@@ -187,7 +193,7 @@ def encode_osdmap(m) -> bytes:
     monitor store value)."""
     e = Encoder()
     e.u32(OSDMAP_MAGIC)
-    with e.start(5):                    # v5: + service flags
+    with e.start(6):                    # v6: + client QoS profiles
         e.u32(m.epoch)
         e.blob(encode_crush_map(m.crush))
         e.u32(m.max_osd)
@@ -209,6 +215,8 @@ def encode_osdmap(m) -> bytes:
         e.map(m.blocklist, lambda e, k: e.string(k),
               lambda e, v: e.f64(v))                           # v4
         e.u64(m.flags)                                         # v5
+        e.map(m.client_profiles, lambda e, k: e.string(k),     # v6
+              lambda e, v: e.f64(v[0]).f64(v[1]).f64(v[2]))
     return e.tobytes()
 
 
@@ -217,7 +225,7 @@ def decode_osdmap(data: bytes):
     d = Decoder(data)
     if d.u32() != OSDMAP_MAGIC:
         raise EncodingError("bad osdmap magic")
-    with d.start(5) as _v:
+    with d.start(6) as _v:
         epoch = d.u32()
         crush = decode_crush_map(d.blob())
         max_osd = d.u32()
@@ -242,6 +250,10 @@ def decode_osdmap(data: bytes):
                                 lambda d: d.f64())
         if _v >= 5:
             m.flags = d.u64()
+        if _v >= 6:
+            m.client_profiles = d.map(
+                lambda d: d.string(),
+                lambda d: (d.f64(), d.f64(), d.f64()))
     return m
 
 
@@ -249,7 +261,7 @@ def encode_incremental(inc) -> bytes:
     """ref: OSDMap::Incremental::encode — the delta the monitor commits
     per epoch and OSDs apply on subscription."""
     e = Encoder()
-    with e.start(5):                    # v5: + service flags
+    with e.start(6):                    # v6: + client QoS profiles
         e.u32(inc.epoch)
         e.optional(inc.new_max_osd, lambda e, v: e.u32(v))
         e.map(inc.new_pools, lambda e, k: e.s64(k), _enc_pool)
@@ -281,6 +293,10 @@ def encode_incremental(inc) -> bytes:
               lambda e, v: e.f64(v))                              # v4
         e.list(inc.old_blocklist, lambda e, v: e.string(v))       # v4
         e.s64(-1 if inc.new_flags is None else inc.new_flags)     # v5
+        e.map(inc.new_client_profiles, lambda e, k: e.string(k),  # v6
+              lambda e, v: e.f64(v[0]).f64(v[1]).f64(v[2]))
+        e.list(inc.old_client_profiles,
+               lambda e, v: e.string(v))                          # v6
     return e.tobytes()
 
 
@@ -288,7 +304,7 @@ def decode_incremental(data: bytes):
     from ceph_tpu.osd.osdmap import Incremental
     d = Decoder(data)
     inc = Incremental()
-    with d.start(5) as _v:
+    with d.start(6) as _v:
         inc.epoch = d.u32()
         inc.new_max_osd = d.optional(lambda d: d.u32())
         inc.new_pools = d.map(lambda d: d.s64(), _dec_pool)
@@ -321,4 +337,9 @@ def decode_incremental(data: bytes):
         if _v >= 5:
             nf = d.s64()
             inc.new_flags = None if nf < 0 else nf
+        if _v >= 6:
+            inc.new_client_profiles = d.map(
+                lambda d: d.string(),
+                lambda d: (d.f64(), d.f64(), d.f64()))
+            inc.old_client_profiles = d.list(lambda d: d.string())
     return inc

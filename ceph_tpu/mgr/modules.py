@@ -362,6 +362,22 @@ class PrometheusModule(MgrModule):
         flags = om.get("flags", "")
         for fname in (flags.split(",") if flags else []):
             lines.append(f'ceph_osdmap_flag{{flag="{fname}"}} 1')
+        # gray failure (round 11): per-OSD slow-score behind OSD_SLOW
+        slow = om.get("slow_osds", {})
+        if slow:
+            lines.append("# TYPE ceph_osd_slow_score gauge")
+            for osd, score in sorted(slow.items()):
+                lines.append(
+                    f'ceph_osd_slow_score{{osd="{osd}"}} {score}')
+        # op QoS scheduler (round 11): the dmClock admission counters
+        qpc = PerfCountersCollection.instance().get("osd_qos")
+        if qpc is not None:
+            qd = qpc.dump()
+            lines.append("# ceph_osd_qos_*: scheduler counters")
+            for key in sorted(qd):
+                val = qd[key]
+                if isinstance(val, (int, float)):
+                    lines.append(f"ceph_osd_qos_{key} {val}")
         # mapping engine (round 6): epoch-cache traffic and delta-remap
         # volume — the counters behind the "<1s to map 100M PGs" target
         mpc = PerfCountersCollection.instance().get("osdmap")

@@ -65,19 +65,14 @@ READONLY_COMMANDS = frozenset((
     "osd pool ls", "osd getmap", "osd getcrushmap", "osd map",
     "osd blocklist ls", "pg dump", "pg map", "fs status", "fs dump",
     "fs subtree ls", "mds dump",
-    "trace dump", "trace ls", "trace show",
+    "trace dump", "trace ls", "trace show", "osd slow ls",
 ))
 AUTH_READS = frozenset(("auth get", "auth ls"))
 
 
-def cap_allows(spec: str, need: str) -> bool:
-    """Does one cap spec string ("allow r", "rw", "*", "allow *")
-    grant ``need`` ("r" | "w" | "*")? ``*`` in the spec grants
-    everything; ``need="*"`` requires a literal ``*``."""
-    tokens = set("".join(t for t in spec.replace("allow", " ").split()))
-    if "*" in tokens:
-        return True
-    return need in tokens and need != "*"
+# the spec grammar lives with the Keyring now (round 11): the OSD's
+# per-op admission check shares it — re-exported here for callers
+from ceph_tpu.msg.auth import cap_allows  # noqa: E402,F401
 
 
 class AuthMonitor(PaxosService):
@@ -124,8 +119,13 @@ class AuthMonitor(PaxosService):
         kr: Keyring | None = self.mon.keyring
         if kr is None:
             return
-        for name, (secret, _caps) in self.keys.items():
+        for name, (secret, caps) in self.keys.items():
             kr.set_key(name, secret)
+            # caps ride along so the OSD's per-op admission check sees
+            # the committed table (vstart shares ONE keyring object;
+            # standalone daemons converge via the MAuthUpdate caps
+            # field on their `keyring` subscription)
+            kr.set_caps(name, caps)
         for name in self.revoked:
             if name not in self.keys:
                 kr.revoke(name)
@@ -164,6 +164,20 @@ class AuthMonitor(PaxosService):
             if name not in self.keys and (is_daemon or name == peer):
                 out[name] = b""
         return out
+
+    def caps_for(self, peer_name: str | None) -> dict[str, str]:
+        """The MAuthUpdate ``caps`` companion table (same filtering as
+        publishable_for): entity -> JSON cap dict, feeding the
+        subscribers' Keyring.set_caps so per-op OSD enforcement works
+        off the committed table. Entities whose caps were CLEARED ride
+        along with an empty blob — the subscriber must drop its stale
+        table, not keep enforcing it."""
+        peer = peer_name or ""
+        is_daemon = peer.split(".", 1)[0] in ("mon", "osd", "mds",
+                                              "mgr")
+        return {name: (json.dumps(caps) if caps else "")
+                for name, (_secret, caps) in self.keys.items()
+                if is_daemon or name == peer}
 
     # -- cap enforcement (first slice; see module docstring) ---------------
     def check_command_caps(self, entity: str,

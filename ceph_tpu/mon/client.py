@@ -138,6 +138,11 @@ class MonClient(Dispatcher):
                 kr.set_key(name, secret)
             else:
                 kr.revoke(name)
+        for name, blob in getattr(m, "caps", {}).items():
+            try:
+                kr.set_caps(name, json.loads(blob) if blob else {})
+            except (json.JSONDecodeError, TypeError):
+                pass
 
     async def _handle_osdmap(self, m: MOSDMap) -> None:
         if m.full:

@@ -198,13 +198,16 @@ class MPGStats(Message):
     ``trace_spans`` (round 9, appended) piggybacks the daemon's
     completed trace spans so the mon's pool — and through it the mgr
     TracingModule — can reassemble cross-daemon traces without a new
-    report channel."""
+    report channel. ``peer_latency`` (round 11, appended) piggybacks
+    the daemon's per-peer heartbeat round-trip EWMAs (osd -> µs) —
+    the raw material of the mon's gray-failure slow-score sweep."""
 
     TYPE = 145
     FIELDS = [("osd", "s32"), ("epoch", "u32"),
               ("stats", "map:str:blob"), ("slow_ops", "u32"),
               ("used_bytes", "u64"), ("capacity_bytes", "u64"),
-              ("trace_spans", "list:blob")]
+              ("trace_spans", "list:blob"),
+              ("peer_latency", "map:str:u64")]
 
 
 @register
@@ -225,10 +228,15 @@ class MAuthUpdate(Message):
     MAuthReply): entity -> secret, an EMPTY secret meaning revoked.
     The table is filtered per subscriber — daemons (mon./osd./mds./
     mgr.) get the full table, a client only its own entry — so a
-    client subscription can never exfiltrate another entity's key."""
+    client subscription can never exfiltrate another entity's key.
+    ``caps`` (round 11, appended) carries each entity's cap table
+    (JSON per entity, same filtering) so the OSD's per-op admission
+    check works off the committed table; pre-caps blobs decode with
+    an empty map per the zero-fill append discipline."""
 
     TYPE = 150
-    FIELDS = [("version", "u64"), ("keys", "map:str:blob")]
+    FIELDS = [("version", "u64"), ("keys", "map:str:blob"),
+              ("caps", "map:str:str")]
 
 
 @register

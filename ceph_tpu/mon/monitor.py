@@ -177,6 +177,13 @@ class Monitor(Dispatcher):
         self._trace_gen = _random.getrandbits(63) | 1
         self.trace_index = TraceIndex(
             max_traces=int(cfg.get("mon_trace_max_traces", 512)))
+        # the mon's OWN span factory (round 11, the PR 8 follow-up:
+        # mons emitted no spans of their own, so paxos commit latency
+        # was opaque): paxos propose -> accept-wait -> commit emits a
+        # span family, drained into the local pool on tick — no wire
+        # hop needed, the pool lives here
+        from ceph_tpu.utils.tracing import Tracer
+        self.tracer = Tracer(f"mon.{name}", cfg)
 
         # subscriptions: conn -> {what: next_epoch}
         self.subs: dict[object, dict[str, int]] = {}
@@ -306,6 +313,11 @@ class Monitor(Dispatcher):
                 now = asyncio.get_event_loop().time()
                 if self._removed:
                     continue          # retired: awaiting teardown
+                # the mon's own spans (paxos) pool locally: same
+                # ingest path the piggybacked daemon spans take
+                own = self.tracer.drain_ship()
+                if own:
+                    self.ingest_trace_spans(own)
                 if self.is_leader():
                     await self.paxos.send_lease()
                     for svc in self.services:
@@ -428,49 +440,58 @@ class Monitor(Dispatcher):
         """Push new osdmap/fsmap/monmap/keyring epochs to subscribers
         (ref: OSDMonitor::check_subs / send_incremental +
         MDSMonitor::check_subs + Monitor::handle_subscribe's monmap
-        send)."""
+        send).
+
+        Fan-out is CONCURRENT with a bounded width (round 11): the
+        serial per-subscriber awaits this loop used to do made every
+        map commit O(subscribers) sequential round-trips — with a
+        10k-session load harness attached, one commit stalled the mon
+        for seconds. Per-connection sends stay ordered (each conn is
+        handled by one task); only distinct subscribers parallelize."""
+        subscribers = list(self.subs.items())
+        if not subscribers:
+            return
+        sem = asyncio.Semaphore(32)
+
+        async def one(conn, subs):
+            async with sem:
+                await self._publish_to(conn, subs)
+        await asyncio.gather(*[one(c, s) for c, s in subscribers],
+                             return_exceptions=True)
+
+    async def _publish_to(self, conn, subs) -> None:
         cur = self.osdmon.osdmap.epoch if self.osdmon.osdmap else 0
         fs_cur = self.mdsmon.fsmap.epoch
         mm_cur = self.monmap.epoch
         auth_cur = self.authmon.version
-        for conn, subs in list(self.subs.items()):
+        try:
             start = subs.get("osdmap")
             if start is not None and start <= cur:
-                try:
-                    await self._send_osdmaps(conn, start)
-                    subs["osdmap"] = cur + 1
-                except Exception:
-                    self.subs.pop(conn, None)
-                    continue
+                await self._send_osdmaps(conn, start)
+                subs["osdmap"] = cur + 1
             fs_start = subs.get("mdsmap")
             if fs_start is not None and fs_start <= fs_cur:
-                try:
-                    await conn.send_message(MMDSMap(
-                        epoch=fs_cur,
-                        fsmap=self.mdsmon.fsmap.encode()))
-                    subs["mdsmap"] = fs_cur + 1
-                except Exception:
-                    self.subs.pop(conn, None)
-                    continue
+                await conn.send_message(MMDSMap(
+                    epoch=fs_cur,
+                    fsmap=self.mdsmon.fsmap.encode()))
+                subs["mdsmap"] = fs_cur + 1
             mm_start = subs.get("monmap")
             if mm_start is not None and mm_start <= mm_cur:
-                try:
-                    await conn.send_message(MMonMap(
-                        monmap=self.monmap.encode(), epoch=mm_cur))
-                    subs["monmap"] = mm_cur + 1
-                except Exception:
-                    self.subs.pop(conn, None)
-                    continue
+                await conn.send_message(MMonMap(
+                    monmap=self.monmap.encode(), epoch=mm_cur))
+                subs["monmap"] = mm_cur + 1
             a_start = subs.get("keyring")
             if a_start is not None and a_start <= auth_cur:
-                try:
-                    await conn.send_message(MAuthUpdate(
-                        version=auth_cur,
-                        keys=self.authmon.publishable_for(
-                            conn.peer_name)))
-                    subs["keyring"] = auth_cur + 1
-                except Exception:
-                    self.subs.pop(conn, None)
+                await conn.send_message(MAuthUpdate(
+                    version=auth_cur,
+                    keys=self.authmon.publishable_for(
+                        conn.peer_name),
+                    caps=self.authmon.caps_for(conn.peer_name)))
+                subs["keyring"] = auth_cur + 1
+        except Exception:
+            # a dead subscriber's session takes its subs with it (a
+            # reconnecting client re-subscribes)
+            self.subs.pop(conn, None)
 
     async def _send_osdmaps(self, conn, start: int) -> None:
         if self.osdmon.osdmap is None:
@@ -659,6 +680,12 @@ class Monitor(Dispatcher):
             pending = self.osdmon.pending_merges()
             if pending:
                 osd_stat["pending_merges"] = pending
+            if self.osdmon.slow_osds:
+                # gray-failure drill-down: score per confirmed-slow
+                # OSD (prometheus renders ceph_osd_slow_score from it)
+                osd_stat["slow_osds"] = {
+                    str(t): v.get("score", 0.0)
+                    for t, v in sorted(self.osdmon.slow_osds.items())}
         return {
             "fsid": self.monmap.fsid,
             "health": health,

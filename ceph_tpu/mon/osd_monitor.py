@@ -74,6 +74,21 @@ class OSDMonitor(PaxosService):
         # only an auto-set flag is auto-cleared — an operator's
         # `osd set full` stays until `osd unset full`
         self._full_auto = False
+        # gray-failure detection (round 11; ref: the osd_perf ping
+        # times `dump_osd_network` aggregates upstream): reporter ->
+        # {target: heartbeat-RTT EWMA µs} from the MPGStats piggyback.
+        # The tick's slow-score sweep compares each target's median
+        # reported latency against the fleet median — a slow-but-alive
+        # OSD scores high long before heartbeats ever time out.
+        self.peer_latency: dict[int, dict[int, int]] = {}
+        # target -> consecutive sweeps above/below threshold (entry/
+        # exit debounce — both directions, or a boundary-hovering OSD
+        # flaps the health check and, with dampening on, churns map
+        # epochs)
+        self._slow_suspect: dict[int, int] = {}
+        self._slow_clear: dict[int, int] = {}
+        # confirmed slow OSDs: target -> {score, latency_ms, since...}
+        self.slow_osds: dict[int, dict] = {}
         # merge readiness barrier (ref: OSDMonitor ready_to_merge_pgs
         # driven by MOSDPGReadyToMerge): (pool, pg_num_pending) ->
         # {source seed: last-report loop time}. Leader memory, not
@@ -220,6 +235,7 @@ class OSDMonitor(PaxosService):
         self.down_at.pop(m.osd, None)
         self.osd_slow_ops.pop(m.osd, None)   # fresh incarnation
         self.osd_utilization.pop(m.osd, None)
+        self._forget_osd_latency(m.osd)
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.osd} boot -> up (epoch "
                     f"{self.osdmap.epoch})")
@@ -257,10 +273,11 @@ class OSDMonitor(PaxosService):
         inc.new_down = [m.target]
         self.failure_reporters.pop(m.target, None)
         # a dead daemon can't send the clearing report: drop its
-        # slow-op count and stale statfs or the SLOW_OPS warning /
-        # FULL evidence outlives it
+        # slow-op count, stale statfs and latency evidence, or the
+        # SLOW_OPS warning / FULL / OSD_SLOW evidence outlives it
         self.osd_slow_ops.pop(m.target, None)
         self.osd_utilization.pop(m.target, None)
+        self._forget_osd_latency(m.target)
         self.down_at[m.target] = asyncio.get_event_loop().time()
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.target} marked down "
@@ -280,6 +297,7 @@ class OSDMonitor(PaxosService):
         self.failure_reporters.pop(m.osd, None)
         self.osd_slow_ops.pop(m.osd, None)
         self.osd_utilization.pop(m.osd, None)
+        self._forget_osd_latency(m.osd)
         self.down_at[m.osd] = asyncio.get_event_loop().time()
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.osd} marked down (mark-me-down)")
@@ -315,6 +333,17 @@ class OSDMonitor(PaxosService):
                 (getattr(m, "used_bytes", 0), cap)
         else:
             self.osd_utilization.pop(m.osd, None)
+        peer_lat = getattr(m, "peer_latency", None)
+        if peer_lat:
+            table = {}
+            for k, us in peer_lat.items():
+                try:
+                    table[int(k)] = int(us)
+                except (TypeError, ValueError):
+                    continue
+            self.peer_latency[m.osd] = table
+        else:
+            self.peer_latency.pop(m.osd, None)
 
     # -- pg merge (ref: OSDMonitor's pg_num_pending machinery) -------------
     def pending_merges(self) -> dict:
@@ -446,6 +475,7 @@ class OSDMonitor(PaxosService):
                 self.failure_reporters.pop(target, None)
         await self._check_fullness()
         await self._check_merge_commit()
+        await self._check_slow_osds()
         if not self.down_at:
             return
         if om.test_flag(FLAG_NOOUT):
@@ -463,6 +493,167 @@ class OSDMonitor(PaxosService):
                 for osd in inc.new_weight:
                     self.down_at.pop(osd, None)
                 log.dout(1, f"auto-out: {list(inc.new_weight)}")
+
+    # -- gray-failure (slow-OSD) sweep (round 11) --------------------------
+    def _forget_osd_latency(self, osd: int) -> None:
+        """Drop every latency trace of a dead/rebooted OSD: its own
+        reports, its entry in every peer's report, and any slow
+        verdict — a DOWN osd is OSD_DOWN's problem, not OSD_SLOW's."""
+        self.peer_latency.pop(osd, None)
+        for table in self.peer_latency.values():
+            table.pop(osd, None)
+        self._slow_suspect.pop(osd, None)
+        self._slow_clear.pop(osd, None)
+        self.slow_osds.pop(osd, None)
+        # a dampened-then-died OSD keeps its lowered affinity in the
+        # MAP; the sweep's to_heal (up + healthy + non-default
+        # affinity) restores it after it boots and scores clean
+
+    def slow_scores(self) -> dict[int, dict]:
+        """Per-OSD relative latency scores from the freshest fleet
+        reports: each target's BEST (minimum) reported heartbeat RTT
+        over the fleet median of those minimums. The min is the
+        framing-proof statistic: a slow/hostile REPORTER inflates only
+        its own view, which the min discards (with a median, a gray
+        reporter in a small cluster drags every healthy target's
+        statistic — and the fleet baseline — up with it, capping its
+        own relative score below the trip ratio); a genuinely slow
+        TARGET is slow in EVERY reporter's view, so its min stays
+        high. ~1.0 = normal; >> 1 = slow for everyone."""
+        import statistics
+        per_target: dict[int, list[int]] = {}
+        for _reporter, targets in self.peer_latency.items():
+            for t, us in targets.items():
+                per_target.setdefault(t, []).append(us)
+        if not per_target:
+            return {}
+        best = {t: min(v) for t, v in per_target.items()}
+        fleet = max(statistics.median(best.values()), 1.0)
+        return {t: {"latency_ms": round(m / 1000.0, 3),
+                    "score": round(m / fleet, 2),
+                    "reporters": len(per_target[t])}
+                for t, m in best.items()}
+
+    async def _check_slow_osds(self) -> None:
+        """The OSD_SLOW sweep: an OSD whose relative score stays past
+        ``mon_osd_slow_ratio`` (with an absolute ``mon_osd_slow_min_ms``
+        floor so a fast idle cluster's jitter can never trip it) for
+        ``mon_osd_slow_confirm`` consecutive sweeps is marked slow —
+        health warning + `ceph osd slow ls` + prometheus score — and
+        cleared the moment its score recovers. With
+        ``mon_osd_slow_primary_dampening`` (off by default) the sweep
+        also commits a primary-affinity dampening for slow OSDs (the
+        optional primary-avoidance hint: reads stop routing to the
+        slow disk's primaries) and restores the previous affinity on
+        heal."""
+        cfg = self.mon.config
+        ratio = float(cfg.get("mon_osd_slow_ratio", 3.0))
+        min_ms = float(cfg.get("mon_osd_slow_min_ms", 50.0))
+        confirm = int(cfg.get("mon_osd_slow_confirm", 2))
+        scores = self.slow_scores()
+        tripped = {t for t, s in scores.items()
+                   if s["score"] >= ratio and s["latency_ms"] >= min_ms}
+        for t in [t for t in self._slow_suspect if t not in tripped]:
+            self._slow_suspect.pop(t, None)
+        newly: list[int] = []
+        for t in tripped:
+            self._slow_clear.pop(t, None)
+            n = self._slow_suspect.get(t, 0) + 1
+            self._slow_suspect[t] = n
+            if n >= confirm and t not in self.slow_osds:
+                newly.append(t)
+        # exit hysteresis: clear only after `confirm` consecutive
+        # clean sweeps, mirroring entry — a score hovering at the
+        # ratio boundary must not flap the verdict every tick
+        healed: list[int] = []
+        for t in [t for t in self.slow_osds if t not in tripped]:
+            n = self._slow_clear.get(t, 0) + 1
+            self._slow_clear[t] = n
+            if n >= confirm:
+                self._slow_clear.pop(t, None)
+                healed.append(t)
+        import time as _time
+        for t in newly:
+            self.slow_osds[t] = {"since": _time.time(), **scores[t]}
+            self.mon.clog(
+                "WRN", f"osd.{t} is slow (score {scores[t]['score']}, "
+                       f"median hb rtt {scores[t]['latency_ms']} ms)")
+            log.dout(1, f"osd.{t} marked SLOW {scores[t]}")
+        for t in self.slow_osds:
+            if t in scores:
+                self.slow_osds[t].update(scores[t])
+        for t in healed:
+            self.slow_osds.pop(t, None)
+            self.mon.clog("INF", f"osd.{t} slow condition cleared")
+            log.dout(1, f"osd.{t} slow condition cleared")
+        await self._apply_primary_dampening()
+
+    def dampened_osds(self) -> list[int]:
+        """OSDs currently primary-dampened. Derived from the MAP (any
+        non-default affinity — this framework has no other
+        primary-affinity writer), so it survives mon leader changes:
+        a fresh leader can heal what the old one dampened without any
+        in-memory handoff. If an operator affinity command is ever
+        added, the dampening sweep must learn to tell the two apart
+        (e.g. a sentinel bit)."""
+        from ceph_tpu.osd.osdmap import DEFAULT_PRIMARY_AFFINITY
+        om = self.osdmap
+        if om is None:
+            return []
+        return [t for t in range(om.max_osd)
+                if int(om.osd_primary_affinity[t]) !=
+                DEFAULT_PRIMARY_AFFINITY]
+
+    async def _apply_primary_dampening(self) -> None:
+        """The optional primary-avoidance hint. HEALING always runs —
+        even with the knob off, a previously-dampened OSD that is
+        healthy again (or a stale dampening left by an old leader)
+        must get its affinity back; only NEW dampening is gated on
+        ``mon_osd_slow_primary_dampening``. Restores to the DEFAULT
+        affinity (not a remembered value): the saved-original design
+        lived in leader RAM and a leader change stranded it."""
+        from ceph_tpu.osd.osdmap import DEFAULT_PRIMARY_AFFINITY
+        cfg = self.mon.config
+        om = self.osdmap
+        dampen_on = bool(cfg.get("mon_osd_slow_primary_dampening",
+                                 False))
+        damp = int(float(cfg.get("mon_osd_slow_primary_affinity",
+                                 0.0)) * DEFAULT_PRIMARY_AFFINITY)
+        dampened = set(self.dampened_osds())
+        to_damp = [t for t in self.slow_osds
+                   if t not in dampened and t < om.max_osd] \
+            if dampen_on else []
+        # restore only UP osds: a dampened OSD that died gets its
+        # affinity back after it boots and scores clean (a down OSD
+        # is never primary anyway, and racing the down commit with an
+        # affinity epoch buys nothing)
+        to_heal = [t for t in dampened
+                   if t not in self.slow_osds and t < om.max_osd
+                   and bool(om.is_up(np.asarray(t)))]
+        if not to_damp and not to_heal:
+            return
+
+        def build(cur):
+            inc = Incremental()
+            for t in to_damp:
+                inc.new_primary_affinity[t] = damp
+            for t in to_heal:
+                inc.new_primary_affinity[t] = DEFAULT_PRIMARY_AFFINITY
+            return (inc, None) if inc.new_primary_affinity else None
+        ok, _ = await self._propose_change(build)
+        if ok:
+            log.dout(1, f"slow-osd primary dampening: damped "
+                        f"{to_damp}, restored {to_heal}")
+
+    async def _cmd_slow_ls(self, cmd, inbl):
+        """`ceph osd slow ls` — confirmed slow OSDs plus the full
+        score table (the drill-down behind OSD_SLOW)."""
+        return 0, "", json.dumps({
+            "slow_osds": {str(t): v for t, v in
+                          sorted(self.slow_osds.items())},
+            "scores": {str(t): v for t, v in
+                       sorted(self.slow_scores().items())},
+            "dampened": self.dampened_osds()}).encode()
 
     async def _check_fullness(self) -> None:
         """The fullness sweep (ref: OSDMonitor::tick ->
@@ -617,6 +808,8 @@ class OSDMonitor(PaxosService):
             "osd pg-upmap-items": self._cmd_pg_upmap_items,
             "osd rm-pg-upmap-items": self._cmd_rm_pg_upmap_items,
             "osd blocklist": self._cmd_blocklist,
+            "osd client-profile": self._cmd_client_profile,
+            "osd slow ls": self._cmd_slow_ls,
         }.get(prefix)
         if handler is None:
             return -22, f"unknown command {prefix!r}", b""
@@ -917,6 +1110,8 @@ class OSDMonitor(PaxosService):
 
     async def _cmd_pool_set(self, cmd, inbl):
         name, var, val = cmd["pool"], cmd["var"], cmd["val"]
+        if var in ("qos_reservation", "qos_weight", "qos_limit"):
+            return await self._cmd_pool_set_qos(name, var, val)
         if var not in ("size", "min_size", "pg_num", "pgp_num"):
             return -22, f"unknown pool var {var!r}", b""
         rejected: dict = {}
@@ -1001,6 +1196,83 @@ class OSDMonitor(PaxosService):
             return 0, f"set pool {name} pg_num_pending to {val} " \
                       f"(merge pending source readiness)", b""
         return 0, f"set pool {name} {var} to {val}", b""
+
+    async def _cmd_pool_set_qos(self, name, var, val):
+        """`osd pool set <pool> qos_reservation|qos_weight|qos_limit
+        <v>` (ref: the per-pool mClock profile overrides): the pool's
+        dmClock parameters for every client queue without a per-entity
+        profile. 0 clears back to the osd_qos_default_* knobs."""
+        try:
+            fval = float(val)
+        except (TypeError, ValueError):
+            return -22, f"invalid {var} value {val!r}", b""
+        if fval < 0:
+            return -22, f"{var} must be >= 0", b""
+
+        def build(om):
+            pool = next((p for p in om.pools.values()
+                         if p.name == name), None)
+            if pool is None:
+                return None
+            import copy
+            newpool = copy.deepcopy(pool)
+            setattr(newpool, var, fval)
+            inc = Incremental()
+            inc.new_pools[pool.id] = newpool
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            if not any(p.name == name
+                       for p in self.osdmap.pools.values()):
+                return -2, f"pool '{name}' does not exist", b""
+            return -11, "proposal failed", b""
+        return 0, f"set pool {name} {var} to {fval}", b""
+
+    async def _cmd_client_profile(self, cmd, inbl):
+        """`ceph osd client-profile set <entity> <reservation>
+        <weight> <limit>` / `rm <entity>` / `ls` — the per-entity QoS
+        table (ref: dmClock's per-client (ρ, w, λ)); rides the osdmap
+        so every OSD's scheduler converges on one committed table."""
+        op = cmd.get("op", "ls")
+        if op == "ls":
+            return 0, "", json.dumps({
+                "profiles": {
+                    e: {"reservation": p[0], "weight": p[1],
+                        "limit": p[2]}
+                    for e, p in sorted(
+                        self.osdmap.client_profiles.items())}}).encode()
+        entity = cmd.get("entity", "")
+        if not entity:
+            return -22, "missing entity", b""
+        if op == "set":
+            try:
+                prof = (float(cmd.get("reservation", 0.0)),
+                        float(cmd.get("weight", 1.0)),
+                        float(cmd.get("limit", 0.0)))
+            except (TypeError, ValueError):
+                return -22, "reservation/weight/limit must be " \
+                            "numbers", b""
+            if min(prof) < 0:
+                return -22, "qos parameters must be >= 0", b""
+
+            def build(om):
+                inc = Incremental()
+                inc.new_client_profiles[entity] = prof
+                return inc, None
+        elif op == "rm":
+            if entity not in self.osdmap.client_profiles:
+                return 0, f"{entity} has no profile", b""
+
+            def build(om):
+                inc = Incremental()
+                inc.old_client_profiles.append(entity)
+                return inc, None
+        else:
+            return -22, f"unknown client-profile op {op!r}", b""
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            return -11, "proposal failed", b""
+        return 0, f"client-profile {op} {entity}", b""
 
     async def _cmd_pool_ls(self, cmd, inbl):
         out = [{"pool": p.id, "name": p.name, "pg_num": p.pg_num,
@@ -1090,10 +1362,15 @@ class OSDMonitor(PaxosService):
                        "quota_bytes": p.quota_bytes,
                        "quota_objects": p.quota_objects,
                        "full": int(p.is_full()),
+                       "qos_reservation": p.qos_reservation,
+                       "qos_weight": p.qos_weight,
+                       "qos_limit": p.qos_limit,
                        "erasure_code_profile": p.erasure_code_profile}
                       for p in om.pools.values()],
             "pg_upmap_items": {str(k): [list(x) for x in v]
                                for k, v in om.pg_upmap_items.items()},
+            "client_profiles": {e: list(p) for e, p in
+                                sorted(om.client_profiles.items())},
         }
         return 0, "", json.dumps(out).encode()
 

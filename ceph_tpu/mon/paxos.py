@@ -184,20 +184,54 @@ class Paxos:
     # -- leader: phase 2 ---------------------------------------------------
     async def propose(self, value: bytes) -> bool:
         """Commit one value through the quorum; returns True on commit
-        (ref: Paxos::propose_pending + begin/commit cycle)."""
+        (ref: Paxos::propose_pending + begin/commit cycle).
+
+        Emits its own span family (round 11 — the PR 8 follow-up that
+        made mon commit latency opaque): a ``paxos_propose`` root with
+        ``paxos_accept_wait`` (BEGIN -> all ACCEPTs) and
+        ``paxos_commit`` (store apply + COMMIT fan-out) children, so
+        `trace show` decomposes a slow commit into quorum round-trip
+        vs store time. The decomposition needs head sampling
+        (``trace_sampling_rate`` > 0): an UNSAMPLED root is
+        local-only, and per the tracing layer's design children of a
+        local-only root are never created — tail retention still
+        keeps the lone root of a slow commit, so SLOW commits stay
+        visible at sampling 0, just not decomposed."""
         async with self._propose_lock:
             if not (self.mon.is_leader() and self.active):
                 return False
-            return await self._begin(self.last_committed + 1, value)
+            tracer = getattr(self.mon, "tracer", None)
+            span = tracer.start_root(
+                "paxos_propose",
+                tags={"version": self.last_committed + 1,
+                      "bytes": len(value),
+                      "quorum": list(self.mon.quorum)}) \
+                if tracer is not None else None
+            try:
+                return await self._begin(self.last_committed + 1,
+                                         value, span)
+            finally:
+                if span is not None:
+                    span.finish()
 
-    async def _begin(self, version: int, value: bytes) -> bool:
+    async def _begin(self, version: int, value: bytes,
+                     span=None) -> bool:
         self._store_uncommitted(version, self.pn, value)
         self._accepted_by = {self.mon.rank}
         self._pending_version = version
         peons = [r for r in self.mon.quorum if r != self.mon.rank]
+        # children only for SAMPLED roots: a local-only (trace_id 0)
+        # root's children would be dropped — or worse, tail-promoted
+        # under a DIFFERENT fresh trace id than the root's, producing
+        # orphan spans that never reassemble (tracing.py's design
+        # note: children of local-only roots are not created)
+        traced = span is not None and span.trace_id
         if peons:
             fut = asyncio.get_event_loop().create_future()
             self._accept_waiter = fut
+            accept_span = span.child(
+                "paxos_accept_wait", tags={"peons": peons}) \
+                if traced else None
             for r in peons:
                 await self.mon.send_mon(r, MMonPaxos(
                     op=PAXOS_BEGIN, pn=self.pn,
@@ -211,17 +245,24 @@ class Paxos:
                             f"timed out; calling election")
                 self._accept_waiter = None
                 self.active = False
+                if accept_span is not None:
+                    accept_span.tag("timed_out", True).finish()
                 self.mon.request_election()
                 return False
             finally:
                 self._accept_waiter = None
+            if accept_span is not None and not accept_span.finished:
+                accept_span.finish()
         # all quorum members accepted: commit
+        commit_span = span.child("paxos_commit") if traced else None
         self._store_committed(version, value)
         for r in peons:
             await self.mon.send_mon(r, MMonPaxos(
                 op=PAXOS_COMMIT, pn=self.pn,
                 last_committed=self.last_committed, version=version,
                 value=value, uncommitted_pn=0, extra={}))
+        if commit_span is not None:
+            commit_span.finish()
         return True
 
     async def handle_begin(self, m: MMonPaxos) -> None:

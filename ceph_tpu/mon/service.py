@@ -267,5 +267,18 @@ class HealthMonitor(PaxosService):
                 "severity": "HEALTH_WARN",
                 "summary": f"{total} slow ops, daemons [{osds}] have "
                            f"slow ops (ref: OpTracker complaint time)"}
+        # gray failure (round 11): slow-but-alive OSDs — detected from
+        # fleet heartbeat-RTT scores, a different animal than SLOW_OPS
+        # (which needs ops to already be stuck behind the slow disk)
+        slow_osds = getattr(mon.osdmon, "slow_osds", {})
+        if slow_osds:
+            rows = ", ".join(
+                f"osd.{t} (score {v.get('score', 0)}, "
+                f"{v.get('latency_ms', 0)} ms)"
+                for t, v in sorted(slow_osds.items()))
+            checks["OSD_SLOW"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(slow_osds)} osd(s) responding "
+                           f"slowly: {rows} — see `ceph osd slow ls`"}
         status = "HEALTH_OK" if not checks else "HEALTH_WARN"
         return {"status": status, "checks": checks}

@@ -43,6 +43,18 @@ class AuthError(Exception):
     pass
 
 
+def cap_allows(spec: str, need: str) -> bool:
+    """Does one cap spec string ("allow r", "rw", "*", "allow *")
+    grant ``need`` ("r" | "w" | "*")? ``*`` in the spec grants
+    everything; ``need="*"`` requires a literal ``*``.
+    (ref: the OSDCap/MonCap grammar, scoped to the r/w/* class —
+    shared by the mon command slice and the OSD's per-op check.)"""
+    tokens = set("".join(t for t in spec.replace("allow", " ").split()))
+    if "*" in tokens:
+        return True
+    return need in tokens and need != "*"
+
+
 class Keyring:
     """entity name -> secret (ref: src/auth/KeyRing.h).
 
@@ -55,6 +67,10 @@ class Keyring:
 
     def __init__(self, keys: dict[str, bytes] | None = None):
         self.keys = dict(keys or {})
+        # entity -> caps dict ({"osd": "allow r", ...}) published by
+        # the AuthMonitor alongside secrets; consumed by the OSD's
+        # per-op admission check. Empty = unrestricted (legacy keys).
+        self.caps: dict[str, dict] = {}
         # observers get key_rotated(name) / key_revoked(name); held as
         # plain refs — messengers deregister on shutdown
         self._observers: list = []
@@ -99,11 +115,23 @@ class Keyring:
             for obs in list(self._observers):
                 obs.key_rotated(name)
 
+    def set_caps(self, name: str, caps: dict) -> None:
+        """Install an entity's published cap table (no session churn:
+        caps gate op admission, not transport)."""
+        if caps:
+            self.caps[name] = dict(caps)
+        else:
+            self.caps.pop(name, None)
+
+    def caps_of(self, name: str) -> dict:
+        return self.caps.get(name, {})
+
     def revoke(self, name: str) -> bool:
         """Remove an entity's secret and FENCE it: observers drop the
         entity's open sessions, and without a key every future
         handshake for it fails. Returns True when a key was actually
         removed (dedupes replayed revocations)."""
+        self.caps.pop(name, None)
         if self.keys.pop(name, None) is None:
             return False
         for obs in list(self._observers):

@@ -363,6 +363,11 @@ class Messenger:
         self._peer_in_seq: dict[str, list[int]] = {}
         self.dispatchers: list[Dispatcher] = []
         self.conns: dict[EntityAddr, Connection] = {}
+        # peer name -> live connections: the 10k-session fix for the
+        # connection-table scans key events used to do (key_rotated/
+        # key_revoked iterated EVERY connection per event — O(sessions)
+        # per auth change). Maintained at attach/accept/close.
+        self._by_peer: dict[str, set[Connection]] = {}
         self._sessions: dict[EntityAddr, _Session] = {}
         self._conn_locks: dict[EntityAddr, asyncio.Lock] = {}
         self._server: asyncio.AbstractServer | None = None
@@ -408,10 +413,19 @@ class Messenger:
 
     # -- key lifecycle (Keyring observer; ref: cephx ticket rotation /
     # session killing on auth removal) ------------------------------------
+    def _index_conn(self, conn: Connection) -> None:
+        self._by_peer.setdefault(conn.peer_name, set()).add(conn)
+
+    def _unindex_conn(self, conn: Connection) -> None:
+        peers = self._by_peer.get(conn.peer_name)
+        if peers is not None:
+            peers.discard(conn)
+            if not peers:
+                self._by_peer.pop(conn.peer_name, None)
+
     def _conns_of(self, name: str) -> list[Connection]:
-        out = [c for c in self.conns.values() if c.peer_name == name]
-        out += [c for c in self._accepted if c.peer_name == name]
-        return out
+        return [c for c in self._by_peer.get(name, ())
+                if not c.closed]
 
     def key_rotated(self, name: str) -> None:
         """The entity's secret changed: bump the frame-key epoch on its
@@ -466,6 +480,7 @@ class Messenger:
             writer.close()
             return
         self._accepted.add(conn)
+        self._index_conn(conn)
         conn._reader_task = asyncio.ensure_future(self._reader_loop(conn))
 
     async def _server_handshake(self, reader, writer) -> Connection:
@@ -555,6 +570,7 @@ class Messenger:
         if not conn.policy.lossy:
             conn.session = self._sessions.setdefault(addr, _Session())
         self.conns[addr] = conn
+        self._index_conn(conn)
         conn._reader_task = asyncio.ensure_future(self._reader_loop(conn))
 
     async def connect(self, addr: EntityAddr,
@@ -625,6 +641,7 @@ class Messenger:
             await self._reader_loop_inner(conn)
         finally:
             self._accepted.discard(conn)
+            self._unindex_conn(conn)
 
     async def _reader_loop_inner(self, conn: Connection) -> None:
         while not conn.closed:

@@ -201,12 +201,15 @@ class BlueStore(ObjectStore):
         ONE kv batch commits every metadata change + deferred record;
         only after the commit are replaced AUs freed and deferred
         bytes applied in place."""
+        import time as _time
         self._validate(t.ops)
         kvt = KVTransaction()
         to_free: list[tuple[int, int]] = []
         deferred: list[tuple[int, bytes]] = []
         dirty: set[tuple[str, str]] = set()
         wrote_block = False
+        self.last_txn_phases = {}            # a raised txn reports none
+        _t0 = _time.monotonic()
         try:
             for op in t.ops:
                 wb = self._apply_op(op, kvt, to_free, deferred, dirty)
@@ -236,7 +239,8 @@ class BlueStore(ObjectStore):
         if wrote_block:
             self._f.flush()
             os.fsync(self._f.fileno())       # data durable BEFORE the
-        try:                                 # metadata points at it
+        _t1 = _time.monotonic()              # metadata points at it
+        try:
             self.db.submit_transaction(kvt)
         except Exception:
             # commit failed: RAM reflects an uncommitted transaction —
@@ -254,6 +258,13 @@ class BlueStore(ObjectStore):
             self._pending_au.clear()
             self._reset_from_kv()
             raise StoreError("fail point: after_kv_commit")
+        _t2 = _time.monotonic()
+        # phase walls for the tracing layer's objectstore sub-span
+        # split (ref: BlueStore's kv_commit vs deferred/aio latency
+        # counters): block COW+fsync, then the kv batch, then deferred
+        # in-place writes (updated again below once they ran)
+        self.last_txn_phases = {"block_write": _t1 - _t0,
+                                "kv_commit": _t2 - _t1}
         try:
             self.alloc.release(to_free)
             if deferred:
@@ -266,6 +277,8 @@ class BlueStore(ObjectStore):
                 self._f.flush()
                 os.fsync(self._f.fileno())
                 self.db.submit_transaction(drop)
+                self.last_txn_phases["deferred_write"] = \
+                    _time.monotonic() - _t2
         except Exception:
             # the kv committed, so the store is durable — but RAM and
             # the overlay must not keep stale state (a leaked pending

@@ -445,12 +445,16 @@ class WALStore(MemStore):
             self.colls.setdefault(cid, {})[oid] = o
 
     def queue_transaction(self, t: Transaction) -> None:
+        import time as _time
         # capture pre-state needed for RMCOLL persistence
         removed_coll_objs: dict[str, list[str]] = {}
         for op in t.ops:
             if op[0] == OP_RMCOLL and op[1] in self.colls:
                 removed_coll_objs[op[1]] = list(self.colls[op[1]])
+        self.last_txn_phases = {}           # a raised txn reports none
+        _t0 = _time.monotonic()
         super().queue_transaction(t)        # apply to memory (may raise)
+        _t1 = _time.monotonic()
         kt = self.db.get_transaction()
         touched: set[tuple[str, str]] = set()
         for op in t.ops:
@@ -476,6 +480,14 @@ class WALStore(MemStore):
         for cid in removed_coll_objs:
             self._verified = {k for k in self._verified if k[0] != cid}
         self.db.submit_transaction(kt)
+        # per-phase wall of the LAST transaction, for the tracing
+        # layer's objectstore sub-span split (ref: BlueStore's
+        # state_kv_queued/state_kv_committing latency counters):
+        # "apply" = in-memory state, "wal_kv_commit" = the WAL-backed
+        # kv batch (the durability point)
+        self.last_txn_phases = {
+            "apply": _t1 - _t0,
+            "wal_kv_commit": _time.monotonic() - _t1}
 
     def read(self, cid, oid, offset=0, length=None):
         data = super().read(cid, oid, offset, length)

@@ -36,7 +36,9 @@ from ceph_tpu.osd.messages import (
     PING_REPLY,
 )
 from ceph_tpu.osd.pg import PG
-from ceph_tpu.osd.recovery import AsyncReserver, RecoveryThrottle
+from ceph_tpu.osd.recovery import AsyncReserver
+from ceph_tpu.osd.scheduler import (OpScheduler, QoSProfile,
+                                    SchedulerThrottle, _Grant)
 from ceph_tpu.osd.types import MAX_OID, pg_t
 from ceph_tpu.utils.logging import get_logger
 from ceph_tpu.utils.op_tracker import OpTracker
@@ -125,28 +127,39 @@ class OSD(Dispatcher):
         self._slow_reported = 0     # last slow-op count sent monward
         self.asok = None
         self._asok_dir = cfg.get("admin_socket_dir")
-        # backfill reservations + recovery QoS (ref: AsyncReserver /
-        # osd_max_backfills; the mClock-analog throttle): local slots
-        # bound how many PGs this OSD backfills AS PRIMARY, remote
-        # slots how many it accepts AS TARGET, and every recovery push
-        # waits on the shared throttle so client ops keep priority
+        # backfill reservations (ref: AsyncReserver /
+        # osd_max_backfills): local slots bound how many PGs this OSD
+        # backfills AS PRIMARY, remote slots how many it accepts AS
+        # TARGET
         max_backfills = cfg.get("osd_max_backfills", 1)
         self.local_reserver = AsyncReserver(max_backfills)
         self.remote_reserver = AsyncReserver(max_backfills)
-        self.recovery_throttle = RecoveryThrottle(
+        # op QoS scheduler (ref: mClockScheduler): the admission path's
+        # dmClock-analog — client ops, recovery grants and scrub
+        # rounds all dequeue through it (osd_op_queue=fifo reverts to
+        # the pre-scheduler FIFO admission loop)
+        self.scheduler = OpScheduler(cfg)
+        # recovery QoS: PR 2's side token bucket folded in as the
+        # scheduler's `recovery` class (SchedulerThrottle keeps the
+        # acquire/release shape every PG call site uses)
+        self.recovery_throttle = SchedulerThrottle(
+            self.scheduler,
             max_active=cfg.get("osd_recovery_max_active", 8),
             bytes_per_s=cfg.get("osd_recovery_max_bytes", 0))
         # client-op admission throttle (ref: OSD client_messenger
         # policy throttles, osd_client_message_cap /
         # osd_client_message_size_cap): ops past the caps queue at
-        # admission instead of dispatching, draining FIFO as in-flight
-        # ops complete
+        # admission instead of dispatching, draining as in-flight
+        # ops complete (dequeue ORDER is the scheduler's)
         self.client_throttle = MessageThrottle(
             max_ops=int(cfg.get("osd_client_message_cap", 256)),
             max_bytes=int(cfg.get("osd_client_message_size_cap",
                                   500 << 20)))
-        self._admit_queue: asyncio.Queue = asyncio.Queue()
         self._admit_task: asyncio.Task | None = None
+        # per-peer heartbeat round-trip EWMA (µs source for the mon's
+        # gray-failure slow-score; ref: the osd_perf commit/apply
+        # latencies the reference reports per OSD)
+        self._peer_rtt: dict[int, float] = {}
         # used-bytes sweep cache: (stamp, used)
         self._used_cache: tuple[float, int] | None = None
         # graceful shutdown in progress: suppresses the
@@ -293,6 +306,7 @@ class OSD(Dispatcher):
                     "pgs": {p: pg.state
                             for p, pg in self.pgs.items()},
                     "client_throttle": self.client_throttle.dump(),
+                    "qos": self.scheduler.dump(),
                     "fullness": {
                         "used_bytes": self.store_used_bytes(),
                         "capacity_bytes": int(self.config.get(
@@ -315,6 +329,15 @@ class OSD(Dispatcher):
             self.asok.register(
                 "dump_slow_ops", self.op_tracker.dump_slow_ops,
                 "in-flight ops older than the complaint threshold")
+            self.asok.register(
+                "dump_qos", lambda: {
+                    "scheduler": self.scheduler.dump(),
+                    "recovery_throttle": self.recovery_throttle.dump(),
+                    "peer_rtt_us": {str(o): int(r * 1e6)
+                                    for o, r in
+                                    sorted(self._peer_rtt.items())}},
+                "op QoS scheduler queues, the folded-in recovery "
+                "throttle, and per-peer heartbeat RTTs")
             self.asok.register(
                 "dump_tracing", self.tracer.dump,
                 "completed trace spans (bounded buffer + slow ring) "
@@ -378,17 +401,40 @@ class OSD(Dispatcher):
                 log.dout(1, f"osd.{self.whoami} mark-me-down failed "
                             f"({e}); stopping anyway")
         self._stopped = True
+        cancelled = []
         for task in (self._hb_task, self._stats_task,
                      self._scrub_task, self._admit_task):
             if task:
                 task.cancel()
+                cancelled.append(task)
         for pg in self.pgs.values():
             if pg._worker:
                 pg._worker.cancel()
+                cancelled.append(pg._worker)
             if pg._peering_task:
                 pg._peering_task.cancel()
             if pg._backfill_task:
                 pg._backfill_task.cancel()
+        # let the cancelled workers unwind so their in-flight ops'
+        # finally blocks release their throttle slots NOW, then drain
+        # every queued-but-never-executed op — a kill mid-admission
+        # must not strand MessageThrottle tokens (the Thrasher-exposed
+        # leak: queued costs were only released on primaryship loss,
+        # never on daemon stop). RE-cancel survivors: pre-3.12
+        # asyncio.wait_for can swallow a cancellation that races the
+        # inner future's completion, leaving a worker looping back to
+        # its queue with the cancel consumed — one more cancel() ends
+        # it (seen under the QoS storm's 64-writer flood).
+        pending = set(cancelled)
+        for _ in range(8):
+            if not pending:
+                break
+            done, pending = await asyncio.wait(pending, timeout=0.5)
+            for task in pending:
+                task.cancel()
+        self.scheduler.drain(release=self._release_admission)
+        for pg in self.pgs.values():
+            pg._drain_op_queue()
         if self.asok:
             await self.asok.stop()
         await self.msgr.shutdown()
@@ -577,6 +623,20 @@ class OSD(Dispatcher):
                     result=-108, epoch=self.osdmap.epoch, data=b"",
                     extra=""))
                 return True
+            if self._op_cap_denied(msg):
+                # per-op cap enforcement (PR 7's auth slice deepened):
+                # the handshake-authenticated entity's `osd` caps are
+                # checked HERE, on the same admission path the
+                # scheduler owns — an `osd r`-only entity's write is
+                # refused -EPERM before it touches any queue. Capless
+                # entities stay unrestricted (legacy boot keys), like
+                # the mon-side slice.
+                from ceph_tpu.osd.messages import MOSDOpReply
+                await msg.conn.send_message(MOSDOpReply(
+                    tid=msg.tid, attempt=getattr(msg, "attempt", 0),
+                    result=-1, epoch=self.osdmap.epoch
+                    if self.osdmap else 0, data=b"", extra=""))
+                return True
             pg = self._pg_for(str(pg_t(msg.pool, msg.seed)))
             if pg is None or not pg.is_primary():
                 # wrong target: client's map is stale; it will resend
@@ -622,19 +682,28 @@ class OSD(Dispatcher):
                 return True
             queue_cap = int(
                 self.config.get("osd_pg_op_queue_cap", 512))
+            entity = msg.src or "?"
             if not pg.role_active() or \
                     pg.op_queue.qsize() >= queue_cap or \
-                    self._admit_queue.qsize() >= queue_cap:
-                # not ready (peering) or saturated — the per-PG queue
-                # OR the OSD-wide admission backlog (the throttle caps
+                    self.scheduler.backlog(
+                        ("client", entity, msg.pool)) >= queue_cap or \
+                    self.scheduler.queued >= int(self.config.get(
+                        "osd_qos_backlog_cap", 4096)):
+                # not ready (peering) or saturated — the per-PG queue,
+                # this TENANT's admission backlog (the throttle caps
                 # dispatched ops below the PG cap, so the backlog is
-                # where a flood actually piles up): backoff instead of
+                # where a flood actually piles up; per-tenant, so a
+                # hot tenant's pile-up backs off the hot tenant, not
+                # everyone), OR the OSD-WIDE backlog bound (per-tenant
+                # caps alone would let 10k distinct tenants hold 10k x
+                # queue_cap payloads in memory): backoff instead of
                 # queueing unboundedly — the client parks and resends
                 # after our UNBLOCK (ref: the PG Backoff machinery)
                 await pg.send_backoff(msg)
                 return True
-            # admission throttle: past the cap, ops queue here (FIFO)
-            # rather than dispatch (ref: osd_client_message_cap)
+            # admission: ops queue at the scheduler (dmClock tags per
+            # client/pool queue; FIFO with osd_op_queue=fifo) rather
+            # than dispatch (ref: mClockScheduler::enqueue)
             op_span = self.tracer.from_msg(
                 "osd_op", msg, tags={"osd": self.whoami,
                                      "oid": msg.oid})
@@ -644,7 +713,9 @@ class OSD(Dispatcher):
                 # closed by the op worker when execution starts
                 msg._span = op_span
                 msg._queue_span = op_span.child("queue")
-            self._admit_queue.put_nowait(msg)
+            self.scheduler.submit(
+                msg, key=("client", entity, msg.pool),
+                profile=self._client_profile(entity, pg.pool))
             return True
         if isinstance(msg, MOSDRepOp):
             pg = self._pg_for(msg.pgid, create=True)
@@ -773,19 +844,106 @@ class OSD(Dispatcher):
             return True
         return False
 
+    def _op_cap_denied(self, msg) -> bool:
+        """Per-op OSD cap check (ref: OSDCap::is_capable, scoped to
+        the r/w class): True when the sender has a configured cap
+        table whose `osd` spec does not grant the op's class. Capless
+        entities are unrestricted — same legacy-boot-key policy as the
+        mon command slice."""
+        kr = self.msgr.keyring
+        if kr is None or not msg.src:
+            return False
+        caps = kr.caps_of(msg.src)
+        if not caps:
+            return False
+        from ceph_tpu.msg.auth import cap_allows
+        need = "w" if any(c in MUTATING_OPS for c in msg.op_codes) \
+            else "r"
+        return not cap_allows(str(caps.get("osd", "")), need)
+
+    def _client_profile(self, entity: str, pool) -> QoSProfile:
+        """QoS profile resolution for one client op: per-entity
+        `osd client-profile` (rides the osdmap) > pool `qos_*` >
+        the osd_qos_default_* knobs."""
+        om = self.osdmap
+        ent = om.client_profiles.get(entity) if om is not None else None
+        if ent:
+            return QoSProfile(reservation=float(ent[0]),
+                              weight=float(ent[1]) or 1.0,
+                              limit=float(ent[2]))
+        if pool is not None and (pool.qos_reservation or
+                                 pool.qos_weight or pool.qos_limit):
+            return QoSProfile(reservation=float(pool.qos_reservation),
+                              weight=float(pool.qos_weight) or 1.0,
+                              limit=float(pool.qos_limit))
+        return self.scheduler.default_profile()
+
     async def _admit_loop(self) -> None:
-        """Admission drain (ref: the messenger dispatch throttle):
-        client ops pass the MessageThrottle in arrival order before
-        reaching their PG's op queue; the throttle slot is released
-        when the PG op worker finishes the op. Backpressure lands
-        HERE, not on the connection reader loop."""
+        """Admission drain: the scheduler decides ORDER (reservation
+        -> weight -> limit across client/recovery/scrub queues; plain
+        FIFO with osd_op_queue=fifo), the MessageThrottle decides
+        VOLUME — a dequeued client op still takes a throttle slot
+        before reaching its PG queue, released when the PG op worker
+        finishes. Backpressure lands HERE, not on the connection
+        reader loop. Recovery/scrub grants resolve inline (their
+        concurrency bound is SchedulerThrottle's semaphore)."""
         try:
             while not self._stopped:
-                msg = await self._admit_queue.get()
+                msg, _op_class = await self.scheduler.dequeue()
+                if isinstance(msg, _Grant):
+                    if not msg.fut.done():
+                        msg.fut.set_result(True)
+                    continue
                 cost = sum(len(d) for d in msg.op_datas)
-                if self.client_throttle.saturated:
+                if self.client_throttle._would_block(cost):
+                    # THIS op's acquire would park (op-count cap or
+                    # byte budget — .saturated alone misses the
+                    # byte-budget case). Park WITHOUT stalling grants:
+                    # a saturated client cap (e.g. ops wedged on a
+                    # degraded replica) must not block the recovery
+                    # pushes that may be needed to unwedge it —
+                    # grants never consume throttle slots, so they
+                    # keep flowing while this op waits its turn
                     OVERLOAD_PERF.inc("throttle_queued")
-                await self.client_throttle.acquire(cost)
+                    acq = asyncio.ensure_future(
+                        self.client_throttle.acquire(cost))
+                    try:
+                        while not acq.done():
+                            g = self.scheduler.pop_grant()
+                            if isinstance(g, _Grant):
+                                if not g.fut.done():
+                                    g.fut.set_result(True)
+                                continue
+                            # sleep until the slot frees OR a new
+                            # submission arrives (a grant may ride
+                            # it) — no timer polling: clearing the
+                            # event first is safe because try_dequeue
+                            # scans the queues directly, never the
+                            # event
+                            self.scheduler._event.clear()
+                            ev = asyncio.ensure_future(
+                                self.scheduler._event.wait())
+                            try:
+                                await asyncio.wait(
+                                    {acq, ev},
+                                    return_when=asyncio
+                                    .FIRST_COMPLETED)
+                            finally:
+                                if not ev.done():
+                                    ev.cancel()
+                        await acq
+                    except asyncio.CancelledError:
+                        acq.cancel()
+                        try:
+                            await acq
+                            # the acquire raced the cancel and WON:
+                            # give the slot back or it leaks
+                            self.client_throttle.release(cost)
+                        except asyncio.CancelledError:
+                            pass
+                        raise
+                else:
+                    await self.client_throttle.acquire(cost)
                 msg._throttle_cost = cost
                 pg = self._pg_for(str(pg_t(msg.pool, msg.seed)))
                 if pg is None or not pg.is_primary():
@@ -805,6 +963,13 @@ class OSD(Dispatcher):
                 await pg.queue_op(msg)
         except asyncio.CancelledError:
             pass
+
+    def _release_admission(self, msg) -> None:
+        """Release a drained op's admission-throttle slot (no-op for
+        ops that never reached the throttle)."""
+        cost = getattr(msg, "_throttle_cost", None)
+        if cost is not None:
+            self.client_throttle.release(cost)
 
     # -- heartbeats --------------------------------------------------------
     async def _hb_loop(self) -> None:
@@ -827,6 +992,7 @@ class OSD(Dispatcher):
                 for o in range(self.osdmap.max_osd):
                     if o == self.whoami or not self.osd_is_up(o):
                         self._hb_last_rx.pop(o, None)
+                        self._peer_rtt.pop(o, None)   # stale evidence
                         continue
                     addr = self.osd_hb_addr(o)
                     if addr is None:
@@ -862,7 +1028,10 @@ class OSD(Dispatcher):
             pass
 
     async def _scrub_loop(self) -> None:
-        """Round-robin background scrub (ref: OSD::sched_scrub)."""
+        """Round-robin background scrub (ref: OSD::sched_scrub).
+        Each PG's round takes a `scrub`-class grant from the op
+        scheduler first (weight-only, `osd_qos_scrub_*`), so scrub is
+        background best-effort against client and recovery work."""
         try:
             while not self._stopped:
                 await asyncio.sleep(self.scrub_interval)
@@ -871,6 +1040,7 @@ class OSD(Dispatcher):
                     # objects would read as inconsistencies
                     if pg.is_primary() and pg.state in ("active",
                                                         "clean"):
+                        await self.scheduler.grant("scrub")
                         await pg.scrubber.scrub()
         except asyncio.CancelledError:
             pass
@@ -883,8 +1053,20 @@ class OSD(Dispatcher):
             reporter=f"osd.{self.whoami}"))
 
     def _hb_rx(self, m: MOSDPing) -> None:
-        self._hb_last_rx[m.from_osd] = \
-            asyncio.get_event_loop().time()
+        now = asyncio.get_event_loop().time()
+        self._hb_last_rx[m.from_osd] = now
+        if m.op == PING_REPLY and m.stamp:
+            # gray-failure signal: the PING_REPLY echoes OUR send
+            # stamp, so now - stamp is a full round trip through the
+            # peer's event loop — a slow-but-alive disk/host inflates
+            # it long before heartbeats time out. EWMA smooths
+            # scheduler jitter; the mon turns the fleet's reports into
+            # a relative slow-score (ref: the osd_perf ping-time data
+            # `dump_osd_network` exposes upstream).
+            rtt = max(now - m.stamp, 0.0)
+            prev = self._peer_rtt.get(m.from_osd)
+            self._peer_rtt[m.from_osd] = rtt if prev is None else \
+                0.7 * prev + 0.3 * rtt
 
     # -- stats -------------------------------------------------------------
     async def _stats_loop(self) -> None:
@@ -910,11 +1092,17 @@ class OSD(Dispatcher):
                 # trace spans ride the stats report (ref: the daemon
                 # perf/health reporting the mgr aggregates upstream)
                 spans = self.tracer.drain_ship()
+                # per-peer heartbeat RTTs (µs) piggyback too: the
+                # mon's slow-score sweep needs a FRESH fleet view
+                # every tick, so holding rtts forces the report
+                peer_lat = {str(o): int(r * 1e6)
+                            for o, r in self._peer_rtt.items()}
                 # keep reporting until a zero count has been sent: a
                 # daemon whose slow ops drained (or whose capacity
                 # went back to unbounded) while it held no primary
                 # PGs must still clear the mon's warning/utilization
                 if not stats and not slow and not cap and not spans \
+                        and not peer_lat \
                         and not self._slow_reported and \
                         not self._statfs_reported:
                     continue
@@ -922,7 +1110,7 @@ class OSD(Dispatcher):
                     osd=self.whoami, epoch=self.osdmap.epoch,
                     stats=stats, slow_ops=slow,
                     used_bytes=used, capacity_bytes=cap,
-                    trace_spans=spans))
+                    trace_spans=spans, peer_latency=peer_lat))
                 self._slow_reported = slow
                 self._statfs_reported = cap
                 # merge readiness barrier: re-reported EVERY tick

@@ -161,6 +161,11 @@ class Incremental:
     # edits under its proposal lock, and an absolute value survives a
     # replayed incremental.
     new_flags: int | None = None
+    # per-entity op QoS profiles (`ceph osd client-profile set/rm`):
+    # entity -> (reservation, weight, limit). Rides the map so every
+    # OSD's scheduler converges on the same committed table.
+    new_client_profiles: dict[str, tuple] = field(default_factory=dict)
+    old_client_profiles: list[str] = field(default_factory=list)
 
 
 class OSDMap:
@@ -195,6 +200,10 @@ class OSDMap:
         # cluster-wide service flags (ref: OSDMap::flags — pauserd,
         # pausewr, full, noout, nodown, noup, noin)
         self.flags = 0
+        # entity -> (reservation, weight, limit): the committed
+        # `osd client-profile` table the OSD schedulers resolve
+        # against (never read by placement)
+        self.client_profiles: dict[str, tuple] = {}
         self._mappers: dict[int | None, Mapper] = {}
         # bumped whenever the crush TREE changes (not reweights):
         # OSDMapMapping keys its topology-fallback detection on it
@@ -383,6 +392,9 @@ class OSDMap:
         self.blocklist.update(inc.new_blocklist)
         for name in inc.old_blocklist:
             self.blocklist.pop(name, None)
+        self.client_profiles.update(inc.new_client_profiles)
+        for name in inc.old_client_profiles:
+            self.client_profiles.pop(name, None)
         for mp in self._mappers.values():
             mp.set_device_weights(self._device_weights())
         self.epoch += 1

@@ -52,6 +52,18 @@ from ceph_tpu.osd.recovery import PERF as RECOVERY_PERF
 from ceph_tpu.osd.types import MAX_OID, MIN_OID, pg_t
 from ceph_tpu.utils.logging import get_logger
 
+
+def _finish_store_span(span, store) -> None:
+    """Close an objectstore_commit span, attaching the store's
+    per-phase sub-spans (the kv/WAL split: WALStore reports
+    apply/wal_kv_commit, BlueStore block_write/kv_commit/
+    deferred_write) recorded during the synchronous commit."""
+    if span is None:
+        return
+    for phase, dt in getattr(store, "last_txn_phases", {}).items():
+        span.annotate(phase, dt)
+    span.finish()
+
 log = get_logger("osd")
 
 PGMETA = "_pgmeta_"
@@ -1815,8 +1827,7 @@ class PG:
             self._repop_waiters.pop(tid, None)
             return -5, False, waiter
         finally:
-            if store_span is not None:
-                store_span.finish()
+            _finish_store_span(store_span, self.osd.store)
         repop_span = op_span.child(
             "repop_wait",
             tags={"replicas": sorted(replicas)}) \
@@ -1888,8 +1899,7 @@ class PG:
                 span.tag("error", str(e)).finish()
             return
         finally:
-            if store_span is not None:
-                store_span.finish()
+            _finish_store_span(store_span, self.osd.store)
         if span is not None:
             span.finish()
         self.pg_log.append(entry)

@@ -124,6 +124,15 @@ class PGPool:
     # ready-to-merge. 0 = no merge pending. Placement NEVER reads this
     # field — clients keep folding by pg_num until the decrease lands.
     pg_num_pending: int = 0
+    # pool-level op QoS (ref: the mClock pool profile options
+    # osd_mclock_scheduler_* per-pool overrides; `ceph osd pool set
+    # qos_reservation|qos_weight|qos_limit`): every client queue in
+    # this pool without a per-entity `osd client-profile` inherits
+    # these dmClock parameters. 0 = unset (fall through to the
+    # osd_qos_default_* knobs). reservation/limit are ops/s.
+    qos_reservation: float = 0.0
+    qos_weight: float = 0.0
+    qos_limit: float = 0.0
 
     def __post_init__(self) -> None:
         if self.pgp_num is None:

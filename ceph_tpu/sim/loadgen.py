@@ -1,0 +1,175 @@
+"""Closed-loop client-session load harness (the 10k-session front end).
+
+ref: the role qa's `rados bench`/cosbench rigs play upstream — drive a
+vstart cluster with MANY concurrent client sessions and measure what
+the front end actually delivers: aggregate ops/s, per-op latency
+percentiles (p50/p99/max), and the error count (which must be ZERO on
+a healthy cluster — the harness is closed-loop, so backpressure shows
+up as latency, never as lost ops).
+
+Session model: every **session** is a closed loop — issue one op,
+await the reply, think, repeat (`ops_per_session` times). Sessions are
+LOGICAL: they multiplex over a bounded pool of real `Rados` handles
+(``clients``), exactly how production client libraries run thousands
+of application streams over a few messenger sessions. That keeps one
+process honest at 10k+ sessions (10k raw TCP pairs would exhaust fd
+limits long before the cluster is the bottleneck) while still pushing
+every shared layer — messenger frames, Objecter tid tables, mon
+subscription fan-out, OSD admission — to session-scale traffic.
+
+Scaling cliffs this harness exposed (fixed in round 11):
+
+- the mon's map-publish loop was one SERIAL await per subscriber per
+  commit (``Monitor._publish_maps``) — now a bounded-concurrency
+  fan-out;
+- messenger key events scanned the whole connection table per auth
+  change (``_conns_of``) — now a per-peer index;
+- OSD admission was a FIFO whose saturation check was global — the
+  scheduler's per-tenant queues made both O(1) per op.
+
+Usage::
+
+    report = await LoadGen(cluster, "pool",
+                           sessions=10000, clients=16,
+                           ops_per_session=5).run()
+    assert report["errors"] == 0
+
+The tier-1 smoke runs <= 200 sessions (tests/test_meta.py budget
+guard); the full 10k run is ``@pytest.mark.slow``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+from ceph_tpu.utils.logging import get_logger
+
+log = get_logger("loadgen")
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class LoadGen:
+    """Closed-loop session fleet against one pool.
+
+    ``sessions`` logical sessions multiplex over ``clients`` real
+    Rados handles (round-robin). Each session performs
+    ``ops_per_session`` ops — a ``read_fraction`` of them reads over a
+    small shared object set, the rest writes of ``write_bytes`` to the
+    session's own object — with ``think_s`` between ops.
+    ``concurrency`` bounds how many sessions are in flight at once
+    (0 = all of them; the closed loop per session still applies)."""
+
+    def __init__(self, cluster, pool: str, sessions: int = 100,
+                 clients: int = 8, ops_per_session: int = 5,
+                 write_bytes: int = 512, read_fraction: float = 0.25,
+                 think_s: float = 0.0, op_timeout: float = 30.0,
+                 concurrency: int = 0, seed: int = 0):
+        self.cluster = cluster
+        self.pool = pool
+        self.sessions = int(sessions)
+        self.clients = max(1, int(clients))
+        self.ops_per_session = int(ops_per_session)
+        self.write_bytes = int(write_bytes)
+        self.read_fraction = float(read_fraction)
+        self.think_s = float(think_s)
+        self.op_timeout = float(op_timeout)
+        self.concurrency = int(concurrency)
+        self.seed = seed
+        self.latencies: list[float] = []
+        self.errors: list[tuple[str, str]] = []
+        self._own: list = []
+
+    async def _open_clients(self) -> list:
+        """A bounded pool of real client handles. The cluster's admin
+        client is reused as handle 0 (it already holds the maps); the
+        rest are fresh Rados sessions under the admin entity. Appends
+        to ``self._own`` as it connects, so a mid-loop failure leaves
+        the already-open handles where run()'s cleanup finds them."""
+        from ceph_tpu.rados import Rados
+        ios = [await self.cluster.client.open_ioctx(self.pool)]
+        for _ in range(self.clients - 1):
+            r = Rados(self.cluster.client.monc.monmap,
+                      keyring=self.cluster.keyring,
+                      config=self.cluster.cfg)
+            await r.connect()
+            self._own.append(r)
+            ios.append(await r.open_ioctx(self.pool))
+        return ios
+
+    async def _session(self, sid: int, io, rng: random.Random,
+                       sem: asyncio.Semaphore | None) -> None:
+        if sem is not None:
+            await sem.acquire()
+        try:
+            oid = f"lg-{self.seed}-{sid}"
+            payload = bytes([sid % 256]) * self.write_bytes
+            wrote = False
+            for i in range(self.ops_per_session):
+                do_read = wrote and rng.random() < self.read_fraction
+                t0 = time.perf_counter()
+                try:
+                    if do_read:
+                        await io.read(oid, timeout=self.op_timeout)
+                    else:
+                        await io.write_full(oid, payload,
+                                            timeout=self.op_timeout)
+                        wrote = True
+                    self.latencies.append(time.perf_counter() - t0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    self.errors.append((f"{oid}#{i}", repr(e)))
+                if self.think_s:
+                    await asyncio.sleep(self.think_s)
+        finally:
+            if sem is not None:
+                sem.release()
+
+    async def run(self) -> dict:
+        """Run the whole fleet; returns the load report."""
+        rng = random.Random(self.seed)
+        sem = asyncio.Semaphore(self.concurrency) \
+            if self.concurrency > 0 else None
+        t0 = time.perf_counter()
+        try:
+            # inside the cleanup scope: a mid-loop connect failure
+            # must still shut down the handles opened before it
+            ios = await self._open_clients()
+            await asyncio.gather(*[
+                self._session(sid, ios[sid % len(ios)],
+                              random.Random(rng.random()), sem)
+                for sid in range(self.sessions)])
+        finally:
+            for r in self._own:
+                await r.shutdown()
+            self._own = []
+        wall = time.perf_counter() - t0
+        lats = sorted(self.latencies)
+        ops = len(lats)
+        report = {
+            "sessions": self.sessions,
+            "clients": len(ios),
+            "ops": ops,
+            "errors": len(self.errors),
+            "error_samples": self.errors[:4],
+            "wall_s": round(wall, 3),
+            "ops_per_s": round(ops / wall, 1) if wall > 0 else 0.0,
+            "p50_ms": round(percentile(lats, 0.50) * 1e3, 2),
+            "p99_ms": round(percentile(lats, 0.99) * 1e3, 2),
+            "max_ms": round(percentile(lats, 1.0) * 1e3, 2),
+        }
+        log.dout(1, f"loadgen: {report['sessions']} sessions, "
+                    f"{report['ops']} ops, {report['errors']} errors, "
+                    f"{report['ops_per_s']} ops/s, "
+                    f"p99 {report['p99_ms']} ms")
+        return report

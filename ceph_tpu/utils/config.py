@@ -127,6 +127,62 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("osd_pg_op_queue_cap", int, 512,
            "per-PG op-queue depth past which the primary sends "
            "MOSDBackoff instead of queueing", min=1),
+    # op QoS scheduler (round 11; ref: osd.yaml.in osd_op_queue +
+    # osd_mclock_scheduler_client/background_* options): the
+    # dmClock-analog admission scheduler and its per-class defaults.
+    # Read LIVE by every OpScheduler, so a runtime flip applies to the
+    # next dequeue decision.
+    Option("osd_op_queue", str, "mclock",
+           "op admission queue: mclock (dmClock-analog QoS tags) | "
+           "fifo (the pre-scheduler baseline)",
+           enum_allowed=("mclock", "fifo")),
+    Option("osd_qos_default_reservation", float, 0.0,
+           "default per-client reservation IOPS (0 = none) for "
+           "queues without a client-profile or pool qos_* override",
+           min=0.0),
+    Option("osd_qos_default_weight", float, 1.0,
+           "default per-client proportional weight", min=0.0),
+    Option("osd_qos_default_limit", float, 0.0,
+           "default per-client limit IOPS (0 = unlimited)", min=0.0),
+    Option("osd_qos_recovery_reservation", float, 10.0,
+           "recovery-class reservation IOPS — the floor that keeps "
+           "recovery from starving under client load (PR 2's "
+           "RecoveryThrottle folded into the scheduler)", min=0.0),
+    Option("osd_qos_recovery_weight", float, 1.0,
+           "recovery-class proportional weight", min=0.0),
+    Option("osd_qos_recovery_limit", float, 0.0,
+           "recovery-class limit IOPS (0 = unlimited)", min=0.0),
+    Option("osd_qos_scrub_weight", float, 0.5,
+           "scrub-class proportional weight (background best-effort)",
+           min=0.0),
+    Option("osd_qos_scrub_limit", float, 10.0,
+           "scrub-class limit in scrub rounds/s (0 = unlimited)",
+           min=0.0),
+    Option("osd_qos_backlog_cap", int, 4096,
+           "OSD-wide admission backlog bound across ALL tenants "
+           "(per-tenant queues are capped by osd_pg_op_queue_cap; "
+           "this bounds their sum so a many-tenant flood backs off "
+           "instead of exhausting memory)", min=1),
+    # gray-failure (slow-OSD) detection (round 11; ref: the
+    # osd_network ping-time warnings mon_warn_on_slow_ping_time
+    # gates): the mon's slow-score sweep over heartbeat-RTT reports.
+    Option("mon_osd_slow_ratio", float, 3.0,
+           "an OSD whose median reported heartbeat RTT exceeds the "
+           "fleet median by this factor is slow-suspect", min=1.0),
+    Option("mon_osd_slow_min_ms", float, 50.0,
+           "absolute latency floor (ms) below which no OSD is ever "
+           "marked slow — fast-cluster jitter must not trip OSD_SLOW",
+           min=0.0),
+    Option("mon_osd_slow_confirm", int, 2,
+           "consecutive slow-score sweeps above threshold before "
+           "OSD_SLOW trips (debounce)", min=1),
+    Option("mon_osd_slow_primary_dampening", bool, False,
+           "when an OSD trips OSD_SLOW, auto-dampen its primary "
+           "affinity (the primary-avoidance hint); restored on heal. "
+           "OFF by default"),
+    Option("mon_osd_slow_primary_affinity", float, 0.0,
+           "the affinity fraction a dampened slow OSD gets (0 = "
+           "never primary while slow)", min=0.0, max=1.0),
     # MDS failover / metadata HA (ref: mds.yaml.in mds_beacon_interval,
     # mds_beacon_grace, mds_reconnect_timeout, mds_standby_replay,
     # mon_mds options in global.yaml.in): the MDSMonitor's beacon-grace

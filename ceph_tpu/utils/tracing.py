@@ -75,6 +75,20 @@ class Span:
         return Span(self.tracer, name, self.trace_id,
                     parent_span_id=self.span_id, tags=tags)
 
+    def annotate(self, name: str, duration: float,
+                 tags: dict | None = None) -> None:
+        """Record an already-measured sub-phase as a FINISHED child
+        span (the kv/WAL split: synchronous store code times its own
+        phases and the caller attaches them post-hoc — a live child
+        span would double-count the enclosing wall)."""
+        s = self.child(name, tags=tags)
+        s.finished = True
+        s.duration = max(float(duration), 0.0)
+        # start back-dated so the child nests inside this span's wall
+        s.start = self.start
+        if s.tracer is not None:
+            s.tracer.record(s)
+
     def finish(self) -> None:
         if self.finished:
             return

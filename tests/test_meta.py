@@ -39,6 +39,10 @@ STORM_BUDGETS = {
     "overload_storm": {"writers": 4, "prefill": 32, "hold_s": 1.0},
     "mds_storm": {"writes": 24, "kills": 1},
     "elastic_storm": {"writes": 40},
+    "qos_storm": {"writes": 30, "hot_parallel": 4},
+    # the 10k-session harness: tier-1 smokes stay <= 200 sessions
+    # (LoadGen is a constructor call, matched by Name too)
+    "LoadGen": {"sessions": 200},
 }
 BUILTIN_MARKS = {
     "parametrize", "skip", "skipif", "xfail", "usefixtures",
@@ -64,14 +68,22 @@ def _storm_calls(fn) -> list[tuple[str, dict]]:
     (nested async helpers included — ast.walk descends)."""
     calls = []
     for n in ast.walk(fn):
-        if isinstance(n, ast.Call) and \
-                isinstance(n.func, ast.Attribute) and \
+        if not isinstance(n, ast.Call):
+            continue
+        name = None
+        if isinstance(n.func, ast.Attribute) and \
                 n.func.attr in STORM_BUDGETS:
-            kwargs = {}
-            for kw in n.keywords:
-                kwargs[kw.arg] = kw.value.value \
-                    if isinstance(kw.value, ast.Constant) else None
-            calls.append((n.func.attr, kwargs))
+            name = n.func.attr
+        elif isinstance(n.func, ast.Name) and \
+                n.func.id in STORM_BUDGETS:
+            name = n.func.id          # constructor-style entry points
+        if name is None:
+            continue
+        kwargs = {}
+        for kw in n.keywords:
+            kwargs[kw.arg] = kw.value.value \
+                if isinstance(kw.value, ast.Constant) else None
+        calls.append((name, kwargs))
     return calls
 
 
@@ -254,7 +266,8 @@ _CANNED_STATUS = {
                "pool_quotas": [{"pool": 1, "name": "p",
                                 "quota_bytes": 4, "quota_objects": 2,
                                 "full": 0}],
-               "pending_merges": {"p": {"ready": 1}}},
+               "pending_merges": {"p": {"ready": 1}},
+               "slow_osds": {"2": 4.5}},
     "pgmap": {"num_pgs": 8, "degraded_pgs": 0, "backfilling_pgs": 0,
               "backfill_progress": {"pushed": 0}, "num_objects": 4,
               "num_bytes": 64, "states": {"active+clean": 8}},
@@ -352,6 +365,37 @@ def test_prometheus_histogram_buckets_monotone():
         assert rows[-1][0] == float("inf"), f"{key}: missing +Inf"
         assert counts.get(key + "}") == rows[-1][1], \
             f"{key}: +Inf bucket != _count"
+
+
+def test_qos_knobs_registered_with_defaults():
+    """Every scheduler/QoS/slow-osd knob read anywhere under ceph_tpu/
+    (a string literal starting with one of the round-11 prefixes
+    passed to a ``.get(...)``) must be a declared Option in
+    utils/config.py — an unregistered knob silently falls back to its
+    call-site default and drifts from `config show`."""
+    from ceph_tpu.utils.config import OPTIONS
+    prefixes = ("osd_qos_", "mon_osd_slow_", "osd_op_queue")
+    used: dict[str, str] = {}
+    for path in sorted((REPO / "ceph_tpu").rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "get" and n.args and \
+                    isinstance(n.args[0], ast.Constant) and \
+                    isinstance(n.args[0].value, str) and \
+                    n.args[0].value.startswith(prefixes):
+                used.setdefault(
+                    n.args[0].value,
+                    f"{path.relative_to(REPO)}:{n.lineno}")
+    assert used, "no QoS knob reads found (guard went stale)"
+    missing = {k: at for k, at in used.items() if k not in OPTIONS}
+    assert not missing, (
+        f"QoS knobs read but not registered in utils/config.py: "
+        f"{missing}")
+    for k in used:
+        assert OPTIONS[k].default is not None, \
+            f"option {k} has no default"
 
 
 def test_every_asok_command_has_docstring():
