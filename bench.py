@@ -322,6 +322,79 @@ def tracing_metric() -> dict:
     }
 
 
+def telemetry_metric() -> dict:
+    """Round-12 telemetry plane: cluster write-path ops/s with the
+    daemon->mgr report loop OFF (mgr_stats_period=0), at the default
+    period, and at 10x the period. The number that must hold: the
+    default report loop stays within noise (<5%) of the off baseline
+    (``telemetry_within_noise`` in the compact tail line) — same
+    verdict shape as the round-9 tracing section. Unlike that
+    section, all three legs run inside ONE cluster by flipping the
+    LIVE ``mgr_stats_period`` knob (the shared-cfg dict pattern):
+    separate cluster spins in one process jitter >10% run-to-run,
+    which would swamp the report loop's actual cost — in-cluster
+    A/B/A alternation with a median collapses that to per-burst
+    noise."""
+    import asyncio
+    import statistics
+
+    async def measure() -> dict[float, float]:
+        from ceph_tpu.cluster.vstart import Cluster
+        from ceph_tpu.mgr.modules import PrometheusModule
+        c = await Cluster(n_mons=1, n_osds=3,
+                          config={"mgr_stats_period": 0.0},
+                          mgr_modules=[PrometheusModule]).start()
+        try:
+            await c.client.pool_create("bench", pg_num=8)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("bench")
+            for i in range(24):                      # warm the path
+                await io.write_full(f"warm-{i}", b"x" * 1024)
+            samples: dict[float, list[float]] = {
+                0.0: [], 0.25: [], 2.5: []}
+            order = list(samples)
+            for rep in range(5):
+                # rotate the leg order per rep: within-cluster drift
+                # (PG logs filling toward their trim cap, allocator
+                # state) is monotone in time, and a constant order
+                # would charge it to whichever leg always runs last
+                rot = rep % len(order)
+                for period in order[rot:] + order[:rot]:
+                    c.cfg["mgr_stats_period"] = period
+                    await asyncio.sleep(0.6)  # loops read it LIVE
+                    t0 = time.perf_counter()
+                    for i in range(160):
+                        await io.write_full(f"obj-{i % 16}",
+                                            b"x" * 1024)
+                    samples[period].append(
+                        160 / (time.perf_counter() - t0))
+            return samples
+        finally:
+            await c.stop()
+
+    samples = asyncio.run(measure())
+    legs = {p: statistics.median(v) for p, v in samples.items()}
+    off = legs[0.0]                      # report loop disabled
+    default = legs[0.25]                 # the vstart default period
+    slow10 = legs[2.5]                   # 10x period
+    overhead = (off - default) / off * 100.0
+    # the off leg's own within-run spread IS the measurement's noise
+    # floor (shared boxes schedule-jitter way past 5%): the verdict
+    # asks whether the default report loop's cost is distinguishable
+    # from that floor, and both raw numbers stay in the record
+    spread = (max(samples[0.0]) - min(samples[0.0])) / off * 100.0
+    return {
+        "write_ops_per_s_reporting_off": round(off, 1),
+        "write_ops_per_s_default_period": round(default, 1),
+        "write_ops_per_s_10x_period": round(slow10, 1),
+        "report_overhead_pct": round(overhead, 2),
+        "noise_floor_pct": round(spread, 2),
+        # the flag — not a hard error — records the verdict
+        "telemetry_within_noise": bool(
+            overhead < max(5.0, spread)),
+    }
+
+
 def qos_metric() -> dict:
     """Round-11 op-QoS layer: a 2-tenant hot/cold mix — ops/s + p99
     for the COLD tenant at its solo baseline, under FIFO admission,
@@ -467,6 +540,10 @@ def main() -> None:
         detail["qos"] = qos_metric()
     except Exception:
         detail["qos_error"] = _short_err()
+    try:
+        detail["telemetry"] = telemetry_metric()
+    except Exception:
+        detail["telemetry_error"] = _short_err()
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),
@@ -511,6 +588,10 @@ def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
         out["qos_protected"] = qos.get("scheduler_protects_cold")
         out["qos_p99_ratio_fifo_vs_mclock"] = [
             qos.get("fifo_p99_ratio"), qos.get("mclock_p99_ratio")]
+    tel = detail.get("telemetry")
+    if isinstance(tel, dict):    # the round-12 report-loop verdict
+        out["telemetry_within_noise"] = tel.get(
+            "telemetry_within_noise")
     # belt-and-braces: the driver's tail capture is ~2000 chars; stay
     # far inside it even if an error string sneaks in
     while len(json.dumps(out)) > 500 and len(out) > 3:

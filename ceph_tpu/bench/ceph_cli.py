@@ -17,6 +17,9 @@ through MonClient, mirroring the reference's command spellings:
     ... osd erasure-code-profile set <name> k=2 m=1 ...
     ... config set <who> <name> <value> | config get <who> [<name>]
     ... quorum_status | mon dump | health
+    ... osd perf                     # per-OSD commit/apply latency
+    ... progress ls | progress json  # long-running-op events
+    ... mgr dump | mgr stat | mgr fail
 
 Admin-socket commands (`ceph daemon <asok-path> <command>`, ref:
 src/ceph.in daemon mode) talk to one daemon out-of-band:
@@ -24,6 +27,8 @@ src/ceph.in daemon mode) talk to one daemon out-of-band:
     ... daemon /tmp/osd.0.asok ops              # in-flight client ops
     ... daemon /tmp/osd.0.asok dump_historic_ops
     ... daemon /tmp/osd.0.asok dump_slow_ops    # past complaint time
+    ... daemon /tmp/mgr.x.asok daemon-stats osd.0   # live rates from
+        the mgr's reported-counter time series
     ... daemon /tmp/cluster.asok fault ls       # runtime fault sets
     ... daemon /tmp/cluster.asok '{"prefix": "fault install",
         "name": "p", "rules": [{"kind": "partition",
@@ -57,7 +62,8 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
              "osd dump", "osd tree", "osd df", "osd pool ls",
              "pg dump", "osd getmap", "osd getcrushmap",
              "config dump", "osd new", "fs status", "fs dump",
-             "auth ls"):
+             "auth ls", "osd perf", "progress ls", "progress json",
+             "mgr dump", "mgr stat", "mgr fail"):
         return {"prefix": "status" if j == "-s" else j}, b""
     if w[:2] == ["mon", "add"]:
         # ceph mon add <name> <host> <port> — runtime monmap growth
@@ -190,6 +196,18 @@ async def _run_daemon(words: list[str]) -> int:
               file=sys.stderr)
         return 1
     path, rest = words[0], " ".join(words[1:])
+    if words[1] == "daemon-stats" and len(words) >= 3:
+        # `ceph daemon <mgr.asok> daemon-stats osd.0` — the mgr-side
+        # live-rates view over one daemon's reported time series
+        cmd: dict = {"prefix": "daemon-stats", "name": words[2]}
+        try:
+            return print(json.dumps(
+                await daemon_command(path, cmd), indent=2,
+                default=str)) or 0
+        except (ConnectionError, OSError) as e:
+            print(f"Error: cannot reach admin socket {path}: {e}",
+                  file=sys.stderr)
+            return 1
     try:
         cmd = json.loads(rest)
         if not isinstance(cmd, dict):

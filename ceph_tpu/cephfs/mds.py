@@ -345,6 +345,8 @@ class MDSDaemon(Dispatcher):
         self.replay_interval = cfg.get("mds_replay_interval", 0.25)
         self._beacon_seq = 0
         self._beacon_task: asyncio.Task | None = None
+        self._mgr_reporter = None
+        self._mgr_report_task: asyncio.Task | None = None
         self._tail_task: asyncio.Task | None = None
         self._takeover_task: asyncio.Task | None = None
         self._active_event = asyncio.Event()
@@ -417,6 +419,18 @@ class MDSDaemon(Dispatcher):
         # MMDSMap publishes arrive on the MonClient's messenger
         self.monc.msgr.add_dispatcher(self)
         await self.monc.subscribe("mdsmap", 0)
+        # mgr report session (round 12, ref: MgrClient): mgrmap finds
+        # the active mgr; the shared "mds" logger ships under THIS
+        # daemon's name (the in-process daemons share one logger —
+        # documented delta; a real multi-process MDS would own it)
+        await self.monc.subscribe("mgrmap", 0)
+        from ceph_tpu.mgr.client import MgrReporter
+        self._mgr_reporter = MgrReporter(
+            f"mds.{self.name}", self.monc.msgr,
+            lambda: self.monc.mgrmap, lambda: [MDS_PERF],
+            self.config)
+        self._mgr_report_task = asyncio.ensure_future(
+            self._mgr_reporter.loop())
         self._beacon_task = asyncio.ensure_future(self._beacon_loop())
         log.dout(1, f"mds.{self.name} (gid {self.gid}) standby at "
                     f"{self.addr}")
@@ -431,7 +445,8 @@ class MDSDaemon(Dispatcher):
         # slipped in before the flag was observed.
         self._stopping = True
         for t in (self._beacon_task, self._tail_task,
-                  self._takeover_task, *self._export_tasks):
+                  self._takeover_task, self._mgr_report_task,
+                  *self._export_tasks):
             if t is not None:
                 t.cancel()
         while self._req_tasks:
@@ -452,7 +467,8 @@ class MDSDaemon(Dispatcher):
         self._killed = True
         self._stopping = True
         for t in (self._beacon_task, self._tail_task,
-                  self._takeover_task, *self._export_tasks):
+                  self._takeover_task, self._mgr_report_task,
+                  *self._export_tasks):
             if t is not None:
                 t.cancel()
         for t in list(self._req_tasks):

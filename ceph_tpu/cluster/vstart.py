@@ -24,6 +24,9 @@ DEFAULT_CFG = {
     "osd_stats_interval": 0.3,
     "mds_beacon_interval": 0.25, "mds_beacon_grace": 2.5,
     "mds_reconnect_timeout": 1.5, "mds_replay_interval": 0.25,
+    "mgr_beacon_interval": 0.25, "mgr_beacon_grace": 2.0,
+    "mgr_stats_period": 0.25, "mgr_stats_stale_s": 5.0,
+    "mgr_stats_schema_refresh": 10, "mgr_progress_interval": 0.25,
 }
 
 
@@ -34,10 +37,12 @@ class Cluster:
                  config: dict | None = None, auth: bool = True,
                  data_dir: str | None = None,
                  mgr_modules: list | None = None,
-                 stores: list | None = None):
+                 stores: list | None = None,
+                 n_mgrs: int = 1):
         self.cfg = dict(DEFAULT_CFG, **(config or {}))
         self.n_mons = n_mons
         self.n_osds = n_osds
+        self.n_mgrs = n_mgrs           # honored when mgr_modules set
         self.auth = auth
         self.data_dir = data_dir       # None = MemStore osds
         self.stores = stores           # explicit per-osd ObjectStores
@@ -47,7 +52,8 @@ class Cluster:
         self.osds: list[OSD] = []
         self.mdss: list = []                 # MDSDaemons (start_fs)
         self.fs_pool: str | None = None
-        self.mgr = None
+        self.mgr = None                # first-started mgr (compat)
+        self.mgrs: list = []
         self.mgr_modules = mgr_modules       # None = no mgr
         self.client: Rados | None = None
         # cluster-wide fault table (sim/faults.FaultInjector): set via
@@ -57,13 +63,15 @@ class Cluster:
 
     async def start(self) -> "Cluster":
         names = "abcdefgh"[:self.n_mons]
+        mgr_names = "xyzwvuts"[:max(self.n_mgrs, 1)]
         if self.keyring:
             for n in names:
                 self.keyring.add(f"mon.{n}")
             for i in range(self.n_osds):
                 self.keyring.add(f"osd.{i}")
             self.keyring.add("client.admin")
-            self.keyring.add("mgr.x")
+            for n in mgr_names:
+                self.keyring.add(f"mgr.{n}")
         for rank, name in enumerate(names):
             self.monmap.add(name, rank, "127.0.0.1", 0)
         for rank, name in enumerate(names):
@@ -74,6 +82,7 @@ class Cluster:
             self.mons.append(mon)
         for mon in self.mons:
             mon._tick_task = asyncio.ensure_future(mon._tick_loop())
+            mon.start_mgr_reporting()
         for mon in self.mons:
             await mon.elector.start()
         self.client = Rados(self.monmap, keyring=self.keyring,
@@ -103,9 +112,15 @@ class Cluster:
         await asyncio.gather(*[o.boot() for o in self.osds])
         if self.mgr_modules is not None:
             from ceph_tpu.mgr import Mgr
-            self.mgr = Mgr("x", self.monmap, keyring=self.keyring,
-                           modules=self.mgr_modules, config=self.cfg)
-            await self.mgr.start(active=True)
+            for i, mname in enumerate(mgr_names):
+                mgr = Mgr(mname, self.monmap, keyring=self.keyring,
+                          modules=self.mgr_modules, config=self.cfg)
+                # first mgr promotes immediately and claims the
+                # MgrMap's active slot via its beacon; the rest are
+                # standbys that promote only when the map names them
+                await mgr.start(active=(i == 0))
+                self.mgrs.append(mgr)
+            self.mgr = self.mgrs[0]
         await self.client.connect()
         return self
 
@@ -124,8 +139,8 @@ class Cluster:
             mds.msgr.faults = injector
             if mds.monc is not None:
                 mds.monc.msgr.faults = injector
-        if self.mgr is not None:
-            self.mgr.monc.msgr.faults = injector
+        for mgr in self.mgrs:
+            mgr.monc.msgr.faults = injector
         if self.client is not None:
             self.client.monc.msgr.faults = injector
 
@@ -298,6 +313,7 @@ class Cluster:
         self.monmap.add(name, new_rank, addr.host, addr.port)
         self.mons.append(mon)
         mon._tick_task = asyncio.ensure_future(mon._tick_loop())
+        mon.start_mgr_reporting()
         await mon.elector.start()
         await self.wait_for_quorum(len(self.monmap.mons),
                                    timeout=timeout)
@@ -352,6 +368,43 @@ class Cluster:
             return None
         await lead.stop()
         return lead
+
+    # -- mgr failover (ref: the qa mgr thrasher half) ----------------------
+    def active_mgr(self):
+        """The Mgr instance the lead mon's committed MgrMap names
+        active (None when no mgr is active or no leader)."""
+        lead = self.leader()
+        if lead is None:
+            return None
+        gid = lead.mgrmon.mgrmap.active_gid
+        return next((m for m in self.mgrs
+                     if m.gid == gid and not m._stopped), None)
+
+    async def kill_mgr(self, mgr=None):
+        """Hard-stop a mgr (default: the active one); the mon's
+        beacon-grace tick fails it and promotes a standby. Returns the
+        killed Mgr."""
+        mgr = mgr or self.active_mgr() or self.mgr
+        await mgr.stop()
+        return mgr
+
+    async def wait_for_mgr_active(self, not_gid: int | None = None,
+                                  timeout: float = 30.0):
+        """Until the committed MgrMap names an active mgr whose gid
+        differs from ``not_gid`` AND that daemon promoted itself;
+        returns the Mgr."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            mgr = self.active_mgr()
+            if mgr is not None and mgr.gid != (not_gid or -1) and \
+                    mgr.active:
+                return mgr
+            if asyncio.get_event_loop().time() > deadline:
+                lead = self.leader()
+                raise TimeoutError(
+                    f"no active mgr (map: "
+                    f"{lead.mgrmon.mgrmap.summary() if lead else None})")
+            await asyncio.sleep(0.05)
 
     # -- helpers (ref: qa/standalone/ceph-helpers.sh) ----------------------
     def leader(self) -> Monitor | None:
@@ -480,8 +533,9 @@ class Cluster:
             await self.asok.stop()
         if self.client:
             await self.client.shutdown()
-        if self.mgr:
-            await self.mgr.stop()
+        for mgr in self.mgrs:
+            if not mgr._stopped:
+                await mgr.stop()
         for m in self.mdss:
             if not m._stopping:
                 await m.stop()

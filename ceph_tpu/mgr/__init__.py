@@ -1,7 +1,8 @@
 from ceph_tpu.mgr.daemon import Mgr, MgrModule
 from ceph_tpu.mgr.modules import (
-    BalancerModule, PGAutoscalerModule, PrometheusModule, RestModule,
+    BalancerModule, PGAutoscalerModule, ProgressModule,
+    PrometheusModule, RestModule,
 )
 
 __all__ = ["Mgr", "MgrModule", "BalancerModule", "PGAutoscalerModule",
-           "PrometheusModule", "RestModule"]
+           "ProgressModule", "PrometheusModule", "RestModule"]

@@ -7,18 +7,39 @@ core surface: ``get("osd_map")``-style state access, ``mon_command``,
 and a periodic ``serve`` tick (ref: MgrModule.get / check_mon_command /
 serve). Standby mgrs hold their modules idle until promoted
 (ref: MgrStandby).
+
+Round 12 — the telemetry hub role (ref: src/mgr/DaemonServer.cc +
+MgrStandby): the mgr binds a server socket, BEACONS to the mon
+(MMgrBeacon -> the MgrMonitor's committed MgrMap, which daemons follow
+via the ``mgrmap`` subscription), and receives every daemon's
+MMgrOpen/MMgrReport session into a :class:`DaemonStateIndex` — so
+`/metrics`, `ceph osd perf` and `ceph daemon-stats` are built from
+REPORTED state, not the process-local singleton, and keep working when
+daemons live in other processes. Active/standby follows the MgrMap:
+the mon's beacon-grace tick fails a silent active and promotes a
+standby, whose fresh (empty) index repopulates as daemons re-open
+their sessions against it.
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 
 from ceph_tpu.encoding import decode_osdmap
+from ceph_tpu.mgr.daemon_state import DaemonStateIndex
+from ceph_tpu.mgr.messages import MMgrOpen, MMgrReport
 from ceph_tpu.mon.client import MonClient
+from ceph_tpu.mon.messages import MMgrBeacon
+from ceph_tpu.msg import Dispatcher
 from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("mgr")
+
+# per-incarnation gid source (the MDS discipline): a restarted mgr is
+# a NEW entity the MgrMap can never confuse with its predecessor
+_GID = itertools.count(1)
 
 
 class MgrModule:
@@ -42,24 +63,33 @@ class MgrModule:
         return await self.mgr.monc.command(cmd, inbl)
 
 
-class Mgr:
+class Mgr(Dispatcher):
     def __init__(self, name: str, monmap, keyring=None,
                  modules: list[type[MgrModule]] | None = None,
                  config: dict | None = None):
         self.name = name
+        self.gid = next(_GID)
         self.monc = MonClient(f"mgr.{name}", monmap, keyring=keyring)
         self.config = config or {}
         from ceph_tpu.mgr.modules import (
             BalancerModule, PGAutoscalerModule, PrometheusModule,
-            TracingModule,
+            ProgressModule, TracingModule,
         )
         self.modules = [cls(self) for cls in (
             modules if modules is not None else
             [BalancerModule, PGAutoscalerModule, PrometheusModule,
-             TracingModule])]
+             TracingModule, ProgressModule])]
         self.active = False
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
+        self.addr = None
+        self._beacon_task: asyncio.Task | None = None
+        self._beacon_seq = 0
+        # daemon report sessions land here (the DaemonServer role):
+        # rebuilt ENTIRELY from fresh sessions after failover
+        self.daemon_state = DaemonStateIndex(
+            retention=int(self.config.get("mgr_stats_retention", 120)))
+        self.asok = None
         # full-cluster mapping table maintained ACROSS osd_map fetches
         # (digest-based crush detection handles the fresh decode per
         # fetch): the balancer's whole-pool reads and calc_pg_upmaps
@@ -91,12 +121,133 @@ class Mgr:
             return json.loads(out) if ret == 0 else {}
         raise KeyError(what)
 
+    # -- daemon report sessions (the DaemonServer role) ----------------
+    async def ms_dispatch(self, msg) -> bool:
+        if isinstance(msg, MMgrOpen):
+            self.daemon_state.open(msg.daemon, msg.session_seq)
+            log.dout(5, f"mgr.{self.name} session open from "
+                        f"{msg.daemon} (seq {msg.session_seq})")
+            return True
+        if isinstance(msg, MMgrReport):
+            try:
+                schema = json.loads(msg.schema) if msg.schema else None
+                values = json.loads(msg.values) if msg.values else {}
+            except (json.JSONDecodeError, TypeError, ValueError):
+                return True          # a bad report must not kill the mgr
+            if not isinstance(values, dict):
+                return True
+            ts = values.get("t", 0.0)
+            counters = values.get("counters", {})
+            if not isinstance(ts, (int, float)) or \
+                    not isinstance(counters, dict):
+                return True      # a bad report must not kill the mgr
+            self.daemon_state.report(
+                msg.daemon, msg.session_seq,
+                schema if isinstance(schema, list) else None,
+                float(ts), counters)
+            return True
+        return False
+
+    def osd_perf_digest(self) -> dict:
+        """Per-OSD commit/apply latency (ms) from the reported
+        objectstore time-avg counters — the table behind `ceph osd
+        perf` and the ceph_osd_*_latency_ms prometheus rows."""
+        out: dict[str, dict] = {}
+        for name, st in self.daemon_state.daemons.items():
+            if not name.startswith("osd."):
+                continue
+            commit = st.avg_value(name, "commit_latency")
+            apply_ = st.avg_value(name, "apply_latency")
+            if commit is None and apply_ is None:
+                continue
+            out[name.split(".", 1)[1]] = {
+                "commit_latency_ms": round((commit or 0.0) * 1e3, 3),
+                "apply_latency_ms": round((apply_ or 0.0) * 1e3, 3)}
+        return out
+
     # -- lifecycle ----------------------------------------------------
     async def start(self, active: bool = True) -> None:
+        """Bind, subscribe, beacon. ``active=True`` promotes
+        immediately (the first beacon claims the MgrMap's active slot
+        on a fresh cluster); ``active=False`` is a STANDBY — it
+        beacons and promotes only when the committed map names its
+        gid (ref: MgrStandby::handle_mgr_map)."""
+        self.addr = await self.monc.msgr.bind()
+        self.monc.msgr.add_dispatcher(self)
         await self.monc.subscribe("osdmap", 0)
         await self.monc.subscribe("monmap", 0)
+        await self.monc.subscribe("mgrmap", 0)
+        await self._start_asok()
+        self._beacon_task = asyncio.ensure_future(self._beacon_loop())
         if active:
             await self.promote()
+
+    async def _start_asok(self) -> None:
+        asok_dir = self.config.get("admin_socket_dir")
+        if not asok_dir or self.asok is not None:
+            return
+        from ceph_tpu.utils.admin_socket import AdminSocket
+        self.asok = AdminSocket(f"{asok_dir}/mgr.{self.name}.asok")
+        self.asok.register(
+            "status", lambda: {
+                "name": self.name, "gid": self.gid,
+                "active": self.active,
+                "modules": [m.NAME for m in self.modules],
+                "reported_daemons": sorted(
+                    self.daemon_state.daemons)},
+            "mgr state summary incl. reporting daemons")
+        self.asok.register(
+            "daemon ls", lambda: {
+                "daemons": {n: {"reports": st.reports,
+                                "counters": len(st.latest)}
+                            for n, st in sorted(
+                                self.daemon_state.daemons.items())}},
+            "daemons with open report sessions")
+        self.asok.register(
+            "daemon-stats",
+            lambda cmd: self.daemon_state.daemon_stats(
+                str(cmd.get("name", ""))) or
+            {"error": f"no reported daemon {cmd.get('name')!r}"},
+            "one daemon's reported counters + live rates from the "
+            "retained time series")
+        await self.asok.start()
+
+    async def _beacon_loop(self) -> None:
+        """Beacon + follow the committed MgrMap (ref: MgrStandby):
+        promotion/demotion is MAP-driven after the first epoch — a
+        standby named active promotes; an active the map no longer
+        names demotes (the mon failed it spuriously and its successor
+        already holds the slot)."""
+        try:
+            while not self._stopped:
+                self._beacon_seq += 1
+                try:
+                    await self.monc.send_report(MMgrBeacon(
+                        gid=self.gid, name=self.name,
+                        addr_host=self.addr.host,
+                        addr_port=self.addr.port,
+                        available=1, beacon_seq=self._beacon_seq,
+                        epoch=self.monc.mgrmap.epoch
+                        if self.monc.mgrmap else 0))
+                except Exception as e:
+                    log.dout(5, f"mgr.{self.name} beacon failed: {e}")
+                mm = self.monc.mgrmap
+                if mm is not None and mm.active_gid:
+                    if mm.active_gid == self.gid and not self.active:
+                        await self.promote()
+                    elif mm.active_gid != self.gid and self.active:
+                        self.demote()
+                # the index's staleness TTL is enforced HERE (the Mgr
+                # owns its state), not only in one consumer's render:
+                # daemon-stats/daemon ls and the ProgressModule's
+                # osd-perf digest must drop dead daemons even when
+                # PrometheusModule isn't loaded
+                self.daemon_state.cull(float(self.config.get(
+                    "mgr_stats_stale_s", 10.0)))
+                await asyncio.sleep(float(self.config.get(
+                    "mgr_beacon_interval", 0.5)))
+        except asyncio.CancelledError:
+            pass
 
     async def promote(self) -> None:
         """Standby -> active (ref: MgrStandby::handle_mgr_map)."""
@@ -108,6 +259,18 @@ class Mgr:
                 asyncio.ensure_future(self._module_loop(mod)))
         log.dout(1, f"mgr.{self.name} active "
                     f"({[m.NAME for m in self.modules]})")
+
+    def demote(self) -> None:
+        """Active -> standby: module loops stop; the report sessions'
+        state stays (harmless — daemons follow the map to the new
+        active, and our index goes stale/culls)."""
+        if not self.active:
+            return
+        self.active = False
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        log.dout(1, f"mgr.{self.name} demoted to standby")
 
     async def _module_loop(self, mod: MgrModule) -> None:
         try:
@@ -125,10 +288,15 @@ class Mgr:
     async def stop(self) -> None:
         self._stopped = True
         self.active = False
+        if self._beacon_task:
+            self._beacon_task.cancel()
         for t in self._tasks:
             t.cancel()
         for mod in self.modules:
             closer = getattr(mod, "close", None)
             if closer:
                 await closer()
+        if self.asok:
+            await self.asok.stop()
+            self.asok = None
         await self.monc.shutdown()

@@ -399,36 +399,91 @@ class PrometheusModule(MgrModule):
                 f"ceph_osdmap_remap_sharded_sweeps "
                 f"{md.get('remap_sharded_sweeps', 0)}",
             ]
-        # in-process perf counters (ref: prometheus module exporting
-        # daemon perf counters); TYPE_HISTOGRAM counters render as
-        # real le-bucketed _bucket/_sum/_count series (round 9 — the
-        # log2 buckets existed since round 1 but nothing exported
-        # them), so tail latency is queryable without traces at all
+        # mgr plane + progress (round 12): active/standby depth and
+        # the in-flight long-running-operation events
+        mgrm = status.get("mgrmap", {})
+        prog = status.get("progress", {})
+        lines += [
+            "# TYPE ceph_mgr_available gauge",
+            f"ceph_mgr_available {int(bool(mgrm.get('available')))}",
+            f"ceph_mgr_standby_count {len(mgrm.get('standbys', []))}",
+            f"ceph_mgrmap_epoch {mgrm.get('epoch', 0)}",
+            f"ceph_progress_events {len(prog.get('events', []))}",
+        ]
+        for ev in prog.get("events", []):
+            if isinstance(ev, dict) and ev.get("id"):
+                lines.append(
+                    f'ceph_progress_fraction{{event="{ev["id"]}"}} '
+                    f'{float(ev.get("fraction", 0.0)):.4f}')
+        # daemon perf counters; TYPE_HISTOGRAM counters render as real
+        # le-bucketed _bucket/_sum/_count series (round 9). Round 12:
+        # rendered from the REPORTED state (daemon -> mgr MMgrReport
+        # sessions, labeled ceph_daemon="osd.0") whenever any daemon
+        # has an open report session — the process-local singleton
+        # render survives ONLY as an explicit standalone/no-mgr
+        # fallback (mgr_stats_singleton_fallback, and only when
+        # nothing reports), because it silently breaks the moment
+        # daemons live in other processes (ROADMAP #1b).
+        from ceph_tpu.utils.perf_counters import hist_cumulative
         hist_lines: list[str] = []
-        for name, counters in PerfCountersCollection.instance() \
-                .dump().items():
+
+        def _perf_rows(label_key: str, label_val: str,
+                       counters: dict, prefix: str = "") -> None:
             for key, val in counters.items():
+                lab = f'{label_key}="{label_val}",' \
+                      f'counter="{prefix}{key}"'
                 if isinstance(val, (int, float)):
-                    lines.append(
-                        f'ceph_perf{{daemon="{name}",counter="{key}"}}'
-                        f' {val}')
+                    lines.append(f'ceph_perf{{{lab}}} {val}')
                 elif isinstance(val, dict) and "log2_buckets" in val:
-                    from ceph_tpu.utils.perf_counters import \
-                        hist_cumulative
-                    lab = f'daemon="{name}",counter="{key}"'
                     for le, cum in hist_cumulative(
                             val["log2_buckets"]):
                         hist_lines.append(
                             f'ceph_perf_hist_bucket{{{lab},'
                             f'le="{le:g}"}} {cum}')
-                    hist_lines += [
+                    hist_lines.extend([
                         f'ceph_perf_hist_bucket{{{lab},le="+Inf"}} '
                         f'{val["count"]}',
                         f'ceph_perf_hist_sum{{{lab}}} '
                         f'{val["sum"]:.9g}',
                         f'ceph_perf_hist_count{{{lab}}} '
                         f'{val["count"]}',
+                    ])
+
+        idx = getattr(self.mgr, "daemon_state", None)
+        if idx is not None:
+            # stale daemons unpin by TTL (a dead OSD stops reporting;
+            # a live one's next report re-extends the window)
+            idx.cull(float(self.mgr.config.get(
+                "mgr_stats_stale_s", 10.0)))
+        reported = idx.dump_all() if idx is not None else {}
+        if reported:
+            lines.append("# ceph_perf: from daemon report sessions")
+            for daemon, loggers in reported.items():
+                for logger, counters in loggers.items():
+                    # the daemon's own logger renders bare counter
+                    # names; a shared/auxiliary logger is prefixed so
+                    # two loggers' counters can never collide
+                    _perf_rows("ceph_daemon", daemon, counters,
+                               prefix="" if logger == daemon
+                               else f"{logger}.")
+            # per-OSD commit/apply latency from the reported
+            # objectstore time-avgs (the `ceph osd perf` table)
+            perf_digest = self.mgr.osd_perf_digest() if hasattr(
+                self.mgr, "osd_perf_digest") else {}
+            if perf_digest:
+                lines.append(
+                    "# TYPE ceph_osd_commit_latency_ms gauge")
+                for osd, row in sorted(perf_digest.items()):
+                    lines += [
+                        f'ceph_osd_commit_latency_ms{{ceph_daemon='
+                        f'"osd.{osd}"}} {row["commit_latency_ms"]}',
+                        f'ceph_osd_apply_latency_ms{{ceph_daemon='
+                        f'"osd.{osd}"}} {row["apply_latency_ms"]}',
                     ]
+        elif self.mgr.config.get("mgr_stats_singleton_fallback", True):
+            for name, counters in PerfCountersCollection.instance() \
+                    .dump().items():
+                _perf_rows("daemon", name, counters)
         if hist_lines:
             lines.append("# TYPE ceph_perf_hist histogram")
             lines += hist_lines
@@ -489,37 +544,53 @@ class TracingModule(MgrModule):
         self._gen = 0            # serving pool's generation token
         self.spans_ingested = 0
         self.asok = None
+        self._own_asok = False
+
+    def _register_asok(self) -> None:
+        def _safe_int(v, default=0):
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return default
+        self.asok.register(
+            "trace ls",
+            lambda cmd: {"traces": self.trace_ls(
+                _safe_int(cmd.get("limit", 20), 20))},
+            "reassembled traces, slowest first")
+        self.asok.register(
+            "trace show",
+            lambda cmd: self.trace_show(
+                _safe_int(cmd.get("trace_id", 0))) or
+            {"error": "no such trace"},
+            "one trace: span tree + per-phase latency breakdown")
+        self.asok.register(
+            "trace status",
+            lambda: {"traces": len(self.index.traces),
+                     "spans_ingested": self.spans_ingested,
+                     "since": self._since},
+            "tracing module ingest cursor + index size")
 
     async def tick(self) -> None:
-        if self.asok is None and self.mgr.config.get(
-                "admin_socket_dir"):
-            from ceph_tpu.utils.admin_socket import AdminSocket
-            self.asok = AdminSocket(
-                f"{self.mgr.config['admin_socket_dir']}/"
-                f"mgr.{self.mgr.name}.asok")
-            def _safe_int(v, default=0):
-                try:
-                    return int(v)
-                except (TypeError, ValueError):
-                    return default
-            self.asok.register(
-                "trace ls",
-                lambda cmd: {"traces": self.trace_ls(
-                    _safe_int(cmd.get("limit", 20), 20))},
-                "reassembled traces, slowest first")
-            self.asok.register(
-                "trace show",
-                lambda cmd: self.trace_show(
-                    _safe_int(cmd.get("trace_id", 0))) or
-                {"error": "no such trace"},
-                "one trace: span tree + per-phase latency breakdown")
-            self.asok.register(
-                "trace status",
-                lambda: {"traces": len(self.index.traces),
-                         "spans_ingested": self.spans_ingested,
-                         "since": self._since},
-                "tracing module ingest cursor + index size")
-            await self.asok.start()
+        if self.asok is None:
+            # round 12: the Mgr owns the per-mgr admin socket (the
+            # daemon-stats verbs live there); the module registers its
+            # trace verbs on it rather than binding the same path a
+            # second time (which would silently orphan the first
+            # server). Creating an own socket survives only for
+            # module-without-Mgr harnesses.
+            mgr_asok = getattr(self.mgr, "asok", None)
+            if mgr_asok is not None:
+                self.asok = mgr_asok
+                self._own_asok = False
+                self._register_asok()
+            elif self.mgr.config.get("admin_socket_dir"):
+                from ceph_tpu.utils.admin_socket import AdminSocket
+                self.asok = AdminSocket(
+                    f"{self.mgr.config['admin_socket_dir']}/"
+                    f"mgr.{self.mgr.name}.asok")
+                self._own_asok = True
+                self._register_asok()
+                await self.asok.start()
         ret, _, out = await self.mon_command(
             {"prefix": "trace dump", "since": self._since})
         if ret != 0:
@@ -554,7 +625,7 @@ class TracingModule(MgrModule):
         return self.index.show(trace_id)
 
     async def close(self) -> None:
-        if self.asok is not None:
+        if self.asok is not None and self._own_asok:
             await self.asok.stop()
 
 
@@ -624,3 +695,167 @@ class RestModule(MgrModule):
     async def close(self) -> None:
         if self._server:
             self._server.close()
+
+
+class ProgressModule(MgrModule):
+    """Progress events for long-running operations (round 12; ref:
+    src/pybind/mgr/progress/module.py): derives completion fractions
+    from pg_dump deltas and surfaces them in `ceph status`'s
+    ``progress`` block and `ceph progress ls/json`.
+
+    Event sources:
+
+    - **backfill**: every PG observed in a backfill state joins the
+      event's pg set; a member's in-flight fraction is its pushed
+      count against the primary's object count (capped below 1 — the
+      ``last_backfill`` watermark only says *done* when the state
+      clears), a member that left the backfill states counts 1.0.
+    - **recovery** (degraded-PG drain): same set discipline over
+      degraded/undersized states, binary per-PG (the dump carries no
+      missing-object counts).
+    - **merge readiness**: per-pool ``ready/sources`` straight from
+      the mon's pending_merges barrier.
+    - **subtree migration**: one explicit event per in-flight FSMap
+      migration (completes when the authority flip commits).
+
+    An event whose members all completed moves to a bounded
+    ``completed`` ring at fraction 1.0 — `progress ls` clears on
+    settle, `progress json` keeps the recent history. Each tick the
+    module DIGESTS its event table (plus the per-OSD commit/apply
+    latency table from the DaemonStateIndex) monward via MMgrDigest,
+    so the mon serves all of it without holding counter state; the
+    full-table re-send is what makes a mon leader change self-heal on
+    the next tick."""
+
+    NAME = "progress"
+    TICK_INTERVAL = 1.0
+
+    # per-PG in-flight progress never reports complete off pushed
+    # counts alone — only the state clearing does
+    MAX_INFLIGHT_FRACTION = 0.95
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        import collections
+        self.events: dict[str, dict] = {}
+        self.completed = collections.deque(maxlen=int(
+            mgr.config.get("mgr_progress_max_events", 64)))
+        self.digests_sent = 0
+
+    async def tick(self) -> None:
+        status = await self.get("status")
+        pg_dump = await self.get("pg_dump")
+        import time as _time
+        self._derive(status, pg_dump, _time.time())
+        await self._send_digest()
+
+    # -- event derivation --------------------------------------------------
+    def _ev(self, key: str, message: str, now: float) -> dict:
+        ev = self.events.get(key)
+        if ev is None:
+            ev = self.events[key] = {
+                "id": key, "message": message, "fraction": 0.0,
+                "started": now, "updated": now, "_pgs": {}}
+        ev["message"] = message
+        ev["updated"] = now
+        return ev
+
+    def _complete(self, key: str, now: float) -> None:
+        ev = self.events.pop(key, None)
+        if ev is None:
+            return
+        ev["fraction"] = 1.0
+        ev["updated"] = now
+        ev.pop("_pgs", None)
+        ev["completed_at"] = now
+        self.completed.append(ev)
+
+    def _derive(self, status: dict, pg_dump: dict, now: float) -> None:
+        stats = pg_dump.get("pg_stats", {}) or {}
+        # -- backfill: pg-set event with watermark-informed fractions
+        cur_bf = {pgid: st for pgid, st in stats.items()
+                  if "backfill" in st.get("state", "")}
+        self._pg_set_event(
+            "backfill", cur_bf, stats, now,
+            lambda st: min(
+                self.MAX_INFLIGHT_FRACTION,
+                st.get("backfill", {}).get("pushed", 0) /
+                max(st.get("num_objects", 0), 1)),
+            lambda n: f"Backfilling {n} pg(s)")
+        # -- recovery: degraded-pg drain (binary per member)
+        cur_deg = {pgid: st for pgid, st in stats.items()
+                   if any(tok in st.get("state", "") for tok in
+                          ("degraded", "undersized", "down"))}
+        self._pg_set_event(
+            "recovery", cur_deg, stats, now, lambda st: 0.0,
+            lambda n: f"Recovering {n} degraded pg(s)")
+        # -- merges: the readiness barrier is the fraction
+        merges = status.get("osdmap", {}).get("pending_merges", {})
+        for pool, v in merges.items():
+            key = f"merge:{pool}"
+            ev = self._ev(key, f"Merging pool '{pool}' pg_num "
+                               f"{v.get('from')} -> {v.get('to')}", now)
+            ev["fraction"] = round(
+                v.get("ready", 0) / max(v.get("sources", 1), 1), 4)
+        for key in [k for k in self.events
+                    if k.startswith("merge:") and
+                    k.split(":", 1)[1] not in merges]:
+            self._complete(key, now)
+        # -- subtree migrations: explicit events, done on the flip
+        migrating = {f"migrate:{m['path']}": m
+                     for m in status.get("fsmap", {})
+                     .get("migrations", []) if isinstance(m, dict)}
+        for key, m in migrating.items():
+            self._ev(key, f"Migrating subtree {m['path']} rank "
+                          f"{m.get('from')} -> {m.get('to')}", now)
+        for key in [k for k in self.events
+                    if k.startswith("migrate:") and
+                    k not in migrating]:
+            self._complete(key, now)
+
+    def _pg_set_event(self, key: str, current: dict, stats: dict,
+                      now: float, inflight_fraction,
+                      message) -> None:
+        """Shared pg-set discipline: members accumulate while the
+        condition holds anywhere; fraction = mean member progress
+        (1.0 for members whose condition cleared); the event completes
+        when every member cleared."""
+        ev = self.events.get(key)
+        if not current and ev is None:
+            return
+        if ev is None:
+            ev = self._ev(key, message(len(current)), now)
+        ev["_pgs"].update({pgid: True for pgid in current})
+        if not current:
+            self._complete(key, now)
+            return
+        ev["message"] = message(len(current))
+        ev["updated"] = now
+        done = 0.0
+        for pgid in ev["_pgs"]:
+            st = current.get(pgid)
+            if st is None:
+                done += 1.0                  # condition cleared
+            else:
+                done += max(0.0, min(self.MAX_INFLIGHT_FRACTION,
+                                     float(inflight_fraction(st))))
+        ev["fraction"] = round(done / max(len(ev["_pgs"]), 1), 4)
+
+    # -- the monward digest ------------------------------------------------
+    def _public_events(self) -> list[dict]:
+        return [{k: v for k, v in ev.items() if not k.startswith("_")}
+                for ev in self.events.values()]
+
+    async def _send_digest(self) -> None:
+        import json as _json
+        from ceph_tpu.mon.messages import MMgrDigest
+        perf = {}
+        if hasattr(self.mgr, "osd_perf_digest"):
+            perf = self.mgr.osd_perf_digest()
+        await self.mgr.monc.send_report(MMgrDigest(
+            name=self.mgr.name, gid=getattr(self.mgr, "gid", 0),
+            progress=_json.dumps(
+                {"events": self._public_events(),
+                 "completed": list(self.completed)}).encode(),
+            osd_perf=_json.dumps(perf).encode()))
+        self.digests_sent += 1

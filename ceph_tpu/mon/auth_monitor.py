@@ -66,6 +66,8 @@ READONLY_COMMANDS = frozenset((
     "osd blocklist ls", "pg dump", "pg map", "fs status", "fs dump",
     "fs subtree ls", "mds dump",
     "trace dump", "trace ls", "trace show", "osd slow ls",
+    # telemetry plane (round 12): digest-backed observability reads
+    "osd perf", "progress ls", "progress json", "mgr dump", "mgr stat",
 ))
 AUTH_READS = frozenset(("auth get", "auth ls"))
 

@@ -14,8 +14,8 @@ import json
 
 from ceph_tpu.encoding import decode_incremental, decode_osdmap
 from ceph_tpu.mon.messages import (
-    MAuthUpdate, MLog, MMDSMap, MMonCommand, MMonCommandAck, MMonMap,
-    MMonSubscribe, MOSDMap,
+    MAuthUpdate, MLog, MMDSMap, MMgrMap, MMonCommand, MMonCommandAck,
+    MMonMap, MMonSubscribe, MOSDMap,
 )
 from ceph_tpu.mon.monitor import MonMap
 from ceph_tpu.msg import (AuthError, Dispatcher, Keyring,
@@ -47,6 +47,10 @@ class MonClient(Dispatcher):
         self.osdmap = None
         self._osdmap_waiters: list[asyncio.Future] = []
         self.map_callbacks: list = []          # async fn(osdmap)
+        # the committed MgrMap (round 12): daemons follow it to find
+        # the ACTIVE mgr for their perf-counter report session — an
+        # epoch naming a new active is the re-open signal
+        self.mgrmap = None
         # opt-in full-cluster mapping table (OSD daemons set this):
         # delta-maintained per epoch and attached to the map so the
         # holder's bulk advance-map placement reads come from the
@@ -80,6 +84,16 @@ class MonClient(Dispatcher):
             return True
         if isinstance(msg, MAuthUpdate):
             self._handle_auth_update(msg)
+            return True
+        if isinstance(msg, MMgrMap):
+            from ceph_tpu.mon.mgr_monitor import MgrMap
+            if "mgrmap" in self._subs:
+                self._subs["mgrmap"] = max(self._subs["mgrmap"],
+                                           msg.epoch + 1)
+            mm = MgrMap.decode(msg.mgrmap)
+            # never regress: a lagging peon can answer with an old map
+            if self.mgrmap is None or mm.epoch >= self.mgrmap.epoch:
+                self.mgrmap = mm
             return True
         if isinstance(msg, MMDSMap):
             # cursor only — the cephfs dispatchers consume the map;

@@ -270,6 +270,56 @@ class MTraceReport(Message):
 
 
 @register
+class MMgrBeacon(Message):
+    """Mgr -> mon liveness beacon (ref: src/messages/MMgrBeacon.h):
+    the MgrMonitor turns beacons into the committed MgrMap — the first
+    available mgr becomes ACTIVE, later ones standbys, and a silent
+    active is failed after ``mgr_beacon_grace`` with a standby
+    promoted in the same commit. ``gid`` is the incarnation id (a
+    restarted mgr is a NEW gid, so a zombie's late beacons can never
+    re-claim the active slot); ``available`` means the daemon is ready
+    to serve if named active; ``epoch`` is the mgrmap epoch the daemon
+    has observed (a far-behind daemon gets a fresh publish)."""
+
+    TYPE = 154
+    # "beacon_seq", not "seq": Message.seq is the transport frame
+    # counter and would overwrite a payload field of that name on send
+    FIELDS = [("gid", "u64"), ("name", "str"), ("addr_host", "str"),
+              ("addr_port", "u32"), ("available", "u8"),
+              ("beacon_seq", "u64"), ("epoch", "u64")]
+
+
+@register
+class MMgrMap(Message):
+    """MgrMap publication to ``mgrmap`` subscribers (ref:
+    src/messages/MMgrMap.h): the full encoded MgrMap — it is tiny
+    (one active + a handful of standbys), so no incremental tier.
+    Daemons follow it to find the active mgr for their perf-counter
+    report sessions; a new epoch naming a different active is the
+    signal to re-open (and re-send the counter schema)."""
+
+    TYPE = 155
+    FIELDS = [("epoch", "u64"), ("mgrmap", "blob")]
+
+
+@register
+class MMgrDigest(Message):
+    """Active mgr -> mon digest (ref: src/messages/MMonMgrReport.h —
+    the reverse leg of the telemetry plane): the ProgressModule's
+    event list and the per-OSD commit/apply latency table derived from
+    reported counters, shipped every progress tick so the mon can
+    serve `ceph progress ls/json`, the status ``progress`` block and
+    `ceph osd perf` without holding any counter state itself. Pooled
+    IN MEMORY on the leader (never paxos — it is derived state the
+    next tick re-sends), so a mon leader change self-heals on the
+    following digest."""
+
+    TYPE = 156
+    FIELDS = [("name", "str"), ("gid", "u64"), ("progress", "blob"),
+              ("osd_perf", "blob")]
+
+
+@register
 class MOSDPGReadyToMerge(Message):
     """Source-PG primary -> mon (ref: src/messages/MOSDPGReadyToMerge.h):
     this merge-source PG (seed >= pool.pg_num_pending) is clean,

@@ -17,8 +17,9 @@ from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
     MAuthUpdate, MDSBeacon, MLog, MMDSMap, MMDSMigrationDone,
-    MMonCommand, MMonCommandAck, MMonElection, MMonGetOSDMap, MMonMap,
-    MMonPaxos, MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
+    MMgrBeacon, MMgrDigest, MMgrMap, MMonCommand, MMonCommandAck,
+    MMonElection, MMonGetOSDMap, MMonMap, MMonPaxos,
+    MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
     MOSDFailure, MOSDMap, MOSDMarkMeDown, MOSDPGReadyToMerge, MPGStats,
     MTraceReport,
 )
@@ -144,19 +145,29 @@ class Monitor(Dispatcher):
         from ceph_tpu.mon.auth_monitor import AuthMonitor
         from ceph_tpu.mon.log_monitor import LogMonitor
         from ceph_tpu.mon.mds_monitor import MDSMonitor
+        from ceph_tpu.mon.mgr_monitor import MgrMonitor
         from ceph_tpu.mon.monmap_monitor import MonmapMonitor
         from ceph_tpu.mon.osd_monitor import OSDMonitor
         from ceph_tpu.mon.service import ConfigMonitor, HealthMonitor
         self.osdmon = OSDMonitor(self)
         self.mdsmon = MDSMonitor(self)
+        self.mgrmon = MgrMonitor(self)
         self.monmapmon = MonmapMonitor(self)
         self.authmon = AuthMonitor(self)
         self.logmon = LogMonitor(self)
         self.configmon = ConfigMonitor(self)
         self.healthmon = HealthMonitor(self)
         self.services = [self.monmapmon, self.authmon, self.logmon,
-                         self.osdmon, self.mdsmon, self.configmon,
-                         self.healthmon]
+                         self.osdmon, self.mdsmon, self.mgrmon,
+                         self.configmon, self.healthmon]
+        # mgr digest pool (round 12, ref: MMonMgrReport's receiver):
+        # the active mgr ships its ProgressModule events + the per-OSD
+        # commit/apply latency table every tick — IN MEMORY only
+        # (derived state; the next digest re-sends everything), so a
+        # leader change self-heals on the following tick
+        self.mgr_progress: dict = {"events": [], "completed": []}
+        self.mgr_osd_perf: dict = {}
+        self._mgr_digest_gid = 0
 
         # trace-span pool (round 9, ref: the mgr's role as trace sink
         # upstream): spans piggybacked on MPGStats/MDSBeacon (and
@@ -184,6 +195,23 @@ class Monitor(Dispatcher):
         # hop needed, the pool lives here
         from ceph_tpu.utils.tracing import Tracer
         self.tracer = Tracer(f"mon.{name}", cfg)
+        # the mon's own perf counters (round 12): mons are daemons too
+        # in the telemetry plane — they open a session to the active
+        # mgr and report like OSDs/MDSes, so paxos traffic is rate-
+        # queryable from the DaemonStateIndex
+        from ceph_tpu.utils.perf_counters import PerfCountersBuilder
+        self.perf = (
+            PerfCountersBuilder(f"mon.{name}")
+            .add_u64_counter("paxos_commits",
+                             "paxos values applied to the store")
+            .add_u64_counter("trace_spans_pooled",
+                             "trace span blobs ingested into the pool")
+            .add_u64_counter("mgr_digests",
+                             "MMgrDigest reports pooled from the "
+                             "active mgr")
+            .create_perf_counters())
+        self._mgr_reporter = None
+        self._mgr_report_task: asyncio.Task | None = None
 
         # subscriptions: conn -> {what: next_epoch}
         self.subs: dict[object, dict[str, int]] = {}
@@ -202,8 +230,24 @@ class Monitor(Dispatcher):
         addr = await self.msgr.bind(host, port)
         await self.start_asok()
         self._tick_task = asyncio.ensure_future(self._tick_loop())
+        self.start_mgr_reporting()
         await self.elector.start()
         return addr
+
+    def start_mgr_reporting(self) -> None:
+        """Mons are daemons in the telemetry plane too (round 12):
+        report this mon's own counters to the active mgr, found
+        through the mgrmon's committed map (no subscription needed —
+        the map refreshes with every paxos commit)."""
+        if self._mgr_report_task is not None:
+            return
+        from ceph_tpu.mgr.client import MgrReporter
+        self._mgr_reporter = MgrReporter(
+            f"mon.{self.name}", self.msgr,
+            lambda: self.mgrmon.mgrmap, lambda: [self.perf],
+            self.config)
+        self._mgr_report_task = asyncio.ensure_future(
+            self._mgr_reporter.loop())
 
     async def start_asok(self) -> None:
         """Per-mon admin socket (ref: the mon's AdminSocket): `status`
@@ -229,6 +273,9 @@ class Monitor(Dispatcher):
         self._stopped = True
         if self._tick_task:
             self._tick_task.cancel()
+        if self._mgr_report_task:
+            self._mgr_report_task.cancel()
+            self._mgr_report_task = None
         if self.elector._timer:
             self.elector._timer.cancel()
         if self.asok:
@@ -378,7 +425,8 @@ class Monitor(Dispatcher):
         if isinstance(msg, (MOSDAlive, MOSDBoot, MOSDFailure,
                             MOSDMarkMeDown, MPGStats, MDSBeacon,
                             MLog, MOSDPGReadyToMerge,
-                            MMDSMigrationDone, MTraceReport)):
+                            MMDSMigrationDone, MTraceReport,
+                            MMgrBeacon, MMgrDigest)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:
@@ -393,6 +441,12 @@ class Monitor(Dispatcher):
                 self.ingest_trace_spans(blobs)
             if isinstance(msg, MTraceReport):
                 return True
+            if isinstance(msg, MMgrBeacon):
+                asyncio.ensure_future(self.mgrmon.handle(msg))
+                return True
+            if isinstance(msg, MMgrDigest):
+                self._ingest_mgr_digest(msg)
+                return True
             if isinstance(msg, (MDSBeacon, MMDSMigrationDone)):
                 svc = self.mdsmon
             elif isinstance(msg, MLog):
@@ -402,6 +456,30 @@ class Monitor(Dispatcher):
             asyncio.ensure_future(svc.handle(msg))
             return True
         return False
+
+    # -- mgr digest pool (round 12) ----------------------------------------
+    def _ingest_mgr_digest(self, m: MMgrDigest) -> None:
+        """Pool the active mgr's digest (progress events + per-OSD
+        commit/apply latency). Only the CURRENT active gid's digests
+        land — a demoted mgr's late frames must not overwrite its
+        successor's view. Malformed JSON is dropped: observability
+        must never take a mon down."""
+        active = self.mgrmon.mgrmap.active_gid
+        if active and m.gid != active:
+            return
+        try:
+            prog = json.loads(m.progress) if m.progress else {}
+            perf = json.loads(m.osd_perf) if m.osd_perf else {}
+        except (json.JSONDecodeError, TypeError, ValueError):
+            return
+        if isinstance(prog, dict):
+            self.mgr_progress = {
+                "events": prog.get("events", []),
+                "completed": prog.get("completed", [])}
+        if isinstance(perf, dict):
+            self.mgr_osd_perf = perf
+        self._mgr_digest_gid = m.gid
+        self.perf.inc("mgr_digests")
 
     # -- trace pool (round 9) ----------------------------------------------
     def ingest_trace_spans(self, blobs) -> None:
@@ -418,6 +496,7 @@ class Monitor(Dispatcher):
             self._trace_seq += 1
             self.trace_spans.append((self._trace_seq, span))
             self.trace_index.add(span)
+            self.perf.inc("trace_spans_pooled")
 
     async def _dispatch_mon_msg(self, msg) -> None:
         if isinstance(msg, MMonElection):
@@ -432,6 +511,7 @@ class Monitor(Dispatcher):
     # -- paxos commit application -----------------------------------------
     def apply_paxos_value(self, version: int, value: bytes) -> None:
         self.store.apply_encoded(value)
+        self.perf.inc("paxos_commits")
         for svc in self.services:
             svc.refresh()
         asyncio.ensure_future(self._publish_maps())
@@ -480,6 +560,13 @@ class Monitor(Dispatcher):
                 await conn.send_message(MMonMap(
                     monmap=self.monmap.encode(), epoch=mm_cur))
                 subs["monmap"] = mm_cur + 1
+            g_start = subs.get("mgrmap")
+            g_cur = self.mgrmon.mgrmap.epoch
+            if g_start is not None and g_start <= g_cur:
+                await conn.send_message(MMgrMap(
+                    epoch=g_cur,
+                    mgrmap=self.mgrmon.mgrmap.encode()))
+                subs["mgrmap"] = g_cur + 1
             a_start = subs.get("keyring")
             if a_start is not None and a_start <= auth_cur:
                 await conn.send_message(MAuthUpdate(
@@ -592,10 +679,40 @@ class Monitor(Dispatcher):
             return await self.configmon.handle_command(cmd, inbl)
         if prefix.startswith(("fs", "mds")):
             return await self.mdsmon.handle_command(cmd, inbl)
+        if prefix.startswith("mgr"):
+            return await self.mgrmon.handle_command(cmd, inbl)
+        if prefix.startswith("progress"):
+            return self._handle_progress_command(cmd)
+        if prefix == "osd perf":
+            # per-OSD commit/apply latency from the mgr's reported
+            # counter digest (ref: `ceph osd perf` off the pgmap's
+            # osd_stat perf numbers — here the mgr derives them from
+            # the reported objectstore time-avgs and digests them back)
+            return 0, "", json.dumps({
+                "osd_perf": {k: self.mgr_osd_perf[k]
+                             for k in sorted(self.mgr_osd_perf)},
+                "from_mgr_gid": self._mgr_digest_gid}).encode()
         if prefix.startswith("trace"):
             return self._handle_trace_command(cmd)
         if prefix.startswith(("osd", "pg")):
             return await self.osdmon.handle_command(cmd, inbl)
+        return -22, f"unknown command {prefix!r}", b""    # -EINVAL
+
+    def _handle_progress_command(self, cmd: dict) -> tuple[int, str,
+                                                           bytes]:
+        """`ceph progress ls/json` (round 12, ref: the progress
+        module's `progress` commands): the in-flight event list the
+        active mgr digests monward — ``ls`` serves events only,
+        ``json`` adds the recently-completed ring."""
+        prefix = cmd.get("prefix", "")
+        if prefix == "progress ls":
+            return 0, "", json.dumps({
+                "events": self.mgr_progress.get("events", [])}).encode()
+        if prefix == "progress json":
+            return 0, "", json.dumps({
+                "events": self.mgr_progress.get("events", []),
+                "completed": self.mgr_progress.get("completed", []),
+                "from_mgr_gid": self._mgr_digest_gid}).encode()
         return -22, f"unknown command {prefix!r}", b""    # -EINVAL
 
     def _handle_trace_command(self, cmd: dict) -> tuple[int, str, bytes]:
@@ -698,6 +815,9 @@ class Monitor(Dispatcher):
             "osdmap": osd_stat,
             "fsmap": self.mdsmon.summary(),
             "pgmap": self.osdmon.pg_summary(),
+            "mgrmap": self.mgrmon.mgrmap.summary(),
+            "progress": {"events":
+                         self.mgr_progress.get("events", [])},
         }
 
     # -- service proposals -------------------------------------------------

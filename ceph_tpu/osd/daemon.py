@@ -123,7 +123,26 @@ class OSD(Dispatcher):
             .add_histogram("op_w_latency_hist",
                            "write op latency, microseconds "
                            "(log2 buckets)")
+            # round 12: the telemetry plane's rate-queryable op
+            # counter plus the objectstore commit/apply time-avgs
+            # behind `ceph osd perf` (ref: l_osd_op +
+            # os_commit_latency/os_apply_latency in osd_stat_t)
+            .add_u64_counter("ops", "client ops completed")
+            .add_time_avg("commit_latency",
+                          "primary-side objectstore txn commit "
+                          "seconds (time-avg)")
+            .add_time_avg("apply_latency",
+                          "replica-side objectstore txn apply "
+                          "seconds (time-avg)")
             .create_perf_counters())
+        # daemon -> mgr report session (round 12, ref: MgrClient):
+        # the mgrmap subscription finds the active mgr; the reporter
+        # ships this daemon's counter schema + value deltas there
+        from ceph_tpu.mgr.client import MgrReporter
+        self._mgr_reporter = MgrReporter(
+            name, self.msgr, lambda: self.monc.mgrmap,
+            lambda: [self.perf], cfg)
+        self._mgr_report_task: asyncio.Task | None = None
         self._slow_reported = 0     # last slow-op count sent monward
         self.asok = None
         self._asok_dir = cfg.get("admin_socket_dir")
@@ -282,6 +301,9 @@ class OSD(Dispatcher):
         # monmap following (runtime mon add/rm) + committed-keyring
         # following (auth rotation/revocation reach the daemon)
         await self.monc.subscribe("monmap", 0)
+        # mgrmap following: the active mgr's address for the
+        # perf-counter report session (re-opened on failover)
+        await self.monc.subscribe("mgrmap", 0)
         if self.msgr.keyring is not None:
             await self.monc.subscribe("keyring", 0)
         await self.monc.wait_for_osdmap()
@@ -313,7 +335,8 @@ class OSD(Dispatcher):
                             "osd_capacity_bytes", 0)),
                         "failsafe_full": self.failsafe_full(),
                         "backfill_toofull": self.backfill_toofull()},
-                    "mapping": self._mapping_status()},
+                    "mapping": self._mapping_status(),
+                    "mgr_session": self._mgr_reporter.dump()},
                 "osd state summary")
             self.asok.register(
                 "dump_ops_in_flight",
@@ -370,6 +393,8 @@ class OSD(Dispatcher):
         self._hb_task = asyncio.ensure_future(self._hb_loop())
         self._stats_task = asyncio.ensure_future(self._stats_loop())
         self._admit_task = asyncio.ensure_future(self._admit_loop())
+        self._mgr_report_task = asyncio.ensure_future(
+            self._mgr_reporter.loop())
         if self.scrub_interval > 0:
             self._scrub_task = asyncio.ensure_future(self._scrub_loop())
         # clog the boot (ref: OSD::init's "osd.N ... boot" clog line)
@@ -403,7 +428,8 @@ class OSD(Dispatcher):
         self._stopped = True
         cancelled = []
         for task in (self._hb_task, self._stats_task,
-                     self._scrub_task, self._admit_task):
+                     self._scrub_task, self._admit_task,
+                     self._mgr_report_task):
             if task:
                 task.cancel()
                 cancelled.append(task)

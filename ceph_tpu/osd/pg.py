@@ -1529,6 +1529,7 @@ class PG:
                         else "op_r_latency_hist"
                     self.osd.perf.hist_add(
                         cls_key, (_time.monotonic() - t0) * 1e6)
+                    self.osd.perf.inc("ops")
                     cost = getattr(m, "_throttle_cost", None)
                     if cost is not None:
                         self.osd.client_throttle.release(cost)
@@ -1820,6 +1821,8 @@ class PG:
         store_span = op_span.child(
             "objectstore_commit",
             tags={"osd": self.osd.whoami}) if op_span else None
+        import time as _time
+        _t0 = _time.monotonic()
         try:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
@@ -1828,6 +1831,10 @@ class PG:
             return -5, False, waiter
         finally:
             _finish_store_span(store_span, self.osd.store)
+            # the `ceph osd perf` commit leg: primary-side txn commit
+            # time as a reported time-avg (ref: os_commit_latency)
+            self.osd.perf.avg_add("commit_latency",
+                                  _time.monotonic() - _t0)
         repop_span = op_span.child(
             "repop_wait",
             tags={"replicas": sorted(replicas)}) \
@@ -1891,6 +1898,8 @@ class PG:
         store_span = span.child(
             "objectstore_commit",
             tags={"osd": self.osd.whoami}) if span else None
+        import time as _time
+        _t0 = _time.monotonic()
         try:
             self.osd.store.queue_transaction(t)
         except StoreError as e:
@@ -1900,6 +1909,9 @@ class PG:
             return
         finally:
             _finish_store_span(store_span, self.osd.store)
+            # the `ceph osd perf` apply leg (ref: os_apply_latency)
+            self.osd.perf.avg_add("apply_latency",
+                                  _time.monotonic() - _t0)
         if span is not None:
             span.finish()
         self.pg_log.append(entry)

@@ -287,6 +287,47 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("trace_buffer_size", int, 256,
            "completed spans retained per daemon for dump_tracing",
            min=8),
+    # cluster telemetry plane (round 12; ref: mgr.yaml.in
+    # mgr_stats_period + mon_mgr_beacon_grace): the daemon->mgr
+    # perf-counter report sessions, the mgr's time-series retention,
+    # and the MgrMap beacon/failover machinery. mgr_stats_period is
+    # read LIVE by every reporter, so a runtime override applies from
+    # the next period on.
+    Option("mgr_stats_period", float, 0.5,
+           "seconds between a daemon's MMgrReport value deltas to the "
+           "active mgr (0 disables reporting entirely — the bench "
+           "section's off leg)", min=0.0),
+    Option("mgr_stats_retention", int, 120,
+           "report samples retained per monotonic counter in the "
+           "mgr's DaemonStateIndex ring (the rate-query window)",
+           min=2),
+    Option("mgr_stats_schema_refresh", int, 20,
+           "reports between periodic schema re-sends — re-seeds a "
+           "session the mgr's TTL cull dropped while the daemon's "
+           "reports were merely delayed (the one-way-channel analog "
+           "of reconnect-resends-schema)", min=1),
+    Option("mgr_stats_stale_s", float, 10.0,
+           "seconds without a report before a daemon is culled from "
+           "the DaemonStateIndex (dead daemons unpin by TTL, not "
+           "conn reset — a transparent TCP reconnect must not wipe "
+           "live state)", min=0.5),
+    Option("mgr_stats_singleton_fallback", bool, True,
+           "render /metrics from the process-local "
+           "PerfCountersCollection when NO daemon has a report "
+           "session (the standalone/no-mgr fallback); false = "
+           "reported state only"),
+    Option("mgr_beacon_interval", float, 0.5,
+           "seconds between MMgrBeacons to the mon", min=0.01),
+    Option("mgr_beacon_grace", float, 4.0,
+           "silent-mgr window before the MgrMonitor fails it (a "
+           "silent active is dropped and a standby promoted in the "
+           "same commit)", min=0.1),
+    Option("mgr_progress_interval", float, 1.0,
+           "ProgressModule tick period (event derivation + the "
+           "monward digest)", min=0.05),
+    Option("mgr_progress_max_events", int, 64,
+           "recently-completed progress events retained for "
+           "`ceph progress json`", min=1),
     # TPU execution knobs (no Ceph analog).
     Option("tpu_ec_backend", str, "auto",
            "GF kernel: bitmatmul (MXU) | lut (VPU) | auto",

@@ -27,6 +27,8 @@ import importlib
 import json
 import pathlib
 
+import pytest
+
 TESTS = pathlib.Path(__file__).parent
 REPO = TESTS.parent
 MSG_GOLDEN = TESTS / "golden" / "messages.json"
@@ -276,23 +278,33 @@ _CANNED_STATUS = {
               "actives": {"0": "a"}, "migrations": [],
               "subtrees": {"/": 0, "/d1": 1},
               "rank_ops_rate": {"0": 1.5}},
+    "mgrmap": {"epoch": 4, "active_name": "x", "active_gid": 1,
+               "available": True, "standbys": ["y"]},
+    "progress": {"events": [{"id": "backfill", "fraction": 0.25,
+                             "message": "Backfilling 2 pg(s)"}]},
 }
 
 _METRIC_RE = __import__("re").compile(
     r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s(\S+)$")
 
 
-def _render_prometheus() -> str:
+def _render_prometheus(reported: bool = False) -> str:
     """PrometheusModule.render against canned cluster state (no live
-    cluster needed — render only consumes `get('status')` plus the
-    process perf-counter collection)."""
+    cluster needed — render only consumes `get('status')` plus either
+    the process perf-counter collection or, with ``reported=True``
+    (round 12), a DaemonStateIndex seeded the way daemon MMgrReport
+    sessions seed it — so BOTH render paths stay inside the
+    exposition-format guards)."""
     import asyncio
 
+    from ceph_tpu.mgr.daemon import Mgr
     from ceph_tpu.mgr.modules import PrometheusModule
     from ceph_tpu.utils.perf_counters import PerfCountersBuilder
 
     class _StubMgr:
         config: dict = {}
+        daemon_state = None
+        osd_perf_digest = Mgr.osd_perf_digest
 
         async def get(self, what):
             assert what == "status"
@@ -301,24 +313,58 @@ def _render_prometheus() -> str:
         async def monc(self):               # pragma: no cover
             raise AssertionError
 
-    # make sure at least one histogram is non-empty so the _bucket
-    # rendering path is exercised by the guard
-    pc = (PerfCountersBuilder("meta_guard")
-          .add_histogram("lat_hist", "guard fixture")
-          .create_perf_counters())
-    for v in (1, 3, 900, 70000):
-        pc.hist_add("lat_hist", v)
+    stub = _StubMgr()
+    if reported:
+        from ceph_tpu.mgr.client import schema_entries
+        from ceph_tpu.mgr.daemon_state import DaemonStateIndex
+        stub.config = {"mgr_stats_singleton_fallback": False}
+        idx = stub.daemon_state = DaemonStateIndex()
+        buckets = [0] * 64
+        buckets[3], buckets[10] = 5, 2
+        for name in ("osd.0", "osd.1"):
+            pc = (PerfCountersBuilder(name)
+                  .add_u64_counter("ops", "guard fixture")
+                  .add_time_avg("commit_latency", "guard fixture")
+                  .add_time_avg("apply_latency", "guard fixture")
+                  .add_histogram("op_w_latency_hist",
+                                 "guard fixture")
+                  .create_perf_counters(register=False))
+            idx.report(name, 1, schema_entries([pc]), 1.0, {name: {
+                "ops": 7,
+                "commit_latency": {"avgcount": 2, "sum": 0.01},
+                "apply_latency": {"avgcount": 2, "sum": 0.008},
+                "op_w_latency_hist": {
+                    "count": 7, "sum": 900.0,
+                    "log2_buckets": buckets}}})
+    else:
+        # make sure at least one histogram is non-empty so the
+        # _bucket rendering path is exercised by the guard
+        pc = (PerfCountersBuilder("meta_guard")
+              .add_histogram("lat_hist", "guard fixture")
+              .create_perf_counters())
+        for v in (1, 3, 900, 70000):
+            pc.hist_add("lat_hist", v)
     mod = PrometheusModule.__new__(PrometheusModule)
-    mod.mgr = _StubMgr()
-    return asyncio.run(mod.render())
+    mod.mgr = stub
+    text = asyncio.run(mod.render())
+    if reported:
+        # the canned index must actually drive the render: reported
+        # rows + the osd perf digest rows, singleton rows absent
+        assert 'ceph_perf{ceph_daemon="osd.0",counter="ops"} 7' \
+            in text, text
+        assert "ceph_osd_commit_latency_ms{" in text
+        assert 'ceph_perf{daemon=' not in text
+    return text
 
 
-def test_prometheus_metric_names_unique_and_snake_case():
+@pytest.mark.parametrize("reported", [False, True],
+                         ids=["singleton", "reported"])
+def test_prometheus_metric_names_unique_and_snake_case(reported):
     """Every metric row `mgr/modules.py` renders must have a
     snake_case-valid name, a float-parseable value, and a UNIQUE
     (name, labelset) identity — a duplicated row silently shadows its
     twin in every scrape."""
-    text = _render_prometheus()
+    text = _render_prometheus(reported)
     seen: dict[tuple, str] = {}
     snake = __import__("re").compile(r"^[a-z][a-z0-9_]*$")
     for line in text.splitlines():
@@ -336,10 +382,12 @@ def test_prometheus_metric_names_unique_and_snake_case():
         seen[key] = line
 
 
-def test_prometheus_histogram_buckets_monotone():
+@pytest.mark.parametrize("reported", [False, True],
+                         ids=["singleton", "reported"])
+def test_prometheus_histogram_buckets_monotone(reported):
     """The le-bucketed series must be valid prometheus histograms:
     cumulative counts monotone over increasing le, +Inf == _count."""
-    text = _render_prometheus()
+    text = _render_prometheus(reported)
     series: dict[str, list[tuple[float, float]]] = {}
     counts: dict[str, float] = {}
     for line in text.splitlines():
@@ -367,14 +415,9 @@ def test_prometheus_histogram_buckets_monotone():
             f"{key}: +Inf bucket != _count"
 
 
-def test_qos_knobs_registered_with_defaults():
-    """Every scheduler/QoS/slow-osd knob read anywhere under ceph_tpu/
-    (a string literal starting with one of the round-11 prefixes
-    passed to a ``.get(...)``) must be a declared Option in
-    utils/config.py — an unregistered knob silently falls back to its
-    call-site default and drifts from `config show`."""
-    from ceph_tpu.utils.config import OPTIONS
-    prefixes = ("osd_qos_", "mon_osd_slow_", "osd_op_queue")
+def _knob_reads(prefixes: tuple) -> dict[str, str]:
+    """All config-knob string literals starting with ``prefixes``
+    passed to any ``.get(...)`` under ceph_tpu/ -> first read site."""
     used: dict[str, str] = {}
     for path in sorted((REPO / "ceph_tpu").rglob("*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -388,14 +431,81 @@ def test_qos_knobs_registered_with_defaults():
                 used.setdefault(
                     n.args[0].value,
                     f"{path.relative_to(REPO)}:{n.lineno}")
-    assert used, "no QoS knob reads found (guard went stale)"
+    return used
+
+
+def _assert_knobs_registered(prefixes: tuple, what: str) -> None:
+    from ceph_tpu.utils.config import OPTIONS
+    used = _knob_reads(prefixes)
+    assert used, f"no {what} knob reads found (guard went stale)"
     missing = {k: at for k, at in used.items() if k not in OPTIONS}
     assert not missing, (
-        f"QoS knobs read but not registered in utils/config.py: "
+        f"{what} knobs read but not registered in utils/config.py: "
         f"{missing}")
     for k in used:
         assert OPTIONS[k].default is not None, \
             f"option {k} has no default"
+
+
+def test_qos_knobs_registered_with_defaults():
+    """Every scheduler/QoS/slow-osd knob read anywhere under ceph_tpu/
+    (a string literal starting with one of the round-11 prefixes
+    passed to a ``.get(...)``) must be a declared Option in
+    utils/config.py — an unregistered knob silently falls back to its
+    call-site default and drifts from `config show`."""
+    _assert_knobs_registered(
+        ("osd_qos_", "mon_osd_slow_", "osd_op_queue"), "QoS")
+
+
+def test_telemetry_knobs_registered_with_defaults():
+    """Round 12: every telemetry-plane knob (`mgr_stats_*`,
+    `mgr_progress_*`, `mgr_beacon_*`) read anywhere must be a
+    registered Option with a default — the report loops read them
+    LIVE, so an unregistered knob silently diverges from
+    `config show` in every daemon."""
+    _assert_knobs_registered(
+        ("mgr_stats_", "mgr_progress_", "mgr_beacon_"), "telemetry")
+
+
+def test_mgr_report_schema_types_cover_perf_counters():
+    """Every counter type PerfCounters can register must be a type
+    the mgr's DaemonStateIndex accepts (daemon_state.ALLOWED_TYPES)
+    — and vice versa. The shipped MMgrReport schema is built straight
+    off PerfCounters instances (mgr/client.schema_entries), so a new
+    TYPE_* constant added without extending ALLOWED_TYPES would make
+    every counter of that type silently vanish from `/metrics`: the
+    index drops schema entries naming unknown types by design."""
+    from ceph_tpu.mgr import daemon_state
+    from ceph_tpu.utils import perf_counters as pcmod
+    registered = {v for k, v in vars(pcmod).items()
+                  if k.startswith("TYPE_") and isinstance(v, str)}
+    assert registered, "no TYPE_* constants found (guard went stale)"
+    assert registered == set(daemon_state.ALLOWED_TYPES), (
+        f"PerfCounters types {sorted(registered)} != mgr-accepted "
+        f"{sorted(daemon_state.ALLOWED_TYPES)} — extend "
+        f"daemon_state.ALLOWED_TYPES (and the rate/percentile "
+        f"handling) when adding a counter type")
+    # the builder surface only ever constructs registered types (an
+    # AST check so a new add_* method can't hand out a bare string)
+    src = (REPO / "ceph_tpu/utils/perf_counters.py").read_text()
+    tree = ast.parse(src)
+    builder = next(n for n in ast.walk(tree)
+                   if isinstance(n, ast.ClassDef) and
+                   n.name == "PerfCountersBuilder")
+    type_names = {k for k in vars(pcmod) if k.startswith("TYPE_")}
+    for meth in builder.body:
+        if not (isinstance(meth, ast.FunctionDef) and
+                meth.name.startswith("add_")):
+            continue
+        ctor_types = {
+            n.args[0].id for n in ast.walk(meth)
+            if isinstance(n, ast.Call) and
+            isinstance(n.func, ast.Name) and
+            n.func.id == "_Counter" and n.args and
+            isinstance(n.args[0], ast.Name)}
+        assert ctor_types and ctor_types <= type_names, (
+            f"PerfCountersBuilder.{meth.name} constructs a counter "
+            f"whose type is not a TYPE_* constant: {ctor_types}")
 
 
 def test_every_asok_command_has_docstring():

@@ -1,0 +1,621 @@
+"""Cluster telemetry plane (round 12).
+
+Acceptance surface:
+
+- in a multi-daemon cluster with the singleton fallback DISABLED,
+  `/metrics` is built solely from shipped MMgrOpen/MMgrReport state
+  and agrees with each daemon's local ``perf dump``;
+- a monotonic-counter rate query returns the correct derivative
+  across report periods (exact in the unit test, live in-cluster);
+- a backfill storm's progress event goes 0 -> 1 and clears on settle
+  (`ceph progress ls` empty, the completed ring keeps the history);
+- mgr failover: kill the active mgr, the mon's beacon-grace tick
+  promotes a standby, daemons re-open their sessions (schema
+  re-sent), the fresh DaemonStateIndex repopulates, and `/metrics` +
+  `progress ls` recover with no stale daemons pinned;
+- `ceph osd perf` serves per-OSD commit/apply latency from the
+  reported objectstore time-avgs, and `daemon-stats` serves live
+  rates from the retained time series over the mgr's admin socket.
+
+Budget discipline: ONE vstart cluster carries every telemetry assert
+(metrics agreement, rates, osd perf, daemon-stats, backfill
+progress); the failover test uses a second, smaller cluster; the
+mid-storm failover variant is ``slow``.
+"""
+
+import asyncio
+import json
+import re
+import time
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.mgr.daemon_state import ALLOWED_TYPES, DaemonStateIndex
+from ceph_tpu.mgr.client import MgrReporter, schema_entries
+from ceph_tpu.mgr.modules import ProgressModule, PrometheusModule
+from ceph_tpu.mon.mgr_monitor import MgrMap
+from ceph_tpu.os_.objectstore import MemStore
+from ceph_tpu.utils.perf_counters import PerfCountersBuilder
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# -- units: the DaemonStateIndex store + query surface ----------------------
+
+def _schema(*entries):
+    return [{"logger": lg, "counter": ct, "type": ty,
+             "monotonic": mono, "doc": ""}
+            for lg, ct, ty, mono in entries]
+
+
+def test_rate_query_exact_derivative():
+    """The acceptance-pinned contract: a monotonic counter reported at
+    known (t, v) pairs yields exactly (v1-v0)/(t1-t0) over the ring,
+    and the windowed variant uses the oldest sample INSIDE the
+    window."""
+    idx = DaemonStateIndex(retention=8)
+    sch = _schema(("osd.0", "ops", "u64", True))
+    idx.report("osd.0", 1, sch, 10.0, {"osd.0": {"ops": 100}})
+    idx.report("osd.0", 1, None, 12.0, {"osd.0": {"ops": 150}})
+    idx.report("osd.0", 1, None, 14.0, {"osd.0": {"ops": 260}})
+    # whole ring: (260 - 100) / (14 - 10)
+    assert idx.rate("osd.0", "osd.0", "ops") == pytest.approx(40.0)
+    # window covering only the last span: (260 - 150) / (14 - 12)
+    assert idx.rate("osd.0", "osd.0", "ops",
+                    window_s=2.0) == pytest.approx(55.0)
+    # unchanged counter still samples: rate decays toward 0
+    idx.report("osd.0", 1, None, 18.0, {})
+    assert idx.rate("osd.0", "osd.0", "ops",
+                    window_s=4.0) == pytest.approx(0.0)
+    # ring is bounded by retention
+    st = idx.daemons["osd.0"]
+    for i in range(20):
+        idx.report("osd.0", 1, None, 20.0 + i,
+                   {"osd.0": {"ops": 300 + i}})
+    assert len(st.series[("osd.0", "ops")]) == 8
+    # non-monotonic / unknown counters have no series
+    assert idx.rate("osd.0", "osd.0", "nope") is None
+
+
+def test_session_seq_discipline_and_schema_first():
+    """A newer session_seq RESETS state (failover re-open / fresh
+    incarnation); an older one is a zombie and is dropped; a
+    schema-less report for an unknown daemon is dropped (the sender
+    re-opens with schema next period); a schema-carrying report is
+    self-sufficient."""
+    idx = DaemonStateIndex()
+    sch = _schema(("osd.1", "ops", "u64", True))
+    # schema-less report for an unknown daemon: dropped
+    assert not idx.report("osd.1", 1, None, 1.0,
+                          {"osd.1": {"ops": 5}})
+    assert "osd.1" not in idx.daemons
+    # schema-carrying report is self-sufficient (lost/raced open)
+    assert idx.report("osd.1", 1, sch, 1.0, {"osd.1": {"ops": 5}})
+    assert idx.daemons["osd.1"].latest[("osd.1", "ops")] == 5
+    # zombie incarnation (older seq): dropped, state intact
+    assert not idx.report("osd.1", 0, sch, 2.0,
+                          {"osd.1": {"ops": 999}})
+    assert idx.daemons["osd.1"].latest[("osd.1", "ops")] == 5
+    # newer seq resets: old counters must not survive the reset
+    idx.daemons["osd.1"].latest[("osd.1", "retired")] = 42
+    assert idx.report("osd.1", 2, sch, 3.0, {"osd.1": {"ops": 7}})
+    st = idx.daemons["osd.1"]
+    assert ("osd.1", "retired") not in st.latest
+    assert st.latest[("osd.1", "ops")] == 7
+    # values without a schema entry are dropped (typeless guessing
+    # is exactly what the schema-first discipline forbids)
+    idx.report("osd.1", 2, None, 4.0, {"osd.1": {"mystery": 1}})
+    assert ("osd.1", "mystery") not in st.latest
+    # schema entries naming unregistered types are dropped
+    n = st.apply_schema(_schema(("osd.1", "bad", "florp", True)))
+    assert n == 0 and ("osd.1", "bad") not in st.schema
+
+
+def test_histogram_percentile_and_avg_reads():
+    idx = DaemonStateIndex()
+    sch = _schema(("osd.2", "lat_hist", "hist", False),
+                  ("osd.2", "commit_latency", "avg", False))
+    buckets = [0] * 64
+    # 90 values in bucket 3 (<=8), 10 in bucket 10 (<=1024)
+    buckets[3], buckets[10] = 90, 10
+    idx.report("osd.2", 1, sch, 1.0, {"osd.2": {
+        "lat_hist": {"count": 100, "sum": 5000.0,
+                     "log2_buckets": buckets},
+        "commit_latency": {"avgcount": 4, "sum": 2.0}}})
+    st = idx.daemons["osd.2"]
+    assert st.percentile("osd.2", "lat_hist", 0.5) == 8.0
+    assert st.percentile("osd.2", "lat_hist", 0.99) == 1024.0
+    assert st.avg_value("osd.2", "commit_latency") == \
+        pytest.approx(0.5)
+    assert st.percentile("osd.2", "commit_latency", 0.5) is None
+
+
+def test_cull_ttl_drops_silent_daemons():
+    idx = DaemonStateIndex()
+    sch = _schema(("osd.3", "ops", "u64", True))
+    idx.report("osd.3", 1, sch, 1.0, {})
+    idx.daemons["osd.3"].last_report -= 100.0      # long silent
+    idx.report("osd.4", 1, _schema(("osd.4", "ops", "u64", True)),
+               1.0, {})
+    assert idx.cull(stale_s=10.0) == ["osd.3"]
+    assert sorted(idx.daemons) == ["osd.4"]
+
+
+def test_mgrmap_roundtrip_and_summary():
+    m = MgrMap()
+    m.epoch = 7
+    m.active_gid = 3
+    m.active_name = "x"
+    m.active_addr = ("127.0.0.1", 4242)
+    m.standbys = {5: ("y", "127.0.0.1", 4243)}
+    again = MgrMap.decode(m.encode())
+    assert (again.epoch, again.active_gid, again.active_name,
+            again.active_addr) == (7, 3, "x", ("127.0.0.1", 4242))
+    assert again.standbys == m.standbys
+    assert again.available()
+    assert MgrMap.decode(b"").epoch == 0
+    assert not MgrMap.decode(b"").available()
+    assert again.summary()["standbys"] == ["y"]
+
+
+class _FakeMessenger:
+    """Records (message, addr, peer) sends for the reporter unit."""
+
+    def __init__(self):
+        self.sent = []
+        self.fail_next = False
+
+    async def send_message(self, msg, addr, peer):
+        if self.fail_next:
+            self.fail_next = False
+            raise ConnectionError("injected")
+        self.sent.append(msg)
+
+
+def test_reporter_schema_once_then_deltas_and_failover_resend():
+    """The wire discipline: schema ships on session open (with FULL
+    values — it re-seeds the receiver), later reports carry only
+    changed counters, and a new active gid (failover) or a send
+    failure re-opens with schema again."""
+    async def go():
+        pc = (PerfCountersBuilder("unit.0")
+              .add_u64_counter("ops", "unit fixture")
+              .add_u64("gauge", "unit fixture")
+              .create_perf_counters(register=False))
+        mm = MgrMap()
+        mm.active_gid, mm.active_name = 1, "x"
+        mm.active_addr = ("127.0.0.1", 9999)
+        msgr = _FakeMessenger()
+        rep = MgrReporter("unit.0", msgr, lambda: mm, lambda: [pc],
+                          {"mgr_stats_schema_refresh": 1000})
+        pc.inc("ops", 3)
+        assert await rep.report_once()
+        open_msg, first = msgr.sent[0], msgr.sent[1]
+        assert open_msg.daemon == "unit.0"
+        sch = json.loads(first.schema)
+        assert {e["counter"] for e in sch} == {"ops", "gauge"}
+        assert all(e["type"] in ALLOWED_TYPES for e in sch)
+        vals = json.loads(first.values)["counters"]["unit.0"]
+        assert vals == {"ops": 3, "gauge": 0}     # full on schema
+        # steady state: only the changed counter travels, no schema
+        pc.inc("ops")
+        assert await rep.report_once()
+        second = msgr.sent[-1]
+        assert second.schema == b""
+        assert json.loads(second.values)["counters"] == \
+            {"unit.0": {"ops": 4}}
+        # all-unchanged period still reports (TTL refresh, rate 0)
+        assert await rep.report_once()
+        assert json.loads(msgr.sent[-1].values)["counters"] == {}
+        # send failure resets the session: next report re-opens
+        msgr.fail_next = True
+        with pytest.raises(ConnectionError):
+            await rep.report_once()
+        n = len(msgr.sent)
+        assert await rep.report_once()
+        reopen, full = msgr.sent[n], msgr.sent[n + 1]
+        assert type(reopen).__name__ == "MMgrOpen"
+        assert reopen.session_seq > open_msg.session_seq
+        assert json.loads(full.schema)            # schema re-sent
+        # failover (new active gid): same re-open discipline
+        mm.active_gid = 2
+        assert await rep.report_once()
+        assert type(msgr.sent[-2]).__name__ == "MMgrOpen"
+        assert json.loads(msgr.sent[-1].schema)
+        assert rep.sessions_opened == 3
+    run(go())
+
+
+# -- the shared-cluster acceptance run --------------------------------------
+
+TELEMETRY_CFG = {
+    "mgr_stats_singleton_fallback": False,   # reported state ONLY
+    "mgr_stats_period": 0.2,
+    "mgr_stats_retention": 600,
+    "mon_osd_down_out_interval": 600.0,
+    # tiny retained log so the backfill phase crosses the trim
+    # horizon, throttled pushes so the progress event is observable
+    # in flight (50 x 256B at ~4KB/s spans multiple progress ticks)
+    "osd_min_pg_log_entries": 5,
+    "osd_recovery_max_bytes": 4000,
+}
+
+_PERF_ROW = re.compile(
+    r'^ceph_perf\{ceph_daemon="([^"]+)",counter="([^"]+)"\} (\S+)$')
+
+
+async def _reported_counter(mgr, daemon, counter):
+    st = mgr.daemon_state.daemons.get(daemon)
+    if st is None:
+        return None
+    return st.latest.get((daemon, counter))
+
+
+async def _wait_reported(mgr, daemons, timeout=20.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while set(daemons) - set(mgr.daemon_state.daemons):
+        assert asyncio.get_event_loop().time() < deadline, (
+            f"daemons never reported: expected {sorted(daemons)}, "
+            f"have {sorted(mgr.daemon_state.daemons)}")
+        await asyncio.sleep(0.05)
+
+
+def test_telemetry_plane(tmp_path):
+    """The tentpole acceptance run on ONE cluster: report sessions
+    populate the index; `/metrics` renders solely from reported state
+    and agrees with each daemon's local perf dump; rate queries are
+    live; `ceph osd perf` + `daemon-stats` serve; a backfill's
+    progress event goes 0 -> 1 and clears on settle."""
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=3, n_mgrs=1,
+            config=dict(TELEMETRY_CFG,
+                        admin_socket_dir=str(tmp_path)),
+            mgr_modules=[PrometheusModule, ProgressModule]).start()
+        try:
+            await c.client.pool_create("t", pg_num=4, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            mgr = c.active_mgr()
+            assert mgr is not None
+
+            # -- sessions: every daemon type reports (OSDs + mon) -----
+            await _wait_reported(
+                mgr, ["osd.0", "osd.1", "osd.2", "mon.a"])
+            for name in ("osd.0", "osd.1", "osd.2", "mon.a"):
+                assert mgr.daemon_state.daemons[name].schema, name
+
+            # -- write burst; reported state must converge on the ----
+            # -- daemons' own perf dumps once quiesced ----------------
+            t0 = time.monotonic()
+            for i in range(40):
+                await io.write_full(f"obj-{i % 8}", b"x" * 512)
+            burst_span = time.monotonic() - t0
+            local = {f"osd.{o.whoami}": o.perf.dump()["ops"]
+                     for o in c.osds}
+            assert sum(local.values()) >= 40
+            deadline = asyncio.get_event_loop().time() + 20
+            while True:
+                reported = {
+                    n: (await _reported_counter(mgr, n, "ops"))
+                    for n in local}
+                if reported == local:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, (
+                    f"reported state never converged: {reported} "
+                    f"vs local {local}")
+                await asyncio.sleep(0.1)
+
+            # -- live rate: the burst's derivative is visible ---------
+            window = max(burst_span, 1.0) + 2.0
+            rates = [mgr.daemon_state.rate(n, n, "ops", window)
+                     for n in local]
+            assert any(r and r > 0 for r in rates), rates
+            # sum of per-OSD op rates over the burst window is the
+            # cluster write rate, bounded by the offered load
+            total = sum(r or 0.0 for r in rates)
+            assert 0 < total <= (40 / burst_span) * 3 + 50, (
+                total, burst_span)
+
+            # -- /metrics is built from reported state ONLY -----------
+            pm = next(m for m in mgr.modules
+                      if m.NAME == "prometheus")
+            text = await pm.render()
+            rows = {}
+            for line in text.splitlines():
+                m2 = _PERF_ROW.match(line)
+                if m2:
+                    rows[(m2.group(1), m2.group(2))] = m2.group(3)
+            for n, v in local.items():
+                assert float(rows[(n, "ops")]) == v, (n, rows)
+            assert ("mon.a", "paxos_commits") in rows
+            # the singleton render's label key never appears
+            assert 'ceph_perf{daemon=' not in text
+            # reported histograms render as le-bucketed series
+            assert 'ceph_perf_hist_bucket{ceph_daemon="' in text
+
+            # -- `ceph osd perf` + prometheus latency rows ------------
+            # (poll: the mon serves the ACTIVE MGR'S LAST DIGEST,
+            # which can predate the write burst by one progress tick)
+            deadline = asyncio.get_event_loop().time() + 15
+            while True:
+                ret, _, out = await c.client.mon_command(
+                    {"prefix": "osd perf"})
+                assert ret == 0
+                perf = json.loads(out)["osd_perf"]
+                if sorted(perf) == ["0", "1", "2"]:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, (
+                    f"osd perf digest never populated: {perf}")
+                await asyncio.sleep(0.1)
+            for row in perf.values():
+                assert row["commit_latency_ms"] >= 0.0
+                assert row["apply_latency_ms"] >= 0.0
+            assert "ceph_osd_commit_latency_ms{" in text
+            assert "ceph_osd_apply_latency_ms{" in text
+
+            # -- daemon-stats over the mgr admin socket ---------------
+            from ceph_tpu.utils.admin_socket import daemon_command
+            stats = await daemon_command(
+                f"{tmp_path}/mgr.{mgr.name}.asok",
+                {"prefix": "daemon-stats", "name": "osd.0"})
+            assert stats["daemon"] == "osd.0"
+            assert stats["series_depth"] >= 2
+            assert "ops" in stats["rates_per_s"].get("osd.0", {})
+            missing = await daemon_command(
+                f"{tmp_path}/mgr.{mgr.name}.asok",
+                {"prefix": "daemon-stats", "name": "osd.99"})
+            assert "error" in missing
+
+            # -- backfill progress: 0 -> 1, clears on settle ----------
+            data = {}
+            for i in range(50):
+                oid = f"bf-{i:04d}"
+                await io.write_full(oid, bytes([i % 256]) * 256)
+                data[oid] = bytes([i % 256]) * 256
+                if i == 9:
+                    await c.kill_osd(2)
+                    await c.wait_for_osd_down(2, timeout=60)
+            await c.revive_osd(2, store=MemStore())   # fresh join
+            saw_inflight = None
+            deadline = asyncio.get_event_loop().time() + 90
+            while True:
+                ret, _, out = await c.client.mon_command(
+                    {"prefix": "progress ls"})
+                assert ret == 0
+                evs = {e["id"]: e for e in
+                       json.loads(out)["events"]}
+                bf = evs.get("backfill")
+                if bf is not None and 0.0 <= bf["fraction"] < 1.0:
+                    saw_inflight = bf
+                try:
+                    await c.wait_for_clean(timeout=0.5)
+                    break
+                except (TimeoutError, AssertionError):
+                    pass
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"backfill never settled (events: {evs})"
+            assert saw_inflight is not None, \
+                "backfill progress event never observed in flight"
+            assert "Backfilling" in saw_inflight["message"]
+            # settle: `progress ls` clears, the completed ring keeps
+            # the event at fraction 1.0
+            deadline = asyncio.get_event_loop().time() + 30
+            while True:
+                ret, _, out = await c.client.mon_command(
+                    {"prefix": "progress json"})
+                assert ret == 0
+                pj = json.loads(out)
+                live = {e["id"] for e in pj["events"]}
+                done = {e["id"]: e for e in pj["completed"]}
+                if "backfill" not in live and "backfill" in done:
+                    assert done["backfill"]["fraction"] == 1.0
+                    break
+                assert asyncio.get_event_loop().time() < deadline, (
+                    f"backfill event never completed: live={live} "
+                    f"done={sorted(done)}")
+                await asyncio.sleep(0.2)
+            # the storm's data really backfilled (not just reported)
+            for oid, payload in data.items():
+                assert await io.read(oid) == payload
+
+            # status carries the progress block + mgrmap
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "status"})
+            status = json.loads(out)
+            assert "progress" in status
+            assert status["mgrmap"]["available"]
+        finally:
+            await c.stop()
+    run(go())
+
+
+# -- mgr failover: the self-healing discipline ------------------------------
+
+FAILOVER_CFG = {
+    "mgr_stats_singleton_fallback": False,
+    "mgr_stats_period": 0.2,
+    "mgr_beacon_grace": 1.5,
+    "mgr_stats_stale_s": 3.0,
+}
+
+
+async def _failover_once(c, io, write_concurrently=False):
+    """Kill the active mgr, wait for the standby's promotion, and
+    assert the new index repopulates from re-opened sessions."""
+    old = c.active_mgr()
+    assert old is not None
+    await _wait_reported(old, ["osd.0", "osd.1"])
+    writer_errors = []
+    stop_writing = asyncio.Event()
+
+    async def writer():
+        i = 0
+        while not stop_writing.is_set():
+            try:
+                await io.write_full(f"st-{i % 16}", b"w" * 512)
+            except Exception as e:           # zero-errors contract
+                writer_errors.append(e)
+            i += 1
+            await asyncio.sleep(0.01)
+
+    wtask = asyncio.ensure_future(writer()) if write_concurrently \
+        else None
+    try:
+        await c.kill_mgr(old)
+        new = await c.wait_for_mgr_active(not_gid=old.gid,
+                                          timeout=30.0)
+        assert new.gid != old.gid and new.active
+        # daemons re-open against the promoted standby: its EMPTY
+        # index repopulates, schema re-sent because the session seq
+        # changed (poll — one report period after promotion)
+        await _wait_reported(new, ["osd.0", "osd.1", "mon.a"],
+                             timeout=30.0)
+        for name in ("osd.0", "osd.1", "mon.a"):
+            st = new.daemon_state.daemons[name]
+            assert st.schema, f"{name}: schema not re-sent"
+        # reporter-side: a fresh session was opened per daemon
+        for osd in c.osds:
+            assert osd._mgr_reporter.sessions_opened >= 2
+    finally:
+        if wtask is not None:
+            stop_writing.set()
+            await wtask
+    assert not writer_errors, writer_errors[:3]
+    return old, new
+
+
+def test_mgr_failover_repopulates_index(tmp_path):
+    """Kill the active mgr; the standby promotes through the mon's
+    beacon-grace tick; daemons re-open sessions; `/metrics` and
+    `progress ls` recover with no stale daemons pinned."""
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=2, n_mgrs=2,
+            config=dict(FAILOVER_CFG,
+                        admin_socket_dir=str(tmp_path)),
+            mgr_modules=[PrometheusModule, ProgressModule]).start()
+        try:
+            await c.client.pool_create("t", pg_num=4, size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            for i in range(10):
+                await io.write_full(f"o-{i}", b"x" * 256)
+            old, new = await _failover_once(c, io)
+            # /metrics from the NEW active renders reported state
+            pm = next(m for m in new.modules
+                      if m.NAME == "prometheus")
+            deadline = asyncio.get_event_loop().time() + 20
+            while True:
+                text = await pm.render()
+                if 'ceph_perf{ceph_daemon="osd.0"' in text and \
+                        'ceph_perf{ceph_daemon="osd.1"' in text:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "new active's /metrics never recovered"
+                await asyncio.sleep(0.1)
+            # no stale daemons pinned: the culled view holds exactly
+            # the live reporters (old mgr's own state never leaks in)
+            new.daemon_state.cull(3.0)
+            assert set(new.daemon_state.daemons) <= \
+                {"osd.0", "osd.1", "mon.a"}
+            # progress serves from the new gid's digests
+            deadline = asyncio.get_event_loop().time() + 20
+            while True:
+                ret, _, out = await c.client.mon_command(
+                    {"prefix": "progress json"})
+                assert ret == 0
+                if json.loads(out).get("from_mgr_gid") == new.gid:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "mon never saw the new active's digest"
+                await asyncio.sleep(0.1)
+            # the map agrees end to end
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "mgr stat"})
+            assert ret == 0
+            stat = json.loads(out)
+            assert stat["active_gid"] == new.gid
+            assert stat["available"]
+        finally:
+            await c.stop()
+    run(go())
+
+
+@pytest.mark.slow
+def test_mgr_failover_mid_storm_deep(tmp_path):
+    """Deep variant: failover UNDER a concurrent write storm (zero
+    writer errors — the data path never depends on the mgr), twice in
+    a row (the second failover exercises a previously-promoted
+    active's replacement), with rate queries live on the final
+    active."""
+    async def go():
+        c = await Cluster(
+            n_mons=1, n_osds=2, n_mgrs=3,
+            config=FAILOVER_CFG,
+            mgr_modules=[PrometheusModule, ProgressModule]).start()
+        try:
+            await c.client.pool_create("t", pg_num=4, size=2)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("t")
+            _, second = await _failover_once(
+                c, io, write_concurrently=True)
+            _, third = await _failover_once(
+                c, io, write_concurrently=True)
+            assert third.gid != second.gid
+            # the final active's time series answers rate queries
+            deadline = asyncio.get_event_loop().time() + 20
+            while True:
+                r = third.daemon_state.rate("osd.0", "osd.0", "ops")
+                if r is not None:
+                    break
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.1)
+        finally:
+            await c.stop()
+    run(go())
+
+
+# -- the CLI surface --------------------------------------------------------
+
+def test_ceph_cli_telemetry_verbs_parse():
+    """`ceph osd perf` / `progress ls|json` / `mgr dump|stat|fail`
+    parse to their mon command prefixes (read-only cap class pinned
+    in mon/auth_monitor.py's READONLY_COMMANDS)."""
+    from ceph_tpu.bench.ceph_cli import _parse_command
+    from ceph_tpu.mon.auth_monitor import READONLY_COMMANDS
+    for words, prefix in [
+            (["osd", "perf"], "osd perf"),
+            (["progress", "ls"], "progress ls"),
+            (["progress", "json"], "progress json"),
+            (["mgr", "dump"], "mgr dump"),
+            (["mgr", "stat"], "mgr stat")]:
+        cmd, _ = _parse_command(words)
+        assert cmd["prefix"] == prefix
+        assert prefix in READONLY_COMMANDS, (
+            f"{prefix} must be readable with read-only caps")
+    cmd, _ = _parse_command(["mgr", "fail"])
+    assert cmd["prefix"] == "mgr fail"
+    assert "mgr fail" not in READONLY_COMMANDS   # it mutates the map
+
+
+def test_schema_entries_match_perf_counters_types():
+    """Every schema entry shipped for a full-typed PerfCounters names
+    a type the DaemonStateIndex accepts (the live half of the
+    test_meta AST guard)."""
+    pc = (PerfCountersBuilder("guard.0")
+          .add_u64_counter("mono", "guard")
+          .add_u64("gauge", "guard")
+          .add_time("elapsed", "guard")
+          .add_time_avg("avg", "guard")
+          .add_histogram("hist", "guard")
+          .create_perf_counters(register=False))
+    entries = schema_entries([pc])
+    assert len(entries) == 5
+    assert all(e["type"] in ALLOWED_TYPES for e in entries)
+    st = DaemonStateIndex().open("guard.0", 1)
+    assert st.apply_schema(entries) == 5
