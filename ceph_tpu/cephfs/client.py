@@ -195,7 +195,8 @@ class CephFSClient(Dispatcher):
     @classmethod
     async def create(cls, monmap, mds_addr, pool: str,
                      keyring=None,
-                     config: dict | None = None) -> "CephFSClient":
+                     config: dict | None = None,
+                     name: str | None = None) -> "CephFSClient":
         """Mount with an OWN RADOS identity — the libcephfs model: ONE
         entity name carries both the MDS sessions and the data-path
         ops, so an MDS eviction's osd blocklist actually fences this
@@ -205,12 +206,31 @@ class CephFSClient(Dispatcher):
         ``mds_addr=None`` mounts in **HA mode**: the client subscribes
         to the mdsmap through its own MonClient and follows every
         rank's holder across failovers and subtree migrations instead
-        of pinning one address."""
+        of pinning one address.
+
+        ``name`` pins the entity identity (a provisioned entity whose
+        committed caps should bind at the MDS/OSD gates); default is a
+        fresh ``client.fsN``."""
         from ceph_tpu.rados import Rados
         CephFSClient._next_id += 1
-        name = f"client.fs{CephFSClient._next_id}"
+        pinned = name is not None
+        if name is None:
+            name = f"client.fs{CephFSClient._next_id}"
         if keyring is not None:
-            keyring.add(name)
+            if pinned:
+                # a pinned name is a PROVISIONED identity: its key
+                # must already be in this keyring (auth get-or-create
+                # committed and the MAuthUpdate push landed). Minting
+                # a fresh key here would diverge from the mon's record
+                # and fail far from the cause — fail loudly instead.
+                if name not in keyring.keys:
+                    from ceph_tpu.msg.auth import AuthError
+                    raise AuthError(
+                        f"pinned entity {name} has no key in this "
+                        "keyring — did its auth get-or-create commit "
+                        "and propagate here yet?")
+            else:
+                keyring.add(name)
         # config reaches the owned objecter's tracer: without it a
         # cluster running trace_sampling_rate>0 would never see this
         # client's metadata/data roots (the cluster knobs only live in

@@ -253,11 +253,17 @@ class MDSDaemon(Dispatcher):
                  messenger: Messenger | None = None,
                  lease_timeout: float = 10.0,
                  revoke_timeout: float = 30.0,
-                 config: dict | None = None):
+                 config: dict | None = None,
+                 keyring=None):
         cfg = config or {}
         self.fs = CephFSLite(ioctx)
         self.ioctx = ioctx
         self.name = name
+        # the committed-caps table for the per-op request gate
+        # (ROADMAP #3b). NOT the messenger's keyring: client MDS-
+        # facing messengers are keyless, so the transport stays
+        # keyless-CRC; in HA mode create()'s monc keyring wins.
+        self.keyring = keyring
         self.msgr = messenger or Messenger(f"mds.{name}")
         self.msgr.add_dispatcher(self)
         self.sessions: dict[str, object] = {}       # client -> conn
@@ -1362,6 +1368,28 @@ class MDSDaemon(Dispatcher):
             log.dout(0, f"client request task failed: "
                         f"{t.exception()!r}")
 
+    def _req_cap_denied(self, entity: str) -> bool:
+        """Per-op MDS cap check (ref: MDSAuthCaps::is_capable, scoped
+        to the r/w class like the OSD/mon slices): True when the
+        sender has a committed cap table whose ``mds`` spec does not
+        grant writes. Capless entities stay unrestricted — the same
+        legacy-boot-key policy as the mon command and OSD admission
+        checks. The table reaches this daemon through a keyring fed
+        by the MAuthUpdate subscription (the monc's in HA mode, an
+        explicitly handed one standalone)."""
+        kr = None
+        if self.monc is not None:
+            kr = self.monc.msgr.keyring
+        if kr is None:
+            kr = self.keyring
+        if kr is None or not entity:
+            return False
+        caps = kr.caps_of(entity)
+        if not caps:
+            return False
+        from ceph_tpu.msg.auth import cap_allows
+        return not cap_allows(str(caps.get("mds", "")), "w")
+
     async def _handle_request(self, m: MClientRequest) -> None:
         if not self._active_event.is_set():
             # not (yet) the active rank: park — clients only target the
@@ -1415,7 +1443,11 @@ class MDSDaemon(Dispatcher):
         # completed-request dedup (ref: Session::have_completed_request):
         # a mutation replayed after failover must answer from the
         # table, not re-execute — a second rename/unlink would fail and
-        # a second create could truncate acknowledged data
+        # a second create could truncate acknowledged data. The dedup
+        # outranks the cap gate below: a mutation that ALREADY applied
+        # must keep answering its recorded result even if the entity's
+        # caps were narrowed after the fact (the at-most-once contract
+        # is about what happened, not what would be admitted today).
         if m.op in MUTATING_OPS:
             done = self._completed.get(m.src)
             if done is not None and m.tid in done:
@@ -1423,6 +1455,17 @@ class MDSDaemon(Dispatcher):
                     tid=m.tid, result=done[m.tid],
                     payload=b"(replayed)", cap_mode=0, cap_seq=0))
                 return
+        if m.op in MUTATING_OPS and self._req_cap_denied(m.src):
+            # per-op cap enforcement at the request gate (ROADMAP #3b,
+            # the MDS leg of PR 11's OSD admission check): an
+            # `mds r`-only entity's NEW mutation is refused -EPERM
+            # before the journal sees it — deterministic and
+            # unrecorded, so a replayed refusal re-refuses identically
+            await m.conn.send_message(MClientReply(
+                tid=m.tid, result=-1,
+                payload=b"EPERM: mds caps deny write",
+                cap_mode=0, cap_seq=0))
+            return
         result, payload, cap_mode, cap_seq = 0, b"", 0, 0
         try:
             if m.op in ("mkdir", "rmdir", "create", "unlink"):

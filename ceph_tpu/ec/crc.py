@@ -1,0 +1,167 @@
+"""CRC32 as GF(2) linear algebra — the fused checksum+encode plan.
+
+The EC write path stamps every shard with a write-time ``_hcrc``
+(zlib.crc32 of the shard bytes; the hinfo analog scrub-repair uses to
+LOCATE a corrupt shard). Historically that was three separate host-side
+``zlib.crc32`` sites in ``osd/ec_pg.py``; this module lets the checksum
+ride the SAME device program as the encode, so checksum+encode is one
+kernel launch per stripe batch.
+
+The decomposition (all facts pinned by tests/test_ec_agg.py):
+
+- ``raw(m) = zlib.crc32(m, 0xffffffff) ^ 0xffffffff`` is the init-free
+  CRC state machine. It is **linear over GF(2)** in the message bits
+  (``raw(a ^ b) = raw(a) ^ raw(b)`` for equal lengths), and
+  ``zlib.crc32(m) = raw(m) ^ zlib.crc32(b"\\0" * len(m))`` — the
+  init/final-xor affine part depends only on the length.
+- For a fixed row length C, ``raw`` of one row is a (32 x 8C) GF(2)
+  matrix ``G_C`` applied to the row's bits: ON DEVICE this is one int8
+  matmul per stripe batch (``(rows, 8C) @ (8C, 32) mod 2``), landing on
+  the MXU right next to the encode matmul — the fused pass emits a
+  uint32 row-CRC per shard row of the batch (data AND parity rows).
+- Rows concatenate through the fixed 32x32 "append C zero bytes"
+  operator ``M_C``: ``raw(A || B) = M_C(raw(A)) ^ raw(B)``. The
+  per-shard fold over a write's ``count`` rows is O(count) 32-bit host
+  ops on the device-produced row CRCs (vectorized across shards) — the
+  O(bytes) work stays on device, in the encode program.
+
+Everything here is host-side plan construction (numpy + zlib), cached
+per chunk size, exactly like the bit-matrix expansion in gf/tables.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import zlib
+
+import numpy as np
+
+_M32 = 0xFFFFFFFF
+
+
+def raw_crc(data: bytes, state: int = 0) -> int:
+    """The init-free CRC32 state machine (zlib pre/post-inverts
+    internally; this peels that off). Linear over GF(2) in the message
+    bits at state 0; composes: ``raw(a + b) = raw(b, raw(a))``."""
+    return zlib.crc32(data, state ^ _M32) ^ _M32
+
+
+@functools.lru_cache(maxsize=1)
+def _byte_table() -> np.ndarray:
+    """(256,) uint64: raw CRC of each single-byte message."""
+    return np.array([raw_crc(bytes([x])) for x in range(256)],
+                    dtype=np.uint64)
+
+
+def _zero_byte_update(state: np.ndarray) -> np.ndarray:
+    """Advance raw CRC state(s) by one zero message byte (vectorized)."""
+    t = _byte_table()
+    s = np.asarray(state, dtype=np.uint64)
+    return (s >> np.uint64(8)) ^ t[(s & np.uint64(0xFF)).astype(np.int64)]
+
+
+@functools.lru_cache(maxsize=8)
+def row_crc_matrix(chunk_size: int) -> np.ndarray:
+    """(8C, 32) int8 GF(2) matrix: bits of a C-byte row (LSB-first per
+    byte, matching gf.ops.unpack_bits) -> bits of the row's raw CRC.
+
+    Row 8p+b is the 32-bit contribution of byte position p, bit b —
+    built by walking the single-byte table backward through the
+    zero-byte-append operator (position p is followed by C-1-p zero
+    bytes in the row's state machine)."""
+    C = int(chunk_size)
+    contrib = np.zeros((C, 8), dtype=np.uint64)
+    contrib[C - 1] = _byte_table()[[1 << b for b in range(8)]]
+    for p in range(C - 2, -1, -1):
+        contrib[p] = _zero_byte_update(contrib[p + 1])
+    bits = (contrib[:, :, None] >> np.arange(32, dtype=np.uint64)) \
+        & np.uint64(1)
+    return bits.reshape(8 * C, 32).astype(np.int8)
+
+
+@functools.lru_cache(maxsize=8)
+def _shift_columns(chunk_size: int) -> np.ndarray:
+    """(32,) uint32-valued columns of M_C, the 'append C zero bytes'
+    operator on raw CRC states: column j = M_C applied to basis 2^j."""
+    cols = np.array([1 << j for j in range(32)], dtype=np.uint64)
+    for _ in range(int(chunk_size)):
+        cols = _zero_byte_update(cols)
+    return cols
+
+
+def combine_row_crcs(row_crcs: np.ndarray, chunk_size: int) -> np.ndarray:
+    """Fold per-row raw CRCs into per-shard raw CRCs.
+
+    ``row_crcs``: (..., count) uint32 — count C-byte rows per shard, in
+    concatenation order. Returns (...) uint64-valued raw CRC of each
+    shard's count*C bytes. O(count) vectorized 32-bit host ops — the
+    O(bytes) part already ran on device."""
+    rc = np.asarray(row_crcs, dtype=np.uint64)
+    cols = _shift_columns(chunk_size)
+    state = np.zeros(rc.shape[:-1], dtype=np.uint64)
+    j = np.arange(32, dtype=np.uint64)
+    for i in range(rc.shape[-1]):
+        bits = ((state[..., None] >> j) & np.uint64(1)).astype(bool)
+        state = np.bitwise_xor.reduce(
+            np.where(bits, cols, np.uint64(0)), axis=-1) ^ rc[..., i]
+    return state
+
+
+def _apply_cols(cols: np.ndarray, state: int) -> int:
+    """Apply a 32x32 GF(2) operator (given as its 32 basis-column
+    images) to one state."""
+    j = np.arange(32, dtype=np.uint64)
+    bits = ((np.uint64(state) >> j) & np.uint64(1)).astype(bool)
+    return int(np.bitwise_xor.reduce(
+        np.where(bits, cols, np.uint64(0))))
+
+
+@functools.lru_cache(maxsize=64)
+def _zero_crc(length: int) -> int:
+    """zlib.crc32 of `length` zero bytes — the affine (init/final-xor)
+    part of the checksum, a function of the length alone. Computed in
+    O(log length) by square-and-multiply over the append-one-zero-byte
+    operator (ref: crc32_combine) — materializing a length-sized zero
+    buffer here would re-introduce the O(bytes) host work the fused
+    path exists to offload."""
+    state = _M32            # the pre-inverted init register
+    cols = _zero_byte_update(
+        np.array([1 << j for j in range(32)], dtype=np.uint64))
+    n = int(length)
+    while n:
+        if n & 1:
+            state = _apply_cols(cols, state)
+        n >>= 1
+        if n:
+            # square the operator: image of basis j under cols∘cols
+            cols = np.array([_apply_cols(cols, int(c)) for c in cols],
+                            dtype=np.uint64)
+    return state ^ _M32
+
+
+def shard_crc32(row_crcs: np.ndarray, chunk_size: int) -> np.ndarray:
+    """Device-produced row CRCs -> zlib.crc32-equal per-shard values.
+
+    ``row_crcs``: (..., count) uint32 from the fused pass. Returns
+    (...) values equal to ``zlib.crc32`` of each shard's bytes."""
+    rc = np.asarray(row_crcs, dtype=np.uint64)
+    lin = combine_row_crcs(rc, chunk_size)
+    return lin ^ np.uint64(_zero_crc(rc.shape[-1] * int(chunk_size)))
+
+
+def hcrc_attr(shard_bytes: bytes, row_crcs=None,
+              chunk_size: int | None = None) -> bytes:
+    """The ONE producer of the ``_hcrc`` shard attribute (4 bytes LE).
+
+    Consumes the fused kernel's per-row CRC output when the caller has
+    one (``row_crcs``: (count,) uint32 for this shard, ``chunk_size``
+    required), and falls back to host-side ``zlib.crc32`` otherwise —
+    both producers are pinned byte-for-byte equal by test."""
+    if row_crcs is not None:
+        if not chunk_size:
+            raise ValueError(
+                "row_crcs needs the chunk size to combine")
+        v = int(shard_crc32(np.asarray(row_crcs), chunk_size))
+    else:
+        v = zlib.crc32(shard_bytes)
+    return int(v).to_bytes(4, "little")

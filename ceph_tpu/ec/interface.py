@@ -156,6 +156,16 @@ class ErasureCodeInterface(ABC):
         return np.stack([np.asarray(self.encode_chunks(data[b]))
                          for b in range(data.shape[0])])
 
+    def encode_batch_with_crc(self, data):
+        """(B, k, C) -> (parity (B, m, C), row_crcs (B, k+m) | None).
+
+        ``row_crcs`` are per-row raw CRC32 values (ec.crc) for every
+        data AND parity row of the batch, produced in the SAME device
+        program as the encode when the plugin supports fusion. Base
+        plugins return None — callers fall back to host zlib.crc32
+        (the ec.crc.hcrc_attr contract)."""
+        return self.encode_batch(data), None
+
     def decode_batch(self, want: Sequence[int], avail: Sequence[int],
                      chunks):
         """(B, len(avail), C) -> (B, len(want), C). Base: per-stripe."""

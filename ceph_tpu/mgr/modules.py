@@ -460,12 +460,40 @@ class PrometheusModule(MgrModule):
             lines.append("# ceph_perf: from daemon report sessions")
             for daemon, loggers in reported.items():
                 for logger, counters in loggers.items():
+                    if logger == "osd_ec_agg":
+                        # dedicated ceph_osd_ec_agg_* rows below —
+                        # rendering it here too would double the
+                        # family's cardinality every scrape
+                        continue
                     # the daemon's own logger renders bare counter
                     # names; a shared/auxiliary logger is prefixed so
                     # two loggers' counters can never collide
                     _perf_rows("ceph_daemon", daemon, counters,
                                prefix="" if logger == daemon
                                else f"{logger}.")
+            # per-OSD EC encode-aggregator rows (round 13): the
+            # coalescing layer's batches/stripes/flush-trigger
+            # counters plus the occupancy/wait long-run averages, as
+            # dedicated ceph_osd_ec_agg_* series from the REPORTED
+            # state (the aggregator's per-daemon counter family is
+            # register=False — it only exists through report sessions)
+            agg_rows: list[str] = []
+            for daemon, loggers in sorted(reported.items()):
+                agg = loggers.get("osd_ec_agg")
+                if not agg:
+                    continue
+                for key, val in sorted(agg.items()):
+                    if isinstance(val, dict) and "avgcount" in val:
+                        val = (val["sum"] / val["avgcount"]
+                               if val["avgcount"] else 0.0)
+                    if isinstance(val, (int, float)):
+                        agg_rows.append(
+                            f'ceph_osd_ec_agg_{key}'
+                            f'{{ceph_daemon="{daemon}"}} {val:.9g}')
+            if agg_rows:
+                lines.append("# ceph_osd_ec_agg_*: EC encode "
+                             "aggregator (reported)")
+                lines += agg_rows
             # per-OSD commit/apply latency from the reported
             # objectstore time-avgs (the `ceph osd perf` table)
             perf_digest = self.mgr.osd_perf_digest() if hasattr(

@@ -38,7 +38,8 @@ from ceph_tpu.osd.messages import (
 from ceph_tpu.osd.pg import PG
 from ceph_tpu.osd.recovery import AsyncReserver
 from ceph_tpu.osd.scheduler import (OpScheduler, QoSProfile,
-                                    SchedulerThrottle, _Grant)
+                                    SchedulerThrottle, _Grant,
+                                    size_scaled_cost)
 from ceph_tpu.osd.types import MAX_OID, pg_t
 from ceph_tpu.utils.logging import get_logger
 from ceph_tpu.utils.op_tracker import OpTracker
@@ -141,7 +142,7 @@ class OSD(Dispatcher):
         from ceph_tpu.mgr.client import MgrReporter
         self._mgr_reporter = MgrReporter(
             name, self.msgr, lambda: self.monc.mgrmap,
-            lambda: [self.perf], cfg)
+            lambda: [self.perf, self.ec_agg.perf], cfg)
         self._mgr_report_task: asyncio.Task | None = None
         self._slow_reported = 0     # last slow-op count sent monward
         self.asok = None
@@ -158,6 +159,11 @@ class OSD(Dispatcher):
         # rounds all dequeue through it (osd_op_queue=fifo reverts to
         # the pre-scheduler FIFO admission loop)
         self.scheduler = OpScheduler(cfg)
+        # EC encode aggregator (round 13): concurrent stripe encodes
+        # from every ECPG on this OSD coalesce into one padded batched
+        # kernel launch per flush window (osd_ec_agg knobs, read LIVE)
+        from ceph_tpu.osd.ec_aggregator import ECAggregator
+        self.ec_agg = ECAggregator(cfg)
         # recovery QoS: PR 2's side token bucket folded in as the
         # scheduler's `recovery` class (SchedulerThrottle keeps the
         # acquire/release shape every PG call site uses)
@@ -336,6 +342,7 @@ class OSD(Dispatcher):
                         "failsafe_full": self.failsafe_full(),
                         "backfill_toofull": self.backfill_toofull()},
                     "mapping": self._mapping_status(),
+                    "ec_agg": self.ec_agg.dump(),
                     "mgr_session": self._mgr_reporter.dump()},
                 "osd state summary")
             self.asok.register(
@@ -459,6 +466,7 @@ class OSD(Dispatcher):
             for task in pending:
                 task.cancel()
         self.scheduler.drain(release=self._release_admission)
+        self.ec_agg.drain()
         for pg in self.pgs.values():
             pg._drain_op_queue()
         if self.asok:
@@ -741,7 +749,8 @@ class OSD(Dispatcher):
                 msg._queue_span = op_span.child("queue")
             self.scheduler.submit(
                 msg, key=("client", entity, msg.pool),
-                profile=self._client_profile(entity, pg.pool))
+                profile=self._client_profile(entity, pg.pool),
+                cost=self._op_cost(msg))
             return True
         if isinstance(msg, MOSDRepOp):
             pg = self._pg_for(msg.pgid, create=True)
@@ -886,6 +895,21 @@ class OSD(Dispatcher):
         need = "w" if any(c in MUTATING_OPS for c in msg.op_codes) \
             else "r"
         return not cap_allows(str(caps.get("osd", "")), need)
+
+    def _op_cost(self, msg) -> float:
+        """Size-scaled dmClock cost over the op bundle's bytes, so a
+        4 MiB op is charged honestly against 4 KiB ops sharing the
+        weight (scheduler.size_scaled_cost — the same divisor the
+        recovery throttle charges). Writes carry their bytes in the
+        data blobs; READS carry theirs in op_lens with empty blobs —
+        both count, or a 4 MiB reader rides at the flat minimum
+        (a length-0 whole-object read still does: its size is
+        unknowable at admission, the reference mclock limitation)."""
+        datas = getattr(msg, "op_datas", ())
+        lens = getattr(msg, "op_lens", None) or (0,) * len(datas)
+        nbytes = sum(max(len(d), int(ln))
+                     for d, ln in zip(datas, lens))
+        return size_scaled_cost(self.config, nbytes)
 
     def _client_profile(self, entity: str, pool) -> QoSProfile:
         """QoS profile resolution for one client op: per-entity

@@ -23,11 +23,10 @@ every shard).
 from __future__ import annotations
 
 import asyncio
-import json
-import zlib
 
 import numpy as np
 
+from ceph_tpu.ec import crc as ec_crc
 from ceph_tpu.ec.registry import factory as ec_factory
 from ceph_tpu.os_.objectstore import StoreError, Transaction
 from ceph_tpu.osd.ecutil import StripeInfo
@@ -501,17 +500,24 @@ class ECPG(PG):
                 lo = max(new_size - base, 0)
                 buf[lo:] = 0
             trunc_stripes = self.sinfo.object_stripes(size)
-        # encode the touched range in one device call
+        # encode the touched range in one device call — routed through
+        # the OSD's cross-op aggregator, which coalesces concurrent
+        # encodes from every PG on this OSD into one padded batched
+        # launch per flush window (per-op path behind osd_ec_agg=off).
+        # A whole-object write also wants per-shard _hcrc stamps, so
+        # the flush runs the FUSED checksum+encode program and this op
+        # gets its shards' row CRCs back alongside the parity.
         C = self.sinfo.chunk_size
         data_chunks = buf.reshape(count, self.k, C)
-        parity = np.asarray(self.ec.encode_batch(data_chunks))
+        whole = write_full is not None
+        parity, row_crcs = await self._agg_encode(data_chunks,
+                                                  with_crc=whole)
         attrs_delta = dict(attrs_delta)
         attrs_delta["_v"] = _vblob(version)
         attrs_delta["_size"] = size.to_bytes(8, "little")
         # fan the per-shard sub-ops out (ref: ECBackend sub writes)
         tid = self.osd.next_tid()
         entry_blob = entry.encode()
-        whole = write_full is not None
         per_osd: dict[int, MOSDECSubOpWrite] = {}
         for pos, osd_id in enumerate(self.acting):
             if osd_id < 0 or not self.osd.osd_is_up(osd_id):
@@ -534,9 +540,14 @@ class ECPG(PG):
             # reading the rest, so it invalidates it — exactly the
             # reference's append-only hinfo discipline). Scrub repair
             # uses it to LOCATE a corrupt shard, which the code alone
-            # cannot do at m=1.
-            attrs["_hcrc"] = zlib.crc32(shard_bytes).to_bytes(
-                4, "little") if whole else b""
+            # cannot do at m=1. The value comes from the fused
+            # checksum+encode pass when it ran (hcrc_attr combines the
+            # device row CRCs; zlib fallback otherwise — pinned equal).
+            attrs["_hcrc"] = ec_crc.hcrc_attr(
+                shard_bytes,
+                row_crcs=row_crcs[:, pos]
+                if row_crcs is not None else None,
+                chunk_size=C) if whole else b""
             per_osd[osd_id] = MOSDECSubOpWrite(
                 tid=tid, epoch=self.epoch, pgid=self.cid, oid=oid,
                 first_stripe=first, data=shard_bytes,
@@ -753,10 +764,30 @@ class ECPG(PG):
                 best = (ver, size)
         return best
 
+    async def _agg_encode(self, data_chunks, with_crc: bool = False):
+        """Every ECPG encode routes through the OSD's cross-op
+        aggregator (osd/ec_aggregator.py); the per-op launch survives
+        behind ``osd_ec_agg=off`` inside it. Bare harnesses without a
+        daemon aggregator take a direct (still fused) call. Returns
+        ``(parity np(B, m, C), row_crcs np(B, k+m) | None)``."""
+        agg = getattr(self.osd, "ec_agg", None)
+        if agg is not None:
+            return await agg.encode(self.ec, data_chunks,
+                                    with_crc=with_crc)
+        if with_crc:
+            parity, crcs = self.ec.encode_batch_with_crc(data_chunks)
+            return np.asarray(parity), \
+                (None if crcs is None else np.asarray(crcs))
+        return np.asarray(self.ec.encode_batch(data_chunks)), None
+
     async def _rebuild_shard(self, oid: str, shard: int, ver: eversion,
                              size: int, apply_local: bool = False,
                              exclude_osds: frozenset = frozenset()
-                             ) -> bytes:
+                             ) -> tuple[bytes, bytes]:
+        """Regenerate position ``shard``'s bytes from k live shards.
+        Returns ``(shard_bytes, hcrc)`` — the write-time checksum
+        comes from the fused checksum+encode pass when an encode ran
+        (parity shards), and the hcrc_attr zlib fallback otherwise."""
         count = self.sinfo.object_stripes(size) or 1
         # never source the holder being rebuilt: its stored bytes are
         # missing, stale, or corrupt — rebuilding FROM them would
@@ -767,22 +798,27 @@ class ECPG(PG):
                                          exclude_osds=exclude_osds)
         if shard < self.k:
             shard_bytes = data_chunks[:, shard, :].tobytes()
+            hcrc = ec_crc.hcrc_attr(shard_bytes)
         else:
-            parity = np.asarray(self.ec.encode_batch(data_chunks))
+            parity, row_crcs = await self._agg_encode(data_chunks,
+                                                      with_crc=True)
             shard_bytes = parity[:, shard - self.k, :].tobytes()
+            hcrc = ec_crc.hcrc_attr(
+                shard_bytes,
+                row_crcs=row_crcs[:, shard]
+                if row_crcs is not None else None,
+                chunk_size=self.sinfo.chunk_size)
         if apply_local:
-            import zlib as _zlib
             t = Transaction()
             t.remove(self.cid, oid)
             t.write(self.cid, oid, 0, shard_bytes)
             attrs = {"_v": _vblob(ver),
                      "_size": size.to_bytes(8, "little"),
                      "_pos": self._pos_attr(shard),
-                     "_hcrc": _zlib.crc32(shard_bytes).to_bytes(
-                         4, "little")}
+                     "_hcrc": hcrc}
             t.setattrs(self.cid, oid, attrs)
             self.osd.store.queue_transaction(t)
-        return shard_bytes
+        return shard_bytes, hcrc
 
     def make_push(self, oid: str, target: int | None = None):
         raise NotImplementedError("EC pushes are built asynchronously")
@@ -805,7 +841,7 @@ class ECPG(PG):
                     version_epoch=0, version_v=0, exists=False,
                     data=b"", attrs={}, omap={},
                     from_osd=self.osd.whoami)
-            shard_bytes = await self._rebuild_shard(
+            shard_bytes, hcrc = await self._rebuild_shard(
                 oid, pos, ver, size,
                 exclude_osds=frozenset({target}))
             omap = {}
@@ -813,7 +849,6 @@ class ECPG(PG):
                 omap = dict(self.osd.store.omap_get(self.cid, oid))
             except StoreError:
                 pass
-            import zlib as _zlib
             return MOSDPGPush(
                 pgid=self.cid, epoch=self.epoch, oid=oid,
                 version_epoch=ver.epoch, version_v=ver.v,
@@ -821,8 +856,7 @@ class ECPG(PG):
                 attrs={"_v": _vblob(ver),
                        "_size": size.to_bytes(8, "little"),
                        "_pos": self._pos_attr(pos),
-                       "_hcrc": _zlib.crc32(shard_bytes).to_bytes(
-                           4, "little")},
+                       "_hcrc": hcrc},
                 omap=omap, from_osd=self.osd.whoami)
         except Exception as e:
             log.dout(1, f"pg {self.pgid} ec push {oid}->osd.{target} "

@@ -79,6 +79,17 @@ QOS_PERF = (
 INF = float("inf")
 
 
+def size_scaled_cost(config: dict, nbytes: int) -> float:
+    """dmClock size-scaled cost (ROADMAP #3a; ref: the mclock
+    cost-per-byte options): an op advances its queue's virtual time
+    by ``max(1, bytes / osd_qos_cost_per_io_bytes)`` tag units
+    instead of a flat 1. ONE definition — client admission
+    (daemon._op_cost) and the recovery throttle charge through it,
+    so the two paths can never silently diverge."""
+    per_io = int(config.get("osd_qos_cost_per_io_bytes", 65536))
+    return max(1.0, nbytes / max(per_io, 1))
+
+
 @dataclass(frozen=True)
 class QoSProfile:
     """One queue's dmClock parameters. ``reservation``/``limit`` are
@@ -451,7 +462,13 @@ class SchedulerThrottle:
 
     async def acquire(self, nbytes: int = 0):
         if self.scheduler is not None:
-            await self.scheduler.grant("recovery", cost=1.0)
+            # size-scaled cost (ROADMAP #3a), same divisor the client
+            # admission path charges: a 4 MiB recovery push pays its
+            # bytes against the recovery reservation instead of
+            # looking as cheap as a metadata-only push
+            await self.scheduler.grant(
+                "recovery",
+                cost=size_scaled_cost(self.scheduler.config, nbytes))
         return await self._legacy.acquire(nbytes)
 
     def op(self, nbytes: int = 0):

@@ -158,6 +158,29 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("osd_qos_scrub_limit", float, 10.0,
            "scrub-class limit in scrub rounds/s (0 = unlimited)",
            min=0.0),
+    Option("osd_qos_cost_per_io_bytes", int, 65536,
+           "dmClock cost divisor: an op is charged "
+           "max(1, bytes / this) tag units, so a 4 MiB writer pays "
+           "its size honestly against 4 KiB writers instead of the "
+           "flat per-op cost (doubly important once the EC "
+           "aggregator makes many-small-writes cheap to encode)",
+           min=1),
+    # EC encode aggregator (round 13; the cross-op stripe-batch
+    # coalescing layer in osd/ec_aggregator.py). Read LIVE per encode,
+    # so osd_ec_agg=false flips a running OSD to the measured per-op
+    # baseline path.
+    Option("osd_ec_agg", bool, True,
+           "coalesce concurrent EC stripe encodes from all PGs on "
+           "this OSD into one padded batched kernel launch per flush "
+           "window; false = the per-op-launch baseline path"),
+    Option("osd_ec_agg_window_us", float, 500.0,
+           "EC aggregator flush window in microseconds — the hard "
+           "bound on how long a lone op's encode may wait for "
+           "company", min=0.0),
+    Option("osd_ec_agg_max_stripes", int, 4096,
+           "stripes that force an immediate aggregator flush (the "
+           "batch-size ceiling; also bounds the padded launch's "
+           "memory)", min=1),
     Option("osd_qos_backlog_cap", int, 4096,
            "OSD-wide admission backlog bound across ALL tenants "
            "(per-tenant queues are capped by osd_pg_op_queue_cap; "

@@ -1,0 +1,347 @@
+"""Round 13: the EC data path at production traffic — the OSD-side
+cross-op encode aggregator, the fused checksum+encode program, and the
+double-buffered streaming pipeline.
+
+ref test model: the per-op vs batched equivalence discipline of
+PR 10's sharded-sweep tests + src/test/osd EC determinism pins. Units
+only (the live-cluster acceptance rides tests/test_ec_cluster.py):
+
+- **CRC algebra** — the GF(2) decomposition ec/crc.py stands on:
+  ``raw`` linearity, the length-only affine split, the per-row bit
+  matrix vs zlib, the row->shard combine, and the two ``hcrc_attr``
+  producers (fused row CRCs vs host zlib) byte-for-byte equal;
+- **fused encode+CRC** — one device program returns the SAME parity as
+  the plain kernel plus per-row CRCs that fold to ``zlib.crc32`` of
+  every shard (data AND parity positions);
+- **aggregator** — concurrent ops coalesce into fewer launches with
+  lane-for-lane identical results, every flush trigger fires
+  (full/window/idle, a lone op never held past the window), the
+  ``osd_ec_agg=off`` baseline bypasses, padding is pow2-bounded, and
+  drain cancels cleanly;
+- **pipeline** — StreamingEncodePipeline's outputs equal per-batch
+  encodes, in order.
+
+One module-scoped plugin instance: every test shares its jit cache
+(tier-1 runs near the wall-clock cap — compiles are the budget).
+"""
+
+import asyncio
+import zlib
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import crc as ec_crc
+from ceph_tpu.ec.interface import ErasureCodeInterface
+from ceph_tpu.ec.jax_plugin import ErasureCodeJax, StreamingEncodePipeline
+from ceph_tpu.osd.ec_aggregator import ECAggregator
+
+K, M, C = 3, 2, 64
+N = K + M
+
+
+@pytest.fixture(scope="module")
+def ec():
+    return ErasureCodeJax(
+        f"plugin=jax k={K} m={M} technique=reed_sol_van")
+
+
+def _rng(seed=13):
+    return np.random.default_rng(seed)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# -- CRC algebra (host-side; the facts the fused pass stands on) -----------
+
+def test_raw_crc_linearity_and_affine_split():
+    """``raw`` is GF(2)-linear in the message bits; zlib.crc32 is raw
+    plus a length-only constant; raw composes through its own state."""
+    rng = _rng(1)
+    for ln in (1, 7, 64, 513):
+        a = rng.integers(0, 256, ln, dtype=np.uint8).tobytes()
+        b = rng.integers(0, 256, ln, dtype=np.uint8).tobytes()
+        x = bytes(p ^ q for p, q in zip(a, b))
+        assert ec_crc.raw_crc(x) == \
+            ec_crc.raw_crc(a) ^ ec_crc.raw_crc(b)
+        assert zlib.crc32(a) == \
+            ec_crc.raw_crc(a) ^ zlib.crc32(b"\x00" * ln)
+        assert ec_crc.raw_crc(a + b) == \
+            ec_crc.raw_crc(b, ec_crc.raw_crc(a))
+    # the affine constant comes from O(log n) operator squaring, not
+    # a length-sized zero buffer — pin it against zlib across scales
+    for ln in (0, 1, 513, 65537, 1 << 20):
+        assert ec_crc._zero_crc(ln) == zlib.crc32(b"\x00" * ln), ln
+
+
+def test_row_crc_matrix_matches_zlib():
+    """The (8C, 32) GF(2) matrix applied to a row's bits (LSB-first
+    per byte) IS the row's raw CRC — the device leg of the fusion."""
+    rng = _rng(2)
+    G = ec_crc.row_crc_matrix(C)
+    assert G.shape == (8 * C, 32)
+    for _ in range(4):
+        row = rng.integers(0, 256, C, dtype=np.uint8)
+        bits = ((row[:, None] >> np.arange(8)) & 1).reshape(-1)
+        acc = (bits.astype(np.int64) @ G.astype(np.int64)) & 1
+        val = int((acc.astype(np.uint64) <<
+                   np.arange(32, dtype=np.uint64)).sum())
+        assert val == ec_crc.raw_crc(row.tobytes())
+
+
+def test_hcrc_attr_producers_agree_byte_for_byte():
+    """The unified ``_hcrc`` helper's two producers — device row CRCs
+    folded through the combine vs host ``zlib.crc32`` — agree on the
+    full attribute bytes for multi-row shards of several lengths."""
+    rng = _rng(3)
+    for count in (1, 2, 5, 16):
+        rows = rng.integers(0, 256, (count, C), dtype=np.uint8)
+        shard = rows.tobytes()
+        row_crcs = np.array(
+            [ec_crc.raw_crc(r.tobytes()) for r in rows],
+            dtype=np.uint32)
+        assert int(ec_crc.shard_crc32(row_crcs, C)) == \
+            zlib.crc32(shard), count
+        assert ec_crc.hcrc_attr(shard, row_crcs=row_crcs,
+                                chunk_size=C) == \
+            ec_crc.hcrc_attr(shard) == \
+            zlib.crc32(shard).to_bytes(4, "little")
+
+
+# -- fused checksum+encode -------------------------------------------------
+
+def test_fused_encode_crc_bit_exact(ec):
+    """One device program: parity identical to the plain kernel, and
+    the per-row CRCs fold to zlib.crc32 of EVERY shard position's
+    bytes (data and parity) — the acceptance pin for the fused
+    ``_hcrc`` stamps."""
+    rng = _rng(4)
+    data = rng.integers(0, 256, (5, K, C), dtype=np.uint8)
+    parity, crcs = ec.encode_batch_with_crc(data)
+    parity, crcs = np.asarray(parity), np.asarray(crcs)
+    assert (parity == np.asarray(ec.encode_batch(data))).all()
+    assert crcs.shape == (5, N) and crcs.dtype == np.uint32
+    word = np.concatenate([data, parity], axis=1)
+    for pos in range(N):
+        shard = word[:, pos, :].tobytes()     # the ec_pg shard layout
+        assert ec_crc.hcrc_attr(shard, row_crcs=crcs[:, pos],
+                                chunk_size=C) == \
+            zlib.crc32(shard).to_bytes(4, "little"), pos
+
+
+def test_base_interface_fused_is_optional():
+    """A plugin without a fused path returns ``(parity, None)`` from
+    the base ``encode_batch_with_crc`` — callers fall back to host
+    zlib via hcrc_attr (the aggregator then hands back None CRCs)."""
+    from ceph_tpu.ec.lrc import ErasureCodeLrc
+    lrc = ErasureCodeLrc("plugin=lrc k=4 m=2 l=3")
+    assert lrc.encode_batch_with_crc.__func__ is \
+        ErasureCodeInterface.encode_batch_with_crc
+    rng = _rng(5)
+    data = rng.integers(0, 256, (2, 4, 32), dtype=np.uint8)
+    parity, crcs = lrc.encode_batch_with_crc(data)
+    assert crcs is None
+    assert (np.asarray(parity) ==
+            np.asarray(lrc.encode_batch(data))).all()
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True})
+        p, c = await agg.encode(lrc, data, with_crc=True)
+        assert c is None
+        assert (p == np.asarray(lrc.encode_batch(data))).all()
+    run(go())
+
+
+# -- the aggregator --------------------------------------------------------
+
+def test_aggregator_coalesces_bit_exact(ec):
+    """Concurrent ops (non-pow2 sizes, mixed with_crc) coalesce into
+    FEWER launches than ops, and every op's slice equals its own
+    per-op encode lane for lane — the bit-exactness contract."""
+    rng = _rng(6)
+    ops = [rng.integers(0, 256, (b, K, C), dtype=np.uint8)
+           for b in (1, 3, 2, 5, 1, 3, 2)]
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True,
+                            "osd_ec_agg_window_us": 2000.0})
+        outs = await asyncio.gather(*[
+            agg.encode(ec, d, with_crc=(i % 2 == 0))
+            for i, d in enumerate(ops)])
+        d = agg.dump()
+        assert 1 <= d["batches"] < len(ops)
+        assert d["ops"] == len(ops)
+        assert d["stripes"] == sum(o.shape[0] for o in ops)
+        for i, (dat, (p, c)) in enumerate(zip(ops, outs)):
+            assert (np.asarray(p) ==
+                    np.asarray(ec.encode_batch(dat))).all(), i
+            if i % 2 == 0:
+                word = np.concatenate(
+                    [dat, np.asarray(p)], axis=1)
+                for pos in range(N):
+                    assert ec_crc.hcrc_attr(
+                        word[:, pos, :].tobytes(),
+                        row_crcs=c[:, pos], chunk_size=C) == \
+                        ec_crc.hcrc_attr(word[:, pos, :].tobytes())
+            else:
+                assert c is None, i
+    run(go())
+
+
+def test_aggregator_full_trigger(ec):
+    """``osd_ec_agg_max_stripes`` forces an immediate flush — the
+    batch-size ceiling fires before any window elapses."""
+    rng = _rng(7)
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True,
+                            "osd_ec_agg_window_us": 1e6,
+                            "osd_ec_agg_max_stripes": 4})
+        ops = [rng.integers(0, 256, (2, K, C), dtype=np.uint8)
+               for _ in range(4)]
+        t0 = asyncio.get_event_loop().time()
+        await asyncio.gather(*[agg.encode(ec, d) for d in ops])
+        took = asyncio.get_event_loop().time() - t0
+        d = agg.dump()
+        assert d["flushes"]["full"] >= 1
+        assert took < 1.0      # nobody waited for the 1s window
+    run(go())
+
+
+def test_aggregator_lone_op_never_held_past_window(ec):
+    """A lone op flushes EARLY on queue idleness — and in any case
+    inside the window (here 10s, so a window-bound wait would hang
+    the assertion far past the observed bound)."""
+    rng = _rng(8)
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True,
+                            "osd_ec_agg_window_us": 10e6})
+        d = rng.integers(0, 256, (1, K, C), dtype=np.uint8)
+        t0 = asyncio.get_event_loop().time()
+        p, _ = await agg.encode(ec, d)
+        took = asyncio.get_event_loop().time() - t0
+        assert (p == np.asarray(ec.encode_batch(d))).all()
+        assert took < 9.0, "lone op pinned to the window"
+        assert agg.dump()["flushes"]["idle"] == 1
+    run(go())
+
+
+def test_aggregator_window_trigger(ec):
+    """An expired window flushes whatever accumulated (window ~0:
+    the first flusher wake is already past the deadline)."""
+    rng = _rng(9)
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True,
+                            "osd_ec_agg_window_us": 0.0})
+        ops = [rng.integers(0, 256, (1, K, C), dtype=np.uint8)
+               for _ in range(2)]
+        await asyncio.gather(*[agg.encode(ec, d) for d in ops])
+        assert agg.dump()["flushes"]["window"] >= 1
+    run(go())
+
+
+def test_aggregator_off_is_per_op_baseline(ec):
+    """``osd_ec_agg=off`` (read LIVE) serves every encode per-op:
+    no batches, a bypass count, identical results — the measured
+    baseline the bench compares against."""
+    rng = _rng(10)
+    ops = [rng.integers(0, 256, (2, K, C), dtype=np.uint8)
+           for _ in range(3)]
+
+    async def go():
+        cfg = {"osd_ec_agg": False}
+        agg = ECAggregator(cfg)
+        for d in ops:
+            p, c = await agg.encode(ec, d, with_crc=True)
+            assert (p == np.asarray(ec.encode_batch(d))).all()
+            assert c is not None      # fusion is orthogonal to agg
+        dmp = agg.dump()
+        assert dmp["batches"] == 0 and dmp["bypass"] == len(ops)
+        assert dmp["enabled"] is False
+        # live flip back on: the same instance coalesces again
+        cfg["osd_ec_agg"] = True
+        await asyncio.gather(*[agg.encode(ec, d) for d in ops])
+        assert agg.dump()["batches"] >= 1
+    run(go())
+
+
+def test_aggregator_pads_to_pow2(ec):
+    """Padded launch sizes bound the jit cache to O(log max_batch)
+    shapes, and the pad rows never leak into results."""
+    for b, want in ((1, 1), (2, 2), (3, 4), (5, 8), (9, 16),
+                    (4096, 4096)):
+        assert ECAggregator._pad(b) == want, b
+    rng = _rng(11)
+    agg = ECAggregator({})
+    d = rng.integers(0, 256, (5, K, C), dtype=np.uint8)  # pads to 8
+    launched = []
+
+    class _Spy:
+        profile = "spy"
+
+        def encode_batch(self, data):
+            launched.append(data.shape[0])
+            return ec.encode_batch(data)
+
+        def encode_batch_with_crc(self, data):
+            launched.append(data.shape[0])
+            return ec.encode_batch_with_crc(data)
+
+    p, crcs = agg._run(_Spy(), d, True)
+    assert launched == [8]              # flush path pads 5 -> 8
+    assert p.shape == (5, M, C)
+    assert crcs.shape == (5, N)
+    assert (p == np.asarray(ec.encode_batch(d))).all()
+    # the osd_ec_agg=off bypass is the UNPADDED historical per-op
+    # launch — the measured baseline must not pay pad compute the
+    # pre-aggregator path never paid
+    p2, _ = agg._run(_Spy(), d, False, pad=False)
+    assert launched == [8, 5]
+    assert (p2 == p).all()
+
+
+def test_aggregator_drain_cancels_waiters(ec):
+    """Daemon stop: pending waiters are CANCELLED (their PG op workers
+    are going down too), timers die, and the stopped aggregator serves
+    later stragglers per-op instead of queueing them forever."""
+    rng = _rng(12)
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True,
+                            "osd_ec_agg_window_us": 10e6,
+                            "osd_ec_agg_max_stripes": 1 << 20})
+        d = rng.integers(0, 256, (1, K, C), dtype=np.uint8)
+        waiter = asyncio.ensure_future(agg.encode(ec, d))
+        await asyncio.sleep(0)          # entry lands, timer armed
+        assert agg.drain() == 1
+        with pytest.raises(asyncio.CancelledError):
+            await waiter
+        assert agg.dump()["pending_ops"] == 0
+        p, _ = await agg.encode(ec, d)  # straggler: served, per-op
+        assert (p == np.asarray(ec.encode_batch(d))).all()
+    run(go())
+
+
+# -- the double-buffered streaming pipeline --------------------------------
+
+def test_streaming_pipeline_matches_per_batch(ec):
+    """Pipelined outputs equal per-batch encodes, in submission
+    order; zero- and one-batch streams behave."""
+    rng = _rng(14)
+    batches = [rng.integers(0, 256, (2, K, C), dtype=np.uint8)
+               for _ in range(5)]
+    pipe = StreamingEncodePipeline(ec)
+    outs = pipe.encode_all([b.copy() for b in batches])
+    assert len(outs) == len(batches)
+    for i, (b, o) in enumerate(zip(batches, outs)):
+        assert (np.asarray(o) ==
+                np.asarray(ec.encode_batch(b))).all(), i
+    assert pipe.encode_all([]) == []
+    one = pipe.encode_all([batches[0].copy()])
+    assert len(one) == 1 and (
+        np.asarray(one[0]) ==
+        np.asarray(ec.encode_batch(batches[0]))).all()

@@ -329,13 +329,27 @@ def _render_prometheus(reported: bool = False) -> str:
                   .add_histogram("op_w_latency_hist",
                                  "guard fixture")
                   .create_perf_counters(register=False))
-            idx.report(name, 1, schema_entries([pc]), 1.0, {name: {
-                "ops": 7,
-                "commit_latency": {"avgcount": 2, "sum": 0.01},
-                "apply_latency": {"avgcount": 2, "sum": 0.008},
-                "op_w_latency_hist": {
-                    "count": 7, "sum": 900.0,
-                    "log2_buckets": buckets}}})
+            # the round-13 EC-aggregator family reaches /metrics ONLY
+            # through report sessions (register=False per OSD) — seed
+            # it so the dedicated ceph_osd_ec_agg_* render path stays
+            # inside the exposition-format guards
+            agg = (PerfCountersBuilder("osd_ec_agg")
+                   .add_u64_counter("batches", "guard fixture")
+                   .add_u64_counter("stripes", "guard fixture")
+                   .add_time_avg("batch_occupancy", "guard fixture")
+                   .create_perf_counters(register=False))
+            idx.report(name, 1, schema_entries([pc, agg]), 1.0, {
+                name: {
+                    "ops": 7,
+                    "commit_latency": {"avgcount": 2, "sum": 0.01},
+                    "apply_latency": {"avgcount": 2, "sum": 0.008},
+                    "op_w_latency_hist": {
+                        "count": 7, "sum": 900.0,
+                        "log2_buckets": buckets}},
+                "osd_ec_agg": {
+                    "batches": 3, "stripes": 96,
+                    "batch_occupancy": {"avgcount": 3,
+                                        "sum": 96.0}}})
     else:
         # make sure at least one histogram is non-empty so the
         # _bucket rendering path is exercised by the guard
@@ -354,6 +368,12 @@ def _render_prometheus(reported: bool = False) -> str:
             in text, text
         assert "ceph_osd_commit_latency_ms{" in text
         assert 'ceph_perf{daemon=' not in text
+        # round 13: the aggregator's dedicated rows (counters plain,
+        # time-avgs rendered as their long-run mean)
+        assert 'ceph_osd_ec_agg_batches{ceph_daemon="osd.0"} 3' \
+            in text, text
+        assert 'ceph_osd_ec_agg_batch_occupancy' \
+            '{ceph_daemon="osd.1"} 32' in text, text
     return text
 
 
@@ -465,6 +485,34 @@ def test_telemetry_knobs_registered_with_defaults():
     `config show` in every daemon."""
     _assert_knobs_registered(
         ("mgr_stats_", "mgr_progress_", "mgr_beacon_"), "telemetry")
+
+
+def test_ec_agg_knobs_registered_with_defaults():
+    """Round 13: every EC-aggregator knob (`osd_ec_agg*`) read
+    anywhere must be a registered Option with a default — the
+    aggregator reads them LIVE per encode, so an unregistered knob
+    silently diverges from `config show`. (The companion
+    `osd_qos_cost_per_io_bytes` rides the QoS-prefix guard above.)"""
+    _assert_knobs_registered(("osd_ec_agg",), "EC aggregator")
+
+
+def test_ec_streaming_bench_schema():
+    """The round-13 `ec_streaming` bench section at a smoke size:
+    JSON-clean, carries every driver-required key (the three measured
+    legs + resident reference + the `ec_agg_within_2x` verdict), and
+    the verdict is a real bool — schema drift fails here before the
+    driver's record goes stale. The within-2x CLAIM itself is pinned
+    on TPU only; this guard pins the shape."""
+    from ceph_tpu.bench.ec_streaming import ec_streaming_section
+    rec = ec_streaming_section(n_ops=4, stripes_per_op=2,
+                               chunk_size=128, k=2, m=1, reps=1)
+    for key in ("aggregated_GiBs", "per_op_GiBs", "pipeline_GiBs",
+                "resident_GiBs"):
+        assert isinstance(rec[key], float) and rec[key] > 0, key
+    assert isinstance(rec["ec_agg_within_2x"], bool)
+    assert rec["agg_batches"] >= 1
+    assert rec["n_ops"] == 4 and rec["k"] == 2 and rec["m"] == 1
+    assert json.loads(json.dumps(rec)) == rec   # JSON-clean
 
 
 def test_mgr_report_schema_types_cover_perf_counters():

@@ -60,6 +60,58 @@ def test_scheduler_weight_split():
     assert got == {"hot": 30, "cold": 10}
 
 
+def test_scheduler_cost_scaled_weight_split():
+    """Round 13 (ROADMAP #3a): ops charge size-scaled cost, so equal
+    WEIGHTS split BYTES, not op counts — a 4 MiB writer (cost 64 at
+    the 64 KiB divisor) gets one grant per 64 of a 4 KiB writer's,
+    and both move the same payload through the window."""
+    clock, s = _vclock_sched()
+    for i in range(4):
+        s.submit(("big", i), key=("client", "big", 1),
+                 profile=QoSProfile(weight=1.0), cost=64.0)
+    for i in range(256):
+        s.submit(("small", i), key=("client", "small", 1),
+                 profile=QoSProfile(weight=1.0), cost=1.0)
+    clock[0] = 1000.0
+    got = {"big": 0, "small": 0}
+    for _ in range(130):
+        item, _cls = s.try_dequeue()
+        got[item[0]] += 1
+    # p-tags: big at 64,128,... / small at 1,2,3,... -> 130 grants
+    # serve small through p=128 and big through p=128: 64x the ops,
+    # equal bytes (2 * 4 MiB == 128 * 64 KiB)
+    assert got == {"big": 2, "small": 128}
+
+
+def test_osd_op_cost_is_size_scaled():
+    """The admission path's cost stamp: max(1, bytes/divisor) over
+    the op bundle, divisor read LIVE from osd_qos_cost_per_io_bytes.
+    Writes charge their payload blobs; reads charge their requested
+    op_lens (empty blobs) — a 4 MiB reader must not ride at the
+    flat minimum."""
+    from types import SimpleNamespace
+
+    from ceph_tpu.osd.daemon import OSD
+
+    def m(datas, lens=None):
+        return SimpleNamespace(
+            op_datas=datas,
+            op_lens=lens if lens is not None
+            else [len(d) for d in datas])
+    cost = OSD._op_cost
+    host = SimpleNamespace(config={})
+    assert cost(host, m([])) == 1.0
+    assert cost(host, m([b"x" * 100])) == 1.0
+    assert cost(host, m([b"x" * (4 << 20)])) == 64.0
+    assert cost(host, m([b"x" * (1 << 16), b"y" * (1 << 16)])) == 2.0
+    # a read: empty data blob, size in op_lens
+    assert cost(host, m([b""], lens=[4 << 20])) == 64.0
+    # whole-object read (length 0): size unknowable at admission
+    assert cost(host, m([b""], lens=[0])) == 1.0
+    host.config = {"osd_qos_cost_per_io_bytes": 1 << 20}
+    assert cost(host, m([b"x" * (4 << 20)])) == 4.0
+
+
 def test_scheduler_reservation_floor_under_flood():
     """A reserved tenant gets >= its reservation IOPS even when a
     floodier tenant has thousands queued — the hard floor the
@@ -534,6 +586,112 @@ def test_per_op_cap_matrix_paxos_spans_and_stop_leak():
                 w.cancel()
             await asyncio.gather(*writers, return_exceptions=True)
         finally:
+            await c.stop()
+    run(go())
+
+
+def test_mds_per_op_cap_matrix():
+    """Round 13 (ROADMAP #3b): the MDS leg of per-op cap enforcement.
+    An ``mds r``-only entity's mutation is refused -EPERM at the MDS
+    request gate (before the dedup table or the journal see it);
+    reads still serve; an ``mds rw`` entity and a capless legacy
+    entity stay unrestricted — the same admission matrix the OSD
+    pins above."""
+    async def go():
+        from ceph_tpu.cephfs import FSError
+        from ceph_tpu.cephfs.client import CephFSClient
+        from ceph_tpu.cephfs.mds import MDSDaemon
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        mounts = []
+        mds = None
+        try:
+            await c.client.pool_create("fs", pg_num=8)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("fs")
+            for _ in range(30):
+                try:
+                    await io.write_full("_warm", b"x")
+                    break
+                except ObjectOperationError:
+                    await asyncio.sleep(1)
+            for entity, mdscap in (("client.fsro", "allow r"),
+                                   ("client.fsrw", "allow rw")):
+                ret, rs, _ = await c.client.mon_command(
+                    {"prefix": "auth get-or-create",
+                     "entity": entity,
+                     "caps": {"mds": mdscap, "osd": "allow rw",
+                              "mon": "allow r"}})
+                assert ret == 0, rs
+            # committed caps reach every shared-keyring holder via
+            # the MAuthUpdate push; the MDS reads the same table
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while c.keyring.caps_of("client.fsro").get("mds") != \
+                    "allow r":
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.05)
+            mds = MDSDaemon(io, keyring=c.keyring)
+            await mds.fs.mount()
+            addr = await mds.start()
+            monmap = c.client.monc.monmap
+            ro = await CephFSClient.create(
+                monmap, addr, "fs", keyring=c.keyring,
+                name="client.fsro", config=c.cfg)
+            rw = await CephFSClient.create(
+                monmap, addr, "fs", keyring=c.keyring,
+                name="client.fsrw", config=c.cfg)
+            legacy = await CephFSClient.create(
+                monmap, addr, "fs", keyring=c.keyring,
+                config=c.cfg)       # fresh capless entity
+            mounts += [ro, rw, legacy]
+            # matrix: (entity, mutation allowed)
+            with pytest.raises(FSError) as ei:
+                await ro.mkdir("/denied")
+            assert ei.value.errno == -1       # -EPERM at the gate
+            # ...and the refusal never reached the journal or the
+            # dedup table (a replay must re-refuse, not re-execute)
+            assert not mds._completed.get("client.fsro")
+            await rw.mkdir("/ok")
+            await legacy.mkdir("/legacy-ok")
+            # reads stay open to the r-only entity
+            names = set(await ro.ls("/"))
+            assert {"ok", "legacy-ok"} <= names
+            # the write CLASS is what's gated, not the entity: rw's
+            # unlink passes the same gate
+            await rw.rmdir("/ok")
+            # replay-after-narrowing: a mutation that ALREADY applied
+            # keeps answering its recorded result even if the
+            # entity's caps narrow afterwards — the dedup table
+            # outranks the cap gate (at-most-once is about what
+            # happened, not what would be admitted today)
+            done = dict(mds._completed.get("client.fsrw") or {})
+            assert done
+            tid, recorded = next(iter(done.items()))
+            c.keyring.set_caps("client.fsrw", {"mds": "allow r"})
+            from ceph_tpu.cephfs.mds import MClientRequest
+            replies = []
+
+            class _Conn:
+                async def send_message(self, msg):
+                    replies.append(msg)
+            req = MClientRequest(tid=tid, op="mkdir", path="/ok",
+                                 path2="", flags=0)
+            req.src = "client.fsrw"
+            req.conn = _Conn()
+            await mds._serve_request(req)
+            assert replies and replies[0].result == recorded
+            # ...while a NEW mutation from the narrowed entity is
+            # refused at the gate
+            with pytest.raises(FSError) as ei2:
+                await rw.mkdir("/now-denied")
+            assert ei2.value.errno == -1
+        finally:
+            for m in mounts:
+                try:
+                    await m.unmount()    # shuts msgr + own rados too
+                except Exception:
+                    pass
+            if mds is not None:
+                await mds.stop()
             await c.stop()
     run(go())
 
