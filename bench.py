@@ -487,8 +487,33 @@ def qos_metric() -> dict:
     return asyncio.run(run())
 
 
+def _compile_seconds() -> float:
+    """Cumulative jit-compile wall observed by the device-runtime
+    monitor (round 14) — the devmon counter every wrapped jit entry
+    point (crush mapper/sharded sweep, EC encode/decode/fused-CRC,
+    streaming pipeline) feeds on its first call per shape."""
+    from ceph_tpu.utils.devmon import devmon
+    d = devmon().perf.dump()
+    return float(d.get("jit_compile_seconds", 0.0))
+
+
+def _with_compile_split(fn, *args):
+    """Run one bench section and split its wall: the returned dict
+    gains ``compile_s`` — the devmon-observed jit compile seconds the
+    section spent — so BENCH records can finally distinguish a compile
+    regression from a runtime regression (first-call minus warm-call,
+    measured rather than inferred)."""
+    c0 = _compile_seconds()
+    out = fn(*args)
+    if isinstance(out, dict):
+        out["compile_s"] = round(_compile_seconds() - c0, 3)
+    return out
+
+
 def main() -> None:
+    c0 = _compile_seconds()
     enc, dec, stream = ec_metrics()
+    ec_compile_s = round(_compile_seconds() - c0, 3)
     detail = {
         "seconds_per_step": round(enc["seconds"], 6),
         "batch": enc["batch"],
@@ -511,7 +536,8 @@ def main() -> None:
     try:
         # resident reference = the headline encode rate; the section
         # re-measures at its own shape when the headline leg crashed
-        detail["ec_streaming"] = ec_streaming_metric(enc.get("GiB/s"))
+        detail["ec_streaming"] = _with_compile_split(
+            ec_streaming_metric, enc.get("GiB/s"))
     except Exception:
         detail["ec_streaming_error"] = _short_err()
     # The remote compile service intermittently drops the mapper's large
@@ -519,7 +545,7 @@ def main() -> None:
     crush = None
     for attempt in (1, 2):
         try:
-            crush = crush_metric()
+            crush = _with_compile_split(crush_metric)
             detail["crush_mappings_per_s"] = crush["mappings_per_s"]
             detail["crush_detail"] = {
                 k: crush[k] for k in ("n_pgs", "n_osds", "num_rep",
@@ -536,32 +562,34 @@ def main() -> None:
             if attempt == 1:
                 time.sleep(90)
     try:
-        detail["crush_multichip"] = crush_multichip_metric(
+        detail["crush_multichip"] = _with_compile_split(
+            crush_multichip_metric,
             crush["mappings_per_s"] if crush else None)
     except Exception:
         detail["crush_multichip_error"] = _short_err()
     try:
-        detail["balancer"] = balancer_metric()
+        detail["balancer"] = _with_compile_split(balancer_metric)
     except Exception:
         detail["balancer_error"] = _short_err()
     try:
-        detail["mapping_engine"] = mapping_engine_metric()
+        detail["mapping_engine"] = _with_compile_split(
+            mapping_engine_metric)
     except Exception:
         detail["mapping_engine_error"] = _short_err()
     try:
-        detail["mds"] = mds_metric()
+        detail["mds"] = _with_compile_split(mds_metric)
     except Exception:
         detail["mds_error"] = _short_err()
     try:
-        detail["tracing"] = tracing_metric()
+        detail["tracing"] = _with_compile_split(tracing_metric)
     except Exception:
         detail["tracing_error"] = _short_err()
     try:
-        detail["qos"] = qos_metric()
+        detail["qos"] = _with_compile_split(qos_metric)
     except Exception:
         detail["qos_error"] = _short_err()
     try:
-        detail["telemetry"] = telemetry_metric()
+        detail["telemetry"] = _with_compile_split(telemetry_metric)
     except Exception:
         detail["telemetry_error"] = _short_err()
     print(json.dumps({
@@ -618,6 +646,12 @@ def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
         out["ec_agg_GiBs"] = [ecs.get("per_op_GiBs"),
                               ecs.get("aggregated_GiBs"),
                               ecs.get("pipeline_GiBs")]
+    # round 14: total observed jit-compile wall for the whole run —
+    # BENCH_r06+ can split a compile regression from a runtime one
+    try:
+        out["compile_total_s"] = round(_compile_seconds(), 3)
+    except Exception:
+        pass
     # belt-and-braces: the driver's tail capture is ~2000 chars; stay
     # far inside it even if an error string sneaks in
     while len(json.dumps(out)) > 500 and len(out) > 3:

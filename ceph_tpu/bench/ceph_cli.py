@@ -145,6 +145,19 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
     if w[:2] == ["osd", "slow"]:
         # ceph osd slow ls — confirmed slow OSDs + score table
         return {"prefix": "osd slow ls"}, b""
+    if w[:2] == ["device-runtime", "status"]:
+        # ceph device-runtime status — per-daemon kernel engine,
+        # mismatch rate, compile count/time, transfer GiB
+        return {"prefix": "device-runtime status"}, b""
+    if w[0] == "crash":
+        # ceph crash ls | info <id> | archive <id> | archive-all —
+        # the pooled daemon crash reports behind RECENT_CRASH
+        if w[1] == "ls":
+            return {"prefix": "crash ls"}, b""
+        if w[1] in ("info", "archive"):
+            return {"prefix": f"crash {w[1]}", "id": w[2]}, b""
+        if w[1] == "archive-all":
+            return {"prefix": "crash archive-all"}, b""
     if w[:2] == ["osd", "client-profile"]:
         # ceph osd client-profile set <entity> <res> <weight> <limit>
         #                          | rm <entity> | ls

@@ -435,9 +435,16 @@ class MDSDaemon(Dispatcher):
             f"mds.{self.name}", self.monc.msgr,
             lambda: self.monc.mgrmap, lambda: [MDS_PERF],
             self.config)
-        self._mgr_report_task = asyncio.ensure_future(
-            self._mgr_reporter.loop())
-        self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+        # crash capture (round 14): the long-lived loops carry the
+        # top-level exception hook — a dead beacon loop is a dead
+        # daemon in disguise, and the report says so
+        from ceph_tpu.utils import crash as _crash
+        self._mgr_report_task = _crash.watch(
+            asyncio.ensure_future(self._mgr_reporter.loop()),
+            f"mds.{self.name}", self.monc, where="mgr_report_loop")
+        self._beacon_task = _crash.watch(
+            asyncio.ensure_future(self._beacon_loop()),
+            f"mds.{self.name}", self.monc, where="beacon_loop")
         log.dout(1, f"mds.{self.name} (gid {self.gid}) standby at "
                     f"{self.addr}")
         return self.addr

@@ -116,11 +116,20 @@ S64_MIN = np.int64(np.iinfo(np.int64).min)
 S64_MAX = np.int64(np.iinfo(np.int64).max)
 LN_ONE = np.int64(1) << 48
 
+# per-process Mapper incarnation tokens: the devmon compile-warmth key
+# for PER-MAPPER jit wrappers (the fused-kernel fns) must be unique per
+# incarnation — id(fn) is recyclable after GC and would mark a fresh
+# Mapper's cold compile warm
+import itertools as _itertools
+
+_MAPPER_TOKEN = _itertools.count(1)
+
 # Lifecycle counters (round-4, VERDICT r3 ask #10): every balancer
 # iteration historically rebuilt a Mapper, and reweights can flip the
 # skip_is_out jit key — this makes pack/compile traffic observable via
 # `perf dump` instead of guessed. Registered process-wide like a
 # daemon's counters (ref: the role of src/common/perf_counters.h).
+from ceph_tpu.utils.devmon import devmon as _devmon
 from ceph_tpu.utils.perf_counters import PerfCountersBuilder as _PCB
 
 PERF = (_PCB("crush_mapper")
@@ -979,8 +988,36 @@ class Mapper:
         # mapping_path()'s prediction so a silent mid-run kernel
         # degrade is a recorded fact, not a mystery slowdown.
         self.last_map_path: str | None = None
+        # devmon identity (round 14): the incarnation token keys
+        # per-Mapper jit wrappers' compile warmth; the arrays
+        # signature (lazy — see _jit_key) keys shared lru'd programs
+        # the way jax itself does (abstract input shapes), so a new
+        # Mapper over a differently-shaped map counts its real
+        # recompile instead of reading warm off the shared fn object.
+        # Set AFTER a kernel failure: the engine this Mapper's plan
+        # promised before it degraded — under
+        # devmon_expected_engine=auto every later sweep keeps counting
+        # a mismatch instead of the baseline silently re-healing to
+        # the fallback engine (the ISSUE's 34x-slower-with-no-signal
+        # case).
+        self._devmon_token = next(_MAPPER_TOKEN)
+        self._arrays_sig: tuple | None = None
+        self._degraded_from: str | None = None
         PERF.inc("packs")
         PERF.tinc("pack_seconds", time.perf_counter() - _t0)
+        # device-runtime accounting (round 14): the pack's H2D staging
+        # footprint — what actually crossed the host boundary (the
+        # int64 shuttle, the meta columns, device weights, optionals);
+        # the process-cached const tables (negln/zg2d) ship once per
+        # process and are excluded. big64 itself is the one transfer
+        # its six views share.
+        staged = int(big64.nbytes) + int(meta_dev.nbytes) + \
+            int(devw_c.nbytes) + sum(
+                int(self.arrays[k].nbytes) for k in
+                ("tree_nodes", "tree_num", "straws", "cw", "cids",
+                 "cm1", "cm0", "csh") if k in self.arrays)
+        _devmon().record_h2d(staged)
+        _devmon().note_staging(staged)
 
     def attach_mesh(self, mesh, mesh_min_batch: int | None = None):
         """Route big sweeps through the mesh-sharded path (round 10)."""
@@ -1006,6 +1043,7 @@ class Mapper:
         self._skip_is_out = bool(
             np.all(np.asarray(device_weights) == WEIGHT_ONE))
         self.cfg["skip_is_out"] = self._skip_is_out
+        self._arrays_sig = None          # devw shapes may have changed
         if self._skip_is_out != _was:
             PERF.inc("reweight_recompiles")
         # kernel plans embed the non-full-device list: rebuild lazily
@@ -1032,6 +1070,9 @@ class Mapper:
                f"({type(exc).__name__}: {str(exc)[:200]}) — "
                f"falling back to the XLA path for this Mapper")
         PERF.inc("kernel_exec_failures")
+        # the engine this Mapper PROMISED before degrading: keeps the
+        # expected-vs-actual baseline honest (see _devmon_token note)
+        self._degraded_from = "pallas"
         self._kernel_mode = None
         self._kernel_plans.clear()
         self._kernel_bodies.clear()
@@ -1196,6 +1237,35 @@ class Mapper:
                     if self._kernel_mode == "interpret" else "pallas")
         return "xla"
 
+    def expected_path(self, ruleno: int, result_max: int) -> str:
+        """The engine this Mapper is EXPECTED to serve (rule, width)
+        on: the built plan's prediction — EXCEPT a Mapper whose fused
+        kernel failed mid-run stays pinned to the engine it promised
+        ('pallas'), so under ``devmon_expected_engine=auto`` a
+        permanently lost plan keeps counting as a mismatch on every
+        sweep instead of silently re-healing the baseline to the
+        fallback engine."""
+        return self._degraded_from or \
+            self.mapping_path(ruleno, result_max)
+
+    def _jit_key(self, ruleno: int, result_max: int, kernel: bool,
+                 extra) -> tuple:
+        """The devmon compile-warmth key, mirroring the REAL jit cache
+        identity: per-Mapper kernel wrappers are cold once per Mapper
+        incarnation (the token — id(fn) is GC-recyclable); shared
+        lru'd XLA programs are warm exactly when jax's own cache is —
+        same rule key AND same abstract input shapes (the staged
+        arrays' signature; a new Mapper over a differently-shaped map
+        genuinely recompiles)."""
+        if kernel:
+            return ("kern", self._devmon_token, ruleno, result_max,
+                    extra)
+        if self._arrays_sig is None:
+            self._arrays_sig = tuple(sorted(
+                (k, tuple(v.shape)) for k, v in self.arrays.items()))
+        return ("xla", self._rule_key(ruleno, result_max),
+                self._arrays_sig, extra)
+
     def rule_is_firstn(self, ruleno: int) -> bool:
         """True when the rule's choose steps are firstn (replicated)."""
         return not any(s.op in (OP_CHOOSE_INDEP, OP_CHOOSELEAF_INDEP)
@@ -1232,16 +1302,48 @@ class Mapper:
         latency."""
         return max(self.block, 1 << 21) if kernel else self.block
 
+    def _record_path(self, path: str, expected: str | None) -> str:
+        """Per-CALL path record (round 14): the returned value is this
+        call's own engine — immune to the interleaving that makes the
+        single-slot ``last_map_path`` attribute (kept as a best-effort
+        mirror for existing readers) unreliable when two sweeps from
+        two PGs overlap. Also feeds the process devmon: a launch
+        counter by engine, and an expected-vs-actual check so a plan
+        that degraded DURING this call is a counted mismatch, not a
+        mystery slowdown."""
+        self.last_map_path = path            # best-effort mirror only
+        dm = _devmon()
+        dm.record_launch(path)
+        if expected is not None:
+            dm.record_path_check(expected, path)
+        return path
+
     def map_pgs(self, ruleno: int, xs, result_max: int) -> jax.Array:
         """Vectorized crush_do_rule over xs -> (N, result_max) device ids
         (ITEM_NONE fills failures/indep holes). Tiled into block-lane
-        chunks so straw2 temps stay bounded at any N."""
+        chunks so straw2 temps stay bounded at any N. The engine path
+        is recorded per call — ``map_pgs_path`` returns it."""
+        out, _path = self.map_pgs_path(ruleno, xs, result_max)
+        return out
+
+    def map_pgs_path(self, ruleno: int, xs, result_max: int,
+                     _expected: str | None = None
+                     ) -> tuple[jax.Array, str]:
+        """``map_pgs`` returning ``(out, path)`` — ``path`` is the
+        engine THIS call executed on. ``_expected`` is internal: the
+        engine predicted at first entry, threaded through the
+        kernel-failure retry so a mid-call degrade records exactly one
+        mismatch against the original plan."""
         if self._scalar_reason:
             PERF.inc("pgs_mapped", len(xs))
-            self.last_map_path = "scalar"
-            return self._scalar_map(ruleno, xs, result_max)
+            return (self._scalar_map(ruleno, xs, result_max),
+                    self._record_path("scalar", _expected))
+        if _expected is None:
+            _expected = self.expected_path(ruleno, result_max)
         if self._use_mesh(len(xs)):
-            return self._sharded_map_pgs(ruleno, xs, result_max)
+            out = self._sharded_map_pgs(ruleno, xs, result_max)
+            path = self.mapping_path(ruleno, result_max) + "+sharded"
+            return out, self._record_path(path, _expected)
         kb = self._kernel_body(ruleno, result_max)
         if kb is not None:
             key = (ruleno, result_max)
@@ -1255,13 +1357,19 @@ class Mapper:
         block = self._block_for(kb is not None)
         if len(xs) == 0:     # the kernel rejects n=0 (and the guard
             with _enable_x64(True):     # readback would IndexError)
-                return jnp.zeros((0, result_max), dtype=jnp.int32)
+                return (jnp.zeros((0, result_max), dtype=jnp.int32),
+                        _expected)
+        dm = _devmon()
         try:
             with _enable_x64(True):
                 xs = jnp.asarray(xs, dtype=jnp.uint32)
                 n = xs.shape[0]
+                kb_kern = kb is not None
                 if n <= block:
-                    out = fn(self.arrays, xs)
+                    out = dm.jit_call(
+                        "crush_map_pgs",
+                        self._jit_key(ruleno, result_max, kb_kern, n),
+                        fn, self.arrays, xs)
                 else:
                     pieces = []
                     for start in range(0, n, block):
@@ -1270,9 +1378,17 @@ class Mapper:
                             pad = block - piece.shape[0]  # so the jit
                             piece = jnp.pad(piece, (0, pad))  # cache
                             pieces.append(      # stays one entry/shape
-                                fn(self.arrays, piece)[:-pad])
+                                dm.jit_call(
+                                    "crush_map_pgs",
+                                    self._jit_key(ruleno, result_max,
+                                                  kb_kern, block),
+                                    fn, self.arrays, piece)[:-pad])
                         else:
-                            pieces.append(fn(self.arrays, piece))
+                            pieces.append(dm.jit_call(
+                                "crush_map_pgs",
+                                self._jit_key(ruleno, result_max,
+                                              kb_kern, block),
+                                fn, self.arrays, piece))
                     out = jnp.concatenate(pieces, axis=0)
                 if kb is not None:
                     # dispatch is async: an execution-time kernel
@@ -1286,10 +1402,12 @@ class Mapper:
             if kb is None:
                 raise                        # XLA path: a real error
             self._disable_kernel("map_pgs", e)
-            return self.map_pgs(ruleno, xs, result_max)
-        self.last_map_path = self.mapping_path(ruleno, result_max)
+            return self.map_pgs_path(ruleno, xs, result_max,
+                                     _expected=_expected)
+        path = self.mapping_path(ruleno, result_max)
         PERF.inc("pgs_mapped", int(n))       # success only: the failed
-        return out                           # attempt must not double-count
+        return out, self._record_path(path, _expected)  # attempt must
+        # not double-count
 
     def _sharded_map_pgs(self, ruleno: int, xs, result_max: int):
         """map_pgs over the attached mesh (crush.sharded_sweep), with
@@ -1323,15 +1441,23 @@ class Mapper:
         CrushTester's size check) are counted on device too.
 
         Returns (counts, bad) device arrays: counts int64 (max_devices,),
-        bad int64 scalar. Nothing of O(n) touches the host.
-        """
+        bad int64 scalar. Nothing of O(n) touches the host. The engine
+        path is recorded per call — ``sweep_path`` returns it."""
+        counts, bad, _path = self.sweep_path(ruleno, start_x, n,
+                                             result_max,
+                                             device_counts_size)
+        return counts, bad
+
+    def sweep_path(self, ruleno: int, start_x: int, n: int,
+                   result_max: int,
+                   device_counts_size: int | None = None,
+                   _expected: str | None = None):
+        """``sweep`` returning ``(counts, bad, path)`` — ``path`` is
+        the engine THIS sweep executed on (see map_pgs_path for the
+        per-call discipline and the ``_expected`` retry threading)."""
         nd_ = device_counts_size or self.packed.max_devices
-        if not self._scalar_reason and self._use_mesh(n) and \
-                device_counts_size is None:
-            return self._sharded_sweep(ruleno, start_x, n, result_max)
         if self._scalar_reason:    # legacy fallback: host aggregation
             PERF.inc("pgs_mapped", int(n))
-            self.last_map_path = "scalar"
             out = self._scalar_map(
                 ruleno, np.arange(start_x, start_x + n, dtype=np.uint32),
                 result_max)
@@ -1339,7 +1465,15 @@ class Mapper:
             counts = np.bincount(out[live], minlength=nd_)[:nd_]
             bad = int((live.sum(axis=1) < result_max).sum()) \
                 if self.rule_is_firstn(ruleno) else 0
-            return np.asarray(counts, dtype=np.int64), np.int64(bad)
+            return (np.asarray(counts, dtype=np.int64), np.int64(bad),
+                    self._record_path("scalar", _expected))
+        if _expected is None:
+            _expected = self.expected_path(ruleno, result_max)
+        if self._use_mesh(n) and device_counts_size is None:
+            counts, bad = self._sharded_sweep(ruleno, start_x, n,
+                                              result_max)
+            path = self.mapping_path(ruleno, result_max) + "+sharded"
+            return counts, bad, self._record_path(path, _expected)
         kb = self._kernel_body(ruleno, result_max)
         fn_body = kb or _rule_body(*self._rule_key(ruleno, result_max))
         firstn = self.rule_is_firstn(ruleno)
@@ -1348,14 +1482,20 @@ class Mapper:
         nblocks = -(-n // block)
 
         step_fn = _compiled_sweep(fn_body, firstn, nd, block, result_max)
+        dm = _devmon()
         try:
             with _enable_x64(True):
                 counts = jnp.zeros(nd + 1, dtype=jnp.int64)
                 bad = jnp.int64(0)
                 for i in range(nblocks):
-                    counts, bad = step_fn(self.arrays, counts, bad,
-                                          jnp.uint32(start_x + i * block),
-                                          jnp.int64(n - i * block))
+                    counts, bad = dm.jit_call(
+                        "crush_sweep",
+                        self._jit_key(ruleno, result_max,
+                                      kb is not None,
+                                      (block, nd, firstn)), step_fn,
+                        self.arrays, counts, bad,
+                        jnp.uint32(start_x + i * block),
+                        jnp.int64(n - i * block))
                     if kb is not None and i == 0:
                         # force the first block's execution (tiny
                         # readback; see map_pgs): a kernel that fails
@@ -1368,12 +1508,13 @@ class Mapper:
             if kb is None:
                 raise                        # XLA path: a real error
             self._disable_kernel("sweep", e)
-            return self.sweep(ruleno, start_x, n, result_max,
-                              device_counts_size)
-        self.last_map_path = self.mapping_path(ruleno, result_max)
+            return self.sweep_path(ruleno, start_x, n, result_max,
+                                   device_counts_size,
+                                   _expected=_expected)
+        path = self.mapping_path(ruleno, result_max)
         PERF.inc("pgs_mapped", int(n))       # success only (no double
         PERF.inc("sweep_blocks", int(nblocks))   # count via the retry)
-        return counts[:nd], bad
+        return counts[:nd], bad, self._record_path(path, _expected)
 
     def _sharded_sweep(self, ruleno: int, start_x: int, n: int,
                        result_max: int):

@@ -166,7 +166,12 @@ def sharded_map_pgs(mesh, mapper, ruleno: int, xs,
         block = min(eff, local_n)
         fn = _shard_fn(mapper, used_kernel, _compiled_sharded_map,
                        fn_body, mesh, block, local_n, result_max)
-        out = fn(mapper.arrays, xs)
+        from ceph_tpu.utils.devmon import devmon as _devmon
+        out = _devmon().jit_call(
+            "crush_sharded_map",
+            mapper._jit_key(ruleno, result_max, used_kernel,
+                            ("sharded", local_n, block)),
+            fn, mapper.arrays, xs)
         mapper.last_map_path = \
             mapper.mapping_path(ruleno, result_max) + "+sharded"
         return out[:n] if pad else out
@@ -235,8 +240,13 @@ def sharded_sweep(mesh, mapper, ruleno: int, start_x: int, n: int,
     fn = _shard_fn(mapper, used_kernel, _compiled_sharded_sweep,
                    fn_body, mapper.rule_is_firstn(ruleno), nd, mesh,
                    block, local_n, result_max)
+    from ceph_tpu.utils.devmon import devmon as _devmon
     with _enable_x64(True):
-        out = fn(mapper.arrays, jnp.uint32(start_x), jnp.int64(n))
+        out = _devmon().jit_call(
+            "crush_sharded_sweep",
+            mapper._jit_key(ruleno, result_max, used_kernel,
+                            ("sharded", local_n, block, nd)),
+            fn, mapper.arrays, jnp.uint32(start_x), jnp.int64(n))
     mapper.last_map_path = \
         mapper.mapping_path(ruleno, result_max) + "+sharded"
     return out

@@ -23,6 +23,7 @@ from ceph_tpu.ec import matrix as rs
 from ceph_tpu.ec.interface import ErasureCodeInterface, ErasureCodeProfile
 from ceph_tpu.gf import ops, tables
 from ceph_tpu.gf import pallas_kernels as pk
+from ceph_tpu.utils.devmon import devmon as _devmon
 from ceph_tpu.utils.logging import get_logger
 
 log = get_logger("ec")
@@ -171,9 +172,15 @@ class ErasureCodeJax(ErasureCodeInterface):
     def encode_batch(self, data: jax.Array) -> jax.Array:
         """Batched TPU path: (batch, k, C) uint8 -> (batch, m, C) parity.
 
-        Stays on device; the benchmark and the sharded pipeline call this.
-        """
-        return self._encode_kernel.apply_batch(data)
+        Stays on device; the benchmark and the sharded pipeline call
+        this. First call per (kernel, shape) is compile-accounted
+        through the device-runtime monitor (round 14) — a new batch
+        shape recompiling under the OSD aggregator is a countable,
+        traceable event now."""
+        kern = self._encode_kernel
+        return _devmon().jit_call(
+            "ec_encode", (id(kern), tuple(data.shape)),
+            kern.apply_batch, data)
 
     def encode_batch_with_crc(self, data):
         """Fused checksum+encode: ONE jitted device program computes
@@ -222,7 +229,9 @@ class ErasureCodeJax(ErasureCodeInterface):
                 return parity, crcs.reshape(-1, n)
 
             fused = self._fused_crc_cache[C] = jax.jit(_fused)
-        return fused(data)
+        return _devmon().jit_call(
+            "ec_encode_crc", (id(fused), tuple(data.shape)),
+            fused, data)
 
     # -- decode -----------------------------------------------------------
     def _decode_kernel(self, avail: tuple[int, ...],
@@ -260,7 +269,9 @@ class ErasureCodeJax(ErasureCodeInterface):
                      chunks: jax.Array) -> jax.Array:
         """Batched decode: chunks (batch, len(avail), C) -> (batch, len(want), C)."""
         kern = self._decode_kernel(tuple(avail), tuple(want))
-        return kern.apply_batch(chunks)
+        return _devmon().jit_call(
+            "ec_decode", (id(kern), tuple(chunks.shape)),
+            kern.apply_batch, chunks)
 
 
 class StreamingEncodePipeline:
@@ -297,23 +308,45 @@ class StreamingEncodePipeline:
 
     def encode_iter(self, batches):
         """host (B, k, C) uint8 batches in -> parity np arrays out,
-        transfer of batch N+1 overlapped with encode of batch N."""
+        transfer of batch N+1 overlapped with encode of batch N.
+
+        Transfer accounting (round 14): every H2D stage and D2H
+        readback feeds the device-runtime monitor's byte counters, so
+        a pipeline-bound ingest shows up as transfer GiB in
+        `device-runtime status` instead of as unexplained wall."""
+        dm = _devmon()
+
+        def _encode(batch):
+            return dm.jit_call("ec_stream_encode",
+                               (id(self._fn), tuple(batch.shape)),
+                               self._fn, batch)
+
+        def _readback(parity):
+            host = np.asarray(parity)
+            dm.record_d2h(host.nbytes)
+            return host
+
         it = iter(batches)
         try:
-            cur = jax.device_put(np.ascontiguousarray(next(it)))
+            first = np.ascontiguousarray(next(it))
         except StopIteration:
             return
+        dm.record_h2d(first.nbytes)
+        dm.note_staging(first.nbytes)
+        cur = jax.device_put(first)
         prev = None
         for nxt_host in it:
-            nxt = jax.device_put(np.ascontiguousarray(nxt_host))
-            out = self._fn(cur)
+            nxt_host = np.ascontiguousarray(nxt_host)
+            dm.record_h2d(nxt_host.nbytes)
+            nxt = jax.device_put(nxt_host)
+            out = _encode(cur)
             if prev is not None:
-                yield np.asarray(prev)
+                yield _readback(prev)
             prev, cur = out, nxt
-        out = self._fn(cur)
+        out = _encode(cur)
         if prev is not None:
-            yield np.asarray(prev)
-        yield np.asarray(out)
+            yield _readback(prev)
+        yield _readback(out)
 
     def encode_all(self, batches) -> list:
         return list(self.encode_iter(batches))

@@ -178,7 +178,12 @@ class Mgr(Dispatcher):
         await self.monc.subscribe("monmap", 0)
         await self.monc.subscribe("mgrmap", 0)
         await self._start_asok()
-        self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+        # crash capture (round 14): a dead beacon loop demotes this
+        # mgr by silence — the crash report says WHY
+        from ceph_tpu.utils import crash as _crash
+        self._beacon_task = _crash.watch(
+            asyncio.ensure_future(self._beacon_loop()),
+            f"mgr.{self.name}", self.monc, where="beacon_loop")
         if active:
             await self.promote()
 
@@ -188,14 +193,19 @@ class Mgr(Dispatcher):
             return
         from ceph_tpu.utils.admin_socket import AdminSocket
         self.asok = AdminSocket(f"{asok_dir}/mgr.{self.name}.asok")
+        from ceph_tpu.utils.devmon import devmon as _devmon
         self.asok.register(
             "status", lambda: {
                 "name": self.name, "gid": self.gid,
                 "active": self.active,
                 "modules": [m.NAME for m in self.modules],
                 "reported_daemons": sorted(
-                    self.daemon_state.daemons)},
-            "mgr state summary incl. reporting daemons")
+                    self.daemon_state.daemons),
+                # the mgr's own balancer/autoscaler sweeps ride the
+                # same device runtime — surface the process view
+                "device": _devmon().dump()},
+            "mgr state summary incl. reporting daemons and the "
+            "process device-runtime view")
         self.asok.register(
             "daemon ls", lambda: {
                 "daemons": {n: {"reports": st.reports,

@@ -369,6 +369,15 @@ class PrometheusModule(MgrModule):
             for osd, score in sorted(slow.items()):
                 lines.append(
                     f'ceph_osd_slow_score{{osd="{osd}"}} {score}')
+        # device runtime (round 14): mismatch ratio per daemon whose
+        # kernel path the mon confirmed degraded (KERNEL_PATH_DEGRADED)
+        dkp = om.get("degraded_kernel_paths", {})
+        if dkp:
+            lines.append("# TYPE ceph_device_path_degraded gauge")
+            for osd, ratio in sorted(dkp.items()):
+                lines.append(
+                    f'ceph_device_path_degraded{{osd="{osd}"}} '
+                    f'{ratio}')
         # op QoS scheduler (round 11): the dmClock admission counters
         qpc = PerfCountersCollection.instance().get("osd_qos")
         if qpc is not None:
@@ -460,10 +469,11 @@ class PrometheusModule(MgrModule):
             lines.append("# ceph_perf: from daemon report sessions")
             for daemon, loggers in reported.items():
                 for logger, counters in loggers.items():
-                    if logger == "osd_ec_agg":
-                        # dedicated ceph_osd_ec_agg_* rows below —
-                        # rendering it here too would double the
-                        # family's cardinality every scrape
+                    if logger in ("osd_ec_agg", "devmon",
+                                  "device_runtime"):
+                        # dedicated ceph_osd_ec_agg_* / ceph_device_*
+                        # rows below — rendering them here too would
+                        # double the family's cardinality every scrape
                         continue
                     # the daemon's own logger renders bare counter
                     # names; a shared/auxiliary logger is prefixed so
@@ -494,6 +504,49 @@ class PrometheusModule(MgrModule):
                 lines.append("# ceph_osd_ec_agg_*: EC encode "
                              "aggregator (reported)")
                 lines += agg_rows
+            # device-runtime plane (round 14): dedicated ceph_device_*
+            # rows from the REPORTED state — per-daemon kernel-path
+            # health (the `devmon` family) and the process monitor's
+            # compile/transfer side (`device_runtime`). Built from
+            # report sessions, NOT the process singleton: the rows
+            # must survive daemons living in other processes.
+            dev_rows: list[str] = []
+            for daemon, loggers in sorted(reported.items()):
+                dd = loggers.get("devmon") or {}
+                dp = loggers.get("device_runtime") or {}
+                if not dd and not dp:
+                    continue
+                lab = f'ceph_daemon="{daemon}"'
+
+                def _num(src, key):
+                    v = src.get(key)
+                    return v if isinstance(v, (int, float)) else 0
+                dev_rows += [
+                    f'ceph_device_path_checks_total{{{lab}}} '
+                    f'{_num(dd, "path_checks")}',
+                    f'ceph_device_path_mismatch_total{{{lab}}} '
+                    f'{_num(dd, "path_mismatch")}',
+                ]
+                for p in ("pallas", "xla", "scalar", "sharded"):
+                    dev_rows.append(
+                        f'ceph_device_launches_total{{{lab},'
+                        f'path="{p}"}} {_num(dd, f"launches_{p}")}')
+                dev_rows += [
+                    f'ceph_device_jit_compiles_total{{{lab}}} '
+                    f'{_num(dp, "jit_compiles")}',
+                    f'ceph_device_jit_compile_seconds_total{{{lab}}} '
+                    f'{_num(dp, "jit_compile_seconds"):.9g}',
+                    f'ceph_device_h2d_bytes_total{{{lab}}} '
+                    f'{_num(dp, "h2d_bytes")}',
+                    f'ceph_device_d2h_bytes_total{{{lab}}} '
+                    f'{_num(dp, "d2h_bytes")}',
+                    f'ceph_device_mem_watermark_bytes{{{lab}}} '
+                    f'{_num(dp, "device_bytes_watermark")}',
+                ]
+            if dev_rows:
+                lines.append("# ceph_device_*: device-runtime "
+                             "observability (reported)")
+                lines += dev_rows
             # per-OSD commit/apply latency from the reported
             # objectstore time-avgs (the `ceph osd perf` table)
             perf_digest = self.mgr.osd_perf_digest() if hasattr(

@@ -68,6 +68,10 @@ READONLY_COMMANDS = frozenset((
     "trace dump", "trace ls", "trace show", "osd slow ls",
     # telemetry plane (round 12): digest-backed observability reads
     "osd perf", "progress ls", "progress json", "mgr dump", "mgr stat",
+    # device-runtime plane (round 14): kernel-path health + crash
+    # evidence reads (crash archive MUTATES the ack bit and stays
+    # behind `mon w`)
+    "device-runtime status", "crash ls", "crash info",
 ))
 AUTH_READS = frozenset(("auth get", "auth ls"))
 

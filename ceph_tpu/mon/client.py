@@ -62,6 +62,9 @@ class MonClient(Dispatcher):
         # the owning daemon sets these before the first tracked map
         self.mapping_mesh = None
         self.mapping_tracer = None
+        # the owning daemon's DeviceRuntimeMonitor (round 14):
+        # tracked-table sweeps record per-daemon kernel-path health
+        self.mapping_devmon = None
 
     @property
     def mapping_table(self):
@@ -189,7 +192,8 @@ class MonClient(Dispatcher):
                 from ceph_tpu.osd.osdmap_mapping import OSDMapMapping
                 self._mapping = OSDMapMapping(
                     mesh=self.mapping_mesh,
-                    tracer=self.mapping_tracer)
+                    tracer=self.mapping_tracer,
+                    devmon=self.mapping_devmon)
             self._mapping.update(self.osdmap)
             self.osdmap.attach_mapping(self._mapping)
         for fut in self._osdmap_waiters:

@@ -207,7 +207,15 @@ class MPGStats(Message):
               ("stats", "map:str:blob"), ("slow_ops", "u32"),
               ("used_bytes", "u64"), ("capacity_bytes", "u64"),
               ("trace_spans", "list:blob"),
-              ("peer_latency", "map:str:u64")]
+              ("peer_latency", "map:str:u64"),
+              # round 14 (appended, zero-filled for pre-devmon blobs):
+              # the daemon's cumulative device-runtime view — kernel-
+              # path checks/mismatches, launches by engine, jit
+              # compile count/ms, transfer bytes (all u64) — plus the
+              # backend name. Per-report deltas drive the mon's
+              # KERNEL_PATH_DEGRADED sweep + `device-runtime status`.
+              ("device_health", "map:str:u64"),
+              ("device_engine", "str")]
 
 
 @register
@@ -317,6 +325,25 @@ class MMgrDigest(Message):
     TYPE = 156
     FIELDS = [("name", "str"), ("gid", "u64"), ("progress", "blob"),
               ("osd_perf", "blob")]
+
+
+@register
+class MCrashReport(Message):
+    """Daemon -> mon crash report (round 14; ref: the ceph-crash ->
+    crash-module posting pipeline): a daemon's top-level task
+    exception hook ships a BOUNDED report (exception repr, capped
+    traceback, daemon identity, wall stamp) the moment a long-lived
+    loop dies with a real exception — the silent half-alive daemon
+    becomes `ceph crash ls` + a RECENT_CRASH health warning until
+    acknowledged (`ceph crash archive`). Fire-and-forget and
+    leader-forwarded like every other daemon report; pooled IN MEMORY
+    (bounded) on the leader — crash evidence is observability, never
+    a paxos artifact."""
+
+    TYPE = 159
+    FIELDS = [("daemon", "str"), ("crash_id", "str"),
+              ("exception", "str"), ("traceback", "str"),
+              ("stamp", "f64")]
 
 
 @register

@@ -16,7 +16,8 @@ import json
 from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
-    MAuthUpdate, MDSBeacon, MLog, MMDSMap, MMDSMigrationDone,
+    MAuthUpdate, MCrashReport, MDSBeacon, MLog, MMDSMap,
+    MMDSMigrationDone,
     MMgrBeacon, MMgrDigest, MMgrMap, MMonCommand, MMonCommandAck,
     MMonElection, MMonGetOSDMap, MMonMap, MMonPaxos,
     MMonProposeForward, MMonSubscribe, MOSDAlive, MOSDBoot,
@@ -168,6 +169,16 @@ class Monitor(Dispatcher):
         self.mgr_progress: dict = {"events": [], "completed": []}
         self.mgr_osd_perf: dict = {}
         self._mgr_digest_gid = 0
+
+        # crash-report pool (round 14, ref: the mgr crash module's
+        # store): crash_id -> bounded report dict, IN MEMORY only
+        # (crash evidence is observability, never a paxos artifact) —
+        # `ceph crash ls/info` serve it and RECENT_CRASH warns until
+        # `ceph crash archive` acks. OrderedDict-bounded: the oldest
+        # reports age out past the cap.
+        from collections import OrderedDict
+        self.crashes: "OrderedDict[str, dict]" = OrderedDict()
+        self.MAX_CRASHES = 64
 
         # trace-span pool (round 9, ref: the mgr's role as trace sink
         # upstream): spans piggybacked on MPGStats/MDSBeacon (and
@@ -426,7 +437,7 @@ class Monitor(Dispatcher):
                             MOSDMarkMeDown, MPGStats, MDSBeacon,
                             MLog, MOSDPGReadyToMerge,
                             MMDSMigrationDone, MTraceReport,
-                            MMgrBeacon, MMgrDigest)):
+                            MMgrBeacon, MMgrDigest, MCrashReport)):
             if not self.is_leader():
                 if self.leader_rank is not None and \
                         self.leader_rank != self.rank:
@@ -446,6 +457,9 @@ class Monitor(Dispatcher):
                 return True
             if isinstance(msg, MMgrDigest):
                 self._ingest_mgr_digest(msg)
+                return True
+            if isinstance(msg, MCrashReport):
+                self._ingest_crash_report(msg)
                 return True
             if isinstance(msg, (MDSBeacon, MMDSMigrationDone)):
                 svc = self.mdsmon
@@ -480,6 +494,66 @@ class Monitor(Dispatcher):
             self.mgr_osd_perf = perf
         self._mgr_digest_gid = m.gid
         self.perf.inc("mgr_digests")
+
+    # -- crash pool (round 14) ---------------------------------------------
+    def _ingest_crash_report(self, m: MCrashReport) -> None:
+        """Pool one daemon crash report (bounded, re-capped fields —
+        the sender caps too, but arbitrary daemons write these; a
+        hostile report must not grow mon memory). Duplicate crash_ids
+        keep the first report; the pool ages out oldest-first past
+        MAX_CRASHES. A fresh report arrives unarchived — RECENT_CRASH
+        raises until `ceph crash archive` acks it."""
+        cid = str(m.crash_id or "")[:200]
+        if not cid or cid in self.crashes:
+            return
+        self.crashes[cid] = {
+            "crash_id": cid,
+            "daemon": str(m.daemon or "?")[:120],
+            "exception": str(m.exception or "")[:400],
+            "traceback": str(m.traceback or "")[:4000],
+            "stamp": float(getattr(m, "stamp", 0.0) or 0.0),
+            "archived": False,
+        }
+        while len(self.crashes) > self.MAX_CRASHES:
+            self.crashes.popitem(last=False)
+        self.clog("WRN", f"daemon crash reported: {m.daemon} "
+                         f"({self.crashes[cid]['exception'][:80]}) "
+                         f"crash_id {cid}")
+        log.dout(1, f"crash report pooled: {cid}")
+
+    def _handle_crash_command(self, cmd: dict) -> tuple[int, str,
+                                                        bytes]:
+        """`ceph crash ls/info/archive/archive-all` (round 14, ref:
+        the mgr crash module's command set): ls + info are read-only
+        cap class; archive flips the ack bit that clears
+        RECENT_CRASH (the report stays listed — `crash ls` is the
+        permanent record within the pool's bound)."""
+        prefix = cmd.get("prefix", "")
+        if prefix == "crash ls":
+            return 0, "", json.dumps({"crashes": [
+                {k: v for k, v in rep.items() if k != "traceback"}
+                for rep in self.crashes.values()]}).encode()
+        if prefix == "crash info":
+            cid = str(cmd.get("id", ""))
+            rep = self.crashes.get(cid)
+            if rep is None:
+                return -2, f"no crash {cid!r}", b""       # -ENOENT
+            return 0, "", json.dumps(rep).encode()
+        if prefix == "crash archive":
+            cid = str(cmd.get("id", ""))
+            rep = self.crashes.get(cid)
+            if rep is None:
+                return -2, f"no crash {cid!r}", b""       # -ENOENT
+            rep["archived"] = True
+            return 0, f"archived {cid}", b""
+        if prefix == "crash archive-all":
+            n = 0
+            for rep in self.crashes.values():
+                if not rep["archived"]:
+                    rep["archived"] = True
+                    n += 1
+            return 0, f"archived {n} crash(es)", b""
+        return -22, f"unknown command {prefix!r}", b""    # -EINVAL
 
     # -- trace pool (round 9) ----------------------------------------------
     def ingest_trace_spans(self, blobs) -> None:
@@ -694,6 +768,15 @@ class Monitor(Dispatcher):
                 "from_mgr_gid": self._mgr_digest_gid}).encode()
         if prefix.startswith("trace"):
             return self._handle_trace_command(cmd)
+        if prefix.startswith("crash"):
+            return self._handle_crash_command(cmd)
+        if prefix == "device-runtime status":
+            # per-daemon device-runtime table from the MPGStats
+            # piggyback (round 14): engine, kernel-path mismatch
+            # rate, compile count/time, transfer GiB + the degraded
+            # table behind KERNEL_PATH_DEGRADED
+            return 0, "", json.dumps(
+                self.osdmon.device_runtime_status()).encode()
         if prefix.startswith(("osd", "pg")):
             return await self.osdmon.handle_command(cmd, inbl)
         return -22, f"unknown command {prefix!r}", b""    # -EINVAL
@@ -803,6 +886,14 @@ class Monitor(Dispatcher):
                 osd_stat["slow_osds"] = {
                     str(t): v.get("score", 0.0)
                     for t, v in sorted(self.osdmon.slow_osds.items())}
+            dkp = getattr(self.osdmon, "degraded_kernel_paths", {})
+            if dkp:
+                # kernel-path drill-down (round 14): mismatch ratio
+                # per confirmed-degraded daemon (prometheus renders
+                # ceph_device_path_degraded from it)
+                osd_stat["degraded_kernel_paths"] = {
+                    str(o): v.get("ratio", 0.0)
+                    for o, v in sorted(dkp.items())}
         return {
             "fsid": self.monmap.fsid,
             "health": health,

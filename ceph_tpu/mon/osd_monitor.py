@@ -89,6 +89,20 @@ class OSDMonitor(PaxosService):
         self._slow_clear: dict[int, int] = {}
         # confirmed slow OSDs: target -> {score, latency_ms, since...}
         self.slow_osds: dict[int, dict] = {}
+        # device-runtime observability (round 14): per-OSD cumulative
+        # device_health snapshots from the MPGStats piggyback (the
+        # `ceph device-runtime status` table), the last cumulative
+        # (checks, mismatches) pair per OSD for delta rates, and the
+        # KERNEL_PATH_DEGRADED debounce — REPORT-driven (one step per
+        # device_health delta with fresh sweeps in it), so the confirm
+        # count is "N consecutive degraded sweeps reported", the
+        # OSD_SLOW entry/exit discipline paced by real sweep traffic
+        self.osd_device_state: dict[int, dict] = {}
+        self._kp_last: dict[int, tuple[int, int]] = {}
+        self._kp_suspect: dict[int, int] = {}
+        self._kp_clear: dict[int, int] = {}
+        # confirmed degraded kernel paths: osd -> {ratio, since, ...}
+        self.degraded_kernel_paths: dict[int, dict] = {}
         # merge readiness barrier (ref: OSDMonitor ready_to_merge_pgs
         # driven by MOSDPGReadyToMerge): (pool, pg_num_pending) ->
         # {source seed: last-report loop time}. Leader memory, not
@@ -236,6 +250,7 @@ class OSDMonitor(PaxosService):
         self.osd_slow_ops.pop(m.osd, None)   # fresh incarnation
         self.osd_utilization.pop(m.osd, None)
         self._forget_osd_latency(m.osd)
+        self._forget_osd_device(m.osd)
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.osd} boot -> up (epoch "
                     f"{self.osdmap.epoch})")
@@ -278,6 +293,7 @@ class OSDMonitor(PaxosService):
         self.osd_slow_ops.pop(m.target, None)
         self.osd_utilization.pop(m.target, None)
         self._forget_osd_latency(m.target)
+        self._forget_osd_device(m.target)
         self.down_at[m.target] = asyncio.get_event_loop().time()
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.target} marked down "
@@ -298,6 +314,7 @@ class OSDMonitor(PaxosService):
         self.osd_slow_ops.pop(m.osd, None)
         self.osd_utilization.pop(m.osd, None)
         self._forget_osd_latency(m.osd)
+        self._forget_osd_device(m.osd)
         self.down_at[m.osd] = asyncio.get_event_loop().time()
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.osd} marked down (mark-me-down)")
@@ -344,6 +361,108 @@ class OSDMonitor(PaxosService):
             self.peer_latency[m.osd] = table
         else:
             self.peer_latency.pop(m.osd, None)
+        self._ingest_device_health(m)
+
+    def _ingest_device_health(self, m: MPGStats) -> None:
+        """Round 14: pool the daemon's cumulative device-runtime view
+        and run one KERNEL_PATH_DEGRADED debounce step off the
+        per-report (checks, mismatches) DELTA. A report without fresh
+        sweeps (delta 0) is evidence of nothing and moves no counter;
+        a restart's counter reset (negative delta) re-baselines."""
+        dh = getattr(m, "device_health", None)
+        if not isinstance(dh, dict) or not dh:
+            return
+        try:
+            checks = int(dh.get("checks", 0))
+            mism = int(dh.get("mismatches", 0))
+        except (TypeError, ValueError):
+            return
+        state = {k: int(v) for k, v in dh.items()
+                 if isinstance(v, (int, float))}
+        state["engine"] = str(getattr(m, "device_engine", "") or "?")
+        state["mismatch_ratio"] = round(mism / checks, 4) if checks \
+            else 0.0
+        self.osd_device_state[m.osd] = state
+        last = self._kp_last.get(m.osd)
+        self._kp_last[m.osd] = (checks, mism)
+        if last is None or checks < last[0] or mism < last[1]:
+            return                        # first report / re-baseline
+        dc, dm_ = checks - last[0], mism - last[1]
+        if dc <= 0:
+            return                        # no new sweeps this period
+        cfg = getattr(self.mon, "config", {})
+        ratio_k = float(cfg.get("mon_kernel_path_degraded_ratio", 0.1))
+        confirm = int(cfg.get("mon_kernel_path_confirm", 2))
+        ratio = dm_ / dc
+        if ratio >= ratio_k:
+            self._kp_clear.pop(m.osd, None)
+            if m.osd in self.degraded_kernel_paths:
+                self.degraded_kernel_paths[m.osd].update(
+                    ratio=round(ratio, 4), engine=state["engine"])
+                return
+            n = self._kp_suspect.get(m.osd, 0) + 1
+            self._kp_suspect[m.osd] = n
+            if n >= confirm:
+                import time as _time
+                self._kp_suspect.pop(m.osd, None)
+                self.degraded_kernel_paths[m.osd] = {
+                    "ratio": round(ratio, 4),
+                    "engine": state["engine"],
+                    "since": _time.time()}
+                self.mon.clog(
+                    "WRN", f"osd.{m.osd} kernel path degraded "
+                           f"(mismatch ratio {ratio:.2f}, engine "
+                           f"{state['engine']})")
+                log.dout(1, f"osd.{m.osd} KERNEL_PATH_DEGRADED "
+                            f"(ratio {ratio:.2f})")
+        else:
+            self._kp_suspect.pop(m.osd, None)
+            if m.osd not in self.degraded_kernel_paths:
+                return
+            n = self._kp_clear.get(m.osd, 0) + 1
+            self._kp_clear[m.osd] = n
+            if n >= confirm:               # symmetric exit debounce
+                self._kp_clear.pop(m.osd, None)
+                self.degraded_kernel_paths.pop(m.osd, None)
+                self.mon.clog(
+                    "INF", f"osd.{m.osd} kernel path healed")
+                log.dout(1, f"osd.{m.osd} kernel path healed")
+
+    def _forget_osd_device(self, osd: int) -> None:
+        """Drop one OSD's device-runtime evidence (down/removed/fresh
+        incarnation): a dead daemon can't send the clearing report,
+        and a revived one re-baselines from its first report."""
+        self.osd_device_state.pop(osd, None)
+        self._kp_last.pop(osd, None)
+        self._kp_suspect.pop(osd, None)
+        self._kp_clear.pop(osd, None)
+        self.degraded_kernel_paths.pop(osd, None)
+
+    def device_runtime_status(self) -> dict:
+        """The `ceph device-runtime status` payload: per-daemon
+        engine, kernel-path launch/mismatch counters, compile
+        count/time and transfer GiB from the reported cumulative
+        state, plus the degraded table behind KERNEL_PATH_DEGRADED."""
+        daemons = {}
+        for osd, st in sorted(self.osd_device_state.items()):
+            daemons[f"osd.{osd}"] = {
+                "engine": st.get("engine", "?"),
+                "checks": st.get("checks", 0),
+                "mismatches": st.get("mismatches", 0),
+                "mismatch_ratio": st.get("mismatch_ratio", 0.0),
+                "launches": {
+                    p: st.get(f"launches_{p}", 0)
+                    for p in ("pallas", "xla", "scalar", "sharded")},
+                "compiles": st.get("compiles", 0),
+                "compile_s": round(st.get("compile_ms", 0) / 1e3, 3),
+                "h2d_GiB": round(
+                    st.get("h2d_bytes", 0) / (1 << 30), 6),
+                "d2h_GiB": round(
+                    st.get("d2h_bytes", 0) / (1 << 30), 6),
+            }
+        return {"daemons": daemons,
+                "degraded": {str(o): dict(v) for o, v in sorted(
+                    self.degraded_kernel_paths.items())}}
 
     # -- pg merge (ref: OSDMonitor's pg_num_pending machinery) -------------
     def pending_merges(self) -> dict:

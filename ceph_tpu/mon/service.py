@@ -259,6 +259,35 @@ class HealthMonitor(PaxosService):
                     f"{pg['backfilling_pgs']} pgs backfilling "
                     f"({prog.get('pushed', 0)} objects pushed, "
                     f"{prog.get('scanned', 0)} scanned)")}
+        # device-runtime health (round 14): a daemon whose CRUSH
+        # sweeps keep running off the expected kernel engine serves
+        # ~34x slower — the mismatch-rate debounce in the OSDMonitor's
+        # device_health ingest confirms/clears it (OSD_SLOW
+        # discipline), this check only surfaces the verdict
+        degraded = getattr(mon.osdmon, "degraded_kernel_paths", {})
+        if degraded:
+            rows = ", ".join(
+                f"osd.{o} (mismatch ratio {v.get('ratio', 0)}, "
+                f"engine {v.get('engine', '?')})"
+                for o, v in sorted(degraded.items()))
+            checks["KERNEL_PATH_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(degraded)} daemon(s) serving the "
+                           f"CRUSH hot path off the expected kernel "
+                           f"engine: {rows} — see `ceph "
+                           f"device-runtime status`"}
+        # recent daemon crashes (round 14): a top-level loop died with
+        # a real exception; warns until `ceph crash archive <id>` acks
+        crashes = getattr(mon, "crashes", {})
+        fresh = [c for c in crashes.values()
+                 if not c.get("archived")]
+        if fresh:
+            names = sorted({c.get("daemon", "?") for c in fresh})
+            checks["RECENT_CRASH"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(fresh)} recent daemon crash(es) "
+                           f"from {names} — `ceph crash ls` / "
+                           f"`ceph crash archive <id>` to ack"}
         slow = mon.osdmon.osd_slow_ops
         if slow:
             total = sum(slow.values())

@@ -41,6 +41,7 @@ from ceph_tpu.osd.scheduler import (OpScheduler, QoSProfile,
                                     SchedulerThrottle, _Grant,
                                     size_scaled_cost)
 from ceph_tpu.osd.types import MAX_OID, pg_t
+from ceph_tpu.utils.devmon import engine_name as _engine_name
 from ceph_tpu.utils.logging import get_logger
 from ceph_tpu.utils.op_tracker import OpTracker
 from ceph_tpu.utils.perf_counters import PerfCountersBuilder
@@ -112,6 +113,19 @@ class OSD(Dispatcher):
         # spans (n_pgs/path/n_devices) through the daemon's tracer, so
         # advance-map sweep cost is drill-downable in `trace show`
         self.monc.mapping_tracer = self.tracer
+        # device-runtime observability (round 14): this daemon's
+        # kernel-path health monitor (per-daemon counter family,
+        # register=False like osd_ec_agg — it reaches /metrics only
+        # through the report session) wired into the tracked table's
+        # sweep sites; the PROCESS monitor gets this daemon's tracer
+        # so jit compiles emit `jit_compile` spans that ship monward
+        # on the existing stats piggyback
+        from ceph_tpu.utils.devmon import DeviceRuntimeMonitor, devmon
+        self.devmon = DeviceRuntimeMonitor(
+            name="devmon", register=False, config=cfg)
+        self.monc.mapping_devmon = self.devmon
+        devmon().attach_tracer(self.tracer)
+        self._proc_devmon = devmon()
         # per-op-class latency histograms (ref: the OSD's
         # l_osd_op_r/w_latency counters, as real TYPE_HISTOGRAM log2
         # buckets in MICROSECONDS — the prometheus module renders them
@@ -142,9 +156,11 @@ class OSD(Dispatcher):
         from ceph_tpu.mgr.client import MgrReporter
         self._mgr_reporter = MgrReporter(
             name, self.msgr, lambda: self.monc.mgrmap,
-            lambda: [self.perf, self.ec_agg.perf], cfg)
+            lambda: [self.perf, self.ec_agg.perf, self.devmon.perf,
+                     self._proc_devmon.perf], cfg)
         self._mgr_report_task: asyncio.Task | None = None
         self._slow_reported = 0     # last slow-op count sent monward
+        self._device_reported: dict = {}   # last device_health sent
         self.asok = None
         self._asok_dir = cfg.get("admin_socket_dir")
         # backfill reservations (ref: AsyncReserver /
@@ -231,6 +247,16 @@ class OSD(Dispatcher):
         if mt is not None:
             out["table_epoch"] = mt.epoch
         return out
+
+    def _device_status(self) -> dict:
+        """The asok ``device`` block / `device-runtime status`
+        payload: this daemon's kernel-path health beside the process
+        monitor's compile/transfer side (one daemon per process in
+        production, so together they ARE the daemon's device view)."""
+        from ceph_tpu.utils import crash as _crash
+        return {"daemon": self.devmon.dump(),
+                "process": self._proc_devmon.dump(),
+                "recent_crashes": _crash.recent_crashes()}
 
     def failsafe_full(self) -> bool:
         """The stale-map-proof last line of defense (ref: OSD
@@ -343,8 +369,15 @@ class OSD(Dispatcher):
                         "backfill_toofull": self.backfill_toofull()},
                     "mapping": self._mapping_status(),
                     "ec_agg": self.ec_agg.dump(),
+                    "device": self._device_status(),
                     "mgr_session": self._mgr_reporter.dump()},
                 "osd state summary")
+            self.asok.register(
+                "device-runtime status",
+                lambda: self._device_status(),
+                "device-runtime observability: engine, kernel-path "
+                "launches/mismatches, jit compile count/time, "
+                "transfer bytes (daemon + process views)")
             self.asok.register(
                 "dump_ops_in_flight",
                 self.op_tracker.dump_ops_in_flight,
@@ -397,13 +430,28 @@ class OSD(Dispatcher):
                             pg.last_backfill != MAX_OID}},
                 "backfill reservations, throttle and per-pg progress")
             await self.asok.start()
-        self._hb_task = asyncio.ensure_future(self._hb_loop())
-        self._stats_task = asyncio.ensure_future(self._stats_loop())
-        self._admit_task = asyncio.ensure_future(self._admit_loop())
-        self._mgr_report_task = asyncio.ensure_future(
-            self._mgr_reporter.loop())
+        # crash capture (round 14): every long-lived loop carries the
+        # top-level exception hook — a loop that dies with a real
+        # exception ships a bounded MCrashReport monward instead of
+        # leaving a silently half-alive daemon
+        from ceph_tpu.utils import crash as _crash
+        _name = f"osd.{self.whoami}"
+        self._hb_task = _crash.watch(
+            asyncio.ensure_future(self._hb_loop()), _name, self.monc,
+            where="hb_loop")
+        self._stats_task = _crash.watch(
+            asyncio.ensure_future(self._stats_loop()), _name,
+            self.monc, where="stats_loop")
+        self._admit_task = _crash.watch(
+            asyncio.ensure_future(self._admit_loop()), _name,
+            self.monc, where="admit_loop")
+        self._mgr_report_task = _crash.watch(
+            asyncio.ensure_future(self._mgr_reporter.loop()), _name,
+            self.monc, where="mgr_report_loop")
         if self.scrub_interval > 0:
-            self._scrub_task = asyncio.ensure_future(self._scrub_loop())
+            self._scrub_task = _crash.watch(
+                asyncio.ensure_future(self._scrub_loop()), _name,
+                self.monc, where="scrub_loop")
         # clog the boot (ref: OSD::init's "osd.N ... boot" clog line)
         asyncio.ensure_future(self.monc.clog(
             "INF", f"osd.{self.whoami} booted at {self.msgr.addr}"))
@@ -1147,12 +1195,19 @@ class OSD(Dispatcher):
                 # every tick, so holding rtts forces the report
                 peer_lat = {str(o): int(r * 1e6)
                             for o, r in self._peer_rtt.items()}
+                # device-runtime piggyback (round 14): the cumulative
+                # kernel-path/compile/transfer view — reported while
+                # it moves, so the mon's per-report deltas track
+                # ACTIVE sweep traffic (an idle daemon's unchanged
+                # cumulative is delta 0, which heals the warning)
+                dh = self.devmon.health_report()
                 # keep reporting until a zero count has been sent: a
                 # daemon whose slow ops drained (or whose capacity
                 # went back to unbounded) while it held no primary
                 # PGs must still clear the mon's warning/utilization
                 if not stats and not slow and not cap and not spans \
                         and not peer_lat \
+                        and dh == self._device_reported \
                         and not self._slow_reported and \
                         not self._statfs_reported:
                     continue
@@ -1160,9 +1215,12 @@ class OSD(Dispatcher):
                     osd=self.whoami, epoch=self.osdmap.epoch,
                     stats=stats, slow_ops=slow,
                     used_bytes=used, capacity_bytes=cap,
-                    trace_spans=spans, peer_latency=peer_lat))
+                    trace_spans=spans, peer_latency=peer_lat,
+                    device_health=dh,
+                    device_engine=_engine_name()))
                 self._slow_reported = slow
                 self._statfs_reported = cap
+                self._device_reported = dh
                 # merge readiness barrier: re-reported EVERY tick
                 # while the decrease is pending, so a mon leader
                 # change can't lose the barrier state

@@ -461,12 +461,25 @@ class OSDMap:
         the half of the pipeline that only weight/topology changes can
         invalidate — OSDMapMapping caches it per pool so up/down flips
         and override edits replay just ``_pipeline_from_crush``."""
+        raw, pps, _paths = self.pg_to_crush_osds_path(pool_id, seeds)
+        return raw, pps
+
+    def pg_to_crush_osds_path(self, pool_id: int, seeds) -> tuple[
+            np.ndarray, np.ndarray, tuple[str | None, str | None]]:
+        """``pg_to_crush_osds`` plus this sweep's PER-CALL engine
+        evidence ``(expected, actual)``: ``expected`` is the serving
+        Mapper's pre-run plan (``mapping_path``), ``actual`` the
+        engine the call really executed on (``map_pgs_path`` — not the
+        racy ``last_map_path`` slot). OSDMapMapping feeds both to the
+        daemon's device-runtime monitor so a silent kernel-path
+        degradation is a counted per-daemon fact (round 14)."""
         pool = self.pools[pool_id]
         seeds = np.asarray(seeds, dtype=np.uint32)
         pps = pool.raw_pg_to_pps(seeds, xp=np)
         mp = self.serving_mapper(pool.id)
-        raw = np.asarray(mp.map_pgs(pool.crush_rule, pps, pool.size))
-        return raw, pps
+        expected = mp.expected_path(pool.crush_rule, pool.size)
+        out, actual = mp.map_pgs_path(pool.crush_rule, pps, pool.size)
+        return np.asarray(out), pps, (expected, actual)
 
     def pg_to_raw_osds(self, pool_id: int,
                        seeds) -> tuple[np.ndarray, np.ndarray]:

@@ -83,7 +83,8 @@ class OSDMapMapping:
     arrays at one epoch, plus the snapshots the delta diff needs."""
 
     def __init__(self, osdmap: OSDMap | None = None, mesh=None,
-                 mesh_min_batch: int | None = None, tracer=None):
+                 mesh_min_batch: int | None = None, tracer=None,
+                 devmon=None):
         self.epoch = -1
         # optional device mesh (round 10): attached to every map this
         # table updates against, so full-pool sweeps — the expensive
@@ -95,6 +96,12 @@ class OSDMapMapping:
         # `crush_sweep` span (n_pgs/path/n_devices tags) so sweep cost
         # shows up in `trace show` instead of as opaque mapper time
         self.tracer = tracer
+        # optional utils.devmon.DeviceRuntimeMonitor (round 14): the
+        # owning DAEMON's monitor — every full-pool sweep records its
+        # per-call engine (launches by path) and an expected-vs-actual
+        # check, so a daemon serving CRUSH off its expected kernel
+        # path is a counted, health-checkable fact
+        self.devmon = devmon
         self._pools: dict[int, _PoolTable] = {}
         self._osd_weight = None
         self._osd_state = None
@@ -164,23 +171,26 @@ class OSDMapMapping:
                 "n_devices": int(self.mesh.devices.size)
                 if self.mesh is not None else 1,
             }) if self.tracer is not None else None
-        mp = None
+        path = expected = None
         ok = False
         try:
-            mp = osdmap.serving_mapper(pool.id)
-            craw, pps = osdmap.pg_to_crush_osds(pid, seeds)
+            craw, pps, (expected, path) = \
+                osdmap.pg_to_crush_osds_path(pid, seeds)
             ok = True
         finally:
             # even a failed sweep must land in the trace buffer — it
             # is exactly the one an operator will want to drill into.
-            # Tag the engine only on success: on failure last_map_path
-            # is a stale value from some earlier sweep.
+            # The engine tag is THIS call's returned path (round 14:
+            # per-call, never the racy last_map_path slot).
             if span is not None:
-                span.tag("path", (mp.last_map_path or "?")
-                         if ok else "error")
+                span.tag("path", (path or "?") if ok else "error")
                 span.finish()
         craw = np.array(craw)    # writable: delta remap patches rows
-        path = mp.last_map_path
+        if self.devmon is not None:
+            # per-daemon kernel-path health: engine launch counter +
+            # expected-vs-actual (the devmon_expected_engine knob pins
+            # the deployment contract; 'auto' trusts the plan)
+            self.devmon.record_sweep(expected, path)
         if path is not None and path.endswith("+sharded"):
             PERF.inc("remap_sharded_sweeps")
             self.last_sharded_sweeps += 1

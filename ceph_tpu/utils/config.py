@@ -351,6 +351,28 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("mgr_progress_max_events", int, 64,
            "recently-completed progress events retained for "
            "`ceph progress json`", min=1),
+    # device-runtime observability plane (round 14; the devmon layer
+    # in utils/devmon.py + the mon's KERNEL_PATH_DEGRADED sweep).
+    # devmon_expected_engine is read LIVE per sweep check, the
+    # mon_kernel_path_* knobs live per report.
+    Option("devmon_expected_engine", str, "auto",
+           "the kernel engine this daemon is EXPECTED to serve CRUSH "
+           "sweeps with: 'auto' trusts the built plan (a mismatch "
+           "then means a plan silently degraded mid-run); pinning "
+           "'pallas' makes every non-kernel sweep a counted — and "
+           "health-checked — mismatch (the deployment contract for "
+           "production TPU daemons)",
+           enum_allowed=("auto", "pallas", "xla", "scalar")),
+    Option("mon_kernel_path_degraded_ratio", float, 0.1,
+           "per-report mismatch/checks ratio at or above which a "
+           "daemon's kernel path counts as degraded for the "
+           "KERNEL_PATH_DEGRADED debounce",
+           min=0.0, max=1.0),
+    Option("mon_kernel_path_confirm", int, 2,
+           "consecutive degraded device-health reports before "
+           "KERNEL_PATH_DEGRADED trips for a daemon (and clean "
+           "reports before it clears) — the OSD_SLOW debounce "
+           "discipline", min=1),
     # TPU execution knobs (no Ceph analog).
     Option("tpu_ec_backend", str, "auto",
            "GF kernel: bitmatmul (MXU) | lut (VPU) | auto",

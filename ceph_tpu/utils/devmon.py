@@ -1,0 +1,334 @@
+"""Device-runtime observability: the monitor every jit entry point
+reports through (round 14).
+
+The device runtime is a first-class observed subsystem now, the same
+way round 8 made ops and round 12 made counters observable. Three
+blind spots motivated it:
+
+- **silent kernel-path degradation**: a daemon that loses its fused
+  Pallas plan serves CRUSH ~34x slower with zero signal — until now
+  the only detector was a bench run's ``path_expected_vs_actual`` row
+  (round 10), which a production daemon never executes;
+- **invisible jit compiles**: a recompile (shape instability, plan
+  rebuild) stalls the shared event loop for seconds — round 12 had to
+  stall-clamp mgr liveness around exactly this without ever being able
+  to SEE the compile that caused it;
+- **unaccounted transfers**: H2D staging and D2H readbacks dominate
+  wall time on tunnel-attached devices, and nothing counted the bytes.
+
+Two kinds of :class:`DeviceRuntimeMonitor` exist:
+
+- the **process singleton** (``devmon()``, counter family
+  ``device_runtime``, registered in the process collection): the
+  compile/transfer side. Process-level code — ``crush.mapper``,
+  ``crush.sharded_sweep``, ``ec.jax_plugin`` — reports here, because
+  the jit caches it observes are process-wide. A daemon's Tracer can
+  be attached (:meth:`attach_tracer`) so each first-compile emits a
+  deterministic ``jit_compile`` span (never sampled away — compiles
+  are rare, operator-critical events) that ships monward on the
+  daemon's existing report piggyback and lands in ``trace ls/show``.
+- **per-daemon instances** (``register=False``, counter family
+  ``devmon``, reaching ``/metrics`` only through the daemon's
+  MMgrReport session — the round-13 ``osd_ec_agg`` discipline): the
+  kernel-path health side. Every ``Mapper``/``OSDMapMapping`` sweep
+  site records which engine actually ran (:meth:`record_launch`) and
+  whether it matched the expectation (:meth:`record_path_check`):
+  ``devmon_expected_engine`` pins the operator's deployed expectation
+  ("this daemon runs pallas"), ``auto`` trusts the built plan so the
+  only mismatch is a plan that silently degraded mid-run.
+
+Cluster surfacing: counters flow through the existing
+MgrReporter -> DaemonStateIndex -> prometheus leg as dedicated
+``ceph_device_*`` rows; the cumulative (checks, mismatches, compiles,
+transfer bytes) piggyback monward on MPGStats (``device_health``), the
+mon debounces per-report mismatch rates into the
+**KERNEL_PATH_DEGRADED** health check (``mon_kernel_path_*`` knobs,
+same confirm/clear discipline as OSD_SLOW), and
+``ceph device-runtime status`` serves the per-daemon table.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ceph_tpu.utils.perf_counters import PerfCountersBuilder
+
+# engines a path string can resolve to ("+sharded" is a suffix, not an
+# engine: the sharded sweep serves whichever engine the single-device
+# path would)
+ENGINES = ("pallas", "xla", "scalar")
+
+# warm-set bound: (fn, key) pairs tracked for first-call compile
+# detection. Shape churn past this just resets the set (a reset
+# re-counts a warm call as a compile once — observability, not
+# accounting for money).
+_WARM_MAX = 4096
+
+
+def normalize_engine(path: str | None) -> str:
+    """Collapse a mapping path to its base engine:
+    'pallas-interpret' -> 'pallas', 'xla+sharded' -> 'xla'."""
+    if not path:
+        return "?"
+    base = path.split("+", 1)[0]
+    if base.startswith("pallas"):
+        return "pallas"
+    return base if base in ENGINES else "?"
+
+
+class DeviceRuntimeMonitor:
+    """Compile accounting + kernel-path health + transfer gauges.
+
+    ``register=True`` puts the counter family in the process-wide
+    collection (the ``devmon()`` singleton); per-daemon instances pass
+    ``register=False`` and reach `/metrics` only through their report
+    session. ``config`` is the owning daemon's LIVE config dict —
+    ``devmon_expected_engine`` is read per check, so a runtime flip
+    applies to the next sweep."""
+
+    def __init__(self, name: str = "device_runtime",
+                 register: bool = True,
+                 config: dict | None = None):
+        self.config = config if config is not None else {}
+        self.perf = (
+            PerfCountersBuilder(name)
+            .add_u64_counter("jit_compiles",
+                             "first-call jit compiles observed (per "
+                             "distinct function + abstract shape key)")
+            .add_time("jit_compile_seconds",
+                      "wall seconds spent in compile-triggering first "
+                      "calls")
+            .add_u64_counter("launches_pallas",
+                             "map/sweep launches served by the fused "
+                             "Pallas kernel (interpret included)")
+            .add_u64_counter("launches_xla",
+                             "map/sweep launches served by the XLA "
+                             "rule VM")
+            .add_u64_counter("launches_scalar",
+                             "map/sweep launches served by the scalar "
+                             "spec walk (legacy tunables)")
+            .add_u64_counter("launches_sharded",
+                             "launches that rode the mesh-sharded "
+                             "path (counted in addition to the engine)")
+            .add_u64_counter("path_checks",
+                             "expected-vs-actual engine checks at "
+                             "Mapper/OSDMapMapping sweep sites")
+            .add_u64_counter("path_mismatch",
+                             "sweeps whose actual engine differed "
+                             "from the expected one (the silent-"
+                             "degradation signal)")
+            .add_u64_counter("h2d_bytes",
+                             "host->device bytes staged (mapper "
+                             "packing, EC pipeline ingest)")
+            .add_u64_counter("d2h_bytes",
+                             "device->host bytes read back")
+            .add_u64("device_bytes_staged",
+                     "bytes of the most recent staging op (gauge)")
+            .add_u64("device_bytes_watermark",
+                     "largest single staging op seen (gauge, "
+                     "monotone max)")
+            .create_perf_counters(register=register))
+        self.tracer = None           # utils.tracing.Tracer | None
+        self._lock = threading.Lock()
+        self._warm: set[tuple] = set()
+        # fn name -> {count, seconds, last_key, last_seconds}
+        self.functions: dict[str, dict] = {}
+        self._watermark = 0
+        self.last_mismatch: dict | None = None
+
+    # -- wiring ------------------------------------------------------------
+    def attach_tracer(self, tracer) -> None:
+        """Attach the owning daemon's Tracer: every first compile
+        emits one deterministic ``jit_compile`` span through it (in
+        multi-daemon test processes the last attach wins — one span
+        per compile either way, never zero, never double)."""
+        self.tracer = tracer
+
+    # -- compile accounting ------------------------------------------------
+    def jit_call(self, fn_name: str, key, fn, *args):
+        """Run ``fn(*args)``, recording the call as a jit compile when
+        this (fn_name, key) pair has never run before. ``key`` must
+        capture the jit cache identity — callers pass (id(jitted_fn),
+        abstract shape), so a process-shared lru'd program is warm
+        across Mapper instances while a per-Mapper kernel wrapper is
+        cold once per Mapper. Warm calls cost one set lookup; a failed
+        first call un-warms so the retry path's compile still counts."""
+        k = (fn_name, key)
+        with self._lock:
+            warm = k in self._warm
+            if not warm:
+                if len(self._warm) >= _WARM_MAX:
+                    self._warm.clear()
+                self._warm.add(k)
+        if warm:
+            return fn(*args)
+        t0 = time.perf_counter()
+        try:
+            out = fn(*args)
+        except BaseException:
+            with self._lock:
+                self._warm.discard(k)
+            raise
+        self.record_compile(fn_name, key, time.perf_counter() - t0)
+        return out
+
+    def record_compile(self, fn_name: str, key, seconds: float) -> None:
+        """One observed compile: counter + time sum + per-function
+        table + (when a tracer is attached) a deterministic
+        ``jit_compile`` span whose wall covers the first call."""
+        seconds = max(float(seconds), 0.0)
+        self.perf.inc("jit_compiles")
+        self.perf.tinc("jit_compile_seconds", seconds)
+        with self._lock:
+            ent = self.functions.setdefault(
+                fn_name, {"count": 0, "seconds": 0.0})
+            ent["count"] += 1
+            ent["seconds"] = round(ent["seconds"] + seconds, 6)
+            ent["last_key"] = str(key)[:120]
+            ent["last_seconds"] = round(seconds, 6)
+        tracer = self.tracer
+        if tracer is not None:
+            # a real Span, but assembled post-hoc: the trace id is
+            # minted directly (head sampling must not drop compile
+            # evidence) and the start is back-dated so the span's
+            # wall IS the measured first-call stall
+            from ceph_tpu.utils.tracing import Span, new_trace_id
+            s = Span(tracer, "jit_compile", new_trace_id(),
+                     tags={"fn": fn_name, "key": str(key)[:120]})
+            s.start -= seconds
+            s.duration = seconds
+            s.finished = True
+            tracer.record(s)
+
+    # -- kernel-path health ------------------------------------------------
+    def expected_engine(self, plan_path: str | None) -> str:
+        """The engine this monitor's owner EXPECTS sweeps to run on:
+        the ``devmon_expected_engine`` knob when pinned, else the
+        plan's own prediction (``plan_path``) — under which the only
+        possible mismatch is a plan that degraded mid-run."""
+        want = str(self.config.get("devmon_expected_engine", "auto"))
+        if want in ("", "auto"):
+            return normalize_engine(plan_path)
+        return want
+
+    def record_launch(self, path: str | None, n: int = 1) -> None:
+        """Count a map/sweep launch by the engine that actually ran."""
+        eng = normalize_engine(path)
+        if eng in ENGINES:
+            self.perf.inc(f"launches_{eng}", n)
+        if path and "+sharded" in path:
+            self.perf.inc("launches_sharded", n)
+
+    def record_path_check(self, expected: str | None,
+                          actual: str | None) -> bool:
+        """One expected-vs-actual engine check; returns True on
+        mismatch. ``expected`` may be a raw path or a bare engine;
+        both sides normalize, so 'pallas-interpret' == 'pallas' and
+        the '+sharded' suffix never trips a false mismatch."""
+        e, a = normalize_engine(expected), normalize_engine(actual)
+        self.perf.inc("path_checks")
+        if e == a or e == "?":
+            return False
+        self.perf.inc("path_mismatch")
+        self.last_mismatch = {"expected": e, "actual": a,
+                              "stamp": time.time()}
+        return True
+
+    def record_sweep(self, plan_path: str | None, actual: str | None,
+                     n_launches: int = 1) -> bool:
+        """The per-sweep-site combo: launch counter + expectation
+        check (knob-pinned or plan-trusted)."""
+        self.record_launch(actual, n_launches)
+        return self.record_path_check(
+            self.expected_engine(plan_path), actual)
+
+    # -- transfers / memory ------------------------------------------------
+    def record_h2d(self, nbytes: int) -> None:
+        if nbytes > 0:
+            self.perf.inc("h2d_bytes", int(nbytes))
+
+    def record_d2h(self, nbytes: int) -> None:
+        if nbytes > 0:
+            self.perf.inc("d2h_bytes", int(nbytes))
+
+    def note_staging(self, nbytes: int) -> None:
+        """One staging op's device-resident footprint: the gauge holds
+        the most recent op, the watermark the largest ever (per-op
+        max, NOT a running sum — frees are not tracked, and a
+        cumulative gauge would be a lie)."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        self.perf.set("device_bytes_staged", nbytes)
+        with self._lock:
+            if nbytes > self._watermark:
+                self._watermark = nbytes
+        self.perf.set("device_bytes_watermark", self._watermark)
+
+    # -- views -------------------------------------------------------------
+    def mismatch_ratio(self) -> float:
+        d = self.perf.dump()
+        checks = int(d.get("path_checks", 0))
+        return (int(d.get("path_mismatch", 0)) / checks) if checks \
+            else 0.0
+
+    def health_report(self) -> dict[str, int]:
+        """The MPGStats ``device_health`` piggyback payload: this
+        monitor's cumulative path health merged with the process
+        singleton's compile/transfer side (one daemon per process in
+        production, so the merge IS the daemon's view). All u64."""
+        d = self.perf.dump()
+        proc = self if self is _singleton else devmon()
+        p = proc.perf.dump() if proc is not self else d
+        return {
+            "checks": int(d.get("path_checks", 0)),
+            "mismatches": int(d.get("path_mismatch", 0)),
+            "launches_pallas": int(d.get("launches_pallas", 0)),
+            "launches_xla": int(d.get("launches_xla", 0)),
+            "launches_scalar": int(d.get("launches_scalar", 0)),
+            "launches_sharded": int(d.get("launches_sharded", 0)),
+            "compiles": int(p.get("jit_compiles", 0)),
+            "compile_ms": int(
+                float(p.get("jit_compile_seconds", 0.0)) * 1e3),
+            "h2d_bytes": int(p.get("h2d_bytes", 0)),
+            "d2h_bytes": int(p.get("d2h_bytes", 0)),
+        }
+
+    def dump(self) -> dict:
+        """The asok ``device`` block / ``device-runtime status``
+        payload for this monitor."""
+        import jax
+        out = {
+            "engine": jax.default_backend(),
+            "expected_engine": str(
+                self.config.get("devmon_expected_engine", "auto")),
+            "counters": self.perf.dump(),
+            "mismatch_ratio": round(self.mismatch_ratio(), 4),
+        }
+        if self.last_mismatch:
+            out["last_mismatch"] = dict(self.last_mismatch)
+        with self._lock:
+            if self.functions:
+                out["compiles_by_fn"] = {
+                    k: dict(v) for k, v in sorted(self.functions.items())}
+        return out
+
+
+_singleton: DeviceRuntimeMonitor | None = None
+
+
+def engine_name() -> str:
+    """The process's default jax backend ('cpu'/'tpu'/...) — the
+    `device_engine` field daemons stamp on their reports."""
+    import jax
+    return str(jax.default_backend())
+
+
+def devmon() -> DeviceRuntimeMonitor:
+    """The process singleton (counter family ``device_runtime``) every
+    process-level jit entry point reports through."""
+    global _singleton
+    if _singleton is None:
+        _singleton = DeviceRuntimeMonitor()
+    return _singleton

@@ -269,7 +269,8 @@ _CANNED_STATUS = {
                                 "quota_bytes": 4, "quota_objects": 2,
                                 "full": 0}],
                "pending_merges": {"p": {"ready": 1}},
-               "slow_osds": {"2": 4.5}},
+               "slow_osds": {"2": 4.5},
+               "degraded_kernel_paths": {"1": 0.5}},
     "pgmap": {"num_pgs": 8, "degraded_pgs": 0, "backfilling_pgs": 0,
               "backfill_progress": {"pushed": 0}, "num_objects": 4,
               "num_bytes": 64, "states": {"active+clean": 8}},
@@ -338,7 +339,24 @@ def _render_prometheus(reported: bool = False) -> str:
                    .add_u64_counter("stripes", "guard fixture")
                    .add_time_avg("batch_occupancy", "guard fixture")
                    .create_perf_counters(register=False))
-            idx.report(name, 1, schema_entries([pc, agg]), 1.0, {
+            # the round-14 device-runtime families reach /metrics the
+            # same report-session-only way (per-daemon `devmon`
+            # path-health counters + the process `device_runtime`
+            # compile/transfer side) — seed both so the dedicated
+            # ceph_device_* render path stays inside the guards
+            dd = (PerfCountersBuilder("devmon")
+                  .add_u64_counter("path_checks", "guard fixture")
+                  .add_u64_counter("path_mismatch", "guard fixture")
+                  .add_u64_counter("launches_pallas", "guard fixture")
+                  .add_u64_counter("launches_xla", "guard fixture")
+                  .create_perf_counters(register=False))
+            dp = (PerfCountersBuilder("device_runtime")
+                  .add_u64_counter("jit_compiles", "guard fixture")
+                  .add_time("jit_compile_seconds", "guard fixture")
+                  .add_u64_counter("h2d_bytes", "guard fixture")
+                  .create_perf_counters(register=False))
+            idx.report(name, 1, schema_entries([pc, agg, dd, dp]),
+                       1.0, {
                 name: {
                     "ops": 7,
                     "commit_latency": {"avgcount": 2, "sum": 0.01},
@@ -349,7 +367,14 @@ def _render_prometheus(reported: bool = False) -> str:
                 "osd_ec_agg": {
                     "batches": 3, "stripes": 96,
                     "batch_occupancy": {"avgcount": 3,
-                                        "sum": 96.0}}})
+                                        "sum": 96.0}},
+                "devmon": {
+                    "path_checks": 12, "path_mismatch": 4,
+                    "launches_pallas": 8, "launches_xla": 4},
+                "device_runtime": {
+                    "jit_compiles": 5,
+                    "jit_compile_seconds": 1.25,
+                    "h2d_bytes": 4096}})
     else:
         # make sure at least one histogram is non-empty so the
         # _bucket rendering path is exercised by the guard
@@ -374,6 +399,17 @@ def _render_prometheus(reported: bool = False) -> str:
             in text, text
         assert 'ceph_osd_ec_agg_batch_occupancy' \
             '{ceph_daemon="osd.1"} 32' in text, text
+        # round 14: the device-runtime rows render from reported
+        # state only (the generic ceph_perf render must NOT double
+        # the families' cardinality)
+        assert 'ceph_device_path_mismatch_total' \
+            '{ceph_daemon="osd.0"} 4' in text, text
+        assert 'ceph_device_launches_total{ceph_daemon="osd.1",' \
+            'path="pallas"} 8' in text, text
+        assert 'ceph_device_jit_compiles_total' \
+            '{ceph_daemon="osd.0"} 5' in text, text
+        assert 'counter="devmon.' not in text, text
+        assert 'counter="device_runtime.' not in text, text
     return text
 
 
@@ -485,6 +521,17 @@ def test_telemetry_knobs_registered_with_defaults():
     `config show` in every daemon."""
     _assert_knobs_registered(
         ("mgr_stats_", "mgr_progress_", "mgr_beacon_"), "telemetry")
+
+
+def test_devmon_knobs_registered_with_defaults():
+    """Round 14: every device-runtime knob (`devmon_*`,
+    `mon_kernel_path_*`) read anywhere must be a registered Option
+    with a default — `devmon_expected_engine` is read LIVE per sweep
+    check and the mon reads the kernel-path debounce knobs per
+    report, so an unregistered knob silently diverges from
+    `config show`."""
+    _assert_knobs_registered(
+        ("devmon_", "mon_kernel_path_"), "device runtime")
 
 
 def test_ec_agg_knobs_registered_with_defaults():
