@@ -552,6 +552,10 @@ def main() -> None:
                                       "seconds_per_batch", "batch",
                                       "method", "seconds_100M_est",
                                       "path", "path_regressions",
+                                      "fetches_per_sweep",
+                                      "fetch_amortization",
+                                      "candidate_batched",
+                                      "kernel_lanes", "candidate_fold",
                                       "variants", "variants_error")
                 if k in crush}
             detail.pop("crush_error", None)
@@ -631,6 +635,15 @@ def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
     regs = detail.get("crush_detail", {}).get("path_regressions")
     if regs:                     # loud in the driver-parsed tail line
         out["crush_path_regression"] = "; ".join(regs)[:120]
+    # round 15: the choose_args rate rides the compact tail — the
+    # variant the 75.6k/s r05 cliff lived in, so its trajectory must
+    # be driver-parsed every round, not buried in the detail blob
+    ca = detail.get("crush_detail", {}).get("variants", {})
+    if isinstance(ca, dict):
+        ca_row = ca.get("choose_args")
+        if isinstance(ca_row, dict) and \
+                ca_row.get("mappings_per_s") is not None:
+            out["crush_choose_args_per_s"] = ca_row["mappings_per_s"]
     qos = detail.get("qos")
     if isinstance(qos, dict):    # the round-11 QoS verdict, compact
         out["qos_protected"] = qos.get("scheduler_protects_cold")

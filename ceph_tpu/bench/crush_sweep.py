@@ -164,6 +164,17 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
         "path": actual_path,
         "platform": jax.devices()[0].platform,
     }
+    # round 15: structural kernel facts per variant — the candidate-
+    # batched descent's fused fetch count is a recorded number, so
+    # BENCH_r06 can show the measured effect of level-major batching.
+    # Attached only when the measured sweep ACTUALLY executed on the
+    # kernel path: an XLA/scalar row has no plan to describe, and a
+    # mid-run degrade (path_expected_vs_actual above) must not dress
+    # its fallback numbers in the batched kernel's geometry.
+    if actual_path.split("+", 1)[0].startswith("pallas"):
+        info = mapper.kernel_plan_info(rule, num_rep)
+        if info is not None:
+            out.update(info)
     if actual_path.replace("+sharded", "") != expected_path:
         # LOUD: the plan promised one engine and the run executed
         # another (kernel compile/exec failure degraded mid-run) —
@@ -200,7 +211,10 @@ def sweep_rate_variants(n_osds: int = 10240, n_pgs: int = 1 << 21,
         out[name] = {k: r[k] for k in
                      ("mappings_per_s", "n_pgs", "seconds_per_batch",
                       "method", "seconds_100M_est", "path",
-                      "path_expected_vs_actual")
+                      "path_expected_vs_actual",
+                      "fetches_per_sweep", "fetch_amortization",
+                      "candidate_batched",
+                      "kernel_lanes", "candidate_fold")
                      if k in r}
     return out
 

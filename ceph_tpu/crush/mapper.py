@@ -63,7 +63,12 @@ the bottom of the serving stack —
   fixed-point lookup decomposes into two 256-wide one-hot matmuls
   (hi/lo byte split, same MXU trick as ``_zg_pair``), so a
   balancer-style weight-set no longer falls off the kernel onto the
-  XLA gather path (the 34x ``choose_args`` cliff in BENCH_r05).
+  XLA gather path (the 34x ``choose_args`` cliff in BENCH_r05). Since
+  round 15 its descent is level-major with the replica-candidate axis
+  folded into the lane axis — one fused fetch+choose per level for
+  ALL candidates, O(l_total) MXU ops independent of numrep
+  (``kernel_plan_info`` reports the per-sweep fetch count and fold
+  for bench rows).
   ``mapping_path(rule, width)`` reports which engine — pallas / xla /
   scalar — serves a given shape; bench rows record it per variant
   (and diff it against ``last_map_path``, the engine that actually
@@ -1089,6 +1094,17 @@ class Mapper:
             PERF.inc("kernel_plans")
         return self._kernel_plans[ruleno]
 
+    @staticmethod
+    def _plan_numrep(plan, result_max: int) -> int:
+        """The replica count the kernel is built for: the rule's arg1
+        (<= 0 means fill from result_max, like the rule VM), clamped
+        to the requested width. Shared by _kernel_body and
+        kernel_plan_info so the reported geometry always describes
+        the kernel actually built."""
+        numrep = plan.numrep_arg if plan.numrep_arg > 0 \
+            else plan.numrep_arg + result_max
+        return min(numrep, result_max)
+
     def _kernel_body(self, ruleno: int, result_max: int):
         """fn_body(arrs, xs) -> (N, result_max), backed by the fused
         kernel with a masked XLA fallback for flagged lanes, or None
@@ -1102,9 +1118,7 @@ class Mapper:
         plan = self._kernel_plan(ruleno)
         body = None
         if plan is not None:
-            numrep = plan.numrep_arg if plan.numrep_arg > 0 \
-                else plan.numrep_arg + result_max
-            numrep = min(numrep, result_max)
+            numrep = self._plan_numrep(plan, result_max)
             if numrep >= 1:
                 body = self._make_kernel_body(plan, ruleno, result_max,
                                               numrep)
@@ -1128,7 +1142,10 @@ class Mapper:
             self.cfg["type_depth"], plan.target_type, 0)
             if plan.recurse else None)
         root_row = -1 - root
-        lanes = plan.lanes
+        # pad to the candidate-batched PG cell width (round 15): the
+        # candidate axis folds into the lane axis, so the per-cell PG
+        # width is plan.lanes // fold, not plan.lanes
+        lanes = _pm.kernel_geometry(plan, numrep + _pm.SPEC_EXTRA)[0]
 
         def fn_body(arrs, xs):
             n = xs.shape[0]
@@ -1237,6 +1254,45 @@ class Mapper:
                     if self._kernel_mode == "interpret" else "pallas")
         return "xla"
 
+    def kernel_plan_info(self, ruleno: int, result_max: int
+                         ) -> dict | None:
+        """Structural facts of the fused-kernel plan serving
+        (rule, width), or None when the XLA/scalar path stands.
+        Bench rows attach this verbatim (crush_sweep.sweep_rate):
+
+        - ``fetches_per_sweep``: fused level fetch+choose passes per
+          grid cell — groups * l_total since the round-15 candidate
+          batching; a PER-CELL count, only comparable across rounds
+          together with ``kernel_lanes`` (the cell's PG width, which
+          the geometry may change): the honest per-PG comparison is
+          ``fetch_amortization`` below. The level-0 entry is the
+          hoisted shared-root broadcast, not a matmul;
+        - ``fetch_amortization``: per-PG level-pass reduction vs the
+          candidate-major baseline at this plan's own width —
+          (n_cand/plan.lanes) / (groups/kernel_lanes); 1.0 means the
+          geometry degenerated to the old kernel (no VMEM headroom),
+          n_cand is the ideal full fold at unchanged cell width;
+        - ``candidate_batched``: more than one candidate rides each
+          level pass (fold > 1);
+        - ``kernel_lanes`` / ``candidate_fold``: the per-cell PG
+          width and fold the geometry search chose for this map.
+        """
+        if self._scalar_reason or \
+                self._kernel_body(ruleno, result_max) is None:
+            return None
+        from ceph_tpu.crush import pallas_mapper as _pm
+        plan = self._kernel_plan(ruleno)
+        n_cand = self._plan_numrep(plan, result_max) + _pm.SPEC_EXTRA
+        lanes, fold, groups = _pm.kernel_geometry(plan, n_cand)
+        return {
+            "fetches_per_sweep": groups * (plan.l_main + plan.l_leaf),
+            "fetch_amortization": round(
+                n_cand * lanes / (groups * plan.lanes), 3),
+            "candidate_batched": fold > 1,
+            "kernel_lanes": lanes,
+            "candidate_fold": fold,
+        }
+
     def expected_path(self, ruleno: int, result_max: int) -> str:
         """The engine this Mapper is EXPECTED to serve (rule, width)
         on: the built plan's prediction — EXCEPT a Mapper whose fused
@@ -1256,10 +1312,14 @@ class Mapper:
         lru'd XLA programs are warm exactly when jax's own cache is —
         same rule key AND same abstract input shapes (the staged
         arrays' signature; a new Mapper over a differently-shaped map
-        genuinely recompiles)."""
+        genuinely recompiles). Kernel keys carry the kernel-variant
+        tag (round 15): a `jit_compile` span must distinguish a
+        fresh batched-kernel compile from a stale plan's re-trace —
+        the tag bumps whenever the kernel body restructures."""
         if kernel:
-            return ("kern", self._devmon_token, ruleno, result_max,
-                    extra)
+            from ceph_tpu.crush import pallas_mapper as _pm
+            return ("kern", _pm.KERNEL_VARIANT, self._devmon_token,
+                    ruleno, result_max, extra)
         if self._arrays_sig is None:
             self._arrays_sig = tuple(sorted(
                 (k, tuple(v.shape)) for k, v in self.arrays.items()))

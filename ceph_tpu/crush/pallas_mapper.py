@@ -96,6 +96,39 @@ misrank. Because (b) requires THREE draws inside a ~1e-4-relative
 window, its rate is quadratically suppressed (~1e-6/choose measured),
 the same order as the round-6 floor-tie flags.
 
+LEVEL-MAJOR candidate batching (round 15, this PR): the descents for
+the n_cand = numrep + SPEC_EXTRA candidate r values are mutually
+independent until the final slot-resolution scan, and until now each
+candidate replayed ALL l_total levels on its own — n_cand x l_total
+one-hot fetches (the (2R, P) level-table load re-issued per
+candidate) plus n_cand separate hash/choose passes per level, even
+though the level-0 fetch is literally identical for every candidate
+(all descents start at row 0). The kernel now advances all candidates
+ONE LEVEL AT A TIME with the candidate axis folded into the lane
+axis: per-candidate rows stack into (1, fold*N) operands so each
+level runs ONE ``_fetch_level`` matmul with a fold-times-wider
+one-hot and ONE batched choose pass with a per-column r vector — the
+choose functions already broadcast (1, N)-shaped r over the slot
+axis, so the per-column math is untouched and bit-exactness holds
+lane for lane. Level 0 is hoisted outright: its stratum is the single
+TAKE root (P == 1), so the "fetch" is one column broadcast shared by
+every candidate and only the choose is candidate-batched. The fold
+factor is VMEM-governed (``kernel_geometry``) and the accounting is
+per PG, not per cell: streaming FLOPs are identical for every
+geometry, so the win is the per-issue overhead ((2R, P) weight
+loads, op issues) paid groups*l_total times per pg_lanes-wide cell —
+minimized by spending the headroom between the LANES cell cap and
+the VMEM model's raw lane budget on the candidate axis (a fold
+carved out of the PG width alone can never beat the old kernel; the
+geometry search proves its pick against fold=1, so the batched
+kernel is never worse per PG and wins wherever VMEM headroom
+exists). Result: the kernel body's dot_general count is O(l_total),
+independent of numrep on headroom-rich maps (pinned by jaxpr
+inspection in tests/test_pallas_mapper.py), and per-PG level passes
+drop by n_cand*kernel_lanes/(groups*plan.lanes) — 5x for 3-replica
+rules on the canonical-shape map, 2.5x on the VMEM-tighter 10k-OSD
+bench map.
+
 Eligibility (build_plan returns None otherwise; the caller keeps the
 XLA path):
 - modern tunables (chooseleaf_stable=1, no legacy local retries),
@@ -138,12 +171,25 @@ from ceph_tpu.crush.types import (
 CRUSH_HASH_SEED = 1315423911
 
 # perf triage only (results become WRONG): comma list of kernel stages
-# to stub out, e.g. "nozg,nofetch,nohash" — used to attribute kernel
-# time between the zg tie matmul, the one-hot table fetch, and the
-# rjenkins hashing on real hardware. Never set in production.
+# to stub out — used to attribute kernel time between the zg tie
+# matmul, the one-hot table fetch, and the rjenkins hashing on real
+# hardware. Never set in production. ABLATE_STAGES is the complete
+# documented set (tests/test_meta.py pins every `in _ABLATE` literal
+# against it, so a new stage cannot ship undocumented):
+# - nozg:    skip the ln-equality tie matmul (_zg_flag -> 0)
+# - nofetch: skip the one-hot level fetch (broadcast column 0)
+# - nohash:  replace rjenkins with a xor mix
+ABLATE_STAGES = ("nozg", "nofetch", "nohash")
 import os as _os
 _ABLATE = set(filter(None, _os.environ.get(
     "CEPH_TPU_KERNEL_ABLATE", "").split(",")))
+
+# Kernel-identity tag for devmon compile-warmth keys (round 15): the
+# level-major candidate-batched kernel compiles a structurally
+# different program than the round-4..14 candidate-major one, so
+# `jit_compile` spans must distinguish a fresh batched-kernel compile
+# from a stale plan re-trace. Bump on any kernel-body restructure.
+KERNEL_VARIANT = "cbatch1"
 SPEC_EXTRA = 2      # candidates beyond numrep; slot s scans
                     # numrep - s + SPEC_EXTRA candidates before the lane
                     # falls back (P(fallback) ~ collision^(SPEC_EXTRA+1))
@@ -209,9 +255,20 @@ ERR_Z = 1e-4
 REL_SLOP = 2.0 ** -20
 
 
-def _plan_lanes(sizes, rows, kmax) -> int:
-    """Widest power-of-two lane count whose VMEM model fits the budget,
-    or 0 when even MIN_LANES does not (caller declines the plan)."""
+def _plan_lanes(sizes, rows, kmax) -> tuple[int, int]:
+    """(lanes, vmem_lanes): the widest power-of-two PG cell width
+    under both the LANES cap and the VMEM model, plus the RAW
+    (uncapped, un-floored) VMEM lane budget — (0, 0) when even
+    MIN_LANES does not fit (caller declines the plan).
+
+    Since round 15 the VMEM model bounds the FOLDED width of a grid
+    cell's intermediates — candidate-batched descent stacks fold
+    candidates along the lane axis, so kernel_geometry spends the
+    headroom between the LANES cap and vmem_lanes on the candidate
+    axis first, and narrows the PG width only when that headroom is
+    short. The per-folded-lane cost model is unchanged: the live
+    temps per choose have the same shapes whether the lane is a PG or
+    a (PG, candidate) column."""
     per_lane = 0
     for (S, P), R, K in zip(sizes, rows, kmax):
         extra = 0
@@ -229,10 +286,57 @@ def _plan_lanes(sizes, rows, kmax) -> int:
             temps += 8
         per_lane = max(per_lane,
                        4 * (temps * S + 2 * R + P + extra))
-    lanes = min(LANES, VMEM_BUDGET // max(per_lane, 1))
+    vmem_lanes = VMEM_BUDGET // max(per_lane, 1)
+    lanes = min(LANES, vmem_lanes)
     if lanes < MIN_LANES:
-        return 0
-    return 1 << (lanes.bit_length() - 1)
+        return 0, 0
+    return 1 << (lanes.bit_length() - 1), vmem_lanes
+
+
+def kernel_geometry(plan, n_cand: int) -> tuple[int, int, int]:
+    """(pg_lanes, fold, groups) for a candidate-batched kernel build.
+
+    ``fold`` candidates ride the lane axis of one grid cell, so the
+    folded intermediates are (S, fold*pg_lanes). The cost that
+    batching actually reduces is the per-issue overhead of each
+    fetch/choose pass — (2R, P) weight loads, op issues — which is
+    paid ``groups * l_total`` times per cell of ``pg_lanes`` PGs, so
+    the figure of merit is per-PG passes ``groups / pg_lanes``
+    (streaming FLOPs are identical for every geometry). That quotient
+    only improves over the candidate-major baseline
+    (``n_cand / plan.lanes``) when the fold comes out of VMEM
+    HEADROOM — the gap between the LANES-capped cell width and the
+    model's raw ``vmem_lanes`` budget — NOT out of the PG width: a
+    fold carved from plan.lanes alone can never beat fold == 1.
+    So this brute-forces fold in [1, n_cand] (n_cand is tiny) for
+    the minimal groups/pg_lanes, with
+
+    - fold * pg_lanes <= vmem_lanes  (the scoped-VMEM model bounds
+      the folded working set),
+    - pg_lanes <= plan.lanes  (the LANES cap keeps its role as the
+      per-cell PG bound) and pg_lanes a power of two >= MIN_LANES
+      (one lane tile; per-candidate column slices stay 128-aligned
+      and relayout-free),
+    - groups = ceil(n_cand / fold) level sweeps when VMEM cannot
+      carry every candidate at once.
+
+    fold == 1 (always admissible) degenerates to the pre-round-15
+    candidate-major geometry, so eligibility never shrinks and the
+    chosen geometry is never worse per PG than the old kernel."""
+    best = None                          # (groups, pg_lanes, fold)
+    for fold in range(1, n_cand + 1):
+        width = min(plan.vmem_lanes // fold, plan.lanes)
+        if width < MIN_LANES:
+            break                        # width shrinks as fold grows
+        pg = 1 << (width.bit_length() - 1)
+        groups = -(-n_cand // fold)
+        # better: fewer per-PG passes (groups/pg, compared exactly in
+        # cross-multiplied integers); tie -> wider cells
+        if best is None or groups * best[1] < best[0] * pg or \
+                (groups * best[1] == best[0] * pg and pg > best[1]):
+            best = (groups, pg, fold)
+    groups, pg, fold = best              # fold=1 is always admissible
+    return pg, fold, groups
 
 
 def _bucket_classes(weights, G):
@@ -319,7 +423,11 @@ class KernelPlan:                               # hash -> usable as a
     zg2dT: np.ndarray      # (256, 256) f32 {0,1}, [lo, hi] ln-equality
     rhlh: np.ndarray | None  # (14, 129) f32 RH/LH byte planes, or None
     ll: np.ndarray | None    # (6, 256) f32 LL byte planes, or None
-    lanes: int             # grid-cell width fitting VMEM_BUDGET
+    lanes: int             # max PG cell width (LANES cap ∧ VMEM
+                           # model); kernel_geometry picks the actual
+                           # per-numrep cell width and candidate fold
+    vmem_lanes: int        # RAW VMEM lane budget (uncapped) — the
+                           # headroom the candidate fold spends
 
 
 def build_plan(m: CrushMap, packed, ruleno: int,
@@ -526,7 +634,7 @@ def build_plan(m: CrushMap, packed, ruleno: int,
     rhlh = ll = None
     if any(k != 1 for k in kmax):     # class (>1) or continuous (0)
         rhlh, ll = _ln_plane_tables()
-    lanes = _plan_lanes(sizes, rows, kmax)
+    lanes, vmem_lanes = _plan_lanes(sizes, rows, kmax)
     if not lanes:
         return None          # flat/huge-bucket map: the per-cell working
                              # set cannot fit scoped VMEM at any useful
@@ -538,7 +646,8 @@ def build_plan(m: CrushMap, packed, ruleno: int,
         numrep_arg=choose.arg1, recurse=recurse,
         vary_r=t.chooseleaf_vary_r, tries=t.choose_total_tries,
         target_type=target_type, rw_ids=rw_ids, rw_w=rw_w,
-        zg2dT=zg2dT, rhlh=rhlh, ll=ll, lanes=lanes)
+        zg2dT=zg2dT, rhlh=rhlh, ll=ll, lanes=lanes,
+        vmem_lanes=vmem_lanes)
 
 
 @functools.lru_cache(maxsize=1)
@@ -963,7 +1072,8 @@ def _fetch_level(tbl_ref, S, P, R, row, n):
 # The kernel
 # ---------------------------------------------------------------------------
 
-def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
+def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int,
+                 skip_rw: bool, fold: int):
     l_total = plan.l_main + plan.l_leaf
     S_list = [s for s, _ in plan.sizes]
     P_list = [p for _, p in plan.sizes]
@@ -990,56 +1100,85 @@ def _make_kernel(plan: KernelPlan, numrep: int, n_cand: int, skip_rw: bool):
         items_c = []
         leaves_c = []
         ok_c = []
-        for r in range(n_cand):
-            row = jnp.zeros((1, n), dtype=jnp.int32)
-            item = None
+        # Level-major candidate-batched descent (round 15): `fold`
+        # candidates ride the lane axis per group — each level runs
+        # ONE fetch and ONE choose for all of them, with a per-column
+        # r vector (the choose functions broadcast (1, N)-shaped r
+        # over the slot axis, so the per-column math is the old math).
+        for g0 in range(0, n_cand, fold):
+            cands = list(range(g0, min(g0 + fold, n_cand)))
+            nf = len(cands)
+            nw = nf * n
+            xw = x if nf == 1 else jnp.concatenate([x] * nf, axis=1)
+
+            def _rvec(vals):
+                cols = [jnp.full((1, n), int(v), dtype=jnp.int32)
+                        for v in vals]
+                return cols[0] if nf == 1 else \
+                    jnp.concatenate(cols, axis=1)
+
             # main descent at r; leaf descent at sub_r (descend_once)
-            sub_r = (r >> (plan.vary_r - 1)) if plan.vary_r else 0
+            r_main = _rvec(cands)
+            r_leaf = _rvec([(c >> (plan.vary_r - 1))
+                            if plan.vary_r else 0 for c in cands])
+            row = jnp.zeros((1, nw), dtype=jnp.int32)
+            amb_w = jnp.zeros((1, nw), dtype=jnp.bool_)
+            item = None
             for li in range(l_total):
                 S = S_list[li]
+                # level 0 is the hoisted shared-root fetch: its
+                # stratum is the single TAKE root (P == 1), so
+                # _fetch_level broadcasts one column — no matmul, one
+                # load serving every candidate in the group
                 full = _fetch_level(
-                    tbl_refs[li], S, P_list[li], R_list[li], row, n)
+                    tbl_refs[li], S, P_list[li], R_list[li], row, nw)
                 ids = full[0:S, :]
                 nxt = full[S:2 * S, :]
                 size = full[2 * S:2 * S + 1, :]
-                rr = r if li < plan.l_main else sub_r
+                rr = r_main if li < plan.l_main else r_leaf
                 if K_list[li] == 1:
                     win_id, win_next = _choose_level(
-                        zg_ref, x, ids, nxt, size, jnp.int32(rr))
+                        zg_ref, xw, ids, nxt, size, rr)
                 elif K_list[li] == 0:        # per-slot continuous draw
                     win_id, win_next, amb = _choose_level_cont(
-                        rhlh_ref, ll_ref, x, ids, nxt, size,
+                        rhlh_ref, ll_ref, xw, ids, nxt, size,
                         full[2 * S + 1:3 * S + 1, :],
                         full[3 * S + 1:4 * S + 1, :],
-                        jnp.int32(rr))
-                    amb_any = amb_any | amb
+                        rr)
+                    amb_w = amb_w | amb
                 else:
                     kk = K_list[li]
                     win_id, win_next, amb = _choose_level_cls(
-                        zg_ref, rhlh_ref, ll_ref, x, ids, nxt, size,
+                        zg_ref, rhlh_ref, ll_ref, xw, ids, nxt, size,
                         full[2 * S + 1:3 * S + 1, :],
                         full[3 * S + 1:3 * S + 1 + kk, :],
                         full[3 * S + 1 + kk:3 * S + 1 + 2 * kk, :],
-                        kk, jnp.int32(rr))
-                    amb_any = amb_any | amb
+                        kk, rr)
+                    amb_w = amb_w | amb
                 if li == plan.l_main - 1:
                     item = win_id                    # target-type bucket
                 row = win_next
-            leaf = row                               # device id (1, N)
+            leaf = row                               # device id (1, nw)
             if item is None:                         # choose-to-device
                 item = leaf
-            ok = jnp.ones((1, n), dtype=jnp.bool_)
+            ok = jnp.ones((1, nw), dtype=jnp.bool_)
             if not skip_rw and K:
-                hh = _hash2(x, leaf) & 0xFFFF
-                w = jnp.full((1, n), WEIGHT_ONE, dtype=jnp.int32)
+                hh = _hash2(xw, leaf) & 0xFFFF
+                w = jnp.full((1, nw), WEIGHT_ONE, dtype=jnp.int32)
                 for k in range(K):                   # K <= MAX_REWEIGHT
                     w = jnp.where(leaf == jnp.int32(plan.rw_ids[k]),
                                   jnp.int32(plan.rw_w[k]), w)
                 out = (w < WEIGHT_ONE) & ((w == 0) | (hh >= w))
                 ok = ok & ~out
-            items_c.append(item)
-            leaves_c.append(leaf)
-            ok_c.append(ok)
+            # unfold: per-candidate (1, n) column slices (lane offsets
+            # are multiples of the power-of-two PG width — relayout-
+            # free) feed the shared-candidate-table slot resolution
+            for i in range(nf):
+                sl = slice(i * n, (i + 1) * n)
+                items_c.append(item[:, sl])
+                leaves_c.append(leaf[:, sl])
+                ok_c.append(ok[:, sl])
+                amb_any = amb_any | amb_w[:, sl]
         # slot resolution: scan the shared candidate table
         bad = jnp.zeros((1, n), dtype=jnp.bool_)
         chosen_i = []
@@ -1073,14 +1212,16 @@ def _run_kernel(plan: KernelPlan, xs: jax.Array, numrep: int,
                 interpret: bool = False):
     """xs (N,) int32 -> (leaves (N, numrep) int32, bad (N,) bool).
 
-    N must be a multiple of plan.lanes."""
+    N must be a multiple of the candidate-batched PG cell width
+    (kernel_geometry(plan, numrep + SPEC_EXTRA)[0] — a power of two
+    dividing plan.lanes, so any plan.lanes multiple qualifies)."""
     n = xs.shape[0]
-    LANES = plan.lanes
-    assert n % LANES == 0, n
     n_cand = numrep + SPEC_EXTRA
+    LANES, fold, _groups = kernel_geometry(plan, n_cand)
+    assert n % LANES == 0, (n, LANES)
     l_total = plan.l_main + plan.l_leaf
     skip_rw = plan.rw_ids.shape[0] == 0
-    kernel = _make_kernel(plan, numrep, n_cand, skip_rw)
+    kernel = _make_kernel(plan, numrep, n_cand, skip_rw, fold)
     grid = (n // LANES,)
     # index maps return jnp.int32(0), not the literal 0: under the
     # caller's enable_x64 the literal traces as i64 and Mosaic cannot

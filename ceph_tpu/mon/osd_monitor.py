@@ -286,18 +286,25 @@ class OSDMonitor(PaxosService):
             return
         inc = Incremental()
         inc.new_down = [m.target]
-        self.failure_reporters.pop(m.target, None)
-        # a dead daemon can't send the clearing report: drop its
-        # slow-op count, stale statfs and latency evidence, or the
-        # SLOW_OPS warning / FULL / OSD_SLOW evidence outlives it
-        self.osd_slow_ops.pop(m.target, None)
-        self.osd_utilization.pop(m.target, None)
-        self._forget_osd_latency(m.target)
-        self._forget_osd_device(m.target)
-        self.down_at[m.target] = asyncio.get_event_loop().time()
+        self._mark_down_bookkeeping(m.target)
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.target} marked down "
                     f"({len(reporters)} reporters)")
+
+    def _mark_down_bookkeeping(self, osd: int) -> None:
+        """The state transition every mark-down path shares (failure
+        reports, mark-me-down, the `osd down` command): a dead daemon
+        can't send the clearing report, so its slow-op count, stale
+        statfs and latency evidence must drop with it — or the
+        SLOW_OPS / FULL / OSD_SLOW evidence outlives it — and the
+        auto-out tick's down_at clock starts (setdefault: an
+        already-aging down OSD keeps its original stamp)."""
+        self.failure_reporters.pop(osd, None)
+        self.osd_slow_ops.pop(osd, None)
+        self.osd_utilization.pop(osd, None)
+        self._forget_osd_latency(osd)
+        self._forget_osd_device(osd)
+        self.down_at.setdefault(osd, asyncio.get_event_loop().time())
 
     async def _handle_mark_me_down(self, m: MOSDMarkMeDown) -> None:
         """ref: OSDMonitor::prepare_mark_me_down — a gracefully
@@ -310,12 +317,7 @@ class OSDMonitor(PaxosService):
             return
         inc = Incremental()
         inc.new_down = [m.osd]
-        self.failure_reporters.pop(m.osd, None)
-        self.osd_slow_ops.pop(m.osd, None)
-        self.osd_utilization.pop(m.osd, None)
-        self._forget_osd_latency(m.osd)
-        self._forget_osd_device(m.osd)
-        self.down_at[m.osd] = asyncio.get_event_loop().time()
+        self._mark_down_bookkeeping(m.osd)
         await self._propose_inc(inc)
         log.dout(1, f"osd.{m.osd} marked down (mark-me-down)")
 
@@ -1430,9 +1432,30 @@ class OSDMonitor(PaxosService):
         return 0, "", json.dumps(["default"] + names).encode()
 
     async def _cmd_down(self, cmd, inbl):
+        osd = int(cmd["id"])
+        # same id guard as the failure/mark-me-down paths: an
+        # out-of-range id would commit an Incremental whose apply
+        # indexes past osd_state (and a negative one would silently
+        # mark — and now auto-out — the LAST osd via numpy indexing)
+        if osd < 0 or osd >= self.osdmap.max_osd:
+            return -22, f"osd.{osd} does not exist", b""
+        # already down: succeed without proposing (the reference's
+        # "osd.N is already down") — a redundant commit would bump
+        # the epoch cluster-wide for a no-op, and re-stamping
+        # down_at after auto-out already popped it would leave an
+        # entry the tick can never remove (removal needs a nonzero
+        # weight)
+        if not bool(self.osdmap.is_up(np.asarray(osd))):
+            return 0, f"osd.{osd} is already down", b""
         inc = Incremental()
-        inc.new_down = [int(cmd["id"])]
+        inc.new_down = [osd]
         ok = await self._propose_inc(inc)
+        if ok:
+            # full failure-path state transition, not just the map
+            # bit: a command-marked-down OSD may be a hard-killed
+            # daemon (an alive one re-boots and re-reports; nothing
+            # is lost)
+            self._mark_down_bookkeeping(osd)
         return (0, f"marked down osd.{cmd['id']}", b"") if ok else \
             (-11, "proposal failed", b"")
 

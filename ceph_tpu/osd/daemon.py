@@ -49,6 +49,32 @@ from ceph_tpu.utils.throttle import MessageThrottle
 
 log = get_logger("osd")
 
+
+def _boot_crush_mesh(cfg: dict):
+    """Mesh provenance (round 15, ROADMAP #1d first slice): the
+    ``osd_crush_mesh`` knob decides where this daemon's device mesh
+    comes from, so mesh-sharded full-pool sweeps stop requiring
+    hand-wiring. ``auto`` builds the local default mesh over every
+    visible device when more than one is visible (one device keeps
+    the plain path — the sharded sweep needs >1 anyway); ``off``
+    (the default) never attaches one. Returns a Mesh or None; any
+    backend probe failure degrades to None — mesh attachment is an
+    optimization, never a boot dependency."""
+    if str(cfg.get("osd_crush_mesh", "off")) != "auto":
+        return None
+    try:
+        import jax
+        devices = jax.devices()
+        if len(devices) > 1:
+            from ceph_tpu.parallel import make_mesh
+            return make_mesh(devices)
+    except Exception as e:
+        log.dout(0, "osd_crush_mesh=auto: mesh probe failed "
+                    f"({type(e).__name__}: {str(e)[:120]}) — "
+                    "keeping the single-device path")
+    return None
+
+
 # process-wide overload-protection counters (exported via `perf dump`
 # + the mgr prometheus module, like osd_recovery's)
 OVERLOAD_PERF = (
@@ -88,6 +114,11 @@ class OSD(Dispatcher):
         # anyway, so the (delta-updated) table replaces those mapper
         # runs rather than adding work
         self.monc.track_mapping = True
+        # mesh provenance (round 15): the registered osd_crush_mesh
+        # knob attaches the boot-time mesh to the tracked table, which
+        # re-attaches it to every map it updates against — sharded
+        # sweeps without hand-wiring (ROADMAP #1d)
+        self.monc.mapping_mesh = _boot_crush_mesh(cfg)
         self.monc.map_callbacks.append(self._on_osdmap)
         self.osdmap = None
         self.pgs: dict[str, PG] = {}

@@ -373,6 +373,18 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
            "KERNEL_PATH_DEGRADED trips for a daemon (and clean "
            "reports before it clears) — the OSD_SLOW debounce "
            "discipline", min=1),
+    # mesh provenance (round 15, ROADMAP #1d first slice): where a
+    # production daemon's device mesh comes from. Read once at OSD
+    # boot — the tracked mapping table re-attaches the mesh on every
+    # update, so the knob governs provenance, not per-sweep routing.
+    Option("osd_crush_mesh", str, "off",
+           "attach a device mesh to this OSD's tracked mapping table "
+           "at boot so full-pool CRUSH sweeps run mesh-sharded "
+           "without hand-wiring: 'auto' builds the local default "
+           "mesh over all visible devices when more than one is "
+           "visible (a single device keeps the plain path); 'off' "
+           "never attaches one",
+           enum_allowed=("off", "auto")),
     # TPU execution knobs (no Ceph analog).
     Option("tpu_ec_backend", str, "auto",
            "GF kernel: bitmatmul (MXU) | lut (VPU) | auto",

@@ -76,3 +76,39 @@ def test_sweep_rate_records_path_regression():
     assert r["path"] == "xla"
     assert r["path_expected_vs_actual"] == "pallas->xla"
     assert path_regressions({"v": r}) == ["v: pallas->xla"]
+    # a degraded row must not dress its fallback numbers in the
+    # batched kernel's geometry (round 15)
+    assert "candidate_batched" not in r
+    assert "fetches_per_sweep" not in r
+
+
+def test_sweep_rate_reports_candidate_batched_kernel(monkeypatch):
+    """Round 15 schema pin: a kernel-path sweep_rate row carries the
+    candidate-batching facts (fetches_per_sweep, candidate_batched)
+    and stays JSON-clean; an XLA-path row omits them. The timed sweep
+    is stubbed — the keys come from the PLAN, and an interpret-mode
+    kernel sweep would cost tier-1 a full compile for nothing."""
+    from ceph_tpu.bench import crush_sweep as cs
+    from ceph_tpu.crush import pallas_mapper as pm
+    from ceph_tpu.crush.mapper import Mapper
+
+    monkeypatch.setenv("CEPH_TPU_CRUSH_KERNEL", "interpret")
+    mp = Mapper(cs.canonical_map(64), block=1 << 10)
+    info = mp.kernel_plan_info(0, 3)
+    assert info is not None and info["candidate_batched"] is True
+    plan = mp._kernel_plan(0)
+    _, fold, groups = pm.kernel_geometry(plan, 3 + pm.SPEC_EXTRA)
+    assert info["fetches_per_sweep"] == \
+        groups * (plan.l_main + plan.l_leaf)
+    monkeypatch.setattr(cs, "_timed_sweep", lambda *a: 0.01)
+    r = cs.sweep_rate(n_osds=64, n_pgs=1 << 12, num_rep=3, mapper=mp)
+    assert r["candidate_batched"] is True
+    assert r["fetches_per_sweep"] == info["fetches_per_sweep"]
+    assert r["candidate_fold"] == info["candidate_fold"]
+    assert json.loads(json.dumps(r)) == r       # JSON-clean
+    # XLA path (kernel off): the keys are absent, not null
+    monkeypatch.setenv("CEPH_TPU_CRUSH_KERNEL", "0")
+    mx = Mapper(cs.canonical_map(64), block=1 << 10)
+    rx = cs.sweep_rate(n_osds=64, n_pgs=1 << 12, num_rep=3, mapper=mx)
+    assert "fetches_per_sweep" not in rx
+    assert "candidate_batched" not in rx

@@ -197,6 +197,23 @@ def test_map_pgs_path_is_per_call():
     assert int(np.asarray(counts).sum()) == 32 * 3
 
 
+def test_kernel_jit_key_carries_variant_tag():
+    """Round 15: the compile-warmth key of a kernel-path jit wrapper
+    carries the kernel-variant tag, so a `jit_compile` span (its key
+    tag is str(key)) distinguishes a fresh candidate-batched-kernel
+    compile from a stale plan's re-trace; XLA keys stay variant-free
+    (the rule VM did not restructure)."""
+    from ceph_tpu.crush import pallas_mapper as pm
+    m = Mapper(_two_rule_map(56), block=1 << 10)
+    kkey = m._jit_key(0, 3, True, 64)
+    assert pm.KERNEL_VARIANT in kkey, kkey
+    assert pm.KERNEL_VARIANT not in m._jit_key(0, 3, False, 64)
+    # two Mapper incarnations over one map still key apart (the
+    # per-incarnation token survives beside the variant tag)
+    m2 = Mapper(_two_rule_map(56), block=1 << 10)
+    assert m2._jit_key(0, 3, True, 64) != kkey
+
+
 def test_degraded_mapper_keeps_counting_mismatches():
     """A Mapper whose fused kernel failed mid-run stays pinned to the
     engine it PROMISED ('pallas') under devmon_expected_engine=auto:

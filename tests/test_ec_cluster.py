@@ -165,6 +165,16 @@ def test_ec_write_survives_position_shuffle():
         try:
             await io.write_full("pre", b"P" * 2000)
             await c.kill_osd(2)
+            # deterministic down-wait: heartbeat-failure detection is
+            # timing-dependent and flaked under whole-suite load
+            # ("osd.2 still up" — reporter tasks starved past the
+            # 60 s wait). The daemon is already hard-stopped, so mark
+            # it down by mon command and wait only for the map commit
+            # — what the test needs is the DOWN map, not the
+            # detection latency (covered by the heartbeat tests).
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "osd down", "id": 2})
+            assert ret == 0, rs
             await c.wait_for_osd_down(2, timeout=60)
             # wait past mon_osd_down_out_interval (2.0s in _ec_cluster)
             # so the OUT remap lands: acting positions shuffle among

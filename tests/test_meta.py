@@ -534,6 +534,31 @@ def test_devmon_knobs_registered_with_defaults():
         ("devmon_", "mon_kernel_path_"), "device runtime")
 
 
+def test_crush_engine_knobs_registered_with_defaults():
+    """Round 15: every CRUSH-engine knob (`osd_crush_*` — mesh
+    provenance lands here) read anywhere must be a registered Option
+    with a default — `osd_crush_mesh` is read at OSD boot, so an
+    unregistered knob silently diverges from `config show`."""
+    _assert_knobs_registered(("osd_crush_",), "CRUSH engine")
+
+
+def test_kernel_ablate_names_documented():
+    """Every CEPH_TPU_KERNEL_ABLATE stage the kernel consults (an
+    `"..." in _ABLATE` literal in pallas_mapper.py) must appear in
+    the module's documented ABLATE_STAGES set — an undocumented
+    stage is an env knob nobody can discover, and a stale entry is a
+    knob that silently stopped doing anything."""
+    import re
+    from ceph_tpu.crush.pallas_mapper import ABLATE_STAGES
+    src = (REPO / "ceph_tpu" / "crush" /
+           "pallas_mapper.py").read_text()
+    used = set(re.findall(r'"([a-z0-9_]+)" in _ABLATE', src))
+    assert used, "no _ABLATE reads found (guard went stale)"
+    assert used == set(ABLATE_STAGES), (
+        f"kernel ablation stages drifted: read {sorted(used)} vs "
+        f"documented {sorted(ABLATE_STAGES)}")
+
+
 def test_ec_agg_knobs_registered_with_defaults():
     """Round 13: every EC-aggregator knob (`osd_ec_agg*`) read
     anywhere must be a registered Option with a default — the

@@ -83,6 +83,39 @@ def test_single_mon_bootstrap():
     run(go())
 
 
+def test_osd_down_command_rejects_bad_ids():
+    """`osd down` guards its id like the failure/mark-me-down paths:
+    an out-of-range id must not commit (apply would index past
+    osd_state), and a NEGATIVE id must not silently mark — and, with
+    the round-15 down_at stamp, later auto-out — the LAST osd via
+    numpy negative indexing."""
+    async def go():
+        mons, monmap = await start_mons(1)
+        leader = await wait_quorum(mons)
+        await wait_for(lambda: leader.osdmon.osdmap is not None,
+                       msg="initial osdmap")
+        max_osd = leader.osdmon.osdmap.max_osd
+        for bad in (-1, max_osd, max_osd + 7):
+            ret, rs, _ = await leader.handle_command(
+                {"prefix": "osd down", "id": bad})
+            assert ret == -22, (bad, ret, rs)
+        assert not leader.osdmon.down_at
+        # already-down id (a created-but-never-booted OSD): succeed
+        # WITHOUT proposing (no epoch bump, no down_at re-stamp the
+        # tick could never clear)
+        ret, _, _ = await leader.handle_command(
+            {"prefix": "osd new", "id": 0})
+        assert ret == 0
+        epoch = leader.osdmon.osdmap.epoch
+        ret, rs, _ = await leader.handle_command(
+            {"prefix": "osd down", "id": 0})
+        assert ret == 0 and "already down" in rs, (ret, rs)
+        assert leader.osdmon.osdmap.epoch == epoch
+        assert not leader.osdmon.down_at
+        await stop_all(mons)
+    run(go())
+
+
 def test_three_mon_quorum_and_replication():
     async def go():
         mons, monmap = await start_mons(3)

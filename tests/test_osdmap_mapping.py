@@ -361,3 +361,65 @@ class TestBalancerOnTable:
             row = up[pg.seed]
             for frm, to in pairs:
                 assert frm not in row
+
+
+class TestMeshProvenance:
+    """Round 15 (ROADMAP #1d first slice): the registered
+    ``osd_crush_mesh`` knob decides where an OSD's device mesh comes
+    from — ``auto`` attaches the local default mesh at boot when more
+    than one device is visible, so sharded full-pool sweeps stop
+    requiring hand-wiring."""
+
+    def test_boot_crush_mesh_knob(self):
+        from ceph_tpu.osd.daemon import _boot_crush_mesh
+        assert _boot_crush_mesh({}) is None                  # default
+        assert _boot_crush_mesh({"osd_crush_mesh": "off"}) is None
+        # auto on a single-device host: the sharded sweep needs >1
+        # device, so no mesh attaches (the plain path stands)
+        import jax
+        if len(jax.devices()) == 1:
+            assert _boot_crush_mesh(
+                {"osd_crush_mesh": "auto"}) is None
+        else:                                # pragma: no cover (TPU)
+            mesh = _boot_crush_mesh({"osd_crush_mesh": "auto"})
+            assert mesh is not None and mesh.devices.size > 1
+
+    def test_auto_builds_mesh_over_visible_devices(self, monkeypatch):
+        """>1 visible device: auto returns make_mesh(devices) — the
+        device probe is faked (CPU CI has one device), the mesh
+        constructor is observed."""
+        from ceph_tpu.osd import daemon as osd_daemon
+        fake_devices = [object(), object()]
+        built = {}
+        monkeypatch.setattr(
+            "jax.devices", lambda *a, **k: fake_devices)
+
+        def fake_make_mesh(devices):
+            built["devices"] = devices
+            return "mesh-sentinel"
+
+        import ceph_tpu.parallel
+        monkeypatch.setattr(ceph_tpu.parallel, "make_mesh",
+                            fake_make_mesh)
+        got = osd_daemon._boot_crush_mesh({"osd_crush_mesh": "auto"})
+        assert got == "mesh-sentinel"
+        assert built["devices"] is fake_devices
+
+    def test_osd_boot_wires_mesh_into_tracked_table(self, monkeypatch):
+        """OSD.__init__ hands the knob's mesh to the MonClient, which
+        constructs the tracked OSDMapMapping with it — the table then
+        re-attaches the mesh to every map it updates against."""
+        from ceph_tpu.mon import MonMap
+        from ceph_tpu.osd import daemon as osd_daemon
+        sentinel = object()
+        monkeypatch.setattr(osd_daemon, "_boot_crush_mesh",
+                            lambda cfg: sentinel
+                            if cfg.get("osd_crush_mesh") == "auto"
+                            else None)
+        monmap = MonMap()
+        monmap.add("a", 0, "127.0.0.1", 6789)
+        osd = osd_daemon.OSD(0, monmap,
+                             config={"osd_crush_mesh": "auto"})
+        assert osd.monc.mapping_mesh is sentinel
+        osd2 = osd_daemon.OSD(1, monmap, config={})
+        assert osd2.monc.mapping_mesh is None

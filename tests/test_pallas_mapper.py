@@ -557,6 +557,128 @@ class TestBitExact:
         assert int(bk) == int(bx)
 
 
+@pytest.fixture(scope="module")
+def batched_plan():
+    """ONE shared map+plan for the candidate-batching tests (tier-1
+    budget: the plan build is host-side but the canonical-shape
+    hierarchy is not free, and the jaxpr pins below only trace — no
+    compile — so sharing the plan keeps the whole class cheap)."""
+    m, rid = _hier(16, 4)
+    plan = pm.build_plan(m, pack_map(m), rid, None)
+    assert plan is not None
+    return m, rid, plan
+
+
+def _count_dot_generals(jaxpr) -> int:
+    """dot_general eqns in ``jaxpr`` and every nested jaxpr (pjit
+    bodies, the pallas_call kernel jaxpr, cond/scan branches)."""
+
+    def _subs(v):
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                yield from _subs(x)
+        elif hasattr(v, "jaxpr"):            # ClosedJaxpr
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):             # Jaxpr
+            yield v
+
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            n += 1
+        for v in eqn.params.values():
+            for sub in _subs(v):
+                n += _count_dot_generals(sub)
+    return n
+
+
+class TestCandidateBatching:
+    """Round 15 level-major descent: the kernel body's MXU traffic is
+    O(l_total), independent of how many replica candidates descend —
+    pinned structurally by jaxpr inspection (no compile, no run; the
+    bit-exactness of the batched math rides the existing suites
+    unchanged)."""
+
+    def test_dot_general_count_independent_of_numrep(self,
+                                                     batched_plan):
+        import jax
+        _, _, plan = batched_plan
+        counts = {}
+        for numrep in (2, 3, 4):
+            n_cand = numrep + pm.SPEC_EXTRA
+            lanes, fold, groups = pm.kernel_geometry(plan, n_cand)
+            # the canonical-shape plan keeps full lanes, so every
+            # candidate folds into one group — the pinned regime
+            assert fold == n_cand and groups == 1, (fold, groups)
+            xs = jnp.zeros(lanes, dtype=jnp.int32)
+            jx = jax.make_jaxpr(
+                lambda v, nr=numrep: pm._run_kernel(
+                    plan, v, nr, interpret=True))(xs)
+            counts[numrep] = _count_dot_generals(jx.jaxpr)
+        assert len(set(counts.values())) == 1, counts
+        # the O(l_total) structural pin: ONE fetch matmul per level
+        # with P > 1 (level 0 is the hoisted P == 1 broadcast) plus
+        # ONE zg tie matmul per uniform choose — nothing scales with
+        # numrep
+        l_total = plan.l_main + plan.l_leaf
+        expect = sum(1 for _, p in plan.sizes if p > 1) + l_total
+        assert counts[2] == expect, (counts, expect)
+
+    def test_kernel_geometry_contract(self, batched_plan):
+        import types
+        _, _, plan = batched_plan
+        for n_cand in (3, 5, 8, 11):
+            lanes, fold, groups = pm.kernel_geometry(plan, n_cand)
+            assert lanes >= pm.MIN_LANES
+            assert lanes & (lanes - 1) == 0          # power of two
+            assert lanes <= plan.lanes               # PG cell cap
+            # the folded working set never exceeds the RAW VMEM
+            # budget, and the groups cover every candidate exactly
+            assert fold * lanes <= plan.vmem_lanes
+            assert fold * (groups - 1) < n_cand <= fold * groups
+            # the load-bearing guarantee: per-PG level passes
+            # (groups/lanes) never exceed the candidate-major
+            # baseline's (n_cand/plan.lanes) — a fold carved out of
+            # the PG width alone would violate this
+            assert groups * plan.lanes <= n_cand * lanes, \
+                (n_cand, lanes, fold, groups)
+        # a plan with zero VMEM headroom past MIN_LANES degenerates
+        # to candidate-major geometry (fold 1, one group per
+        # candidate) — eligibility never shrinks
+        narrow = types.SimpleNamespace(lanes=pm.MIN_LANES,
+                                       vmem_lanes=pm.MIN_LANES)
+        assert pm.kernel_geometry(narrow, 5) == (pm.MIN_LANES, 1, 5)
+        # headroom-rich plan: full fold at the unchanged cell width
+        rich = types.SimpleNamespace(lanes=1024, vmem_lanes=8192)
+        assert pm.kernel_geometry(rich, 5) == (1024, 5, 1)
+        # the 10k-OSD bench shape (vmem ~3.4x the cap): the search
+        # must prefer fold 3 at full width (2 groups/1024 PGs) over
+        # the naive full fold at a narrowed cell (1 group/512 PGs =
+        # same passes, narrower cells) and over fold 1 (5 groups)
+        bench = types.SimpleNamespace(lanes=1024, vmem_lanes=3503)
+        assert pm.kernel_geometry(bench, 5) == (1024, 3, 2)
+
+    def test_plan_info_through_mapper(self):
+        """Mapper.kernel_plan_info: the bench-row facts — plan build
+        only, no kernel compile (the body closure is built lazily and
+        never traced here)."""
+        m, rid = _hier(8, 4)
+        mapper = Mapper(m)
+        info = mapper.kernel_plan_info(rid, 3)
+        assert info is not None
+        plan = mapper._kernel_plan(rid)
+        _, fold, groups = pm.kernel_geometry(plan, 3 + pm.SPEC_EXTRA)
+        assert info["candidate_batched"] == (fold > 1)
+        assert info["fetches_per_sweep"] == \
+            groups * (plan.l_main + plan.l_leaf)
+        assert info["candidate_fold"] == fold
+        # the XLA path has no plan to describe
+        mi, root = builder.build_flat(
+            4, weights=[pm.MAX_CONT_WEIGHT + i for i in range(4)])
+        ri = builder.add_simple_rule(mi, root, builder.TYPE_OSD)
+        assert Mapper(mi).kernel_plan_info(ri, 3) is None
+
+
 class TestKernelInternals:
     def test_hash_bit_exact(self):
         from ceph_tpu.crush import hash as H
