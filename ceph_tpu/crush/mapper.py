@@ -145,6 +145,13 @@ PERF = (_PCB("crush_mapper")
         .add_u64_counter("kernel_exec_failures",
                          "fused-kernel compile/run failures that degraded "
                          "this Mapper to the XLA path")
+        .add_u64_counter("kernel_probes",
+                         "quarantine re-probe attempts (backoff-paced "
+                         "kernel runs compared bit-exact vs the serving "
+                         "path)")
+        .add_u64_counter("kernel_repromotes",
+                         "quarantined kernels re-promoted after a "
+                         "bit-exact probe passed")
         .add_u64_counter("rule_compiles", "XLA rule-body jit builds")
         .add_u64_counter("sweep_compiles", "aggregated-sweep jit builds")
         .add_u64_counter("reweights", "set_device_weights calls")
@@ -841,8 +848,15 @@ class Mapper:
                  device_weights: np.ndarray | None = None,
                  block: int | None = None,
                  choose_args: int | None = None,
-                 mesh=None, mesh_min_batch: int | None = None):
+                 mesh=None, mesh_min_batch: int | None = None,
+                 config: dict | None = None):
         _t0 = time.perf_counter()
+        # LIVE config dict for the quarantine knobs
+        # (crush_kernel_reprobe_*); None falls back to the process
+        # devmon's config, which Cluster.install_faults points at the
+        # cluster's shared dict — so a served cluster's knob flips
+        # reach every Mapper without re-plumbing constructors.
+        self._config = config
         self.map = crush_map
         self.packed: PackedMap = pack_map(crush_map)
         self.choose_args_key = choose_args
@@ -1008,6 +1022,16 @@ class Mapper:
         self._devmon_token = next(_MAPPER_TOKEN)
         self._arrays_sig: tuple | None = None
         self._degraded_from: str | None = None
+        # Kernel quarantine state machine (round 16): a kernel failure
+        # no longer permanently drops to XLA — the kernel is
+        # quarantined (XLA serves) and re-probed on capped exponential
+        # backoff; only crush_kernel_reprobe_disable_after CONSECUTIVE
+        # probe failures make it permanent. See _disable_kernel /
+        # _maybe_reprobe.
+        self._quar_state: str | None = None  # quarantined|reprobing|permanent
+        self._quar_mode: str | None = None   # kernel mode to restore
+        self._quar_failures = 0              # consecutive failures
+        self._quar_next_probe = 0.0          # monotonic deadline
         PERF.inc("packs")
         PERF.tinc("pack_seconds", time.perf_counter() - _t0)
         # device-runtime accounting (round 14): the pack's H2D staging
@@ -1061,8 +1085,23 @@ class Mapper:
         self.__dict__.pop("_sharded_fns", None)
 
     # -- fused Pallas kernel path (round 4) --------------------------------
+    def _knob(self, name: str, default):
+        """crush_kernel_reprobe_* knobs, read LIVE from this Mapper's
+        config dict (or the process devmon's — see __init__)."""
+        cfg = self._config if self._config is not None \
+            else _devmon().config
+        try:
+            return type(default)(cfg.get(name, default))
+        except (TypeError, ValueError):
+            return default
+
     def _disable_kernel(self, where: str, exc: Exception) -> None:
-        """Permanently drop to the XLA path after a kernel failure.
+        """Quarantine the fused kernel after a failure: XLA serves
+        while a re-probe is pending on capped exponential backoff
+        (crush_kernel_reprobe_base/_max); after
+        crush_kernel_reprobe_disable_after CONSECUTIVE failures the
+        quarantine is permanent (today's sticky behavior, for a
+        genuinely broken libtpu).
 
         The fused kernel is an optimization, never a correctness
         dependency: any compile/runtime failure (e.g. a libtpu with a
@@ -1070,19 +1109,138 @@ class Mapper:
         must degrade to the always-correct XLA path instead of killing
         the caller — round 4's driver bench died exactly this way."""
         from ceph_tpu.utils.logging import get_logger
-        get_logger("crush").dout(
-            0, f"fused CRUSH kernel failed in {where} "
-               f"({type(exc).__name__}: {str(exc)[:200]}) — "
-               f"falling back to the XLA path for this Mapper")
         PERF.inc("kernel_exec_failures")
         # the engine this Mapper PROMISED before degrading: keeps the
         # expected-vs-actual baseline honest (see _devmon_token note)
         self._degraded_from = "pallas"
+        if self._quar_mode is None:
+            self._quar_mode = self._kernel_mode
         self._kernel_mode = None
         self._kernel_plans.clear()
         self._kernel_bodies.clear()
         self._kernel_fns.clear()
         self.__dict__.pop("_sharded_fns", None)   # see set_device_weights
+        entering = self._quar_state is None
+        self._quar_failures += 1
+        disable_after = max(
+            1, self._knob("crush_kernel_reprobe_disable_after", 5))
+        dm = _devmon()
+        if self._quar_failures >= disable_after:
+            self._quar_state = "permanent"
+            self._quar_next_probe = float("inf")
+            get_logger("crush").dout(
+                0, f"fused CRUSH kernel failed in {where} "
+                   f"({type(exc).__name__}: {str(exc)[:200]}) — "
+                   f"{self._quar_failures} consecutive failures, "
+                   f"permanently disabled for this Mapper")
+        else:
+            base = self._knob("crush_kernel_reprobe_base", 0.5)
+            cap = self._knob("crush_kernel_reprobe_max", 30.0)
+            backoff = min(base * (2 ** (self._quar_failures - 1)), cap)
+            self._quar_next_probe = time.monotonic() + backoff
+            self._quar_state = "quarantined" if entering else "reprobing"
+            get_logger("crush").dout(
+                0, f"fused CRUSH kernel failed in {where} "
+                   f"({type(exc).__name__}: {str(exc)[:200]}) — "
+                   f"quarantined (XLA serves; re-probe in "
+                   f"{backoff:.2f}s, failure "
+                   f"{self._quar_failures}/{disable_after})")
+        if entering:
+            dm.record_quarantine_enter(self._devmon_token,
+                                       self._quar_state)
+        else:
+            dm.set_quarantine_state(self._devmon_token,
+                                    self._quar_state)
+
+    def _maybe_reprobe(self, ruleno: int, result_max: int) -> None:
+        """Run one backoff-paced quarantine probe when due (called at
+        the top of fresh map_pgs/sweep entries — never from the
+        degrade-retry re-entry, so a probe can't recurse into the
+        failure that scheduled it)."""
+        if self._quar_state in (None, "permanent"):
+            return
+        if time.monotonic() < self._quar_next_probe:
+            return
+        self._reprobe(ruleno, result_max)
+
+    def _reprobe(self, ruleno: int, result_max: int) -> None:
+        """One probe: rebuild the kernel body, run it on a small PG
+        sample, compare BIT-EXACT against the serving XLA path. Pass
+        -> re-promote (quarantine exits, failure count resets); raise
+        or mismatch -> back to quarantine with doubled backoff."""
+        from ceph_tpu.utils.logging import get_logger
+        dm = _devmon()
+        self._kernel_mode = self._quar_mode
+        self._kernel_plans.clear()
+        self._kernel_bodies.clear()
+        self._kernel_fns.clear()
+        self.__dict__.pop("_sharded_fns", None)
+        try:
+            kb = self._kernel_body(ruleno, result_max)
+        except Exception as e:
+            dm.record_probe(False)
+            PERF.inc("kernel_probes")
+            self._disable_kernel("reprobe", e)
+            return
+        if kb is None:
+            # this (rule, width) never rides the kernel — nothing to
+            # judge here; stand down and probe on a kernel-eligible
+            # call instead
+            self._kernel_mode = None
+            self._kernel_bodies.clear()
+            return
+        PERF.inc("kernel_probes")
+        nprobe = 128
+        try:
+            with _enable_x64(True):
+                xs = jnp.arange(nprobe, dtype=jnp.uint32)
+                fn = jax.jit(kb)
+                got = np.asarray(dm.jit_call(
+                    "crush_map_pgs",
+                    self._jit_key(ruleno, result_max, True,
+                                  ("probe", nprobe)),
+                    fn, self.arrays, xs))
+                ref = np.asarray(dm.jit_call(
+                    "crush_map_pgs",
+                    self._jit_key(ruleno, result_max, False, nprobe),
+                    self._rule_fn(ruleno, result_max),
+                    self.arrays, xs))
+            if not np.array_equal(got, ref):
+                bad = int((got != ref).sum())
+                raise RuntimeError(
+                    f"probe mismatch: kernel disagrees with the "
+                    f"serving path on {bad}/{got.size} slots")
+        except Exception as e:
+            dm.record_probe(False)
+            self._disable_kernel("reprobe", e)
+            return
+        # bit-exact: re-promote
+        dm.record_probe(True)
+        self._kernel_fns[(ruleno, result_max)] = fn
+        PERF.inc("kernel_compiles")
+        PERF.inc("kernel_repromotes")
+        self._quar_state = None
+        self._quar_mode = None
+        self._quar_failures = 0
+        self._quar_next_probe = 0.0
+        self._degraded_from = None
+        dm.record_quarantine_exit(self._devmon_token)
+        get_logger("crush").dout(
+            0, f"fused CRUSH kernel re-promoted after quarantine "
+               f"(probe bit-exact vs the serving path on {nprobe} "
+               f"PGs, rule {ruleno})")
+
+    def kernel_quarantine_info(self) -> dict | None:
+        """The quarantine state machine's live view (bench / status),
+        or None when the kernel is healthy."""
+        if self._quar_state is None:
+            return None
+        due = self._quar_next_probe - time.monotonic()
+        return {"state": self._quar_state,
+                "failures": self._quar_failures,
+                "next_probe_in_s": (round(max(due, 0.0), 3)
+                                    if self._quar_state != "permanent"
+                                    else None)}
 
     def _kernel_plan(self, ruleno: int):
         if ruleno not in self._kernel_plans:
@@ -1399,6 +1557,7 @@ class Mapper:
             return (self._scalar_map(ruleno, xs, result_max),
                     self._record_path("scalar", _expected))
         if _expected is None:
+            self._maybe_reprobe(ruleno, result_max)
             _expected = self.expected_path(ruleno, result_max)
         if self._use_mesh(len(xs)):
             out = self._sharded_map_pgs(ruleno, xs, result_max)
@@ -1528,6 +1687,7 @@ class Mapper:
             return (np.asarray(counts, dtype=np.int64), np.int64(bad),
                     self._record_path("scalar", _expected))
         if _expected is None:
+            self._maybe_reprobe(ruleno, result_max)
             _expected = self.expected_path(ruleno, result_max)
         if self._use_mesh(n) and device_counts_size is None:
             counts, bad = self._sharded_sweep(ruleno, start_x, n,

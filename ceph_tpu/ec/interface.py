@@ -156,6 +156,19 @@ class ErasureCodeInterface(ABC):
         return np.stack([np.asarray(self.encode_chunks(data[b]))
                          for b in range(data.shape[0])])
 
+    def encode_batch_reference(self, data):
+        """(B, k, C) uint8 -> (B, m, C) parity via a HOST-ONLY path —
+        no jit, no device, bit-exact with ``encode_batch`` by
+        construction. This is the last rung of the OSD aggregator's
+        degrade ladder (osd/ec_aggregator): when the device encode
+        keeps failing, a client write is served from here rather than
+        erroring. Base: the per-stripe loop (still host-only when
+        ``encode_chunks`` is — device plugins MUST override with a
+        genuinely device-free implementation)."""
+        data = np.asarray(data)
+        return np.stack([np.asarray(self.encode_chunks(data[b]))
+                         for b in range(data.shape[0])])
+
     def encode_batch_with_crc(self, data):
         """(B, k, C) -> (parity (B, m, C), row_crcs (B, k+m) | None).
 

@@ -182,6 +182,33 @@ class ErasureCodeJax(ErasureCodeInterface):
             "ec_encode", (id(kern), tuple(data.shape)),
             kern.apply_batch, data)
 
+    def encode_batch_reference(self, data):
+        """Host-only bit-exact reference encode — the last rung of the
+        OSD aggregator's degrade ladder. Pure numpy, no jit, no
+        device: ``gf_matmul_np`` (the numpy oracle both JAX kernels
+        are pinned against) for the GF(2^8) techniques, and the
+        packet-plane XOR mirror of ``bitmatrix_encode_stripes`` for
+        the array codes. (B, k, C) uint8 -> (B, m, C)."""
+        data = np.ascontiguousarray(np.asarray(data), dtype=np.uint8)
+        B, k, C = data.shape
+        if self._bitmatrix is not None:
+            w = self.w
+            ps = C // w
+            bm = np.asarray(self._bitmatrix) != 0         # (mw, kw)
+            planes = data.reshape(B, k * w, ps)
+            flat = planes.transpose(1, 0, 2).reshape(k * w, B * ps)
+            out = np.zeros((bm.shape[0], B * ps), dtype=np.uint8)
+            for r in range(bm.shape[0]):
+                sel = flat[bm[r]]
+                if sel.shape[0]:
+                    out[r] = np.bitwise_xor.reduce(sel, axis=0)
+            mw = out.shape[0]
+            return out.reshape(mw, B, ps).transpose(1, 0, 2).reshape(
+                B, mw // w, C)
+        coeffs = self._encode_kernel.coeffs
+        x = data.transpose(1, 0, 2)                       # (k, B, C)
+        return tables.gf_matmul_np(coeffs, x).transpose(1, 0, 2)
+
     def encode_batch_with_crc(self, data):
         """Fused checksum+encode: ONE jitted device program computes
         the parity AND a raw-CRC32 per shard row (data rows included).
@@ -303,8 +330,23 @@ class StreamingEncodePipeline:
         if donate is None:
             donate = jax.default_backend() == "tpu"
         kern = ec._encode_kernel
+        self._kern = kern
         self._fn = jax.jit(kern.apply_batch,
                            donate_argnums=(0,) if donate else ())
+        # lazily-built non-donated fallback jit (see encode_iter)
+        self._plain_fn = None
+
+    def _encode_plain(self, host, dm):
+        """The non-donated unpipelined fallback: stage, encode, read
+        back — one batch at a time, no buffer donation, no overlap."""
+        if self._plain_fn is None:
+            self._plain_fn = jax.jit(self._kern.apply_batch)
+        fn = self._plain_fn
+        out = dm.jit_call("ec_stream_encode",
+                          (id(fn), tuple(host.shape)), fn, host)
+        host_out = np.asarray(out)
+        dm.record_d2h(host_out.nbytes)
+        return host_out
 
     def encode_iter(self, batches):
         """host (B, k, C) uint8 batches in -> parity np arrays out,
@@ -313,7 +355,15 @@ class StreamingEncodePipeline:
         Transfer accounting (round 14): every H2D stage and D2H
         readback feeds the device-runtime monitor's byte counters, so
         a pipeline-bound ingest shows up as transfer GiB in
-        `device-runtime status` instead of as unexplained wall."""
+        `device-runtime status` instead of as unexplained wall.
+
+        Fault discipline (round 16): a transfer/encode failure
+        mid-pipeline does NOT lose batches — every staged host batch
+        is kept until its parity is yielded, so on failure the
+        pipeline falls back to the non-donated unpipelined path,
+        re-encodes the in-flight batches from their host copies and
+        drains the rest of the iterator (devmon counts a
+        ``stream_fallbacks``)."""
         dm = _devmon()
 
         def _encode(batch):
@@ -327,26 +377,47 @@ class StreamingEncodePipeline:
             return host
 
         it = iter(batches)
+        # host copies of staged batches whose parity has NOT been
+        # yielded yet, oldest first — the fallback's replay source
+        pending: list[np.ndarray] = []
         try:
-            first = np.ascontiguousarray(next(it))
-        except StopIteration:
-            return
-        dm.record_h2d(first.nbytes)
-        dm.note_staging(first.nbytes)
-        cur = jax.device_put(first)
-        prev = None
-        for nxt_host in it:
-            nxt_host = np.ascontiguousarray(nxt_host)
-            dm.record_h2d(nxt_host.nbytes)
-            nxt = jax.device_put(nxt_host)
+            try:
+                first = np.ascontiguousarray(next(it))
+            except StopIteration:
+                return
+            pending.append(first)
+            dm.record_h2d(first.nbytes)
+            dm.note_staging(first.nbytes)
+            cur = jax.device_put(first)
+            prev = None
+            for nxt_host in it:
+                nxt_host = np.ascontiguousarray(nxt_host)
+                pending.append(nxt_host)
+                dm.record_h2d(nxt_host.nbytes)
+                nxt = jax.device_put(nxt_host)
+                out = _encode(cur)
+                if prev is not None:
+                    yield _readback(prev)
+                    pending.pop(0)
+                prev, cur = out, nxt
             out = _encode(cur)
             if prev is not None:
                 yield _readback(prev)
-            prev, cur = out, nxt
-        out = _encode(cur)
-        if prev is not None:
-            yield _readback(prev)
-        yield _readback(out)
+                pending.pop(0)
+            yield _readback(out)
+            pending.pop(0)
+        except Exception as e:
+            dm.perf.inc("stream_fallbacks")
+            log.dout(0, f"streaming encode pipeline failed "
+                        f"({type(e).__name__}: {str(e)[:200]}) — "
+                        f"falling back to the unpipelined path for "
+                        f"{len(pending)} in-flight batches + the rest")
+            for host in pending:
+                yield self._encode_plain(host, dm)
+            for nxt_host in it:
+                host = np.ascontiguousarray(nxt_host)
+                dm.record_h2d(host.nbytes)
+                yield self._encode_plain(host, dm)
 
     def encode_all(self, batches) -> list:
         return list(self.encode_iter(batches))

@@ -543,6 +543,21 @@ class PrometheusModule(MgrModule):
                     f'ceph_device_mem_watermark_bytes{{{lab}}} '
                     f'{_num(dp, "device_bytes_watermark")}',
                 ]
+                # quarantine plane (round 16): one gauge per phase
+                # from the process monitor's state machine, plus the
+                # EC degrade ladder's client-saving fallback count
+                for ph, key in (("quarantined", "quarantined_now"),
+                                ("reprobing", "reprobing_now"),
+                                ("permanent",
+                                 "quarantine_permanent_now")):
+                    dev_rows.append(
+                        f'ceph_device_quarantine{{{lab},'
+                        f'phase="{ph}"}} {_num(dp, key)}')
+                da = loggers.get("osd_ec_agg") or {}
+                if da:
+                    dev_rows.append(
+                        f'ceph_osd_ec_fallback_ops_total{{{lab}}} '
+                        f'{_num(da, "fallback_ops")}')
             if dev_rows:
                 lines.append("# ceph_device_*: device-runtime "
                              "observability (reported)")

@@ -42,6 +42,20 @@ log = get_logger("mon")
 PFX = "osdmap"
 
 
+def _quarantine_phase(state) -> str | None:
+    """Collapse a device_health piggyback dict into the quarantine
+    dimension the KERNEL_PATH_DEGRADED check reports: the kernel path
+    is either permanently retired, actively re-probing, parked in
+    quarantine awaiting its next probe, or (None) healthy."""
+    if state.get("quarantine_permanent", 0):
+        return "permanent"
+    if state.get("reprobing", 0):
+        return "reprobing"
+    if state.get("quarantined", 0):
+        return "quarantined"
+    return None
+
+
 class OSDMonitor(PaxosService):
     prefix = PFX
 
@@ -400,7 +414,8 @@ class OSDMonitor(PaxosService):
             self._kp_clear.pop(m.osd, None)
             if m.osd in self.degraded_kernel_paths:
                 self.degraded_kernel_paths[m.osd].update(
-                    ratio=round(ratio, 4), engine=state["engine"])
+                    ratio=round(ratio, 4), engine=state["engine"],
+                    phase=_quarantine_phase(state))
                 return
             n = self._kp_suspect.get(m.osd, 0) + 1
             self._kp_suspect[m.osd] = n
@@ -410,6 +425,7 @@ class OSDMonitor(PaxosService):
                 self.degraded_kernel_paths[m.osd] = {
                     "ratio": round(ratio, 4),
                     "engine": state["engine"],
+                    "phase": _quarantine_phase(state),
                     "since": _time.time()}
                 self.mon.clog(
                     "WRN", f"osd.{m.osd} kernel path degraded "
@@ -461,6 +477,16 @@ class OSDMonitor(PaxosService):
                     st.get("h2d_bytes", 0) / (1 << 30), 6),
                 "d2h_GiB": round(
                     st.get("d2h_bytes", 0) / (1 << 30), 6),
+                # quarantine state machine + EC degrade evidence
+                # (round 16; rides the same piggyback)
+                "quarantine": {
+                    "phase": _quarantine_phase(st),
+                    "quarantined": st.get("quarantined", 0),
+                    "reprobing": st.get("reprobing", 0),
+                    "permanent": st.get("quarantine_permanent", 0),
+                    "entries": st.get("quarantine_entries", 0),
+                    "exits": st.get("quarantine_exits", 0)},
+                "ec_fallback_ops": st.get("ec_fallback_ops", 0),
             }
         return {"daemons": daemons,
                 "degraded": {str(o): dict(v) for o, v in sorted(

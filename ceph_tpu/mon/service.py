@@ -268,7 +268,8 @@ class HealthMonitor(PaxosService):
         if degraded:
             rows = ", ".join(
                 f"osd.{o} (mismatch ratio {v.get('ratio', 0)}, "
-                f"engine {v.get('engine', '?')})"
+                f"engine {v.get('engine', '?')}"
+                + (f", {v['phase']}" if v.get("phase") else "") + ")"
                 for o, v in sorted(degraded.items()))
             checks["KERNEL_PATH_DEGRADED"] = {
                 "severity": "HEALTH_WARN",

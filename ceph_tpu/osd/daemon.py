@@ -1232,6 +1232,13 @@ class OSD(Dispatcher):
                 # ACTIVE sweep traffic (an idle daemon's unchanged
                 # cumulative is delta 0, which heals the warning)
                 dh = self.devmon.health_report()
+                # EC degrade evidence rides the same piggyback: ops
+                # this OSD served from the reference encoder after
+                # device retries exhausted (round 16)
+                agg = self.ec_agg.perf.dump()
+                dh["ec_fallback_ops"] = int(agg.get("fallback_ops", 0))
+                dh["ec_flush_failures"] = int(
+                    agg.get("flush_failures", 0))
                 # keep reporting until a zero count has been sent: a
                 # daemon whose slow ops drained (or whose capacity
                 # went back to unbounded) while it held no primary

@@ -36,6 +36,7 @@ function of the profile).
 from __future__ import annotations
 
 import asyncio
+import time
 
 import numpy as np
 
@@ -68,6 +69,20 @@ def _agg_perf():
         .add_time_avg("batch_wait",
                       "seconds an op waited for its flush (long-run "
                       "avg)")
+        .add_u64_counter("flush_failures",
+                         "batched flushes whose device encode raised "
+                         "(the batch disaggregated per-op)")
+        .add_u64_counter("per_op_retries",
+                         "bounded per-op device retries after a "
+                         "failed batch (osd_ec_fallback_retries)")
+        .add_u64_counter("fallback_ops",
+                         "ops served by the bit-exact reference "
+                         "(numpy) encoder after device retries "
+                         "exhausted")
+        .add_u64_counter("crc_fallbacks",
+                         "fused checksum+encode failures that dropped "
+                         "to plain encode + host crc (the fused jit "
+                         "quarantines on backoff)")
         .create_perf_counters(register=False))
 
 
@@ -102,6 +117,11 @@ class ECAggregator:
         self.perf = _agg_perf()
         self._groups: dict[tuple, _Group] = {}
         self.stopped = False
+        # fused checksum+encode quarantine (round 16): after the fused
+        # jit raises, flushes serve plain encode + host crc until the
+        # backoff deadline passes, then the fused path is retried
+        self._crc_q_until = 0.0
+        self._crc_failures = 0
 
     # -- knobs (read LIVE) -------------------------------------------------
     def enabled(self) -> bool:
@@ -112,6 +132,9 @@ class ECAggregator:
 
     def max_stripes(self) -> int:
         return int(self.config.get("osd_ec_agg_max_stripes", 4096))
+
+    def _retries(self) -> int:
+        return int(self.config.get("osd_ec_fallback_retries", 1))
 
     # -- submit ------------------------------------------------------------
     async def encode(self, ec, data, with_crc: bool = False):
@@ -129,7 +152,10 @@ class ECAggregator:
             # speedup (fused checksum still applies — the fusion is
             # orthogonal to coalescing)
             self.perf.inc("bypass")
-            return self._run(ec, data, with_crc, pad=False)
+            try:
+                return self._run(ec, data, with_crc, pad=False)
+            except Exception as e:
+                return self._degrade_one(ec, data, with_crc, e)
         key = (str(ec.profile), int(data.shape[1]), int(data.shape[2]))
         g = self._groups.get(key)
         if g is None:
@@ -191,10 +217,8 @@ class ECAggregator:
         loop = asyncio.get_event_loop()
         try:
             parity, crcs = self._run(g.ec, big, want_crc)
-        except Exception as e:               # pragma: no cover - device
-            for ent in entries:
-                if not ent.fut.done():
-                    ent.fut.set_exception(e)
+        except Exception as e:
+            self._degrade(g.ec, entries, e)
             return
         off = 0
         now = loop.time()
@@ -215,25 +239,102 @@ class ECAggregator:
         log.dout(10, f"ec_agg flush {trigger}: {len(entries)} ops, "
                      f"{big.shape[0]} stripes")
 
+    # -- degrade ladder (round 16) -----------------------------------------
+    def _degrade(self, ec, entries, err: Exception) -> None:
+        """Failed batch flush: DISAGGREGATE — retry each member stripe
+        as its own device encode, then the bit-exact reference (numpy)
+        encoder; only the op whose stripe still fails under the
+        reference sees the exception. One poisoned stripe must not
+        fail its batchmates, and a client write must never error
+        because the accelerator did."""
+        self.perf.inc("flush_failures")
+        log.dout(0, f"ec_agg batch flush failed "
+                    f"({type(err).__name__}: {str(err)[:200]}) — "
+                    f"disaggregating {len(entries)} ops")
+        loop = asyncio.get_event_loop()
+        for ent in entries:
+            try:
+                res = self._run(ec, ent.data, ent.with_crc, pad=False)
+            except Exception as e:
+                try:
+                    res = self._degrade_one(ec, ent.data,
+                                            ent.with_crc, e)
+                except Exception as e2:
+                    if not ent.fut.done():
+                        ent.fut.set_exception(e2)
+                    self.perf.avg_add("batch_wait",
+                                      loop.time() - ent.t0)
+                    continue
+            if not ent.fut.done():
+                ent.fut.set_result(res)
+            self.perf.avg_add("batch_wait", loop.time() - ent.t0)
+
+    def _degrade_one(self, ec, data, with_crc: bool, err: Exception):
+        """Per-op tail of the ladder: osd_ec_fallback_retries more
+        device attempts, then the reference encoder (host numpy,
+        bit-exact by construction; crcs fall back to the caller's
+        zlib path). Raises the last device error only when the
+        reference itself fails."""
+        exc = err
+        for _ in range(max(0, self._retries())):
+            self.perf.inc("per_op_retries")
+            try:
+                return self._run(ec, data, with_crc, pad=False)
+            except Exception as e:
+                exc = e
+        try:
+            parity = np.asarray(ec.encode_batch_reference(data),
+                                dtype=np.uint8)
+        except Exception:
+            raise exc
+        self.perf.inc("fallback_ops")
+        log.dout(1, f"ec_agg op served by the reference encoder "
+                    f"({data.shape[0]} stripes) after device retries "
+                    f"exhausted")
+        return parity, None
+
     @staticmethod
     def _pad(b: int) -> int:
         """Next power of two: bounds the jit cache to O(log) shapes."""
         return 1 << (int(b) - 1).bit_length() if b > 1 else 1
 
     def _run(self, ec, data, want_crc: bool, pad: bool = True):
-        """One device launch over a (possibly padded) batch."""
+        """One device launch over a (possibly padded) batch. The fused
+        checksum+encode jit carries its own quarantine: after it
+        raises, flushes drop to plain encode + host crc (callers'
+        zlib path) until an exponential-backoff deadline
+        (osd_ec_fallback_quarantine_base/_max) passes, then the fused
+        path is probed again by simply serving the next crc flush."""
         b = data.shape[0]
         padded = self._pad(b) if pad else b
         if padded != b:
             pad = np.zeros((padded - b,) + data.shape[1:],
                            dtype=np.uint8)
             data = np.concatenate([data, pad], axis=0)
-        if want_crc:
-            parity, crcs = ec.encode_batch_with_crc(data)
-            parity = np.asarray(parity)[:b]
-            crcs = None if crcs is None else np.asarray(crcs)[:b]
-            return parity, crcs
+        if want_crc and time.monotonic() >= self._crc_q_until:
+            try:
+                parity, crcs = ec.encode_batch_with_crc(data)
+                parity = np.asarray(parity)[:b]
+                crcs = None if crcs is None else np.asarray(crcs)[:b]
+            except Exception as e:
+                self._crc_fail(e)
+            else:
+                self._crc_failures = 0
+                return parity, crcs
         return np.asarray(ec.encode_batch(data))[:b], None
+
+    def _crc_fail(self, e: Exception) -> None:
+        self.perf.inc("crc_fallbacks")
+        self._crc_failures += 1
+        base = float(self.config.get(
+            "osd_ec_fallback_quarantine_base", 1.0))
+        cap = float(self.config.get(
+            "osd_ec_fallback_quarantine_max", 30.0))
+        backoff = min(base * (2 ** (self._crc_failures - 1)), cap)
+        self._crc_q_until = time.monotonic() + backoff
+        log.dout(0, f"fused checksum+encode failed "
+                    f"({type(e).__name__}: {str(e)[:200]}) — plain "
+                    f"encode + host crc for {backoff:.2f}s")
 
     # -- lifecycle / observability ----------------------------------------
     def drain(self) -> int:

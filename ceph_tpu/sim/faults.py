@@ -39,6 +39,30 @@ Fault semantics per kind:
   the next message to the same peer overtakes it (or ``hold_s``
   elapses, so a lone message is never lost).
 
+Device fault kinds (round 16) reuse the same rule table but fire at a
+different choke point: ``utils.devmon.jit_call``, the one entry every
+jit-backed device call (CRUSH map/sweep kernels, EC encode/decode)
+already passes through. For these kinds ``a`` is an fnmatch pattern
+over the call's ``fn_name`` (``crush_map_pgs``, ``crush_sweep``,
+``ec_encode``, ``ec_encode_crc``, ``ec_decode``,
+``ec_stream_encode``) and ``b`` is a pattern over ``str(key)`` — the
+jit cache key, whose kernel-path form starts with ``('kern', ...)``,
+so ``b="*'kern'*"`` targets only kernel-path launches and leaves the
+XLA serving path alone:
+
+- ``jit_fail(fn, key, prob, count)`` — the call raises RuntimeError
+  before dispatch (a failed compile/launch as the caller sees it).
+- ``jit_stall(fn, min_s, max_s, key, prob, count)`` — the call sleeps
+  before dispatch (a recompile storm / contended-device stall).
+- ``bad_result(fn, key, prob, count)`` — the call completes but its
+  returned array comes back corrupted (one flipped element — the
+  silent-wrong-answer case bit-exact probes must catch).
+
+``count`` bounds total firings per rule (0 = unlimited); a spent rule
+stops firing but stays installed until its set is cleared. Device
+kinds never match the messenger hooks, and messenger kinds never
+match ``jit_call``.
+
 Rules compose: every matching rule applies. Sets are named and can be
 installed/cleared at runtime on a served cluster (the vstart --serve
 admin socket exposes ``fault install/clear/ls``); the Thrasher
@@ -55,35 +79,50 @@ import random
 from dataclasses import dataclass, field
 from fnmatch import fnmatch
 
+# kinds consulted by utils.devmon.jit_call instead of the messenger
+DEVICE_KINDS = ("jit_fail", "jit_stall", "bad_result")
+
 
 @dataclass(frozen=True)
 class FaultRule:
     """One fault between entity-name patterns (fnmatch syntax, e.g.
     ``osd.1``, ``osd.*``, ``client.*``). ``a``/``b`` are src/dst for
-    one-way kinds and unordered endpoints for ``partition``."""
+    one-way kinds and unordered endpoints for ``partition``. For
+    device kinds ``a`` matches the jit ``fn_name`` and ``b`` matches
+    ``str(key)`` (the jit cache key)."""
 
-    kind: str                  # partition|drop|delay|duplicate|reorder
+    kind: str       # partition|drop|delay|duplicate|reorder|DEVICE_KINDS
     a: str
     b: str
     prob: float = 1.0
     min_s: float = 0.0
     max_s: float = 0.0
     hold_s: float = 0.05
+    count: int = 0             # max firings, 0 = unlimited
 
     def matches(self, src: str, dst: str) -> bool:
+        if self.kind in DEVICE_KINDS:
+            return False
         if self.kind == "partition":
             return (fnmatch(src, self.a) and fnmatch(dst, self.b)) or \
                    (fnmatch(src, self.b) and fnmatch(dst, self.a))
         return fnmatch(src, self.a) and fnmatch(dst, self.b)
 
+    def matches_device(self, fn_name: str, key_s: str) -> bool:
+        return self.kind in DEVICE_KINDS and \
+            fnmatch(fn_name, self.a) and fnmatch(key_s, self.b)
+
     def describe(self) -> dict:
         d = {"kind": self.kind, "a": self.a, "b": self.b}
-        if self.kind in ("drop", "duplicate", "reorder"):
+        if self.kind in ("drop", "duplicate", "reorder") or \
+                self.kind in DEVICE_KINDS:
             d["prob"] = self.prob
-        if self.kind == "delay":
+        if self.kind in ("delay", "jit_stall"):
             d["min_s"], d["max_s"] = self.min_s, self.max_s
         if self.kind == "reorder":
             d["hold_s"] = self.hold_s
+        if self.kind in DEVICE_KINDS and self.count:
+            d["count"] = self.count
         return d
 
 
@@ -116,8 +155,34 @@ def reorder(src: str, dst: str, prob: float = 1.0,
     return FaultRule("reorder", src, dst, prob=prob, hold_s=hold_s)
 
 
+def jit_fail(fn: str, key: str = "*", prob: float = 1.0,
+             count: int = 0) -> FaultRule:
+    """Device calls matching (fn_name, key) patterns raise before
+    dispatch — a failed compile/launch as the caller observes it."""
+    return FaultRule("jit_fail", fn, key, prob=prob, count=count)
+
+
+def jit_stall(fn: str, min_s: float, max_s: float | None = None,
+              key: str = "*", prob: float = 1.0,
+              count: int = 0) -> FaultRule:
+    """Device calls matching the patterns sleep a fixed (max_s=None)
+    or uniform-random time before dispatch."""
+    return FaultRule("jit_stall", fn, key, prob=prob, min_s=min_s,
+                     max_s=min_s if max_s is None else max_s,
+                     count=count)
+
+
+def bad_result(fn: str, key: str = "*", prob: float = 1.0,
+               count: int = 0) -> FaultRule:
+    """Device calls matching the patterns complete, but the returned
+    array has one element flipped — the silent-corruption case."""
+    return FaultRule("bad_result", fn, key, prob=prob, count=count)
+
+
 _BUILDERS = {"partition": partition, "drop": drop, "delay": delay,
-             "duplicate": duplicate, "reorder": reorder}
+             "duplicate": duplicate, "reorder": reorder,
+             "jit_fail": jit_fail, "jit_stall": jit_stall,
+             "bad_result": bad_result}
 
 
 def rule_from_dict(d: dict) -> FaultRule:
@@ -126,7 +191,7 @@ def rule_from_dict(d: dict) -> FaultRule:
     kind = d.get("kind")
     if kind not in _BUILDERS:
         raise ValueError(f"unknown fault kind {kind!r}")
-    kw = {k: d[k] for k in ("prob", "min_s", "max_s", "hold_s")
+    kw = {k: d[k] for k in ("prob", "min_s", "max_s", "hold_s", "count")
           if k in d}
     return FaultRule(kind, d["a"], d["b"], **kw)
 
@@ -149,18 +214,39 @@ class FaultInjector:
         # (src, dst) -> event used by reorder: a held message waits on
         # it; the next message through the pair sets it
         self._holds: dict[tuple[str, str], asyncio.Event] = {}
+        # per-rule firing counts for count-bounded rules
+        self._spent: dict[int, int] = {}
+        # device-rule fast path: jit_call (a hot chokepoint) only pays
+        # for str(key) + rule iteration when a device rule is installed
+        self._n_device = 0
 
     # -- set management ----------------------------------------------------
+    def _recount(self) -> None:
+        live = set()
+        n = 0
+        for s in self._sets.values():
+            for r in s.rules:
+                live.add(id(r))
+                if r.kind in DEVICE_KINDS:
+                    n += 1
+        self._n_device = n
+        self._spent = {k: v for k, v in self._spent.items() if k in live}
+
     def install(self, name: str, rules: list[FaultRule]) -> None:
         """Install (or replace) a named fault set."""
         self._sets[name] = _FaultSet(name, list(rules))
+        self._recount()
 
     def clear(self, name: str) -> bool:
         """Remove one named set (heal those faults)."""
-        return self._sets.pop(name, None) is not None
+        hit = self._sets.pop(name, None) is not None
+        if hit:
+            self._recount()
+        return hit
 
     def clear_all(self) -> None:
         self._sets.clear()
+        self._recount()
         # release any held reorder messages immediately
         for ev in self._holds.values():
             ev.set()
@@ -233,3 +319,38 @@ class FaultInjector:
             if ev is not None:
                 ev.set()
         return dup
+
+    # -- device hooks (utils.devmon.jit_call) ------------------------------
+    def has_device_rules(self) -> bool:
+        """Cheap gate jit_call checks before paying for str(key)."""
+        return self._n_device > 0
+
+    def _fires(self, r: FaultRule) -> bool:
+        """Probability + count gate; a firing consumes budget."""
+        if r.count > 0 and self._spent.get(id(r), 0) >= r.count:
+            return False
+        if r.prob < 1.0 and self._rng.random() >= r.prob:
+            return False
+        if r.count > 0:
+            self._spent[id(r)] = self._spent.get(id(r), 0) + 1
+        return True
+
+    def device_verdicts(self, fn_name: str,
+                        key_s: str) -> tuple[float, bool, bool]:
+        """The jit_call verdict for one device call: (stall seconds,
+        raise-before-dispatch, corrupt-the-result). Every matching
+        rule applies; stalls add."""
+        stall, fail, corrupt = 0.0, False, False
+        for s in self._sets.values():
+            for r in s.rules:
+                if not r.matches_device(fn_name, key_s) or \
+                        not self._fires(r):
+                    continue
+                if r.kind == "jit_stall":
+                    stall += (r.min_s if r.max_s <= r.min_s else
+                              self._rng.uniform(r.min_s, r.max_s))
+                elif r.kind == "jit_fail":
+                    fail = True
+                elif r.kind == "bad_result":
+                    corrupt = True
+        return stall, fail, corrupt

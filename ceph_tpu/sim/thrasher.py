@@ -834,6 +834,178 @@ class Thrasher:
         return {"kills": kills, "acked_writes": len(acked),
                 "errors": len(errors), "takeover_s": takeover_s}
 
+    async def device_storm(self, io, io_ec=None, ec_writes: int = 8,
+                           ec_fails: int = 3, stall_s: float = 0.02,
+                           probe_hosts: int = 4,
+                           probe_timeout: float = 120.0) -> dict:
+        """The device-fault resilience storm (the round-16 acceptance
+        shape): jit_fail / jit_stall / bad_result bursts at the devmon
+        chokepoint while replicated AND erasure-coded client writes
+        keep flowing — the acceptance is ZERO client-visible errors
+        and the kernel path RE-PROMOTED (not merely degraded) once the
+        faults clear.
+
+        Three legs run concurrently:
+
+        1. **EC degrade ladder** — ``jit_fail`` on ``ec_encode*``
+           poisons ``ec_fails`` device encodes; the OSD aggregator
+           must serve every one of ``ec_writes`` client writes
+           through per-op retry / the host reference encoder.
+        2. **Cluster latency** — ``jit_stall`` on every CRUSH device
+           call adds ``stall_s`` of injected device latency under the
+           background replicated writer (latency, never errors).
+        3. **Kernel quarantine cycle** — a dedicated interpret-mode
+           probe Mapper (the cluster daemons serve plain XLA on CPU;
+           only this mapper HAS a kernel path to lose) rides the full
+           state machine: ``jit_fail`` keyed ``*'kern'*`` quarantines
+           it, a ``bad_result`` keyed the same way makes the first
+           re-probe REFUSE promotion (corrupt kernel output never
+           serves), and once the faults clear a clean probe promotes
+           it back — bit-exact against the healthy output.
+
+        The caller runs ``settle_and_verify`` afterwards as usual.
+        Returns the counter evidence (quarantine entries/exits,
+        probes, EC fallbacks, write errors)."""
+        import os
+        import time as _time
+
+        import numpy as np
+
+        from ceph_tpu.crush import builder as crush_builder
+        from ceph_tpu.crush.mapper import Mapper
+        from ceph_tpu.utils import devmon as devmon_mod
+
+        knobs = {"crush_kernel_reprobe_base": 0.05,
+                 "crush_kernel_reprobe_max": 0.2,
+                 "crush_kernel_reprobe_disable_after": 8}
+        cm, root = crush_builder.build_hierarchy(probe_hosts, 2)
+        rid = crush_builder.add_simple_rule(
+            cm, root, crush_builder.TYPE_HOST)
+        prev = os.environ.get("CEPH_TPU_CRUSH_KERNEL")
+        os.environ["CEPH_TPU_CRUSH_KERNEL"] = "interpret"
+        try:
+            probe = Mapper(cm, config=knobs)
+        finally:
+            if prev is None:
+                os.environ.pop("CEPH_TPU_CRUSH_KERNEL", None)
+            else:
+                os.environ["CEPH_TPU_CRUSH_KERNEL"] = prev
+        assert probe._kernel_mode == "interpret", \
+            "probe mapper has no kernel path to quarantine"
+        xs = np.arange(64, dtype=np.uint32)
+        out0, path0 = probe.map_pgs_path(rid, xs, 2)
+        out0 = np.asarray(out0)
+        assert path0 == "pallas-interpret", path0
+
+        dm = devmon_mod.devmon()
+        q0 = dm.perf.dump()
+        errs0 = self._write_errors
+        wt = asyncio.ensure_future(self._writer(io))
+        ec_acked = 0
+        try:
+            # one storm burst: fails bounded by count, stalls by prob
+            self.injector.install("device_storm", [
+                F.jit_fail("ec_encode*", count=ec_fails),
+                F.jit_stall("crush_*", stall_s, prob=0.5, count=16),
+                F.jit_fail("crush_map_pgs", key="*'kern'*", count=1),
+            ])
+            # leg 3a: the injected kernel failure quarantines the
+            # probe mapper — the SAME call still answers (XLA serves)
+            out_q, path_q = probe.map_pgs_path(rid, xs, 2)
+            info = probe.kernel_quarantine_info()
+            assert info is not None and path_q == "xla", (info, path_q)
+            assert np.array_equal(np.asarray(out_q), out0), \
+                "degraded serving path diverged from healthy output"
+            # leg 1: EC writes through the poisoned encode path
+            # (tracked separately from self.acked: settle_and_verify
+            # reads acked oids through the REPLICATED ioctx)
+            ec_data: dict[str, bytes] = {}
+            if io_ec is not None:
+                for i in range(ec_writes):
+                    oid = f"devstorm-ec-{self.seed}-{i:03d}"
+                    data = bytes([i % 256]) * (1024 + i)
+                    await io_ec.write_full(
+                        oid, data, timeout=self.write_timeout * 6)
+                    ec_data[oid] = data
+                    ec_acked += 1
+            # let the stalled replicated writer breathe a little more
+            await asyncio.sleep(0.3)
+            # stop the writer BEFORE the probe legs: an interpret-mode
+            # probe compile blocks the event loop for seconds, which
+            # would spuriously time out in-flight storm writes that
+            # made no progress while the loop was held
+            wt.cancel()
+            await asyncio.gather(wt, return_exceptions=True)
+            # leg 3b: a corrupt probe must REFUSE promotion
+            self.injector.install("device_storm_probe", [
+                F.bad_result("crush_map_pgs", key="*'kern'*",
+                             count=1)])
+            fails_before = int(info["failures"])
+            deadline = _time.monotonic() + probe_timeout
+            while _time.monotonic() < deadline:
+                probe.map_pgs_path(rid, xs, 2)   # probe when due
+                info = probe.kernel_quarantine_info()
+                if info is None or \
+                        info["failures"] > fails_before:
+                    break
+                await asyncio.sleep(0.02)
+            assert info is not None and \
+                info["failures"] > fails_before, \
+                "corrupt re-probe should have failed, not promoted"
+            # heal the device plane; a clean probe must re-promote
+            self.injector.clear("device_storm")
+            self.injector.clear("device_storm_probe")
+            out_h = None
+            path_h = None
+            deadline = _time.monotonic() + probe_timeout
+            while _time.monotonic() < deadline:
+                out_h, path_h = probe.map_pgs_path(rid, xs, 2)
+                if probe.kernel_quarantine_info() is None:
+                    break
+                await asyncio.sleep(0.05)
+            assert probe.kernel_quarantine_info() is None, \
+                "kernel path never re-promoted after faults cleared"
+            assert path_h == "pallas-interpret", path_h
+            assert np.array_equal(np.asarray(out_h), out0), \
+                "re-promoted kernel output diverged"
+            # every EC write served through the degrade ladder reads
+            # back bit-identical now that the device plane healed
+            for oid, data in ec_data.items():
+                got = await io_ec.read(oid)
+                assert got == data, \
+                    f"degraded-path EC write {oid} corrupted"
+        finally:
+            wt.cancel()
+            await asyncio.gather(wt, return_exceptions=True)
+            self.injector.clear("device_storm")
+            self.injector.clear("device_storm_probe")
+        q1 = dm.perf.dump()
+
+        def _delta(key):
+            return int(q1.get(key, 0)) - int(q0.get(key, 0))
+        agg_fb = sum(
+            int(o.ec_agg.perf.dump().get("fallback_ops", 0)) +
+            int(o.ec_agg.perf.dump().get("per_op_retries", 0))
+            for o in self.c.osds)
+        storm_errors = self._write_errors - errs0
+        assert storm_errors == 0, \
+            f"{storm_errors} client-visible errors under device storm"
+        self._log(f"device storm: {ec_acked} EC writes served "
+                  f"degraded, quarantine "
+                  f"{_delta('quarantine_entries')} in / "
+                  f"{_delta('quarantine_exits')} out, "
+                  f"{_delta('quarantine_probes')} probes "
+                  f"({_delta('quarantine_probe_failures')} refused)")
+        return {"write_errors": storm_errors,
+                "ec_writes_acked": ec_acked,
+                "quarantine_entries": _delta("quarantine_entries"),
+                "quarantine_exits": _delta("quarantine_exits"),
+                "probes": _delta("quarantine_probes"),
+                "probe_failures": _delta("quarantine_probe_failures"),
+                "faults_injected": _delta("faults_injected"),
+                "ec_degraded_ops": agg_fb,
+                "repromoted_path": path_h}
+
     async def settle_and_verify(self, io, timeout: float = 240.0,
                                 fsck_stores=None) -> dict:
         """Heal everything, revive everything, converge, verify.

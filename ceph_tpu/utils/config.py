@@ -373,6 +373,33 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
            "KERNEL_PATH_DEGRADED trips for a daemon (and clean "
            "reports before it clears) — the OSD_SLOW debounce "
            "discipline", min=1),
+    # device-fault resilience plane (round 16): the CRUSH kernel
+    # quarantine/re-probe state machine (crush/mapper.py) and the EC
+    # aggregator's degrade ladder (osd/ec_aggregator.py). All read
+    # LIVE from cluster config — a running cluster can be retuned.
+    Option("crush_kernel_reprobe_base", float, 0.5,
+           "seconds before the FIRST re-probe after a kernel-path "
+           "execution failure quarantines it; doubles per "
+           "consecutive failure (capped by crush_kernel_reprobe_max)",
+           min=0.0),
+    Option("crush_kernel_reprobe_max", float, 30.0,
+           "backoff ceiling for kernel quarantine re-probes",
+           min=0.0),
+    Option("crush_kernel_reprobe_disable_after", int, 5,
+           "consecutive kernel failures (initial + failed probes) "
+           "after which the quarantine goes PERMANENT — the kernel "
+           "path stays retired until the daemon restarts", min=1),
+    Option("osd_ec_fallback_retries", int, 1,
+           "per-op device encode retries after a failed aggregator "
+           "batch before the op is served from the host-only "
+           "reference encoder", min=0),
+    Option("osd_ec_fallback_quarantine_base", float, 1.0,
+           "seconds the fused encode+CRC jit path rests after a "
+           "failure before being retried; doubles per consecutive "
+           "failure", min=0.0),
+    Option("osd_ec_fallback_quarantine_max", float, 30.0,
+           "backoff ceiling for the fused encode+CRC rest window",
+           min=0.0),
     # mesh provenance (round 15, ROADMAP #1d first slice): where a
     # production daemon's device mesh comes from. Read once at OSD
     # boot — the tracked mapping table re-attaches the mesh on every

@@ -60,10 +60,52 @@ from ceph_tpu.utils.perf_counters import PerfCountersBuilder
 ENGINES = ("pallas", "xla", "scalar")
 
 # warm-set bound: (fn, key) pairs tracked for first-call compile
-# detection. Shape churn past this just resets the set (a reset
-# re-counts a warm call as a compile once — observability, not
-# accounting for money).
+# detection. Shape churn past this evicts the OLDEST entry only, so a
+# long-running daemon's hot paths stay warm (a full clear would
+# re-count every hot path's next call as a fresh compile).
 _WARM_MAX = 4096
+
+# device fault injection (round 16): jit_call is the one chokepoint
+# every jit-backed device call passes through, so it is also where
+# sim.faults' device kinds (jit_fail / jit_stall / bad_result) fire.
+# Installed process-wide by Cluster.install_faults; None in production.
+_fault_injector = None
+
+
+def set_fault_injector(inj) -> None:
+    """Attach (or detach, with None) the process's FaultInjector to
+    the jit_call chokepoint. The injector is consulted only when it
+    has device rules installed — the no-faults fast path costs one
+    attribute read."""
+    global _fault_injector
+    _fault_injector = inj
+
+
+def _corrupt_result(out):
+    """The ``bad_result`` fault: flip one element of the returned
+    array (first element of a tuple result — the payload; EC's crc
+    sidecar rides along untouched so checksum verification still
+    sees the corrupt payload). Returns a host copy; shapes/dtypes
+    are preserved so only bit-exact checks can tell."""
+    import numpy as np
+    if isinstance(out, tuple):
+        if not out:
+            return out
+        return (_corrupt_result(out[0]),) + tuple(out[1:])
+    try:
+        arr = np.array(out)
+    except Exception:
+        return out
+    if arr.size == 0:
+        return out
+    flat = arr.reshape(-1)
+    if arr.dtype.kind in "iu":
+        flat[0] ^= 1
+    elif arr.dtype.kind == "f":
+        flat[0] = flat[0] + 1.0
+    else:
+        return out
+    return arr
 
 
 def normalize_engine(path: str | None) -> str:
@@ -128,14 +170,45 @@ class DeviceRuntimeMonitor:
             .add_u64("device_bytes_watermark",
                      "largest single staging op seen (gauge, "
                      "monotone max)")
+            .add_u64_counter("quarantine_entries",
+                             "kernel-path quarantine entries (a device "
+                             "failure benched the fused kernel)")
+            .add_u64_counter("quarantine_exits",
+                             "kernel-path re-promotions (a bit-exact "
+                             "probe passed and the kernel serves again)")
+            .add_u64_counter("quarantine_probes",
+                             "backoff re-probe attempts against a "
+                             "quarantined kernel")
+            .add_u64_counter("quarantine_probe_failures",
+                             "re-probes that raised or mismatched the "
+                             "serving path bit-exactly")
+            .add_u64("quarantined_now",
+                     "kernels currently quarantined (serving the "
+                     "fallback engine, re-probe pending; gauge)")
+            .add_u64("reprobing_now",
+                     "quarantined kernels past their first failed "
+                     "re-probe (gauge)")
+            .add_u64("quarantine_permanent_now",
+                     "kernels permanently disabled after "
+                     "crush_kernel_reprobe_disable_after consecutive "
+                     "failures (gauge)")
+            .add_u64_counter("faults_injected",
+                             "device faults fired at the jit_call "
+                             "chokepoint (sim.faults device kinds)")
+            .add_u64_counter("stream_fallbacks",
+                             "streaming-encode pipelines that fell "
+                             "back to the unpipelined path")
             .create_perf_counters(register=register))
         self.tracer = None           # utils.tracing.Tracer | None
         self._lock = threading.Lock()
-        self._warm: set[tuple] = set()
+        # insertion-ordered: eviction at _WARM_MAX pops oldest only
+        self._warm: dict[tuple, None] = {}
         # fn name -> {count, seconds, last_key, last_seconds}
         self.functions: dict[str, dict] = {}
         self._watermark = 0
         self.last_mismatch: dict | None = None
+        # quarantine token -> "quarantined"|"reprobing"|"permanent"
+        self._quarantine: dict = {}
 
     # -- wiring ------------------------------------------------------------
     def attach_tracer(self, tracer) -> None:
@@ -153,24 +226,47 @@ class DeviceRuntimeMonitor:
         abstract shape), so a process-shared lru'd program is warm
         across Mapper instances while a per-Mapper kernel wrapper is
         cold once per Mapper. Warm calls cost one set lookup; a failed
-        first call un-warms so the retry path's compile still counts."""
+        first call un-warms so the retry path's compile still counts.
+
+        This is also the device-fault injection chokepoint: when a
+        FaultInjector with device rules is attached
+        (:func:`set_fault_injector`), its verdict runs first —
+        ``jit_stall`` sleeps here, ``jit_fail`` raises before any
+        warm-set bookkeeping (so a later retry still counts its
+        compile), ``bad_result`` corrupts the completed result."""
+        corrupt = False
+        inj = _fault_injector
+        if inj is not None and inj.has_device_rules():
+            stall, fail, corrupt = inj.device_verdicts(
+                fn_name, str(key))
+            if stall > 0:
+                time.sleep(stall)
+            if fail:
+                self.perf.inc("faults_injected")
+                raise RuntimeError(
+                    f"injected device fault: jit_fail on {fn_name}")
         k = (fn_name, key)
         with self._lock:
             warm = k in self._warm
             if not warm:
                 if len(self._warm) >= _WARM_MAX:
-                    self._warm.clear()
-                self._warm.add(k)
+                    self._warm.pop(next(iter(self._warm)))
+                self._warm[k] = None
         if warm:
-            return fn(*args)
-        t0 = time.perf_counter()
-        try:
             out = fn(*args)
-        except BaseException:
-            with self._lock:
-                self._warm.discard(k)
-            raise
-        self.record_compile(fn_name, key, time.perf_counter() - t0)
+        else:
+            t0 = time.perf_counter()
+            try:
+                out = fn(*args)
+            except BaseException:
+                with self._lock:
+                    self._warm.pop(k, None)
+                raise
+            self.record_compile(fn_name, key,
+                                time.perf_counter() - t0)
+        if corrupt:
+            self.perf.inc("faults_injected")
+            out = _corrupt_result(out)
         return out
 
     def record_compile(self, fn_name: str, key, seconds: float) -> None:
@@ -243,6 +339,40 @@ class DeviceRuntimeMonitor:
         return self.record_path_check(
             self.expected_engine(plan_path), actual)
 
+    # -- kernel quarantine (round 16) --------------------------------------
+    def set_quarantine_state(self, token, state: str | None) -> None:
+        """Track one kernel owner's quarantine state (keyed by an
+        opaque token — Mappers use their per-incarnation devmon
+        token). ``None`` clears. The three gauges always reflect the
+        live table."""
+        with self._lock:
+            if state is None:
+                self._quarantine.pop(token, None)
+            else:
+                self._quarantine[token] = state
+            states = list(self._quarantine.values())
+        self.perf.set("quarantined_now",
+                      sum(1 for s in states
+                          if s in ("quarantined", "reprobing")))
+        self.perf.set("reprobing_now",
+                      sum(1 for s in states if s == "reprobing"))
+        self.perf.set("quarantine_permanent_now",
+                      sum(1 for s in states if s == "permanent"))
+
+    def record_quarantine_enter(self, token,
+                                state: str = "quarantined") -> None:
+        self.perf.inc("quarantine_entries")
+        self.set_quarantine_state(token, state)
+
+    def record_quarantine_exit(self, token) -> None:
+        self.perf.inc("quarantine_exits")
+        self.set_quarantine_state(token, None)
+
+    def record_probe(self, ok: bool) -> None:
+        self.perf.inc("quarantine_probes")
+        if not ok:
+            self.perf.inc("quarantine_probe_failures")
+
     # -- transfers / memory ------------------------------------------------
     def record_h2d(self, nbytes: int) -> None:
         if nbytes > 0:
@@ -293,6 +423,13 @@ class DeviceRuntimeMonitor:
                 float(p.get("jit_compile_seconds", 0.0)) * 1e3),
             "h2d_bytes": int(p.get("h2d_bytes", 0)),
             "d2h_bytes": int(p.get("d2h_bytes", 0)),
+            # quarantine lives process-side (Mappers are process-level)
+            "quarantined": int(p.get("quarantined_now", 0)),
+            "reprobing": int(p.get("reprobing_now", 0)),
+            "quarantine_permanent": int(
+                p.get("quarantine_permanent_now", 0)),
+            "quarantine_entries": int(p.get("quarantine_entries", 0)),
+            "quarantine_exits": int(p.get("quarantine_exits", 0)),
         }
 
     def dump(self) -> dict:

@@ -221,7 +221,11 @@ def test_degraded_mapper_keeps_counting_mismatches():
     not silently re-heal to the fallback engine (the 34x-slower
     silent-degradation case the plane exists to catch)."""
     dm = devmon()
-    m = Mapper(_two_rule_map(56), block=1 << 10)
+    # reprobe pinned far out: this test is about the PINNED baseline,
+    # not the round-16 re-probe cycle (covered below) — a default
+    # 0.5s backoff could fire a probe mid-test on a slow host
+    m = Mapper(_two_rule_map(56), block=1 << 10,
+               config={"crush_kernel_reprobe_base": 3600.0})
     xs = np.arange(37, dtype=np.uint32)     # warm shape (cold test)
     assert m.expected_path(0, 3) == "xla"
     before = dm.perf.dump()["path_mismatch"]
@@ -233,6 +237,172 @@ def test_degraded_mapper_keeps_counting_mismatches():
     m.map_pgs(0, xs, 3)
     m.map_pgs(0, xs, 3)
     assert dm.perf.dump()["path_mismatch"] == before + 2
+    # hygiene: drop this mapper's quarantine token so later tests see
+    # clean gauges (the token table is process-global)
+    dm.set_quarantine_state(m._devmon_token, None)
+
+
+# -- round 16: warm-set eviction, fault injection, kernel quarantine --------
+
+def test_warm_set_evicts_oldest_only(monkeypatch):
+    """At _WARM_MAX the warm set evicts the OLDEST key only — the
+    pre-round-16 full clear made every concurrently-live jit look
+    cold again on its next call, spiking jit_compiles (and minting
+    phantom compile spans) across the board."""
+    from ceph_tpu.utils import devmon as devmon_mod
+    monkeypatch.setattr(devmon_mod, "_WARM_MAX", 3)
+    dm = DeviceRuntimeMonitor(name="devmon_unit_warm", register=False)
+    for i in range(3):
+        dm.jit_call("f", (i,), lambda: i)
+    assert dm.perf.dump()["jit_compiles"] == 3
+    # 4th distinct key evicts ONLY ("f", (0,))
+    dm.jit_call("f", (3,), lambda: 3)
+    assert dm.perf.dump()["jit_compiles"] == 4
+    # keys 1..3 are still warm: no new compiles
+    for i in (1, 2, 3):
+        dm.jit_call("f", (i,), lambda: i)
+    assert dm.perf.dump()["jit_compiles"] == 4
+    # the evicted oldest re-counts (evicting ("f",(1,)) in turn)
+    dm.jit_call("f", (0,), lambda: 0)
+    assert dm.perf.dump()["jit_compiles"] == 5
+
+
+def test_device_fault_injection_at_jit_call():
+    """The devmon chokepoint honors device FaultRules: jit_fail
+    raises before warm bookkeeping (the retry's compile still
+    counts), bad_result corrupts the completed array, count bounds a
+    rule to its first N firings, and key patterns target by jit-key
+    string."""
+    from ceph_tpu.sim import faults as F
+    from ceph_tpu.utils import devmon as devmon_mod
+    inj = F.FaultInjector(seed=3)
+    inj.install("dev", [
+        F.jit_fail("ec_encode", count=1),
+        F.bad_result("crush_map_pgs", key="*'kern'*", count=1),
+    ])
+    dm = DeviceRuntimeMonitor(name="devmon_unit_fi", register=False)
+    devmon_mod.set_fault_injector(inj)
+    try:
+        # fn-name pattern: only ec_encode fails, and only once
+        with pytest.raises(RuntimeError, match="injected device"):
+            dm.jit_call("ec_encode", ("xla", 1), lambda: "never")
+        assert dm.jit_call("ec_encode", ("xla", 1), lambda: "ok") \
+            == "ok"
+        # the failed first call un-warmed: the retry counted a compile
+        assert dm.perf.dump()["jit_compiles"] == 1
+        # key pattern: the xla-keyed call passes clean...
+        clean = dm.jit_call("crush_map_pgs", ("xla", 4),
+                            lambda: np.arange(6))
+        assert np.array_equal(clean, np.arange(6))
+        # ...the kern-keyed call is corrupted (one element flipped)
+        bad = dm.jit_call("crush_map_pgs", ("kern", "v", 4),
+                          lambda: np.arange(6))
+        assert bad.shape == (6,) and \
+            not np.array_equal(bad, np.arange(6))
+        assert int((bad != np.arange(6)).sum()) == 1
+        # count exhausted: clean again
+        ok = dm.jit_call("crush_map_pgs", ("kern", "v", 4),
+                         lambda: np.arange(6))
+        assert np.array_equal(ok, np.arange(6))
+        assert dm.perf.dump()["faults_injected"] == 2
+    finally:
+        devmon_mod.set_fault_injector(None)
+
+
+def _quarantine_mapper(fake_kernel, **knobs):
+    """A Mapper whose 'kernel' is a stand-in jax fn — the quarantine
+    state machine is exercised without paying interpret-mode compiles
+    (the REAL kernel cycle runs in the device_storm acceptance and in
+    test_pallas_mapper's interpret suite)."""
+    cfg = {"crush_kernel_reprobe_base": 0.0,
+           "crush_kernel_reprobe_max": 0.0,
+           "crush_kernel_reprobe_disable_after": 3}
+    cfg.update(knobs)
+    m = Mapper(_two_rule_map(56), block=1 << 10, config=cfg)
+    fn = fake_kernel(m)
+    # gate on _kernel_mode like the real body: while quarantined
+    # (mode None) the serving path must see NO kernel and ride XLA
+    m._kernel_body = lambda ruleno, result_max: (
+        fn if m._kernel_mode is not None else None)
+    m._kernel_mode = "interpret"
+    return m
+
+
+def test_kernel_quarantine_reprobe_cycle():
+    """fail -> quarantined (XLA serves the SAME call) -> the due
+    probe runs the kernel on a sample, matches the serving path
+    bit-exact, and RE-PROMOTES: expected_path returns to pallas, the
+    serving output is unchanged, and the devmon records the full
+    enter/probe/exit cycle."""
+    dm = devmon()
+    before = dm.perf.dump()
+    # the stand-in kernel IS the serving rule fn: bit-exact trivially
+    m = _quarantine_mapper(lambda m: m._rule_fn(0, 3))
+    xs = np.arange(37, dtype=np.uint32)
+    ref = np.asarray(m.map_pgs(0, xs, 3))
+
+    m._disable_kernel("unit", RuntimeError("injected"))
+    info = m.kernel_quarantine_info()
+    assert info == {"state": "quarantined", "failures": 1,
+                    "next_probe_in_s": 0.0}
+    assert m.expected_path(0, 3) == "pallas"   # the promise holds
+    # base=0: the next fresh call probes, passes, and re-promotes
+    out, path = m.map_pgs_path(0, xs, 3)
+    assert m.kernel_quarantine_info() is None
+    assert path == "pallas-interpret", path
+    assert np.array_equal(np.asarray(out), ref)
+    after = dm.perf.dump()
+    assert after["quarantine_entries"] - \
+        before.get("quarantine_entries", 0) == 1
+    assert after["quarantine_exits"] - \
+        before.get("quarantine_exits", 0) == 1
+    assert after["quarantine_probes"] - \
+        before.get("quarantine_probes", 0) == 1
+    assert after["quarantine_probe_failures"] == \
+        before.get("quarantine_probe_failures", 0)
+    # this mapper's enter/exit netted zero on the live gauge
+    assert after["quarantined_now"] == before.get("quarantined_now", 0)
+
+
+def test_kernel_quarantine_permanent_after_disable_after():
+    """A kernel that keeps LYING (probe output mismatches the serving
+    path) can never re-promote: each probe fails, backoff doubles,
+    and after crush_kernel_reprobe_disable_after consecutive failures
+    the quarantine goes permanent — no further probes, XLA serves
+    forever, the devmon gauge says so."""
+    import jax.numpy as jnp
+    dm = devmon()
+    m = _quarantine_mapper(
+        lambda m: (lambda arrays, xs:
+                   jnp.full((xs.shape[0], 3), -1, jnp.int32)))
+    xs = np.arange(37, dtype=np.uint32)
+    # the honest reference comes from the serving XLA path — the
+    # stand-in kernel LIES by construction
+    m._kernel_mode = None
+    ref = np.asarray(m.map_pgs(0, xs, 3))
+    m._kernel_mode = "interpret"
+    m._disable_kernel("unit", RuntimeError("injected"))
+    probes0 = dm.perf.dump()["quarantine_probes"]
+    # failures 2 and 3: each call probes, mismatches, re-quarantines
+    out, path = m.map_pgs_path(0, xs, 3)
+    assert path == "xla" and np.array_equal(np.asarray(out), ref)
+    assert m.kernel_quarantine_info()["state"] == "reprobing" or \
+        m.kernel_quarantine_info()["failures"] == 2
+    m.map_pgs(0, xs, 3)
+    info = m.kernel_quarantine_info()
+    assert info["state"] == "permanent"
+    assert info["failures"] == 3
+    assert info["next_probe_in_s"] is None
+    # permanent: no more probes, ever
+    m.map_pgs(0, xs, 3)
+    d = dm.perf.dump()
+    assert d["quarantine_probes"] - probes0 == 2
+    assert d["quarantine_probe_failures"] >= 2
+    assert d["quarantine_permanent_now"] >= 1
+    assert m.expected_path(0, 3) == "pallas"   # still the promise
+    # hygiene: clear the permanent entry so later tests see clean
+    # gauges (the token table is process-global)
+    dm.set_quarantine_state(m._devmon_token, None)
 
 
 def test_pre_append_mpgstats_blobs_decode_zero_filled():
@@ -397,6 +567,19 @@ def test_kernel_path_degraded_and_crash_cluster(tmp_path):
                 await _make_pool(c, f"kp-{i}")
                 await asyncio.sleep(0.45)
 
+            # the entry/exit pair is a symmetric clog discipline:
+            # WRN on confirm, INF through the SAME debounce on heal
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "log last", "num": 200})
+            assert ret == 0
+            lines = json.loads(out)["lines"]
+            assert any(ln["level"] == "WRN" and
+                       "kernel path degraded" in ln["msg"]
+                       for ln in lines), lines
+            assert any(ln["level"] == "INF" and
+                       "kernel path healed" in ln["msg"]
+                       for ln in lines), lines
+
             # -- crash capture on the same cluster --------------------
             from ceph_tpu.utils import crash as crash_mod
             osd = c.osds[0]
@@ -446,6 +629,64 @@ def test_kernel_path_degraded_and_crash_cluster(tmp_path):
             status = osd.devmon.dump()
             assert status["expected_engine"] == "auto"
             assert status["counters"]["path_mismatch"] >= 1
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_device_storm_cluster():
+    """The round-16 acceptance run: jit_fail / jit_stall / bad_result
+    bursts at the devmon chokepoint under concurrent replicated + EC
+    client writes — ZERO client-visible errors, counters prove the
+    kernel path was quarantined AND re-promoted (not just degraded),
+    a poisoned EC encode is absorbed by the degrade ladder, and every
+    acked byte reads back bit-identical on settle."""
+    async def go():
+        from ceph_tpu.cluster.vstart import Cluster
+        from ceph_tpu.sim.thrasher import Thrasher
+        c = await Cluster(n_mons=1, n_osds=4,
+                          config={"mon_osd_down_out_interval": 2.0}
+                          ).start()
+        try:
+            await c.client.pool_create("rp", pg_num=4, size=2)
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "osd erasure-code-profile set",
+                 "name": "kprof",
+                 "profile": ["k=2", "m=1", "crush-failure-domain=osd",
+                             "stripe_unit=1024"]})
+            assert ret == 0, rs
+            ret, rs, _ = await c.client.mon_command(
+                {"prefix": "osd pool create", "pool": "ecpool",
+                 "pg_num": 4, "pool_type": "erasure",
+                 "erasure_code_profile": "kprof"})
+            assert ret == 0, rs
+            await c.wait_for_clean(timeout=240)
+            io = await c.client.open_ioctx("rp")
+            io_ec = await c.client.open_ioctx("ecpool")
+
+            th = Thrasher(c, seed=16, min_live_osds=4)
+            summary = await th.device_storm(io, io_ec, ec_writes=6)
+
+            # zero client-visible errors is asserted INSIDE the storm;
+            # the counters prove the full quarantine cycle happened
+            assert summary["write_errors"] == 0
+            assert summary["ec_writes_acked"] == 6
+            assert summary["quarantine_entries"] >= 1
+            assert summary["quarantine_exits"] >= 1
+            assert summary["probes"] >= 2           # refused + clean
+            assert summary["probe_failures"] >= 1   # the bad_result
+            assert summary["repromoted_path"] == "pallas-interpret"
+            assert summary["ec_degraded_ops"] >= 1  # ladder engaged
+            assert summary["faults_injected"] >= 2
+            await th.settle_and_verify(io)
+
+            # the quarantine evidence reached the mon's status surface
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "device-runtime status"})
+            assert ret == 0
+            drs = json.loads(out)
+            row = drs["daemons"].get("osd.0")
+            assert row is not None and "quarantine" in row, drs
         finally:
             await c.stop()
     run(go())

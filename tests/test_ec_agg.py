@@ -19,13 +19,20 @@ only (the live-cluster acceptance rides tests/test_ec_cluster.py):
   ``osd_ec_agg=off`` baseline bypasses, padding is pow2-bounded, and
   drain cancels cleanly;
 - **pipeline** — StreamingEncodePipeline's outputs equal per-batch
-  encodes, in order.
+  encodes, in order;
+- **degrade ladder (round 16)** — a failed batched flush
+  disaggregates and rejects ONLY its own poisoned waiter, per-op
+  device retries are bounded, the host reference encoder serves
+  bit-exactly as the last rung, the fused checksum+encode jit
+  quarantines on backoff after failures, and the streaming pipeline
+  falls back to the unpipelined path without losing a batch.
 
 One module-scoped plugin instance: every test shares its jit cache
 (tier-1 runs near the wall-clock cap — compiles are the budget).
 """
 
 import asyncio
+import time
 import zlib
 
 import numpy as np
@@ -345,3 +352,199 @@ def test_streaming_pipeline_matches_per_batch(ec):
     assert len(one) == 1 and (
         np.asarray(one[0]) ==
         np.asarray(ec.encode_batch(batches[0]))).all()
+
+
+# -- the degrade ladder (round 16) -----------------------------------------
+
+class _FlakyEC:
+    """Delegates to the module plugin but fails on command: device
+    launches raise while a ``poison`` stripe rides in the batch (or
+    always, with ``fail_all``), and the reference encoder refuses the
+    poison stripe itself — the worst case the ladder must isolate."""
+
+    profile = "flaky"
+
+    def __init__(self, ec, poison=None, fail_all=False):
+        self._ec = ec
+        self._poison = poison
+        self.fail_all = fail_all
+        self.device_calls = 0
+
+    def _poisoned(self, data):
+        return self._poison is not None and \
+            bool((data == self._poison).all(axis=(1, 2)).any())
+
+    def _maybe_fail(self, data):
+        self.device_calls += 1
+        if self.fail_all or self._poisoned(data):
+            raise RuntimeError("injected device failure")
+
+    def encode_batch(self, data):
+        self._maybe_fail(data)
+        return self._ec.encode_batch(data)
+
+    def encode_batch_with_crc(self, data):
+        self._maybe_fail(data)
+        return self._ec.encode_batch_with_crc(data)
+
+    def encode_batch_reference(self, data):
+        if self._poisoned(data):
+            raise RuntimeError("reference refuses the poison stripe")
+        return self._ec.encode_batch_reference(data)
+
+
+def test_flush_failure_rejects_only_the_poisoned_op(ec):
+    """A failed batched flush DISAGGREGATES: each batchmate retries
+    per-op and is served lane-for-lane exactly; only the op whose
+    stripe fails even under the reference encoder sees the exception.
+    One poisoned stripe must not fail its batchmates."""
+    rng = _rng(16)
+    good = [rng.integers(0, 256, (2, K, C), dtype=np.uint8)
+            for _ in range(2)]
+    poison = np.full((1, K, C), 0xAB, dtype=np.uint8)
+    flaky = _FlakyEC(ec, poison=0xAB)
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True,
+                            "osd_ec_agg_window_us": 2000.0,
+                            "osd_ec_fallback_retries": 1})
+        outs = await asyncio.gather(
+            agg.encode(flaky, good[0]),
+            agg.encode(flaky, poison),
+            agg.encode(flaky, good[1]),
+            return_exceptions=True)
+        for i, dat in ((0, good[0]), (2, good[1])):
+            p, c = outs[i]
+            assert c is None
+            assert (np.asarray(p) ==
+                    np.asarray(ec.encode_batch(dat))).all(), i
+        assert isinstance(outs[1], RuntimeError)
+        d = agg.perf.dump()
+        assert d.get("flush_failures", 0) == 1
+        assert d.get("per_op_retries", 0) == 1   # the poison op only
+        assert d.get("fallback_ops", 0) == 0     # nothing NEEDED ref
+        assert agg.dump()["pending_ops"] == 0
+        # the aggregator stays LIVE after a failed flush: the next
+        # batch coalesces and serves normally
+        p, _ = await agg.encode(flaky, good[0])
+        assert (np.asarray(p) ==
+                np.asarray(ec.encode_batch(good[0]))).all()
+        assert agg.perf.dump().get("batches", 0) == 1
+    run(go())
+
+
+def test_degrade_ladder_reference_serves_after_retries(ec):
+    """Device encode hard-down: the op is served by the bit-exact
+    host reference encoder after exactly ``osd_ec_fallback_retries``
+    more device attempts — a client write never errors because the
+    accelerator did; CRCs fall back to None (the caller's zlib
+    path)."""
+    rng = _rng(17)
+    d = rng.integers(0, 256, (3, K, C), dtype=np.uint8)
+    flaky = _FlakyEC(ec, fail_all=True)
+
+    async def go():
+        agg = ECAggregator({"osd_ec_agg": True,
+                            "osd_ec_agg_window_us": 100.0,
+                            "osd_ec_fallback_retries": 2})
+        p, c = await agg.encode(flaky, d, with_crc=True)
+        assert c is None
+        assert (np.asarray(p) ==
+                np.asarray(ec.encode_batch(d))).all()
+        dmp = agg.perf.dump()
+        assert dmp.get("flush_failures", 0) == 1
+        assert dmp.get("per_op_retries", 0) == 2
+        assert dmp.get("fallback_ops", 0) == 1
+    run(go())
+
+
+def test_reference_encoder_bit_exact_both_planes(ec):
+    """``encode_batch_reference`` (pure numpy, no jit) equals the
+    device kernel bit for bit on BOTH kernel planes: the GF(2^8)
+    matmul (reed_sol_van, the module plugin) and the packet-plane
+    bitmatrix XOR (liberation)."""
+    rng = _rng(18)
+    d = rng.integers(0, 256, (4, K, C), dtype=np.uint8)
+    assert (np.asarray(ec.encode_batch_reference(d)) ==
+            np.asarray(ec.encode_batch(d))).all()
+    lib = ErasureCodeJax("plugin=jax k=4 m=2 technique=liberation w=7")
+    dl = rng.integers(0, 256, (2, 4, 56), dtype=np.uint8)  # C = 8w
+    assert (np.asarray(lib.encode_batch_reference(dl)) ==
+            np.asarray(lib.encode_batch(dl))).all()
+
+
+def test_fused_crc_quarantine_backoff(ec):
+    """After the fused checksum+encode jit raises, flushes serve plain
+    encode + host crc until an exponential-backoff deadline passes;
+    the next crc flush past the deadline IS the probe, and a success
+    resets the failure streak."""
+    rng = _rng(19)
+    d = rng.integers(0, 256, (2, K, C), dtype=np.uint8)
+
+    class _CrcDown:
+        profile = "crcdown"
+
+        def __init__(self):
+            self.fused_calls = 0
+            self.ok = False
+
+        def encode_batch(self, data):
+            return ec.encode_batch(data)
+
+        def encode_batch_with_crc(self, data):
+            self.fused_calls += 1
+            if not self.ok:
+                raise RuntimeError("fused jit down")
+            return ec.encode_batch_with_crc(data)
+
+    plug = _CrcDown()
+    agg = ECAggregator({"osd_ec_fallback_quarantine_base": 0.05,
+                        "osd_ec_fallback_quarantine_max": 0.2})
+    p, c = agg._run(plug, d, True)       # fused fails -> plain serves
+    assert c is None and plug.fused_calls == 1
+    assert (p == np.asarray(ec.encode_batch(d))).all()
+    p, c = agg._run(plug, d, True)       # inside the rest window
+    assert c is None and plug.fused_calls == 1    # fused NOT retried
+    assert agg.perf.dump().get("crc_fallbacks", 0) == 1
+    time.sleep(0.06)
+    p, c = agg._run(plug, d, True)       # probe past deadline: fails
+    assert plug.fused_calls == 2 and c is None
+    assert agg._crc_failures == 2        # backoff doubled (0.1s)
+    assert agg.perf.dump().get("crc_fallbacks", 0) == 2
+    plug.ok = True
+    time.sleep(0.11)
+    p, c = agg._run(plug, d, True)       # probe succeeds: fused back
+    assert plug.fused_calls == 3 and c is not None
+    assert agg._crc_failures == 0
+    assert (p == np.asarray(ec.encode_batch(d))).all()
+
+
+def test_streaming_pipeline_falls_back_on_device_fault(ec):
+    """An injected mid-stream jit failure loses NO batches: the
+    pipeline re-encodes in-flight host copies on the non-donated
+    unpipelined path and drains the rest, outputs in submission
+    order — and devmon counts the fallback and the injected fault."""
+    from ceph_tpu.sim import faults as F
+    from ceph_tpu.utils import devmon as devmon_mod
+    rng = _rng(20)
+    batches = [rng.integers(0, 256, (2, K, C), dtype=np.uint8)
+               for _ in range(4)]
+    dm = devmon_mod.devmon()
+    before = dm.perf.dump()
+    inj = F.FaultInjector(seed=16)
+    inj.install("stream", [F.jit_fail("ec_stream_encode", count=1)])
+    devmon_mod.set_fault_injector(inj)
+    try:
+        pipe = StreamingEncodePipeline(ec)
+        outs = pipe.encode_all([b.copy() for b in batches])
+    finally:
+        devmon_mod.set_fault_injector(None)
+    after = dm.perf.dump()
+    assert after.get("stream_fallbacks", 0) - \
+        before.get("stream_fallbacks", 0) == 1
+    assert after.get("faults_injected", 0) - \
+        before.get("faults_injected", 0) == 1
+    assert len(outs) == len(batches)
+    for i, (b, o) in enumerate(zip(batches, outs)):
+        assert (np.asarray(o) ==
+                np.asarray(ec.encode_batch(b))).all(), i

@@ -42,6 +42,9 @@ STORM_BUDGETS = {
     "mds_storm": {"writes": 24, "kills": 1},
     "elastic_storm": {"writes": 40},
     "qos_storm": {"writes": 30, "hot_parallel": 4},
+    # the round-16 device-fault storm pays up to three interpret-mode
+    # kernel compiles (probe mapper) — keep the IO budgets tiny
+    "device_storm": {"ec_writes": 12, "probe_hosts": 4},
     # the 10k-session harness: tier-1 smokes stay <= 200 sessions
     # (LoadGen is a constructor call, matched by Name too)
     "LoadGen": {"sessions": 200},
@@ -473,14 +476,15 @@ def test_prometheus_histogram_buckets_monotone(reported):
 
 def _knob_reads(prefixes: tuple) -> dict[str, str]:
     """All config-knob string literals starting with ``prefixes``
-    passed to any ``.get(...)`` under ceph_tpu/ -> first read site."""
+    passed to any ``.get(...)`` — or the Mapper's ``._knob(...)``
+    live-config accessor — under ceph_tpu/ -> first read site."""
     used: dict[str, str] = {}
     for path in sorted((REPO / "ceph_tpu").rglob("*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
         for n in ast.walk(tree):
             if isinstance(n, ast.Call) and \
                     isinstance(n.func, ast.Attribute) and \
-                    n.func.attr == "get" and n.args and \
+                    n.func.attr in ("get", "_knob") and n.args and \
                     isinstance(n.args[0], ast.Constant) and \
                     isinstance(n.args[0].value, str) and \
                     n.args[0].value.startswith(prefixes):
@@ -557,6 +561,37 @@ def test_kernel_ablate_names_documented():
     assert used == set(ABLATE_STAGES), (
         f"kernel ablation stages drifted: read {sorted(used)} vs "
         f"documented {sorted(ABLATE_STAGES)}")
+
+
+def test_resilience_knobs_registered_with_defaults():
+    """Round 16: every device-fault resilience knob — the CRUSH
+    kernel quarantine/re-probe backoffs (`crush_kernel_reprobe_*`)
+    and the EC degrade-ladder bounds (`osd_ec_fallback_*`) — read
+    anywhere must be a registered Option with a default. Both planes
+    read them LIVE (the Mapper per probe decision, the aggregator per
+    degraded batch), so an unregistered knob silently diverges from
+    `config show` exactly when an operator is tuning a sick
+    cluster."""
+    _assert_knobs_registered(
+        ("crush_kernel_reprobe_", "osd_ec_fallback_"),
+        "device-fault resilience")
+
+
+def test_fault_kinds_documented():
+    """Every fault kind the injector can build (`faults._BUILDERS`)
+    must appear as a backticked table row in sim/README.md — an
+    undocumented kind is an asok `fault install` verb nobody can
+    discover, and a stale row documents a kind `rule_from_dict`
+    would reject."""
+    import re
+    from ceph_tpu.sim.faults import _BUILDERS
+    readme = (REPO / "ceph_tpu" / "sim" / "README.md").read_text()
+    rows = set(re.findall(r"^\|\s*`([a-z_]+)`", readme,
+                          flags=re.MULTILINE))
+    assert rows, "no fault-kind table rows found in sim/README.md"
+    assert rows == set(_BUILDERS), (
+        f"fault-kind registry drifted: documented {sorted(rows)} vs "
+        f"buildable {sorted(_BUILDERS)}")
 
 
 def test_ec_agg_knobs_registered_with_defaults():
