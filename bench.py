@@ -487,6 +487,135 @@ def qos_metric() -> dict:
     return asyncio.run(run())
 
 
+def tuning_metric() -> dict:
+    """Round-17 self-driving tuner: the hot-pool-burst storm with the
+    mgr TunerModule ``off`` (static config) vs ``drive`` (closing the
+    loop), both legs inside ONE cluster with the leg order rotated
+    per rep and medians across reps (the round-12 in-cluster A/B
+    discipline — separate cluster spins jitter >10%). The claim the
+    section pins: in drive mode the tuner's hot-pool protector
+    commits a tightened client-profile on the aggressor and the cold
+    tenant's p95 stays at-or-under the static run's, without
+    collapsing aggregate throughput (``tuner_protects_cold``)."""
+    import asyncio
+    import statistics
+
+    async def run() -> dict:
+        from ceph_tpu.cluster.vstart import Cluster
+        from ceph_tpu.mgr.tuner import TunerModule
+        from ceph_tpu.msg import Keyring as _Keyring
+        from ceph_tpu.rados import Rados as _Rados
+        from ceph_tpu.sim.thrasher import Thrasher
+        c = await Cluster(n_mons=1, n_osds=3,
+                          mgr_modules=[TunerModule], config={
+            "osd_client_message_cap": 4,
+            "osd_op_queue": "mclock",
+            "mgr_tuner_mode": "off",
+            # smoke-speed control loop: fast ticks, short hysteresis,
+            # trip threshold sized to the storm's offered load, pg
+            # stats refreshed faster than the tick so consecutive
+            # breach windows see fresh rates
+            "osd_stats_interval": 0.1,
+            "mgr_tuner_interval": 0.2,
+            "mgr_tuner_act_ticks": 2,
+            "mgr_tuner_revert_ticks": 4,
+            "mgr_tuner_hot_pool_min_ops": 5.0,
+            # keep the recovery governor quiet (no backfill here):
+            # the section isolates the hot-pool protector
+            "mgr_tuner_qos_floor_ms": 5000.0}).start()
+        try:
+            await c.client.pool_create("cold", pg_num=8)
+            await c.client.pool_create("hot", pg_num=8)
+            await c.wait_for_clean(timeout=120)
+
+            async def tenant(entity: str) -> _Rados:
+                ret, rs, out = await c.client.mon_command(
+                    {"prefix": "auth get-or-create",
+                     "entity": entity})
+                assert ret == 0, rs
+                key = bytes.fromhex(json.loads(out)["key"])
+                r = _Rados(c.monmap, name=entity,
+                           keyring=_Keyring({entity: key}),
+                           config=c.cfg)
+                await r.connect()
+                return r
+            cold = await tenant("client.cold")
+            hot = await tenant("client.hot")
+            io_cold = await cold.open_ioctx("cold")
+            io_hot = await hot.open_ioctx("hot")
+            await c.wait_for_clean(timeout=60)
+            for i in range(6):
+                await io_cold.write_full(f"warm-c-{i}", b"w" * 256)
+                await io_hot.write_full(f"warm-h-{i}", b"w" * 256)
+            th = Thrasher(c, seed=17)
+            samples: dict[str, list[dict]] = {"off": [], "drive": []}
+            committed = reverted = 0
+            order = ["off", "drive"]
+            for rep in range(2):
+                rot = rep % len(order)
+                for leg in order[rot:] + order[:rot]:
+                    ret, _, out = await c.client.mon_command(
+                        {"prefix": "tune status"})
+                    before = json.loads(out) if ret == 0 else {}
+                    c.cfg["mgr_tuner_mode"] = leg   # read LIVE per tick
+                    r = await th.tuner_storm(
+                        io_cold, io_hot, writes=24, hot_parallel=4,
+                        hot_burst=16, ramp_s=1.0)
+                    samples[leg].append(r)
+                    if leg == "drive" and r.get("tuner"):
+                        committed += max(0, r["tuner"].get(
+                            "committed", 0) - before.get("committed", 0))
+                        reverted += max(0, r["tuner"].get(
+                            "reverted", 0) - before.get("reverted", 0))
+                    # restore the static config between legs: a
+                    # tuner-committed profile must not leak into an
+                    # off leg (the operator rm releases its lease)
+                    c.cfg["mgr_tuner_mode"] = "off"
+                    for ent in ("client.hot", "client.cold"):
+                        await c.client.mon_command(
+                            {"prefix": "osd client-profile",
+                             "op": "rm", "entity": ent})
+                    await c.wait_for_clean(timeout=60)
+            await cold.shutdown()
+            await hot.shutdown()
+
+            def med(leg: str, key: str) -> float:
+                return statistics.median(
+                    x[key] for x in samples[leg])
+            off_p95, drv_p95 = med("off", "cold_p95_s"), \
+                med("drive", "cold_p95_s")
+            off_agg, drv_agg = med("off", "agg_ops_per_s"), \
+                med("drive", "agg_ops_per_s")
+            return {
+                "off": {"cold_p95_s": round(off_p95, 4),
+                        "cold_p99_s": round(
+                            med("off", "cold_p99_s"), 4),
+                        "agg_ops_per_s": off_agg},
+                "drive": {"cold_p95_s": round(drv_p95, 4),
+                          "cold_p99_s": round(
+                              med("drive", "cold_p99_s"), 4),
+                          "agg_ops_per_s": drv_agg},
+                "cold_p99_ratio_drive_vs_off": round(
+                    med("drive", "cold_p99_s") /
+                    max(med("off", "cold_p99_s"), 1e-9), 2),
+                "agg_ops_delta_pct": round(
+                    (drv_agg - off_agg) / max(off_agg, 1e-9) * 100,
+                    1),
+                "actions_committed": committed,
+                "actions_reverted": reverted,
+                # p95 for the verdict (smoke-count p99 is the max);
+                # "protects" = no worse for the cold tenant, actions
+                # actually landed, throughput not collapsed
+                "tuner_protects_cold": bool(
+                    drv_p95 <= off_p95 * 1.05 and committed >= 1 and
+                    drv_agg >= 0.5 * off_agg),
+            }
+        finally:
+            await c.stop()
+
+    return asyncio.run(run())
+
+
 def device_resilience_metric() -> dict:
     """Round-16 device-fault resilience plane, two legs:
 
@@ -728,6 +857,10 @@ def main() -> None:
             device_resilience_metric)
     except Exception:
         detail["device_resilience_error"] = _short_err()
+    try:
+        detail["tuning"] = _with_compile_split(tuning_metric)
+    except Exception:
+        detail["tuning_error"] = _short_err()
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),
@@ -795,6 +928,11 @@ def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
     if isinstance(res, dict):    # the round-16 fault-plane verdict
         out["resilience_within_noise"] = res.get(
             "no_fault", {}).get("resilience_within_noise")
+    tun = detail.get("tuning")
+    if isinstance(tun, dict):    # the round-17 self-driving verdict
+        out["tuner_protects_cold"] = tun.get("tuner_protects_cold")
+        out["tuner_actions"] = [tun.get("actions_committed"),
+                                tun.get("actions_reverted")]
     # round 14: total observed jit-compile wall for the whole run —
     # BENCH_r06+ can split a compile regression from a runtime one
     try:

@@ -20,6 +20,7 @@ through MonClient, mirroring the reference's command spellings:
     ... osd perf                     # per-OSD commit/apply latency
     ... progress ls | progress json  # long-running-op events
     ... mgr dump | mgr stat | mgr fail
+    ... tune status | tune log [n]   # mgr tuner ledger + audit trail
 
 Admin-socket commands (`ceph daemon <asok-path> <command>`, ref:
 src/ceph.in daemon mode) talk to one daemon out-of-band:
@@ -145,6 +146,17 @@ def _parse_command(words: list[str]) -> tuple[dict, bytes]:
     if w[:2] == ["osd", "slow"]:
         # ceph osd slow ls — confirmed slow OSDs + score table
         return {"prefix": "osd slow ls"}, b""
+    if w[:2] == ["tune", "status"]:
+        # ceph tune status — TunerModule mode + commit/revert counters
+        # + owned-target table (what the tuner is currently holding)
+        return {"prefix": "tune status"}, b""
+    if w[:2] == ["tune", "log"]:
+        # ceph tune log [n] — the bounded tuner audit trail, newest
+        # last; each entry carries policy + sensors + command
+        cmd = {"prefix": "tune log"}
+        if len(w) > 2:
+            cmd["num"] = int(w[2])
+        return cmd, b""
     if w[:2] == ["device-runtime", "status"]:
         # ceph device-runtime status — per-daemon kernel engine,
         # mismatch rate, compile count/time, transfer GiB

@@ -75,10 +75,11 @@ class Mgr(Dispatcher):
             BalancerModule, PGAutoscalerModule, PrometheusModule,
             ProgressModule, TracingModule,
         )
+        from ceph_tpu.mgr.tuner import TunerModule
         self.modules = [cls(self) for cls in (
             modules if modules is not None else
             [BalancerModule, PGAutoscalerModule, PrometheusModule,
-             TracingModule, ProgressModule])]
+             TracingModule, ProgressModule, TunerModule])]
         self.active = False
         self._tasks: list[asyncio.Task] = []
         self._stopped = False

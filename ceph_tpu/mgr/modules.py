@@ -424,6 +424,28 @@ class PrometheusModule(MgrModule):
                 lines.append(
                     f'ceph_progress_fraction{{event="{ev["id"]}"}} '
                     f'{float(ev.get("fraction", 0.0)):.4f}')
+        # self-driving tuner (round 17): mode, action counters, live
+        # guardrail state — read from the sibling module so the rows
+        # track the SAME loop the audit log records
+        tuner = next((m for m in getattr(self.mgr, "modules", [])
+                      if getattr(m, "NAME", "") == "tuner"), None)
+        if tuner is not None:
+            mode = str(self.mgr.config.get("mgr_tuner_mode",
+                                           "observe"))
+            gr = tuner.guardrails
+            lines += [
+                "# TYPE ceph_tuner_actions_committed counter",
+                f'ceph_tuner_mode{{mode="{mode}"}} 1',
+                f"ceph_tuner_ticks {tuner.ticks}",
+                f"ceph_tuner_actions_committed "
+                f"{tuner.actions_committed}",
+                f"ceph_tuner_actions_reverted "
+                f"{tuner.actions_reverted}",
+                f"ceph_tuner_observations {tuner.observations}",
+                f"ceph_tuner_proposals_deferred "
+                f"{gr.deferred_total}",
+                f"ceph_tuner_active_streaks {len(gr.streaks)}",
+            ]
         # daemon perf counters; TYPE_HISTOGRAM counters render as real
         # le-bucketed _bucket/_sum/_count series (round 9). Round 12:
         # rendered from the REPORTED state (daemon -> mgr MMgrReport

@@ -72,6 +72,9 @@ READONLY_COMMANDS = frozenset((
     # evidence reads (crash archive MUTATES the ack bit and stays
     # behind `mon w`)
     "device-runtime status", "crash ls", "crash info",
+    # tuner plane (round 17): audit/ownership reads (`tune record`
+    # MUTATES the audit ring and stays behind `mon w`)
+    "tune status", "tune log",
 ))
 AUTH_READS = frozenset(("auth get", "auth ls"))
 

@@ -170,6 +170,15 @@ class Monitor(Dispatcher):
         self.mgr_osd_perf: dict = {}
         self._mgr_digest_gid = 0
 
+        # tuner audit + ownership pool (round 17, see mon/tune.py):
+        # provenance-carrying actuator commits land in the bounded
+        # audit ring, observe-mode would-be actions arrive via `tune
+        # record`, and the owned table is what the dampening sweep's
+        # single-writer guard and a freshly-promoted mgr's tuner both
+        # read back. Leader-local, like the slow-OSD verdicts.
+        from ceph_tpu.mon.tune import TuneState
+        self.tune = TuneState(cfg)
+
         # crash-report pool (round 14, ref: the mgr crash module's
         # store): crash_id -> bounded report dict, IN MEMORY only
         # (crash evidence is observability, never a paxos artifact) —
@@ -719,7 +728,56 @@ class Monitor(Dispatcher):
 
     async def handle_command(self, cmd: dict,
                              inbl: bytes = b"") -> tuple[int, str, bytes]:
-        """ref: Monitor::handle_command routing table."""
+        """ref: Monitor::handle_command routing table — wrapped with
+        the round-17 tuner provenance capture: a command carrying a
+        ``provenance`` dict that COMMITS lands in the tune audit ring
+        (with its sensor readings) and updates actuator ownership; a
+        provenance-less command touching an owned target releases it
+        (the operator wins)."""
+        prefix = cmd.get("prefix", "")
+        if prefix.startswith("tune"):
+            return self._handle_tune_command(cmd)
+        ret, rs, outbl = await self._route_command(cmd, inbl)
+        prov = cmd.get("provenance")
+        if ret == 0:
+            if isinstance(prov, dict):
+                entry = self.tune.record_commit(cmd, prov)
+                self.clog(
+                    "INF",
+                    f"tuner[{entry['policy']}] committed "
+                    f"{prefix!r} ({entry['action']})")
+            else:
+                self.tune.record_operator(cmd)
+        return ret, rs, outbl
+
+    def _handle_tune_command(self, cmd: dict) -> tuple[int, str,
+                                                       bytes]:
+        """`ceph tune status|log` (read-only) + `tune record` (the
+        tuner's observe-mode would-be-action feed)."""
+        prefix = cmd.get("prefix", "")
+        mode = str(self.config.get("mgr_tuner_mode", "observe"))
+        if prefix == "tune status":
+            return 0, "", json.dumps(
+                self.tune.status(mode)).encode()
+        if prefix == "tune log":
+            num = cmd.get("num")
+            try:
+                num = int(num) if num is not None else None
+            except (TypeError, ValueError):
+                return -22, "num must be an integer", b""
+            return 0, "", json.dumps(
+                {"entries": self.tune.log(num)}).encode()
+        if prefix == "tune record":
+            entry = cmd.get("entry")
+            if not isinstance(entry, dict):
+                return -22, "entry must be a dict", b""
+            self.tune.record_observation(entry)
+            return 0, "", b""
+        return -22, f"unknown command {prefix!r}", b""    # -EINVAL
+
+    async def _route_command(self, cmd: dict,
+                             inbl: bytes = b"") -> tuple[int, str,
+                                                         bytes]:
         prefix = cmd.get("prefix", "")
         if prefix in ("status", "health"):
             return 0, "", json.dumps(self.get_status()).encode()

@@ -103,6 +103,10 @@ class OSDMonitor(PaxosService):
         self._slow_clear: dict[int, int] = {}
         # confirmed slow OSDs: target -> {score, latency_ms, since...}
         self.slow_osds: dict[int, dict] = {}
+        # OSDs the dampening sweep is currently deferring to a tuner
+        # affinity lease (round 17) — tracked so the WRN clog fires
+        # once per deferral episode, not every sweep
+        self._damp_deferred: set[int] = set()
         # device-runtime observability (round 14): per-OSD cumulative
         # device_health snapshots from the MPGStats piggyback (the
         # `ceph device-runtime status` table), the last cumulative
@@ -737,12 +741,14 @@ class OSDMonitor(PaxosService):
 
     def dampened_osds(self) -> list[int]:
         """OSDs currently primary-dampened. Derived from the MAP (any
-        non-default affinity — this framework has no other
-        primary-affinity writer), so it survives mon leader changes:
-        a fresh leader can heal what the old one dampened without any
-        in-memory handoff. If an operator affinity command is ever
-        added, the dampening sweep must learn to tell the two apart
-        (e.g. a sentinel bit)."""
+        non-default affinity), so it survives mon leader changes: a
+        fresh leader can heal what the old one dampened without any
+        in-memory handoff. Since round 17 there ARE other affinity
+        writers (`osd primary-affinity` — operators and the mgr
+        tuner): the sweep tells them apart through the mon's tuner
+        affinity leases (``mon.tune``) and defers to active ones in
+        :meth:`_apply_primary_dampening`; an operator write releases
+        any lease, so a leased entry is always the tuner's."""
         from ceph_tpu.osd.osdmap import DEFAULT_PRIMARY_AFFINITY
         om = self.osdmap
         if om is None:
@@ -777,6 +783,28 @@ class OSDMonitor(PaxosService):
         to_heal = [t for t in dampened
                    if t not in self.slow_osds and t < om.max_osd
                    and bool(om.is_up(np.asarray(t)))]
+        # single-writer guard (round 17): an OSD whose affinity the
+        # mgr tuner committed within its lease is the TUNER's to
+        # dampen and heal — the sweep auto-defers (WRN once per
+        # deferral episode) instead of fighting the gray-OSD
+        # responder tick for tick
+        from ceph_tpu.mon.tune import tuner_lease_filter
+        import time as _t
+        tune = getattr(self.mon, "tune", None)
+        if tune is not None and (to_damp or to_heal):
+            to_damp, to_heal, deferred = tuner_lease_filter(
+                to_damp, to_heal, tune.owned, _t.time(),
+                float(cfg.get("mon_tune_affinity_lease_s", 600.0)))
+            newly_deferred = [t for t in deferred
+                              if t not in self._damp_deferred]
+            self._damp_deferred = set(deferred)
+            if newly_deferred:
+                self.mon.clog(
+                    "WRN", f"slow-osd dampening deferred for osd(s) "
+                           f"{newly_deferred}: a tuner holds their "
+                           f"primary-affinity lease")
+        elif tune is not None:
+            self._damp_deferred = set()
         if not to_damp and not to_heal:
             return
 
@@ -791,6 +819,41 @@ class OSDMonitor(PaxosService):
         if ok:
             log.dout(1, f"slow-osd primary dampening: damped "
                         f"{to_damp}, restored {to_heal}")
+
+    async def _cmd_primary_affinity(self, cmd, inbl):
+        """`ceph osd primary-affinity <id> <weight>` (ref:
+        OSDMonitor prepare_command "osd primary-affinity"): the
+        operator/tuner primary-affinity write path (round 17). The
+        mgr TunerModule's gray-OSD responder and kernel-path watchdog
+        commit through HERE with a ``provenance`` dict — the monitor
+        records the resulting affinity lease, and the mon-side
+        dampening sweep defers to it (single-writer guard)."""
+        from ceph_tpu.osd.osdmap import DEFAULT_PRIMARY_AFFINITY
+        try:
+            osd = int(cmd["id"])
+            weight = float(cmd["weight"])
+        except (KeyError, TypeError, ValueError):
+            return -22, "usage: osd primary-affinity <id> " \
+                        "<weight 0.0..1.0>", b""
+        if not 0.0 <= weight <= 1.0:
+            return -22, "weight must be in [0.0, 1.0]", b""
+        om = self.osdmap
+        if om is None or not (0 <= osd < om.max_osd) or \
+                not om.osd_state[osd] & STATE_EXISTS:
+            return -2, f"osd.{osd} does not exist", b""
+        raw = int(round(weight * DEFAULT_PRIMARY_AFFINITY))
+
+        def build(cur):
+            if int(cur.osd_primary_affinity[osd]) == raw:
+                return None               # already there: idempotent
+            inc = Incremental()
+            inc.new_primary_affinity[osd] = raw
+            return inc, None
+        ok, _ = await self._propose_change(build)
+        if ok or int(om.osd_primary_affinity[osd]) == raw:
+            return 0, f"set osd.{osd} primary-affinity to " \
+                      f"{weight:.4g}", b""
+        return -11, "proposal failed", b""
 
     async def _cmd_slow_ls(self, cmd, inbl):
         """`ceph osd slow ls` — confirmed slow OSDs plus the full
@@ -956,6 +1019,7 @@ class OSDMonitor(PaxosService):
             "osd rm-pg-upmap-items": self._cmd_rm_pg_upmap_items,
             "osd blocklist": self._cmd_blocklist,
             "osd client-profile": self._cmd_client_profile,
+            "osd primary-affinity": self._cmd_primary_affinity,
             "osd slow ls": self._cmd_slow_ls,
         }.get(prefix)
         if handler is None:
@@ -1507,6 +1571,7 @@ class OSDMonitor(PaxosService):
         return (0, "", b"") if ok else (-11, "proposal failed", b"")
 
     async def _cmd_dump(self, cmd, inbl):
+        from ceph_tpu.osd.osdmap import DEFAULT_PRIMARY_AFFINITY
         om = self.osdmap
         out = {
             "epoch": om.epoch, "max_osd": om.max_osd,
@@ -1518,6 +1583,9 @@ class OSDMonitor(PaxosService):
                 "weight": float(om.osd_weight[o] / WEIGHT_ONE),
                 "nearfull": int(om.is_nearfull(o)),
                 "full": int(om.is_full(o)),
+                "primary_affinity": round(
+                    int(om.osd_primary_affinity[o]) /
+                    DEFAULT_PRIMARY_AFFINITY, 4),
                 "addr": list(om.osd_addrs.get(o, ())),
             } for o in range(om.max_osd)
                 if om.osd_state[o] & STATE_EXISTS],

@@ -45,10 +45,25 @@ class ConfigMonitor(PaxosService):
         prefix = cmd.get("prefix", "")
         if prefix == "config set":
             who, name = cmd["who"], cmd["name"]
+            # registered Options are validated up front and, once the
+            # proposal commits, pushed into the LIVE config (round 17:
+            # the tuner's recovery governor flips osd_recovery_* at
+            # runtime through this path — daemons reading knobs live
+            # off the shared config follow without a restart)
+            live = _MISSING = object()
+            from ceph_tpu.utils.config import OPTIONS
+            opt = OPTIONS.get(name)
+            if opt is not None:
+                try:
+                    live = opt.validate(cmd["value"])
+                except ValueError as e:
+                    return -22, str(e), b""
             t = self.store.transaction()
             t.set(self.prefix, f"{who}/{name}",
                   str(cmd["value"]).encode())
             ok = await self.mon.propose_txn(t)
+            if ok and live is not _MISSING:
+                self.mon.config[name] = live
             return (0, f"set {who}/{name}", b"") if ok else \
                 (-11, "proposal failed", b"")
         if prefix == "config rm":

@@ -217,7 +217,8 @@ class OSD(Dispatcher):
         self.recovery_throttle = SchedulerThrottle(
             self.scheduler,
             max_active=cfg.get("osd_recovery_max_active", 8),
-            bytes_per_s=cfg.get("osd_recovery_max_bytes", 0))
+            bytes_per_s=cfg.get("osd_recovery_max_bytes", 0),
+            config=cfg)
         # client-op admission throttle (ref: OSD client_messenger
         # policy throttles, osd_client_message_cap /
         # osd_client_message_size_cap): ops past the caps queue at

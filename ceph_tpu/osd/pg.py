@@ -148,6 +148,13 @@ class PG:
         self._reserve_tids: dict[int, int] = {}
         self.backfill_stats = {"scanned": 0, "pushed": 0,
                                "removed": 0, "resumed_from": ""}
+        # per-client op counts (round 17): the mgr tuner's hot-pool
+        # protector reads these off `pg dump` and diffs across ticks
+        # to rank pools/entities by live op rate — no wire change, the
+        # counts ride the MPGStats stats blob like backfill progress.
+        # Primary-only and reset with the PG object (a new primary
+        # restarts at zero; the tuner diffs, so baselines self-heal).
+        self.client_ops: dict[str, int] = {}
         # peering scratch
         self.peer_logs: dict[int, PGLog] = {}
         self.peer_missing: dict[int, dict[str, LogEntry]] = {}
@@ -1530,6 +1537,9 @@ class PG:
                     self.osd.perf.hist_add(
                         cls_key, (_time.monotonic() - t0) * 1e6)
                     self.osd.perf.inc("ops")
+                    src = str(m.src)
+                    self.client_ops[src] = \
+                        self.client_ops.get(src, 0) + 1
                     cost = getattr(m, "_throttle_cost", None)
                     if cost is not None:
                         self.osd.client_throttle.release(cost)
@@ -2476,6 +2486,9 @@ class PG:
                "acting": self.acting, "up": self.up,
                "last_update": str(self.pg_log.head),
                "scrub_errors": self.scrub_errors}
+        if self.client_ops:
+            out["num_ops"] = sum(self.client_ops.values())
+            out["client_ops"] = dict(self.client_ops)
         if self.is_merge_source():
             # merge progress rides MPGStats into pg dump / status
             out["merge"] = {"pending": self.pool.pg_num_pending,

@@ -115,6 +115,7 @@ class RecoveryThrottle:
         self.max_active = max(1, int(max_active))
         self.bytes_per_s = max(0, int(bytes_per_s))
         self._sem = asyncio.Semaphore(self.max_active)
+        self._debt = 0          # permits to absorb after a live shrink
         self._tokens = float(self.bytes_per_s)
         self._last_refill = None
         self.throttled_ops = 0
@@ -127,6 +128,43 @@ class RecoveryThrottle:
             float(self.bytes_per_s),
             self._tokens + (now - self._last_refill) * self.bytes_per_s)
         self._last_refill = now
+
+    def set_limits(self, max_active: int | None = None,
+                   bytes_per_s: int | None = None) -> bool:
+        """Retune LIVE (round 17: the mgr tuner's recovery governor
+        commits `config set` and running OSDs must follow without a
+        restart). Growing ``max_active`` releases the extra permits
+        immediately; shrinking records a debt that in-flight releases
+        absorb — already-granted pushes finish, new acquires see the
+        tighter bound. Returns True when anything changed."""
+        changed = False
+        if max_active is not None:
+            max_active = max(1, int(max_active))
+            delta = max_active - self.max_active
+            if delta:
+                changed = True
+                self.max_active = max_active
+                if delta > 0:
+                    take = min(delta, self._debt)
+                    self._debt -= take
+                    for _ in range(delta - take):
+                        self._sem.release()
+                else:
+                    # absorb -delta permits as they come back
+                    self._debt += -delta
+        if bytes_per_s is not None:
+            bytes_per_s = max(0, int(bytes_per_s))
+            if bytes_per_s != self.bytes_per_s:
+                changed = True
+                self.bytes_per_s = bytes_per_s
+                self._tokens = min(self._tokens, float(bytes_per_s))
+        return changed
+
+    def _release_slot(self) -> None:
+        if self._debt > 0:
+            self._debt -= 1
+        else:
+            self._sem.release()
 
     async def acquire(self, nbytes: int = 0):
         """Take one recovery slot (+ tokens for nbytes). Returns a
@@ -153,7 +191,7 @@ class RecoveryThrottle:
                     PERF.inc("throttle_waits")
                 need = min(nbytes, self.bytes_per_s) - self._tokens
                 await asyncio.sleep(need / self.bytes_per_s)
-        return self._sem.release
+        return self._release_slot
 
     def op(self, nbytes: int = 0) -> "_ThrottledOp":
         return _ThrottledOp(self, nbytes)
@@ -161,7 +199,8 @@ class RecoveryThrottle:
     def dump(self) -> dict:
         return {"max_active": self.max_active,
                 "bytes_per_s": self.bytes_per_s,
-                "active": self.max_active - self._sem._value,
+                "active": self.max_active + self._debt -
+                self._sem._value,
                 "throttled_ops": self.throttled_ops,
                 "throttled_bytes": self.throttled_bytes}
 

@@ -454,13 +454,26 @@ class SchedulerThrottle:
     exactly the old side throttle."""
 
     def __init__(self, scheduler: OpScheduler | None,
-                 max_active: int = 8, bytes_per_s: int = 0):
+                 max_active: int = 8, bytes_per_s: int = 0,
+                 config: dict | None = None):
         from ceph_tpu.osd.recovery import RecoveryThrottle
         self.scheduler = scheduler
+        # with a config dict, the knobs are read LIVE per acquire
+        # (round 17: the tuner's recovery governor commits `config
+        # set` and every in-flight backfill follows on its next push)
+        self.config = config
         self._legacy = RecoveryThrottle(max_active=max_active,
                                         bytes_per_s=bytes_per_s)
 
+    def _sync_knobs(self) -> None:
+        if self.config is None:
+            return
+        self._legacy.set_limits(
+            max_active=self.config.get("osd_recovery_max_active", 8),
+            bytes_per_s=self.config.get("osd_recovery_max_bytes", 0))
+
     async def acquire(self, nbytes: int = 0):
+        self._sync_knobs()
         if self.scheduler is not None:
             # size-scaled cost (ROADMAP #3a), same divisor the client
             # admission path charges: a 4 MiB recovery push pays its

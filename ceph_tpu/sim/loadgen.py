@@ -36,6 +36,15 @@ Usage::
 
 The tier-1 smoke runs <= 200 sessions (tests/test_meta.py budget
 guard); the full 10k run is ``@pytest.mark.slow``.
+
+Scenario schedules (round 17): ``SCENARIOS`` holds named multi-phase
+workload shapes — each phase runs one LoadGen fleet per pool to
+completion (optionally firing a cluster event first) — and
+``run_scenario`` drives them. They exist to exercise the mgr
+TunerModule's policies with realistic load TRANSITIONS: the diurnal
+ramp (does a quiet trough commit anything? it must not), the hot-pool
+burst (the hot-pool protector's trip/heal cycle), and an OSD outage
+landing mid-rush (the recovery governor's backfill-vs-QoS trade).
 """
 
 from __future__ import annotations
@@ -173,3 +182,86 @@ class LoadGen:
                     f"{report['ops_per_s']} ops/s, "
                     f"p99 {report['p99_ms']} ms")
         return report
+
+
+# -- scenario schedules (round 17) ----------------------------------------
+# Each scenario is an ordered list of phases; a phase optionally fires
+# one cluster event ("osd_out:<id>" / "osd_in:<id>") and then runs one
+# closed-loop LoadGen fleet PER POOL concurrently to completion. The
+# pool names are roles — run_scenario maps them to real pools. Session
+# counts are smoke-sized; ``scale`` multiplies them for bigger rigs.
+SCENARIOS: dict[str, list[dict]] = {
+    # a compressed day: quiet -> peak -> quiet. The steady shape the
+    # tuner must NOT act on (zero-commit acceptance).
+    "diurnal_ramp": [
+        {"name": "trough", "load": {"a": dict(
+            sessions=6, ops_per_session=4, think_s=0.03)}},
+        {"name": "peak", "load": {"a": dict(
+            sessions=20, ops_per_session=6)}},
+        {"name": "evening", "load": {"a": dict(
+            sessions=6, ops_per_session=4, think_s=0.03)}},
+    ],
+    # one tenant pool goes hot while a cold tenant keeps its paced
+    # trickle — the hot-pool protector's trip (burst) and heal (after)
+    "hot_pool_burst": [
+        {"name": "steady", "load": {"cold": dict(
+            sessions=6, ops_per_session=4, think_s=0.02)}},
+        {"name": "burst", "load": {
+            "cold": dict(sessions=6, ops_per_session=4,
+                         think_s=0.02),
+            "hot": dict(sessions=24, ops_per_session=10)}},
+        {"name": "after", "load": {"cold": dict(
+            sessions=6, ops_per_session=4, think_s=0.02)}},
+    ],
+    # an OSD drops out in the middle of the rush: backfill pressure
+    # lands ON TOP of peak client load — the recovery governor's
+    # QoS-floor-vs-backfill trade, then the drain after the OSD
+    # returns
+    "backfill_storm_mid_rush": [
+        {"name": "rush", "load": {"a": dict(
+            sessions=16, ops_per_session=6)}},
+        {"name": "outage", "event": "osd_out:1", "load": {"a": dict(
+            sessions=16, ops_per_session=6)}},
+        {"name": "return", "event": "osd_in:1", "load": {"a": dict(
+            sessions=8, ops_per_session=4, think_s=0.02)}},
+    ],
+}
+
+
+async def run_scenario(cluster, name: str,
+                       pools: dict[str, str] | None = None,
+                       scale: float = 1.0, seed: int = 0,
+                       clients: int = 4) -> dict:
+    """Drive one named scenario: per phase, fire its event (if any)
+    through the admin client, then run every pool's LoadGen fleet
+    concurrently to completion. ``pools`` maps the scenario's role
+    names to real pool names (identity when omitted — the pools must
+    already exist). Returns per-phase reports keyed by role."""
+    sched = SCENARIOS[name]
+    pools = pools or {}
+    phases = []
+    for pi, phase in enumerate(sched):
+        event = phase.get("event")
+        if event:
+            verb, _, arg = event.partition(":")
+            prefix = {"osd_out": "osd out",
+                      "osd_in": "osd in"}[verb]
+            ret, rs, _ = await cluster.client.mon_command(
+                {"prefix": prefix, "id": int(arg)})
+            if ret != 0:
+                raise RuntimeError(f"scenario event {event}: {rs}")
+        gens = {
+            role: LoadGen(cluster, pools.get(role, role),
+                          clients=clients,
+                          seed=seed * 1000 + pi,
+                          **{**kw, "sessions": max(
+                              1, int(kw["sessions"] * scale))})
+            for role, kw in phase["load"].items()}
+        reports = dict(zip(gens, await asyncio.gather(
+            *[g.run() for g in gens.values()])))
+        phases.append({"name": phase["name"], "event": event,
+                       "reports": reports})
+        log.dout(1, f"scenario {name}/{phase['name']}: " + ", ".join(
+            f"{r}={reports[r]['ops_per_s']} ops/s "
+            f"(p99 {reports[r]['p99_ms']} ms)" for r in reports))
+    return {"scenario": name, "phases": phases}

@@ -44,6 +44,7 @@ one-off thrash tests assert):
 from __future__ import annotations
 
 import asyncio
+import json
 import random
 
 from ceph_tpu.sim import faults as F
@@ -690,6 +691,110 @@ class Thrasher:
                 "cold_ops_per_s": round(len(lat) / wall, 1)
                 if wall > 0 else 0.0,
                 "hot_ops": hot_ops[0]}
+
+    async def tuner_storm(self, io_cold, io_hot, writes: int = 24,
+                          hot_parallel: int = 4, hot_burst: int = 16,
+                          cold_think_s: float = 0.02,
+                          write_bytes: int = 1024,
+                          op_timeout: float = 30.0,
+                          ramp_s: float = 0.5) -> dict:
+        """The self-driving-tuner acceptance storm (round 17): the
+        qos_storm's two-tenant shape split across TWO POOLS — the hot
+        tenant floods its own pool open-loop while the cold tenant
+        paces on another — so the mgr tuner's hot-pool protector has
+        a per-pool op-rate signal to trip on (the hot pool starving
+        the cold one), not just per-entity queues. ``ramp_s`` holds
+        the hot flood before the cold measurement starts, giving the
+        tuner's hysteresis window time to see the breach.
+
+        ``io_cold``/``io_hot`` must be IoCtxs of DIFFERENT client
+        entities over DIFFERENT pools. Returns the qos_storm report
+        shape plus the mon's tuner ledger (committed/reverted/
+        observed + mode) sampled after the storm — the caller diffs
+        ledgers across runs to count actions this storm caused."""
+        import time as _time
+        from ceph_tpu.sim.loadgen import percentile
+        stop = asyncio.Event()
+        hot_ops = [0]
+        rng = random.Random(self.seed ^ 0x70E5)
+
+        async def one_hot(w: int, i: int) -> None:
+            oid = f"tuner-hot-{self.seed}-{w}-{i % 64:03d}"
+            data = bytes([i % 256]) * write_bytes
+            try:
+                await io_hot.write_full(oid, data,
+                                        timeout=op_timeout)
+                hot_ops[0] += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.dout(5, f"tuner storm hot write failed: {e!r}")
+
+        async def hot_writer(w: int) -> None:
+            i = 0
+            while not stop.is_set():
+                await asyncio.gather(*[
+                    one_hot(w, i + k) for k in range(hot_burst)])
+                i += hot_burst
+        tasks = [asyncio.ensure_future(hot_writer(w))
+                 for w in range(hot_parallel)]
+        lat: list[float] = []
+        errors = 0
+        t_start = _time.perf_counter()
+        try:
+            if hot_parallel:
+                await asyncio.sleep(ramp_s)    # let the breach register
+            t0 = _time.perf_counter()
+            for i in range(writes):
+                oid = f"tuner-cold-{self.seed}-{i:04d}"
+                data = bytes([i % 256]) * rng.randint(1, write_bytes)
+                s0 = _time.perf_counter()
+                try:
+                    await io_cold.write_full(oid, data,
+                                             timeout=op_timeout)
+                    lat.append(_time.perf_counter() - s0)
+                    self.acked[oid] = data
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    errors += 1
+                await asyncio.sleep(cold_think_s)
+            wall = _time.perf_counter() - t0
+        finally:
+            stop.set()
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        wall_total = _time.perf_counter() - t_start
+        lat.sort()
+        tuner = {}
+        ret, _, out = await self.c.client.mon_command(
+            {"prefix": "tune status"})
+        if ret == 0:
+            st = json.loads(out)
+            tuner = {k: st.get(k) for k in
+                     ("mode", "committed", "reverted", "observed")}
+        self._log(f"tuner storm: cold {len(lat)}/{writes} acked "
+                  f"(p99 {percentile(lat, 0.99) * 1e3:.1f} ms), "
+                  f"hot {hot_ops[0]} ops, tuner {tuner}")
+        return {"mode": str(self.c.cfg.get("mgr_tuner_mode",
+                                           "observe")),
+                "cold_ops": len(lat),
+                "cold_errors": errors,
+                "cold_p50_s": percentile(lat, 0.50),
+                "cold_p95_s": percentile(lat, 0.95),
+                "cold_p99_s": percentile(lat, 0.99),
+                "cold_ops_per_s": round(len(lat) / wall, 1)
+                if wall > 0 else 0.0,
+                "hot_ops": hot_ops[0],
+                "wall_s": round(wall_total, 3),
+                # both tenants over the storm's full window (incl the
+                # ramp the hot flood runs alone) — the throughput side
+                # of the protect-the-cold-tenant trade
+                "agg_ops_per_s": round(
+                    (len(lat) + hot_ops[0]) / wall_total, 1)
+                if wall_total > 0 else 0.0,
+                "tuner": tuner}
 
     async def _pool_set(self, pool: str, var: str, val: int) -> None:
         ret, rs, _ = await self.c.client.mon_command(

@@ -351,6 +351,66 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("mgr_progress_max_events", int, 64,
            "recently-completed progress events retained for "
            "`ceph progress json`", min=1),
+    # self-driving tuner (round 17; mgr/tuner.py TunerModule + the
+    # mon's tune audit/ownership pool in mon/tune.py). The mgr_tuner_*
+    # knobs are read LIVE every tick, so mode/threshold flips apply
+    # to the next evaluation without a mgr restart.
+    Option("mgr_tuner_interval", float, 1.0,
+           "TunerModule tick period (sensor evaluation + guardrailed "
+           "actuation)", min=0.05),
+    Option("mgr_tuner_mode", str, "observe",
+           "the tuner's mode ladder: 'off' evaluates nothing, "
+           "'observe' (the safe default) logs would-be actions to "
+           "`ceph tune log` without committing, 'drive' (opt-in) "
+           "commits them through the mon command paths",
+           enum_allowed=("off", "observe", "drive")),
+    Option("mgr_tuner_act_ticks", int, 3,
+           "hysteresis: consecutive breaching ticks before a policy's "
+           "action becomes eligible (a flapping sensor commits "
+           "nothing)", min=1),
+    Option("mgr_tuner_revert_ticks", int, 5,
+           "hysteresis: consecutive clean ticks before a policy's "
+           "revert becomes eligible", min=1),
+    Option("mgr_tuner_max_changes_per_tick", int, 2,
+           "cluster-wide change budget per tick; eligible proposals "
+           "past it DEFER to the next tick (streaks retained) rather "
+           "than drop", min=1),
+    Option("mgr_tuner_qos_floor_ms", float, 250.0,
+           "the client p99 QoS floor (ms) the recovery governor "
+           "protects: p99 above it scales recovery down, p99 under "
+           "the headroom fraction of it lets pending backfill scale "
+           "recovery up", min=1.0),
+    Option("mgr_tuner_headroom_frac", float, 0.5,
+           "fraction of the QoS floor p99 must stay UNDER to count "
+           "as headroom for scaling recovery up", min=0.01, max=1.0),
+    Option("mgr_tuner_recovery_max_active_cap", int, 32,
+           "ceiling the recovery governor may scale "
+           "osd_recovery_max_active up to", min=1),
+    Option("mgr_tuner_hot_pool_ratio", float, 4.0,
+           "hot-pool protector trip: a pool whose op rate exceeds "
+           "this multiple of the busiest OTHER pool's is the "
+           "aggressor", min=1.0),
+    Option("mgr_tuner_hot_pool_min_ops", float, 50.0,
+           "absolute op-rate floor (ops/s) below which no pool can "
+           "trip the hot-pool protector (idle-cluster noise "
+           "immunity)", min=0.0),
+    Option("mgr_tuner_hot_limit_frac", float, 0.5,
+           "the tightened client-profile qos_limit as a fraction of "
+           "the aggressor's observed op rate", min=0.01, max=1.0),
+    Option("mgr_tuner_hot_weight", float, 0.5,
+           "the tightened client-profile dmClock weight committed on "
+           "an aggressor entity", min=0.01),
+    Option("mgr_tuner_affinity", float, 0.0,
+           "the dampened primary affinity the gray-OSD responder and "
+           "kernel-path watchdog commit (0 = never primary)",
+           min=0.0, max=1.0),
+    Option("mon_tune_audit_max", int, 256,
+           "bounded length of the mon's tuner audit ring "
+           "(`ceph tune log`)", min=8),
+    Option("mon_tune_affinity_lease_s", float, 600.0,
+           "how long a tuner-committed primary-affinity lease defers "
+           "the mon's own slow-OSD dampening sweep; expired leases "
+           "return the OSD to the sweep", min=1.0),
     # device-runtime observability plane (round 14; the devmon layer
     # in utils/devmon.py + the mon's KERNEL_PATH_DEGRADED sweep).
     # devmon_expected_engine is read LIVE per sweep check, the

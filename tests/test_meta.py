@@ -42,6 +42,9 @@ STORM_BUDGETS = {
     "mds_storm": {"writes": 24, "kills": 1},
     "elastic_storm": {"writes": 40},
     "qos_storm": {"writes": 30, "hot_parallel": 4},
+    # the round-17 tuner acceptance storm: qos_storm's two-tenant
+    # shape over two pools — same smoke caps
+    "tuner_storm": {"writes": 30, "hot_parallel": 4},
     # the round-16 device-fault storm pays up to three interpret-mode
     # kernel compiles (probe mapper) — keep the IO budgets tiny
     "device_storm": {"ec_writes": 12, "probe_hosts": 4},
@@ -317,7 +320,21 @@ def _render_prometheus(reported: bool = False) -> str:
         async def monc(self):               # pragma: no cover
             raise AssertionError
 
+    class _StubTuner:
+        # the round-17 tuner rows render off the sibling module's
+        # live counters — a shaped stand-in keeps the exposition
+        # guards over them without a mgr loop
+        NAME = "tuner"
+        ticks, actions_committed, actions_reverted = 3, 2, 1
+        observations = 4
+
+        class _G:
+            deferred_total = 1
+            streaks = {("gray_osd_responder", "affinity:2", "act"): 2}
+        guardrails = _G()
+
     stub = _StubMgr()
+    stub.modules = [_StubTuner()]
     if reported:
         from ceph_tpu.mgr.client import schema_entries
         from ceph_tpu.mgr.daemon_state import DaemonStateIndex
@@ -389,6 +406,12 @@ def _render_prometheus(reported: bool = False) -> str:
     mod = PrometheusModule.__new__(PrometheusModule)
     mod.mgr = stub
     text = asyncio.run(mod.render())
+    # round 17: the tuner rows track the stub module's counters
+    assert 'ceph_tuner_mode{mode="observe"} 1' in text, text
+    assert "ceph_tuner_actions_committed 2" in text, text
+    assert "ceph_tuner_actions_reverted 1" in text, text
+    assert "ceph_tuner_proposals_deferred 1" in text, text
+    assert "ceph_tuner_active_streaks 1" in text, text
     if reported:
         # the canned index must actually drive the render: reported
         # rows + the osd perf digest rows, singleton rows absent
@@ -575,6 +598,16 @@ def test_resilience_knobs_registered_with_defaults():
     _assert_knobs_registered(
         ("crush_kernel_reprobe_", "osd_ec_fallback_"),
         "device-fault resilience")
+
+
+def test_tuner_knobs_registered_with_defaults():
+    """Round 17: every self-driving-tuner knob (`mgr_tuner_*` policy
+    thresholds + guardrails, `mon_tune_*` audit/lease bounds) read
+    anywhere must be a registered Option with a default — the tuner
+    reads them LIVE every tick (the mode ladder is a runtime flip),
+    so an unregistered knob silently diverges from `config show`
+    exactly when an operator is reining the loop in."""
+    _assert_knobs_registered(("mgr_tuner_", "mon_tune_"), "tuner")
 
 
 def test_fault_kinds_documented():

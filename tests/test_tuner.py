@@ -1,0 +1,711 @@
+"""The round-17 self-driving tuner, tested in layers:
+
+- **guardrail algebra** — Guardrails under virtual ticks (no clock,
+  no cluster): hysteresis streaks, flap protection, the per-tick
+  change budget that DEFERS instead of dropping;
+- **mon-side ledger** — TuneState ownership/audit lifecycle and the
+  pure single-writer lease filter the dampening sweep consults;
+- **policy convergence** — TunerModule against a scripted world (a
+  stub mon that applies actuator commands the way the real one does,
+  backed by a REAL TuneState): observe commits nothing, drive
+  act/revert cycles are level-based (a fresh module instance — the
+  promoted-standby shape — resumes without double-committing), the
+  operator always wins;
+- **one storm acceptance** — the only cluster spin here (tier-1 is
+  near its wall-clock cap): a steady balanced workload in drive mode
+  commits ZERO, a hot-pool burst trips a guardrailed client-profile
+  commit whose audit entry carries the sensors, a mid-storm mgr
+  failover does not double-commit, and the heal reverts.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.mgr.tuner import Guardrails, Proposal, TunerModule
+from ceph_tpu.mon.tune import TuneState, tuner_lease_filter
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def _p(policy="pol", key="affinity:1", kind="act"):
+    return Proposal(policy, key, kind,
+                    {"prefix": "osd primary-affinity", "id": 1,
+                     "weight": 0.0},
+                    {"osd": 1}, f"{kind} {key}")
+
+
+# -- guardrail algebra (virtual ticks) -------------------------------------
+
+def test_guardrails_hysteresis_and_flap():
+    """act needs N CONSECUTIVE breaching ticks; a flapping sensor
+    (breach every other tick) never accumulates a streak and commits
+    nothing."""
+    g = Guardrails({"mgr_tuner_act_ticks": 3})
+    assert g.filter([_p()]) == ([], [])            # tick 1
+    assert g.filter([_p()]) == ([], [])            # tick 2
+    granted, deferred = g.filter([_p()])           # tick 3
+    assert len(granted) == 1 and not deferred
+    # flap: present on odd ticks only -> streak resets each gap
+    g2 = Guardrails({"mgr_tuner_act_ticks": 2})
+    for _ in range(6):
+        assert g2.filter([_p()]) == ([], [])
+        assert g2.filter([]) == ([], [])           # clean tick resets
+    assert g2.streaks == {}
+
+
+def test_guardrails_revert_threshold_is_separate():
+    """reverts wait out their own (longer) clean-streak threshold."""
+    g = Guardrails({"mgr_tuner_act_ticks": 1, "mgr_tuner_revert_ticks": 3})
+    r = _p(kind="revert")
+    assert g.filter([r]) == ([], [])
+    assert g.filter([r]) == ([], [])
+    granted, _ = g.filter([r])
+    assert [p.kind for p in granted] == ["revert"]
+
+
+def test_guardrails_budget_defers_not_drops():
+    """Three eligible changes against a budget of 2: two granted, one
+    DEFERRED — and the deferred one keeps its streak, so it is granted
+    on the very next tick (not dropped, not restarted)."""
+    g = Guardrails({"mgr_tuner_act_ticks": 1,
+                    "mgr_tuner_max_changes_per_tick": 2})
+    props = [_p(key=f"affinity:{i}") for i in range(3)]
+    granted, deferred = g.filter(props)
+    assert [p.key for p in granted] == ["affinity:0", "affinity:1"]
+    assert [p.key for p in deferred] == ["affinity:2"]
+    assert g.deferred_total == 1
+    granted2, deferred2 = g.filter([props[2]])
+    assert [p.key for p in granted2] == ["affinity:2"] and not deferred2
+
+
+def test_guardrails_settle_restarts_streak():
+    """settle() after an apply restarts the ident's streak — in
+    observe mode this is the audit-ring anti-spam (one record per
+    hysteresis window, not one per tick)."""
+    g = Guardrails({"mgr_tuner_act_ticks": 2})
+    g.filter([_p()])
+    granted, _ = g.filter([_p()])
+    assert granted
+    g.settle(granted[0])
+    assert g.filter([_p()]) == ([], [])            # streak restarted
+
+
+# -- the single-writer lease filter ----------------------------------------
+
+def test_lease_filter_defers_both_directions():
+    """An OSD under an active tuner affinity lease is the TUNER's to
+    dampen AND to heal — the mon sweep's candidates are filtered in
+    both directions; expired leases and profile keys don't count."""
+    owned = {"affinity:2": {"since": 100.0},
+             "affinity:5": {"since": 0.0},          # expired
+             "profile:client.x": {"since": 100.0}}
+    damp, heal, deferred = tuner_lease_filter(
+        [1, 2], [2, 5], owned, now=110.0, lease_s=60.0)
+    assert damp == [1]
+    assert heal == [5]                              # lease expired
+    assert deferred == [2]
+    # no leases -> pass-through
+    assert tuner_lease_filter([1], [2], {}, 0.0, 60.0) == \
+        ([1], [2], [])
+
+
+# -- TuneState: ownership + bounded audit ----------------------------------
+
+def test_tune_state_ownership_lifecycle():
+    ts = TuneState({})
+    prov = {"policy": "gray_osd_responder", "mode": "drive",
+            "action": "act", "sensors": {"osd": 2}}
+    ts.record_commit({"prefix": "osd primary-affinity", "id": 2,
+                      "weight": 0.0}, prov)
+    assert "affinity:2" in ts.owned
+    assert ts.committed == 1
+    # the revert half releases
+    ts.record_commit({"prefix": "osd primary-affinity", "id": 2,
+                      "weight": 1.0},
+                     {**prov, "action": "revert"})
+    assert "affinity:2" not in ts.owned and ts.reverted == 1
+    # profile set acquires, operator rm releases (the operator wins)
+    ts.record_commit({"prefix": "osd client-profile", "op": "set",
+                      "entity": "client.h", "reservation": 0.0,
+                      "weight": 0.5, "limit": 40.0},
+                     {"policy": "hot_pool_protector",
+                      "action": "act"})
+    assert "profile:client.h" in ts.owned
+    ts.record_operator({"prefix": "osd client-profile", "op": "rm",
+                        "entity": "client.h"})
+    assert ts.owned == {}
+    # config set carries no per-target ownership
+    assert TuneState.target_key({"prefix": "config set",
+                                 "name": "osd_recovery_max_active"}) \
+        is None
+    # observations never touch ownership
+    ts.record_observation({"policy": "p", "action": "act",
+                           "sensors": {}, "cmd": {}})
+    assert ts.owned == {} and ts.observed == 1
+    assert ts.log()[-1]["committed"] is False
+
+
+def test_tune_state_audit_bounded_and_status_shape():
+    ts = TuneState({"mon_tune_audit_max": 8})
+    for i in range(30):
+        ts.record_observation({"policy": "p", "action": "act",
+                               "sensors": {"i": i}, "cmd": {}})
+    assert len(ts.audit) == 8
+    assert ts.log(3)[-1]["sensors"] == {"i": 29}    # newest last
+    assert len(ts.log(3)) == 3
+    st = ts.status("observe")
+    assert st["mode"] == "observe" and st["audit_max"] == 8
+    assert st["audit_entries"] == 8 and st["observed"] == 30
+    ts.record_commit({"prefix": "osd primary-affinity", "id": 1,
+                      "weight": 0.0}, {"policy": "x", "action": "act"})
+    st = ts.status("drive")
+    assert "affinity:1" in st["owned"]
+    assert "cmd" not in st["owned"]["affinity:1"]   # status stays small
+    assert json.loads(json.dumps(st)) == st          # JSON-clean
+
+
+# -- read-only cap class + CLI spellings -----------------------------------
+
+def test_tune_command_cap_class_and_cli_parse():
+    """`tune status`/`tune log` are mon-r reads; `tune record` mutates
+    the audit ring and must stay behind mon w. The CLI spells all
+    three views."""
+    from ceph_tpu.bench.ceph_cli import parse_command
+    from ceph_tpu.mon.auth_monitor import READONLY_COMMANDS
+    assert "tune status" in READONLY_COMMANDS
+    assert "tune log" in READONLY_COMMANDS
+    assert "tune record" not in READONLY_COMMANDS
+    assert parse_command(["tune", "status"])[0] == \
+        {"prefix": "tune status"}
+    assert parse_command(["tune", "log"])[0] == {"prefix": "tune log"}
+    assert parse_command(["tune", "log", "5"])[0] == \
+        {"prefix": "tune log", "num": 5}
+
+
+# -- policy convergence against a scripted world ---------------------------
+
+class _World:
+    """The tuner-relevant slice of a mon: canned status/pg_dump/
+    osd_dump, and a command endpoint that applies actuator commands
+    to that state the way the real routing does — backed by a REAL
+    TuneState, so ownership/audit semantics are the shipped ones."""
+
+    def __init__(self, **cfg):
+        self.config = {
+            "mgr_tuner_mode": "drive",
+            "mgr_tuner_act_ticks": 2,
+            "mgr_tuner_revert_ticks": 2,
+            "mgr_tuner_hot_pool_min_ops": 1.0,
+            "mgr_tuner_hot_pool_ratio": 2.0,
+            **cfg}
+        self.tune = TuneState(self.config)
+        self.commands: list[dict] = []
+        self.status = {"osdmap": {"slow_osds": {}},
+                       "pgmap": {"backfilling_pgs": 0,
+                                 "degraded_pgs": 0}}
+        self.pg_dump = {"pg_stats": {}}
+        self.osd_dump = {
+            "osds": [{"osd": i, "primary_affinity": 1.0}
+                     for i in range(3)],
+            "client_profiles": {}}
+        self.degraded: dict = {}
+
+    async def command(self, cmd: dict, inbl: bytes = b""):
+        self.commands.append(dict(cmd))
+        prefix = cmd.get("prefix")
+        if prefix == "tune status":
+            mode = str(self.config.get("mgr_tuner_mode", "observe"))
+            return 0, "", json.dumps(
+                self.tune.status(mode)).encode()
+        if prefix == "tune record":
+            self.tune.record_observation(cmd["entry"])
+            return 0, "", b""
+        if prefix == "device-runtime status":
+            return 0, "", json.dumps(
+                {"daemons": {}, "degraded": self.degraded}).encode()
+        if prefix == "osd primary-affinity":
+            for o in self.osd_dump["osds"]:
+                if o["osd"] == int(cmd["id"]):
+                    o["primary_affinity"] = float(cmd["weight"])
+        elif prefix == "osd client-profile":
+            profs = self.osd_dump["client_profiles"]
+            if cmd["op"] == "set":
+                profs[cmd["entity"]] = [cmd["reservation"],
+                                        cmd["weight"], cmd["limit"]]
+            elif cmd["op"] == "rm":
+                profs.pop(cmd["entity"], None)
+        elif prefix == "config set":
+            self.config[cmd["name"]] = cmd["value"]
+        else:
+            return -22, f"unknown {prefix}", b""
+        prov = cmd.get("provenance")
+        if prov is not None:
+            self.tune.record_commit(cmd, prov)
+        else:
+            self.tune.record_operator(cmd)
+        return 0, "", b""
+
+    def actuations(self, prefix: str) -> list[dict]:
+        return [c for c in self.commands
+                if c.get("prefix") == prefix]
+
+
+class _StubMgr:
+    def __init__(self, world):
+        self.config = world.config
+        self.monc = world                    # .command()
+        self.modules: list = []
+        self.daemon_state = None
+        self._world = world
+
+    async def get(self, what: str):
+        return {"status": self._world.status,
+                "pg_dump": self._world.pg_dump,
+                "osd_dump": self._world.osd_dump}[what]
+
+
+def _tuner(world) -> TunerModule:
+    mgr = _StubMgr(world)
+    t = TunerModule(mgr)
+    mgr.modules = [t]
+    return t
+
+
+async def _ticks(t: TunerModule, n: int) -> None:
+    for _ in range(n):
+        await t.tick()
+        await asyncio.sleep(0.002)     # real dt for the rate sensor
+
+
+def test_observe_mode_commits_nothing():
+    """A sustained breach in observe mode issues ONLY reads and
+    `tune record` — no actuator command, no map change — and the
+    settle discipline keeps it to one record per hysteresis window,
+    not one per tick."""
+    async def go():
+        w = _World(mgr_tuner_mode="observe")
+        w.status["osdmap"]["slow_osds"] = {"2": 4.0}
+        await _ticks(_tuner(w), 5)
+        assert not w.actuations("osd primary-affinity")
+        assert not w.actuations("config set")
+        assert w.osd_dump["osds"][2]["primary_affinity"] == 1.0
+        assert w.tune.owned == {}
+        # act_ticks=2 over 5 ticks -> records at ticks 2 and 4 only
+        assert w.tune.observed == 2
+        entry = w.tune.log()[-1]
+        assert entry["committed"] is False
+        assert entry["policy"] == "gray_osd_responder"
+        assert entry["sensors"]["osd"] == 2
+    run(go())
+
+
+def test_gray_osd_drive_act_then_level_holds_then_revert():
+    """Drive mode: a confirmed-slow OSD is dampened after act_ticks,
+    further ticks propose NOTHING (desired == actual — the level-based
+    no-double-commit property), and the heal reverts after
+    revert_ticks with both halves in the audit."""
+    async def go():
+        w = _World()
+        w.status["osdmap"]["slow_osds"] = {"2": 4.0}
+        t = _tuner(w)
+        await _ticks(t, 2)
+        assert w.osd_dump["osds"][2]["primary_affinity"] == 0.0
+        assert w.tune.committed == 1 and t.actions_committed == 1
+        assert "affinity:2" in w.tune.owned
+        assert w.tune.owned["affinity:2"]["policy"] == \
+            "gray_osd_responder"
+        await _ticks(t, 3)                 # held: no re-commit
+        assert len(w.actuations("osd primary-affinity")) == 1
+        w.status["osdmap"]["slow_osds"] = {}
+        await _ticks(t, 2)
+        assert w.osd_dump["osds"][2]["primary_affinity"] == 1.0
+        assert w.tune.reverted == 1 and t.actions_reverted == 1
+        assert w.tune.owned == {}
+        acts = [(e["action"], e["committed"]) for e in w.tune.log()]
+        assert acts == [("act", True), ("revert", True)]
+    run(go())
+
+
+def test_promoted_standby_resumes_without_double_commit():
+    """The failover shape without a cluster: a FRESH TunerModule (the
+    promoted standby — empty streaks, no rate baseline) against the
+    same mon state sees desired == actual for the in-flight action and
+    commits nothing; when the OSD heals, the new instance owns the
+    revert because ownership lives mon-side."""
+    async def go():
+        w = _World()
+        w.status["osdmap"]["slow_osds"] = {"1": 5.0}
+        await _ticks(_tuner(w), 2)         # incarnation A commits
+        assert w.tune.committed == 1
+        t_b = _tuner(w)                    # incarnation B, clean RAM
+        await _ticks(t_b, 4)
+        assert w.tune.committed == 1       # no double-commit
+        assert len(w.actuations("osd primary-affinity")) == 1
+        w.status["osdmap"]["slow_osds"] = {}
+        await _ticks(t_b, 2)
+        assert w.tune.reverted == 1 and w.tune.owned == {}
+        assert w.osd_dump["osds"][1]["primary_affinity"] == 1.0
+    run(go())
+
+
+def test_operator_wins_and_tuner_stands_down():
+    """An operator (provenance-less) command on a tuner-held target
+    releases the lease; the tuner then has nothing to revert and
+    issues no further actuator commands."""
+    async def go():
+        w = _World()
+        w.status["osdmap"]["slow_osds"] = {"2": 4.0}
+        t = _tuner(w)
+        await _ticks(t, 2)
+        assert "affinity:2" in w.tune.owned
+        # the operator undoes it by hand (no provenance)
+        await w.command({"prefix": "osd primary-affinity", "id": 2,
+                         "weight": 1.0})
+        assert w.tune.owned == {}
+        w.status["osdmap"]["slow_osds"] = {}
+        n_before = len(w.actuations("osd primary-affinity"))
+        await _ticks(t, 4)
+        assert len(w.actuations("osd primary-affinity")) == n_before
+    run(go())
+
+
+def test_hot_pool_protector_trip_and_heal():
+    """Per-pool op rates from pg-stats client_ops deltas: a pool
+    starving another gets its aggressor entity a tightened profile
+    (reservation 0, bounded limit); when the burst ends the owned
+    profile is removed."""
+    async def go():
+        w = _World()
+        hot, cold = [100], [10]
+        w.pg_dump["pg_stats"] = {
+            "1.0": {"client_ops": {"client.hot": hot[0]}},
+            "2.0": {"client_ops": {"client.cold": cold[0]}}}
+
+        def bump():
+            hot[0] += 200
+            cold[0] += 2
+            w.pg_dump["pg_stats"]["1.0"]["client_ops"] = \
+                {"client.hot": hot[0]}
+            w.pg_dump["pg_stats"]["2.0"]["client_ops"] = \
+                {"client.cold": cold[0]}
+        t = _tuner(w)
+        await _ticks(t, 1)                 # baseline tick: no rates
+        assert not w.actuations("osd client-profile")
+        for _ in range(3):
+            bump()
+            await _ticks(t, 1)
+        profs = w.osd_dump["client_profiles"]
+        assert "client.hot" in profs
+        res, weight, limit = profs["client.hot"]
+        assert res == 0.0 and limit > 0.0
+        assert "profile:client.hot" in w.tune.owned
+        entry = next(e for e in w.tune.log()
+                     if e["policy"] == "hot_pool_protector")
+        assert entry["sensors"]["entity"] == "client.hot"
+        assert entry["sensors"]["hot_pool"] == 1
+        assert entry["sensors"]["hot_pool_rate"] > 0
+        # heal: counters stop moving -> rates decay to zero
+        await _ticks(t, 3)
+        assert w.osd_dump["client_profiles"] == {}
+        assert w.tune.owned == {} and w.tune.reverted == 1
+    run(go())
+
+
+def test_kernel_watchdog_acts_on_permanent_only():
+    """Only a PERMANENTLY degraded kernel path (quarantine gave up)
+    loses primary eligibility; transient backoff phases never
+    actuate. The heal reverts through the same affinity path."""
+    async def go():
+        w = _World()
+        w.degraded = {"1": {"ratio": 0.8, "engine": "pallas",
+                            "phase": "backoff", "since": 0.0}}
+        t = _tuner(w)
+        await _ticks(t, 3)
+        assert not w.actuations("osd primary-affinity")
+        w.degraded["1"]["phase"] = "permanent"
+        await _ticks(t, 2)
+        assert w.osd_dump["osds"][1]["primary_affinity"] == 0.0
+        assert w.tune.owned["affinity:1"]["policy"] == \
+            "kernel_path_watchdog"
+        entry = w.tune.log()[-1]
+        assert entry["sensors"]["mismatch_ratio"] == 0.8
+        assert entry["sensors"]["engine"] == "pallas"
+        w.degraded = {}
+        await _ticks(t, 2)
+        assert w.osd_dump["osds"][1]["primary_affinity"] == 1.0
+        assert w.tune.owned == {}
+    run(go())
+
+
+def test_shared_affinity_key_single_writer_per_tick():
+    """An OSD both confirmed-slow AND permanently degraded: the two
+    policies share the affinity actuator, and the per-tick dedupe
+    keeps ONE writer (the responder) — one commit, one owner."""
+    async def go():
+        w = _World()
+        w.status["osdmap"]["slow_osds"] = {"1": 6.0}
+        w.degraded = {"1": {"ratio": 0.9, "engine": "pallas",
+                            "phase": "permanent", "since": 0.0}}
+        t = _tuner(w)
+        await _ticks(t, 3)
+        cmds = w.actuations("osd primary-affinity")
+        assert len(cmds) == 1
+        assert w.tune.owned["affinity:1"]["policy"] == \
+            "gray_osd_responder"
+        # heal BOTH sensors -> a single revert
+        w.status["osdmap"]["slow_osds"] = {}
+        w.degraded = {}
+        await _ticks(t, 2)
+        assert len(w.actuations("osd primary-affinity")) == 2
+        assert w.tune.owned == {}
+    run(go())
+
+
+def test_change_budget_spreads_commits_across_ticks():
+    """Three OSDs go slow at once against a budget of 2: the third
+    commit lands one tick later (deferred, not dropped)."""
+    async def go():
+        w = _World(mgr_tuner_max_changes_per_tick=2)
+        w.status["osdmap"]["slow_osds"] = {"0": 4.0, "1": 4.0,
+                                           "2": 4.0}
+        t = _tuner(w)
+        await _ticks(t, 2)
+        assert w.tune.committed == 2
+        assert t.guardrails.deferred_total >= 1
+        await _ticks(t, 1)
+        assert w.tune.committed == 3
+        affinity = {o["osd"]: o["primary_affinity"]
+                    for o in w.osd_dump["osds"]}
+        assert affinity == {0: 0.0, 1: 0.0, 2: 0.0}
+    run(go())
+
+
+def test_recovery_governor_levels():
+    """The governor's level table, policy-direct (no tick loop):
+    QoS-floor breach halves, backfill-with-headroom doubles toward
+    the cap, drained backfill reverts to the registered default, and
+    the steady state proposes nothing."""
+    from ceph_tpu.utils.config import OPTIONS
+    base = OPTIONS["osd_recovery_max_active"].default
+    w = _World()
+    t = _tuner(w)
+
+    def gov(p99, bf, cur):
+        w.config["osd_recovery_max_active"] = cur
+        return t._recovery_governor(
+            {"p99_ms": p99, "backfilling_pgs": bf})
+    # breach: shed NOW, even below base
+    props = gov(5000.0, 3, base)
+    assert props[0].kind == "act"
+    assert props[0].cmd["value"] == str(base // 2)
+    # headroom + pending backfill: double
+    props = gov(10.0, 2, base)
+    assert props[0].cmd["value"] == str(base * 2)
+    # capped
+    w.config["mgr_tuner_recovery_max_active_cap"] = base * 2
+    assert gov(10.0, 2, base * 2) == []
+    # drained: revert to the registered default
+    props = gov(None, 0, base * 4)
+    assert props[0].kind == "revert"
+    assert props[0].cmd["value"] == str(base)
+    # steady: nothing
+    assert gov(None, 0, base) == []
+    # floor breach at 1 can't go lower
+    assert gov(5000.0, 1, 1) == []
+
+
+def test_tuner_progress_events_pair():
+    """A drive-mode act renders a held ``tuner:<key>`` event in the
+    ProgressModule's table; the revert completes it into the
+    `progress json` ring."""
+    async def go():
+        from ceph_tpu.mgr.modules import ProgressModule
+        w = _World()
+        w.status["osdmap"]["slow_osds"] = {"2": 4.0}
+        t = _tuner(w)
+        prog = ProgressModule(t.mgr)
+        t.mgr.modules.append(prog)
+        await _ticks(t, 2)
+        ev = prog.events.get("tuner:affinity:2")
+        assert ev is not None and ev["fraction"] == 0.5
+        assert "[gray_osd_responder]" in ev["message"]
+        # the progress module's own derivation must not sweep the
+        # foreign tuner event
+        prog._derive(w.status, w.pg_dump, 1.0)
+        assert "tuner:affinity:2" in prog.events
+        w.status["osdmap"]["slow_osds"] = {}
+        await _ticks(t, 2)
+        assert "tuner:affinity:2" not in prog.events
+        assert any(e["id"] == "tuner:affinity:2"
+                   for e in prog.completed)
+    run(go())
+
+
+# -- the storm acceptance (the ONE cluster spin in this module) ------------
+
+def test_tuner_closed_loop_storm():
+    """Closed loop on a live cluster, drive mode, one spin:
+
+    - a steady balanced two-pool workload commits ZERO actions;
+    - a hot-pool burst trips the protector — a guardrailed
+      client-profile commit whose audit entry carries the sensor
+      readings that justified it, visible in `ceph progress ls`;
+    - the heal removes the owned profile (act/revert pair);
+    - a mgr failover mid-storm promotes a standby whose tuner resumes
+      WITHOUT double-committing, and the revert after the storm is
+      the promoted incarnation's.
+    """
+    async def go():
+        from ceph_tpu.cluster.vstart import Cluster
+        from ceph_tpu.mgr.modules import ProgressModule
+        from ceph_tpu.msg import Keyring
+        from ceph_tpu.rados import Rados
+        from ceph_tpu.sim.thrasher import Thrasher
+        c = await Cluster(
+            n_mons=1, n_osds=3, n_mgrs=2,
+            mgr_modules=[ProgressModule, TunerModule],
+            config={
+                "osd_client_message_cap": 4,
+                "osd_op_queue": "mclock",
+                # fresh counts in every rate window (the tick must
+                # never see two identical pg dumps mid-burst, or the
+                # consecutive-breach streak resets)
+                "osd_stats_interval": 0.1,
+                # off during boot/teardown; flipped live below
+                "mgr_tuner_mode": "off",
+                "mgr_tuner_interval": 0.25,
+                "mgr_tuner_act_ticks": 3,
+                "mgr_tuner_revert_ticks": 3,
+                "mgr_tuner_hot_pool_min_ops": 30.0,
+                "mgr_tuner_hot_pool_ratio": 4.0,
+                # keep the recovery governor out of the frame: no
+                # backfill here, and no latency under a 30 s op
+                # timeout can breach this floor
+                "mgr_tuner_qos_floor_ms": 60000.0,
+            }).start()
+        try:
+            await c.client.pool_create("cold", pg_num=4)
+            await c.client.pool_create("hot", pg_num=4)
+            await c.wait_for_clean(timeout=120)
+            ret, rs, out = await c.client.mon_command(
+                {"prefix": "auth get-or-create",
+                 "entity": "client.hot"})
+            assert ret == 0, rs
+            key = bytes.fromhex(json.loads(out)["key"])
+            hot = Rados(c.monmap, name="client.hot",
+                        keyring=Keyring({"client.hot": key}),
+                        config=c.cfg)
+            await hot.connect()
+            io_hot = await hot.open_ioctx("hot")
+            io_cold = await c.client.open_ioctx("cold")
+            for i in range(4):           # warm both write paths
+                await io_cold.write_full(f"w-{i}", b"w" * 256,
+                                         timeout=30.0)
+                await io_hot.write_full(f"w-{i}", b"w" * 256,
+                                        timeout=30.0)
+
+            async def tune_status():
+                ret, _, out = await c.client.mon_command(
+                    {"prefix": "tune status"})
+                assert ret == 0
+                return json.loads(out)
+
+            # -- steady: balanced trickle, drive mode, ZERO commits --
+            c.cfg["mgr_tuner_mode"] = "drive"
+            for i in range(12):
+                await io_cold.write_full(f"s-{i}", b"s" * 256,
+                                         timeout=30.0)
+                await io_hot.write_full(f"s-{i}", b"s" * 256,
+                                        timeout=30.0)
+                await asyncio.sleep(0.04)
+            await asyncio.sleep(1.2)     # several tuner ticks
+            st = await tune_status()
+            assert st["mode"] == "drive"
+            assert st["committed"] == 0 and st["reverted"] == 0, st
+
+            # -- storm 1: the protector trips and heals --------------
+            th = Thrasher(c, seed=17)
+            storm = await th.tuner_storm(io_cold, io_hot, writes=24,
+                                         hot_parallel=4,
+                                         hot_burst=16, ramp_s=1.0)
+            assert storm["cold_errors"] == 0
+            assert storm["tuner"]["committed"] >= 1, storm
+            log_entries = (await c.client.mon_command(
+                {"prefix": "tune log"}))[2]
+            entries = json.loads(log_entries)["entries"]
+            act = next(e for e in entries
+                       if e["policy"] == "hot_pool_protector" and
+                       e["action"] == "act")
+            assert act["committed"] is True
+            assert act["sensors"]["entity"] == "client.hot"
+            assert act["sensors"]["hot_pool_rate"] > 0
+            assert act["cmd"]["prefix"] == "osd client-profile"
+            # heal: the owned profile comes off within the revert
+            # window once the burst stops
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while True:
+                st = await tune_status()
+                if not st["owned"]:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"tuner never reverted: {st}"
+                await asyncio.sleep(0.2)
+            assert st["reverted"] >= 1
+
+            # -- storm 2: mgr failover mid-storm, no double-commit ---
+            base = await tune_status()
+            storm_task = asyncio.ensure_future(
+                th.tuner_storm(io_cold, io_hot, writes=24,
+                               hot_parallel=4, hot_burst=16,
+                               ramp_s=1.0, cold_think_s=0.05))
+            deadline = asyncio.get_event_loop().time() + 25.0
+            while True:                  # wait for the commit to land
+                st = await tune_status()
+                if st["committed"] > base["committed"]:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "storm 2 never tripped the protector"
+                await asyncio.sleep(0.2)
+            committed_mid = st["committed"]
+            assert "profile:client.hot" in st["owned"]
+            # the in-flight act renders as a held tuner event in
+            # `ceph progress ls` (the mgr digests it monward)
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while True:
+                ret, _, pout = await c.client.mon_command(
+                    {"prefix": "progress ls"})
+                evs = json.loads(pout)["events"]
+                if any(e.get("id") == "tuner:profile:client.hot"
+                       for e in evs):
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"no tuner progress event: {evs}"
+                await asyncio.sleep(0.2)
+            old = await c.kill_mgr()
+            new = await c.wait_for_mgr_active(not_gid=old.gid,
+                                              timeout=30)
+            assert new is not None and new.gid != old.gid
+            storm2 = await storm_task
+            assert storm2["cold_errors"] == 0
+            # the promoted tuner saw desired == actual: same commit
+            # count, and the heal (its revert) still lands
+            st = await tune_status()
+            assert st["committed"] == committed_mid, st
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while True:
+                st = await tune_status()
+                if not st["owned"]:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"promoted tuner never reverted: {st}"
+                await asyncio.sleep(0.2)
+            assert st["committed"] == committed_mid
+            c.cfg["mgr_tuner_mode"] = "off"
+            await hot.shutdown()
+        finally:
+            await c.stop()
+    run(go())
