@@ -673,6 +673,57 @@ def device_resilience_metric() -> dict:
     }
 
 
+def multiproc_metric() -> dict:
+    """Round 18: the SAME closed-loop client workload against the two
+    cluster backends — every daemon in ONE interpreter vs one OS
+    process per daemon, over identical localhost-TCP messengers
+    (cluster/README.md). The claim the section pins: crossing the
+    process boundary (real kernel scheduler, per-process interpreter)
+    costs less than 2x in client ops/s (``proc_within_2x`` in the
+    compact tail), and proc spawn-to-healthy stays a dev-loop cost
+    (seconds, not minutes)."""
+    import asyncio
+
+    from ceph_tpu.cluster.vstart import Cluster
+    from ceph_tpu.sim.loadgen import LoadGen
+
+    async def one(backend: str) -> dict:
+        t0 = time.perf_counter()
+        c = await Cluster(n_mons=1, n_osds=3,
+                          backend=backend).start()
+        spawn_s = time.perf_counter() - t0
+        try:
+            await c.client.pool_create("mpbench", pg_num=16)
+            await c.wait_for_clean(timeout=120)
+            rep = await LoadGen(
+                c, "mpbench", sessions=200, clients=8,
+                ops_per_session=2, write_bytes=512,
+                concurrency=64, op_timeout=60.0).run()
+            assert rep["errors"] == 0, rep["error_samples"]
+            return {"backend": backend,
+                    "spawn_to_healthy_s": round(spawn_s, 3),
+                    "ops": rep["ops"],
+                    "ops_per_s": rep["ops_per_s"],
+                    "p50_ms": rep["p50_ms"],
+                    "p99_ms": rep["p99_ms"]}
+        finally:
+            await c.stop()
+
+    async def run() -> dict:
+        inproc = await one("inproc")
+        proc = await one("proc")
+        return {
+            "inproc": inproc,
+            "proc": proc,
+            "ops_ratio_inproc_vs_proc": round(
+                inproc["ops_per_s"] / proc["ops_per_s"], 3)
+            if proc["ops_per_s"] else None,
+            "proc_within_2x":
+                proc["ops_per_s"] * 2 >= inproc["ops_per_s"],
+        }
+    return asyncio.run(run())
+
+
 def _device_fault_cycle(F, devmon_mod) -> dict:
     """The injected-fault leg: quarantine entry and re-promotion,
     measured on a small interpret-mode kernel mapper (the only
@@ -861,6 +912,10 @@ def main() -> None:
         detail["tuning"] = _with_compile_split(tuning_metric)
     except Exception:
         detail["tuning_error"] = _short_err()
+    try:
+        detail["multiproc"] = _with_compile_split(multiproc_metric)
+    except Exception:
+        detail["multiproc_error"] = _short_err()
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),
@@ -933,6 +988,11 @@ def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
         out["tuner_protects_cold"] = tun.get("tuner_protects_cold")
         out["tuner_actions"] = [tun.get("actions_committed"),
                                 tun.get("actions_reverted")]
+    mp = detail.get("multiproc")
+    if isinstance(mp, dict):     # the round-18 process-boundary verdict
+        out["proc_within_2x"] = mp.get("proc_within_2x")
+        out["proc_spawn_s"] = mp.get("proc", {}).get(
+            "spawn_to_healthy_s")
     # round 14: total observed jit-compile wall for the whole run —
     # BENCH_r06+ can split a compile regression from a runtime one
     try:

@@ -359,12 +359,15 @@ class MDSDaemon(Dispatcher):
         self._replay_done = asyncio.Event()
         self._recovering: set[str] = set()       # sessions awaiting
         self._killed = False                     # reconnect claims
+        # central-config application state (round 18)
+        self._mon_cfg_state: dict = {}
+        self.mirror_global_config = False
 
     # -- lifecycle ---------------------------------------------------------
     @classmethod
     async def create(cls, monmap, pool: str, name: str = "a",
-                     keyring=None, config: dict | None = None
-                     ) -> "MDSDaemon":
+                     keyring=None, config: dict | None = None,
+                     gid: int | None = None) -> "MDSDaemon":
         """Build a mon-coordinated MDS with an OWN per-incarnation
         RADOS identity. The identity is what the MDSMonitor blocklists
         at failover — data-path ops through a shared admin ioctx would
@@ -373,9 +376,17 @@ class MDSDaemon(Dispatcher):
         from ceph_tpu.rados import Rados
         cfg = config or {}
         self = cls.__new__(cls)
-        gid = next(_GID)
+        # _GID is process-local: proc-backend children pass their pid
+        # so separate-process MDSs can't collide on gid
+        if gid is None:
+            gid = next(_GID)
         ident = f"mds.{name}.{gid}"
-        if keyring is not None:
+        if keyring is not None and f"mds.{name}" not in keyring.keys:
+            # no provisioned base entity to derive from (standalone
+            # harnesses): mint a local key. When ``mds.<name>`` IS
+            # provisioned, Keyring.get derives the incarnation key on
+            # BOTH ends — adding a random one here would shadow the
+            # derivation locally and fail auth against a remote mon.
             keyring.add(ident)
         r = Rados(monmap, name=ident, keyring=keyring)
         await r.connect()
@@ -416,6 +427,17 @@ class MDSDaemon(Dispatcher):
         log.dout(1, f"mds up at {self.addr}")
         return self.addr
 
+    def _apply_config_map(self, cfgmap: dict) -> None:
+        """Apply a mon-published central config map (round 18)."""
+        from ceph_tpu.utils.config import apply_mon_config
+        changed = apply_mon_config(
+            f"mds.{self.name}", cfgmap, self.config,
+            self._mon_cfg_state,
+            mirror_global=self.mirror_global_config)
+        if changed:
+            log.dout(10, f"mds.{self.name} applied mon config "
+                         f"{sorted(changed)}")
+
     async def start_ha(self, host: str = "127.0.0.1", port: int = 0):
         """Mon-coordinated start: bind, subscribe to the mdsmap, and
         beacon as a standby; all serving waits for the FSMap to
@@ -430,6 +452,9 @@ class MDSDaemon(Dispatcher):
         # daemon's name (the in-process daemons share one logger —
         # documented delta; a real multi-process MDS would own it)
         await self.monc.subscribe("mgrmap", 0)
+        # central config db (round 18): wire-delivered live knob flips
+        self.monc.config_callbacks.append(self._apply_config_map)
+        await self.monc.subscribe("config", 0)
         from ceph_tpu.mgr.client import MgrReporter
         self._mgr_reporter = MgrReporter(
             f"mds.{self.name}", self.monc.msgr,

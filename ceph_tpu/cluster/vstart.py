@@ -31,14 +31,27 @@ DEFAULT_CFG = {
 
 
 class Cluster:
-    """A running dev cluster (the vstart.sh artifact)."""
+    """A running dev cluster (the vstart.sh artifact).
+
+    Two backends (round 18): the default ``backend="inproc"`` runs
+    every daemon inside this interpreter (fast, introspectable — the
+    objects are right there); ``backend="proc"`` returns a
+    :class:`ceph_tpu.cluster.proc.ProcCluster` instead, spawning each
+    daemon as a SEPARATE supervised OS process over the same real-TCP
+    messenger, where kill means SIGKILL and stop means SIGTERM."""
+
+    def __new__(cls, *args, backend: str = "inproc", **kwargs):
+        if backend == "proc" and cls is Cluster:
+            from ceph_tpu.cluster.proc import ProcCluster
+            return ProcCluster(*args, **kwargs)
+        return super().__new__(cls)
 
     def __init__(self, n_mons: int = 1, n_osds: int = 3,
                  config: dict | None = None, auth: bool = True,
                  data_dir: str | None = None,
                  mgr_modules: list | None = None,
                  stores: list | None = None,
-                 n_mgrs: int = 1):
+                 n_mgrs: int = 1, backend: str = "inproc"):
         self.cfg = dict(DEFAULT_CFG, **(config or {}))
         self.n_mons = n_mons
         self.n_osds = n_osds
@@ -85,6 +98,8 @@ class Cluster:
             mon.start_mgr_reporting()
         for mon in self.mons:
             await mon.elector.start()
+        for mon in self.mons:
+            await mon.start_asok()   # no-op without admin_socket_dir
         self.client = Rados(self.monmap, keyring=self.keyring,
                             config=self.cfg)
         # wait for a working quorum via the client path
@@ -534,7 +549,11 @@ class Cluster:
             "cluster daemon summary")
         await self.asok.start()
 
-    async def stop(self) -> None:
+    async def stop(self, graceful: bool = False) -> None:
+        """``graceful=True`` is the SIGTERM path: each OSD announces
+        its departure (``stop(mark_down=True)``) so the map converges
+        immediately instead of waiting out heartbeat grace — the
+        same contract the proc backend's signal handler honors."""
         if self.asok:
             await self.asok.stop()
         if self.client:
@@ -552,7 +571,7 @@ class Cluster:
                 m._own_rados = None
         for o in self.osds:
             if not o._stopped:
-                await o.stop()
+                await o.stop(mark_down=graceful)
         for m in self.mons:
             if not m._stopped:
                 await m.stop()
@@ -570,8 +589,14 @@ async def _demo() -> None:
 
 
 async def _serve(args) -> None:
-    """Run a cluster until killed, publishing its conf for the ceph/
-    rados CLIs (the long-lived half of vstart.sh)."""
+    """Run a cluster until signalled, publishing its conf for the
+    ceph/rados CLIs (the long-lived half of vstart.sh). Every daemon
+    type is served — mons/osds/mgrs (and mds with --mds-num) each get
+    an admin socket next to the cluster one — and SIGTERM is a
+    GRACEFUL stop (departing OSDs mark themselves down) while SIGKILL
+    stays an honest crash, on both backends."""
+    import signal as _signal
+
     from ceph_tpu.cluster.conf import write_conf
     cfg = {}
     if args.asok:
@@ -579,22 +604,40 @@ async def _serve(args) -> None:
         # `ceph_cli daemon <dir>/osd.N.asok ops` works out of the box
         import os
         cfg["admin_socket_dir"] = os.path.dirname(args.asok) or "."
+    mgr_modules = None
+    if args.mgr_num > 0:
+        from ceph_tpu.mgr.modules import (
+            BalancerModule, PGAutoscalerModule, ProgressModule,
+            PrometheusModule,
+        )
+        mgr_modules = [BalancerModule, PGAutoscalerModule,
+                       PrometheusModule, ProgressModule]
     c = await Cluster(n_mons=args.mon_num, n_osds=args.osd_num,
-                      data_dir=args.data_dir, config=cfg).start()
+                      n_mgrs=args.mgr_num, mgr_modules=mgr_modules,
+                      data_dir=args.data_dir, config=cfg,
+                      backend=args.backend).start()
     if args.pool:
         await c.client.pool_create(args.pool, pg_num=args.pg_num)
         await c.wait_for_clean(timeout=300)
+        if args.mds_num > 0:
+            await c.start_fs(pool=args.pool, n_mds=args.mds_num)
     write_conf(args.conf, c.monmap, c.keyring)
-    if args.asok:
+    if args.asok and args.backend == "inproc":
         await c.start_admin_socket(args.asok)
     print(f"cluster up; conf at {args.conf}", flush=True)
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        loop.add_signal_handler(sig, stop_ev.set)
     try:
-        while True:
-            await asyncio.sleep(3600)
+        await stop_ev.wait()
     except asyncio.CancelledError:
         pass
     finally:
-        await c.stop()
+        if args.backend == "inproc":
+            await c.stop(graceful=True)
+        else:
+            await c.stop()      # ProcCluster SIGTERMs its children
 
 
 def main(argv=None) -> None:
@@ -602,8 +645,15 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="vstart", description=__doc__)
     p.add_argument("--serve", action="store_true",
                    help="run until killed; write --conf for the CLIs")
+    p.add_argument("--backend", default="inproc",
+                   choices=("inproc", "proc"),
+                   help="inproc: all daemons in this interpreter; "
+                        "proc: one supervised OS process per daemon")
     p.add_argument("--mon-num", type=int, default=1)
     p.add_argument("--osd-num", type=int, default=3)
+    p.add_argument("--mgr-num", type=int, default=0)
+    p.add_argument("--mds-num", type=int, default=0,
+                   help="with --pool: boot a filesystem on it")
     p.add_argument("--pool", default=None,
                    help="create this pool and wait for clean")
     p.add_argument("--pg-num", type=int, default=8)

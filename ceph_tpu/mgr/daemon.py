@@ -66,9 +66,13 @@ class MgrModule:
 class Mgr(Dispatcher):
     def __init__(self, name: str, monmap, keyring=None,
                  modules: list[type[MgrModule]] | None = None,
-                 config: dict | None = None):
+                 config: dict | None = None,
+                 gid: int | None = None):
         self.name = name
-        self.gid = next(_GID)
+        # _GID is process-local: separate-process mgrs (proc backend)
+        # must pass an externally unique gid (their pid) or every
+        # child claims gid 1 and the MgrMap can't tell them apart
+        self.gid = next(_GID) if gid is None else gid
         self.monc = MonClient(f"mgr.{name}", monmap, keyring=keyring)
         self.config = config or {}
         from ceph_tpu.mgr.modules import (
@@ -98,6 +102,11 @@ class Mgr(Dispatcher):
         # the mapper every seconds_per_iteration
         from ceph_tpu.osd.osdmap_mapping import OSDMapMapping
         self._mapping = OSDMapMapping()
+        # central-config application state (round 18): proc-backend
+        # children live off the wire-published config db, not the
+        # in-process shared dict
+        self._mon_cfg_state: dict = {}
+        self.mirror_global_config = False
 
     # -- state access -------------------------------------------------
     async def get(self, what: str):
@@ -178,6 +187,10 @@ class Mgr(Dispatcher):
         await self.monc.subscribe("osdmap", 0)
         await self.monc.subscribe("monmap", 0)
         await self.monc.subscribe("mgrmap", 0)
+        if self.monc.msgr.keyring is not None:
+            await self.monc.subscribe("keyring", 0)
+        self.monc.config_callbacks.append(self._apply_config_map)
+        await self.monc.subscribe("config", 0)
         await self._start_asok()
         # crash capture (round 14): a dead beacon loop demotes this
         # mgr by silence — the crash report says WHY
@@ -221,7 +234,30 @@ class Mgr(Dispatcher):
             {"error": f"no reported daemon {cmd.get('name')!r}"},
             "one daemon's reported counters + live rates from the "
             "retained time series")
+        self.asok.register(
+            "metrics", self._render_metrics,
+            "the /metrics prometheus exposition rendered from "
+            "REPORTED daemon state — lets the proc backend verify the "
+            "telemetry plane re-populates after a mgr failover "
+            "without scraping HTTP")
         await self.asok.start()
+
+    async def _render_metrics(self) -> dict:
+        for mod in self.modules:
+            if mod.NAME == "prometheus":
+                return {"body": await mod.render()}
+        return {"error": "prometheus module not loaded"}
+
+    def _apply_config_map(self, cfgmap: dict) -> None:
+        """Apply a mon-published central config map (round 18)."""
+        from ceph_tpu.utils.config import apply_mon_config
+        changed = apply_mon_config(
+            f"mgr.{self.name}", cfgmap, self.config,
+            self._mon_cfg_state,
+            mirror_global=self.mirror_global_config)
+        if changed:
+            log.dout(10, f"mgr.{self.name} applied mon config "
+                         f"{sorted(changed)}")
 
     async def _beacon_loop(self) -> None:
         """Beacon + follow the committed MgrMap (ref: MgrStandby):

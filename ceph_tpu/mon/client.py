@@ -14,8 +14,8 @@ import json
 
 from ceph_tpu.encoding import decode_incremental, decode_osdmap
 from ceph_tpu.mon.messages import (
-    MAuthUpdate, MLog, MMDSMap, MMgrMap, MMonCommand, MMonCommandAck,
-    MMonMap, MMonSubscribe, MOSDMap,
+    MAuthUpdate, MConfigMap, MLog, MMDSMap, MMgrMap, MMonCommand,
+    MMonCommandAck, MMonMap, MMonSubscribe, MOSDMap,
 )
 from ceph_tpu.mon.monitor import MonMap
 from ceph_tpu.msg import (AuthError, Dispatcher, Keyring,
@@ -51,6 +51,12 @@ class MonClient(Dispatcher):
         # the ACTIVE mgr for their perf-counter report session — an
         # epoch naming a new active is the re-open signal
         self.mgrmap = None
+        # the central config db (round 18): the decoded MConfigMap
+        # mask map + version; callbacks (sync fns) fire per map so a
+        # daemon applies live knob flips into its own process
+        self.config_map: dict | None = None
+        self.config_version = 0
+        self.config_callbacks: list = []       # fn(cfgmap: dict)
         # opt-in full-cluster mapping table (OSD daemons set this):
         # delta-maintained per epoch and attached to the map so the
         # holder's bulk advance-map placement reads come from the
@@ -105,6 +111,9 @@ class MonClient(Dispatcher):
             if "mdsmap" in self._subs:
                 self._subs["mdsmap"] = max(self._subs["mdsmap"],
                                            msg.epoch + 1)
+        if isinstance(msg, MConfigMap):
+            self._handle_config_map(msg)
+            return True
         return False
 
     def _handle_monmap(self, mm: MonMap) -> None:
@@ -139,6 +148,28 @@ class MonClient(Dispatcher):
         if rank not in ranks:
             return ranks[0]
         return ranks[(ranks.index(rank) + 1) % len(ranks)]
+
+    def _handle_config_map(self, m: MConfigMap) -> None:
+        """Apply a published config-db version: cursor forward, decode
+        the mask map, fan out to the owning daemon's callbacks (which
+        do the per-entity resolution)."""
+        if "config" in self._subs:
+            self._subs["config"] = max(self._subs["config"],
+                                       m.version + 1)
+        if m.version < self.config_version and \
+                self.config_map is not None:
+            return              # a lagging peon answered with old state
+        self.config_version = m.version
+        try:
+            cfgmap = json.loads(m.cfgmap.decode()) if m.cfgmap else {}
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return
+        self.config_map = cfgmap
+        for cb in self.config_callbacks:
+            try:
+                cb(cfgmap)
+            except Exception:
+                log.dout(1, "config callback failed")
 
     def _handle_auth_update(self, m: MAuthUpdate) -> None:
         """Apply a published key table to the live keyring: install/

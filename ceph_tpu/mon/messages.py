@@ -360,3 +360,16 @@ class MOSDPGReadyToMerge(Message):
     TYPE = 151
     FIELDS = [("pgid", "str"), ("epoch", "u32"), ("from_osd", "s32"),
               ("pending", "u32")]
+
+
+@register
+class MConfigMap(Message):
+    """Mon -> daemon (ref: src/messages/MConfig.h): the full central
+    config db at a version, published over the `config` subscription
+    after every ConfigMonitor commit. ``cfgmap`` is the JSON-encoded
+    ``{who: {name: raw-str}}`` mask map — full-map (not delta) so a
+    daemon that missed versions applies one message and is current,
+    and so `config rm` is visible as absence (round 18)."""
+
+    TYPE = 190
+    FIELDS = [("version", "u64"), ("cfgmap", "blob")]

@@ -16,7 +16,7 @@ import json
 from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.mon.elector import Elector
 from ceph_tpu.mon.messages import (
-    MAuthUpdate, MCrashReport, MDSBeacon, MLog, MMDSMap,
+    MAuthUpdate, MConfigMap, MCrashReport, MDSBeacon, MLog, MMDSMap,
     MMDSMigrationDone,
     MMgrBeacon, MMgrDigest, MMgrMap, MMonCommand, MMonCommandAck,
     MMonElection, MMonGetOSDMap, MMonMap, MMonPaxos,
@@ -142,6 +142,14 @@ class Monitor(Dispatcher):
         self.leader_rank: int | None = None
         self.quorum: list[int] = []
         self.state = "probing"               # probing|electing|leader|peon
+        self._stopped = False
+        # set when a committed monmap no longer contains this mon: the
+        # retired daemon stops electing/ticking (ref: a removed mon
+        # shutting down after MonmapMonitor::prepare_update commits).
+        # Assigned BEFORE the services: a restart over a durable store
+        # replays a committed monmap through MonmapMonitor.refresh →
+        # update_monmap inside the constructor calls below
+        self._removed = False
 
         from ceph_tpu.mon.auth_monitor import AuthMonitor
         from ceph_tpu.mon.log_monitor import LogMonitor
@@ -236,11 +244,6 @@ class Monitor(Dispatcher):
         # subscriptions: conn -> {what: next_epoch}
         self.subs: dict[object, dict[str, int]] = {}
         self._tick_task: asyncio.Task | None = None
-        self._stopped = False
-        # set when a committed monmap no longer contains this mon: the
-        # retired daemon stops electing/ticking (ref: a removed mon
-        # shutting down after MonmapMonitor::prepare_update commits)
-        self._removed = False
         self.asok = None
         self._asok_dir = cfg.get("admin_socket_dir")
 
@@ -658,6 +661,13 @@ class Monitor(Dispatcher):
                         conn.peer_name),
                     caps=self.authmon.caps_for(conn.peer_name)))
                 subs["keyring"] = auth_cur + 1
+            c_start = subs.get("config")
+            c_cur = self.configmon.version
+            if c_start is not None and c_start <= c_cur:
+                await conn.send_message(MConfigMap(
+                    version=c_cur,
+                    cfgmap=self.configmon.encode_map()))
+                subs["config"] = c_cur + 1
         except Exception:
             # a dead subscriber's session takes its subs with it (a
             # reconnecting client re-subscribes)

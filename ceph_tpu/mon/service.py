@@ -37,9 +37,63 @@ class PaxosService:
 class ConfigMonitor(PaxosService):
     """Central config db (ref: src/mon/ConfigMonitor.cc): `config set
     <who> <name> <value>` with who = global | <type> | <type>.<id>;
-    resolution walks most-specific first, like the reference's masks."""
+    resolution walks most-specific first, like the reference's masks.
+
+    Round 18: the db is VERSIONED (a ``__version`` store key bumped in
+    the same txn as every mutation) and published over the `config`
+    subscription as an MConfigMap, so daemons in other processes —
+    which cannot see the in-process shared dict — apply live knob
+    flips identically (proc backend's missing ConfigMonitor analog)."""
 
     prefix = "config"
+    VERSION_KEY = "__version"
+
+    def __init__(self, mon) -> None:
+        super().__init__(mon)
+        self.version = 0
+        self.cfg_map: dict[str, dict[str, str]] = {}
+        self._apply_state: dict = {}
+        # pre-push baselines for handle_command's direct live pushes,
+        # separate from _apply_state: refresh() resolves only the
+        # mon's own entity, so an osd-scoped push tracked there would
+        # be "restored" (undone) on the very next refresh
+        self._push_baseline: dict = {}
+        self._mutate_lock = None   # lazy: created on first mutation
+
+    def refresh(self) -> None:
+        v = self.store.get(self.prefix, self.VERSION_KEY)
+        self.version = int(v.decode()) if v else 0
+        m: dict[str, dict[str, str]] = {}
+        for k, val in self.store.iterate(self.prefix):
+            if k == self.VERSION_KEY:
+                continue
+            who, _, name = k.partition("/")
+            if name:
+                m.setdefault(who, {})[name] = val.decode()
+        self.cfg_map = m
+        # every mon applies its own entity's resolution into its live
+        # config — private per process on the proc backend, the shared
+        # cluster dict (idempotent re-apply) in-process
+        from ceph_tpu.utils.config import apply_mon_config
+        apply_mon_config(f"mon.{self.mon.name}", m, self.mon.config,
+                         self._apply_state)
+
+    def encode_map(self) -> bytes:
+        import json as _json
+        return _json.dumps(self.cfg_map, sort_keys=True).encode()
+
+    async def _mutate(self, build) -> bool:
+        """Serialize mutations so the version bump is strictly
+        monotonic even when commands interleave across awaits."""
+        import asyncio
+        if self._mutate_lock is None:
+            self._mutate_lock = asyncio.Lock()
+        async with self._mutate_lock:
+            t = self.store.transaction()
+            build(t)
+            t.set(self.prefix, self.VERSION_KEY,
+                  str(self.version + 1).encode())
+            return await self.mon.propose_txn(t)
 
     async def handle_command(self, cmd, inbl=b""):
         prefix = cmd.get("prefix", "")
@@ -58,19 +112,38 @@ class ConfigMonitor(PaxosService):
                     live = opt.validate(cmd["value"])
                 except ValueError as e:
                     return -22, str(e), b""
-            t = self.store.transaction()
-            t.set(self.prefix, f"{who}/{name}",
-                  str(cmd["value"]).encode())
-            ok = await self.mon.propose_txn(t)
+            elif self.mon.config.get("mon_config_strict", False):
+                return -22, f"unregistered option {name!r} " \
+                            f"(mon_config_strict)", b""
+            ok = await self._mutate(lambda t: t.set(
+                self.prefix, f"{who}/{name}",
+                str(cmd["value"]).encode()))
             if ok and live is not _MISSING:
+                # remember what we are about to clobber (once, and only
+                # when this push actually changes the value — refresh()
+                # or a shared-dict daemon may have applied it already)
+                cur = self.mon.config.get(name, _MISSING)
+                if name not in self._push_baseline and cur != live:
+                    self._push_baseline[name] = \
+                        (name in self.mon.config, cur)
                 self.mon.config[name] = live
             return (0, f"set {who}/{name}", b"") if ok else \
                 (-11, "proposal failed", b"")
         if prefix == "config rm":
             who, name = cmd["who"], cmd["name"]
-            t = self.store.transaction()
-            t.rmkey(self.prefix, f"{who}/{name}")
-            ok = await self.mon.propose_txn(t)
+            ok = await self._mutate(
+                lambda t: t.rmkey(self.prefix, f"{who}/{name}"))
+            if ok and not any(
+                    k.partition("/")[2] == name
+                    for k, _ in self.store.iterate(self.prefix)) \
+                    and name in self._push_baseline:
+                # the name left EVERY scope: undo our live push so the
+                # daemon-side restores aren't fighting a stuck override
+                had, old = self._push_baseline.pop(name)
+                if had:
+                    self.mon.config[name] = old
+                else:
+                    self.mon.config.pop(name, None)
             return (0, "", b"") if ok else (-11, "proposal failed", b"")
         if prefix == "config get":
             who = cmd["who"]
@@ -85,7 +158,9 @@ class ConfigMonitor(PaxosService):
             return 0, "", json.dumps(out).encode()
         if prefix == "config dump":
             out = {k: v.decode()
-                   for k, v in self.store.iterate(self.prefix)}
+                   for k, v in self.store.iterate(self.prefix)
+                   if k != self.VERSION_KEY}
+            out["__version"] = str(self.version)
             return 0, "", json.dumps(out).encode()
         return -22, f"unknown command {prefix!r}", b""
 

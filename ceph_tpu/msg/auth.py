@@ -88,6 +88,16 @@ class Keyring:
         try:
             return self.keys[name]
         except KeyError:
+            base, sep, inc = name.rpartition(".")
+            if sep and inc.isdigit() and base in self.keys:
+                # Per-incarnation identity (``mds.<name>.<gid>``):
+                # BOTH ends derive the incarnation secret from the
+                # provisioned base entity's key, so a separate-process
+                # daemon needs no shared dict (and no mon round-trip)
+                # to mint it — ref: cephx service-ticket derivation.
+                # Rotating the base key rotates every derivation.
+                return hmac.new(self.keys[base], name.encode(),
+                                hashlib.sha256).digest()
             raise AuthError(f"no key for {name}") from None
 
     def copy_for(self, *names: str) -> "Keyring":
@@ -189,18 +199,57 @@ class Authenticator:
         return _mac(self.session_key, seq.to_bytes(8, "little"), body)[:16]
 
     # -- per-frame AEAD (secure mode) --------------------------------------
-    def epoch_key(self, epoch: int) -> bytes:
+    def epoch_key(self, epoch: int, direction: int = 0) -> bytes:
         """128-bit frame key for one rekey epoch, derived from the
         handshake session key (the rotation analog of cephx ticket
-        renewal: old-epoch keys protect nothing new)."""
+        renewal: old-epoch keys protect nothing new). After
+        :meth:`install_secret`, the direction's keys from that epoch
+        on mix the ROTATED entity secret instead — so rotation really
+        re-keys the live session (an old-secret holder cannot derive
+        them), not merely re-labels epochs of the same material."""
         if not hasattr(self, "_ekeys"):
-            self._ekeys: dict[int, bytes] = {}
-        k = self._ekeys.get(epoch)
+            self._ekeys: dict[tuple[int, int], bytes] = {}
+        k = self._ekeys.get((direction, epoch))
         if k is None:
-            k = _mac(self.session_key, b"aead",
-                     epoch.to_bytes(4, "little"))[:16]
-            self._ekeys[epoch] = k
+            rk = getattr(self, "_rekeys", {}).get(direction)
+            if rk is not None and epoch >= rk[0]:
+                k = _mac(rk[1], b"aead-rekey", self.session_key,
+                         epoch.to_bytes(4, "little"))[:16]
+            else:
+                k = _mac(self.session_key, b"aead",
+                         epoch.to_bytes(4, "little"))[:16]
+            self._ekeys[(direction, epoch)] = k
         return k
+
+    def install_secret(self, direction: int, secret: bytes,
+                       from_epoch: int) -> None:
+        """Round 18 (rotation re-auth): from ``from_epoch`` on, the
+        given tx direction's frame keys derive from the rotated entity
+        secret, bound to this session's handshake key. Per-direction
+        because each side rotates its own tx epoch independently —
+        a shared cutover would re-derive the OTHER direction's
+        current-epoch key under the other side's feet."""
+        if not hasattr(self, "_rekeys"):
+            self._rekeys: dict[int, tuple[int, bytes]] = {}
+        cur = self._rekeys.get(direction)
+        if cur is not None and cur[1] == secret:
+            from_epoch = min(from_epoch, cur[0])
+        self._rekeys[direction] = (from_epoch, secret)
+        if hasattr(self, "_ekeys"):
+            for dk in [dk for dk in self._ekeys
+                       if dk[0] == direction and dk[1] >= from_epoch]:
+                del self._ekeys[dk]
+
+    def rekey_ticket(self, secret: bytes, epoch: int) -> bytes:
+        """The REKEY frame's session-ticket analog (round 18, ref:
+        cephx ticket renewal): a MAC under the ROTATED secret over
+        this session's handshake key + the announced epoch. Proves the
+        announcer holds the current secret for this session's entity;
+        a receiver whose keyring disagrees (skew, revocation) fails
+        the compare and fences — the reconnect re-runs full mutual
+        auth."""
+        return _mac(secret, b"rekey-ticket", self.session_key,
+                    epoch.to_bytes(4, "little"))
 
     @staticmethod
     def _nonce(direction: int, tag: int, epoch: int, seq: int) -> bytes:
@@ -215,7 +264,7 @@ class Authenticator:
     def seal(self, direction: int, epoch: int, tag: int, seq: int,
              aad: bytes, body: bytes) -> bytes:
         n = self._nonce(direction, tag, epoch, seq)
-        key = self.epoch_key(epoch)
+        key = self.epoch_key(epoch, direction)
         if HAVE_AESGCM:
             return AESGCM(key).encrypt(n, bytes(body), bytes(aad))
         return _etm_seal(key, n, aad, body)
@@ -223,7 +272,7 @@ class Authenticator:
     def open(self, direction: int, epoch: int, tag: int, seq: int,
              aad: bytes, ct: bytes) -> bytes:
         n = self._nonce(direction, tag, epoch, seq)
-        key = self.epoch_key(epoch)
+        key = self.epoch_key(epoch, direction)
         if HAVE_AESGCM:
             try:
                 return AESGCM(key).decrypt(n, bytes(ct), bytes(aad))

@@ -212,6 +212,32 @@ class Connection:
             raise ConnectionError_("frame integrity check failed")
         return tag, seq, frame[9:]
 
+    def _rekey_material(self, new_epoch: int
+                        ) -> tuple[bytes, bytes | None]:
+        """REKEY frame body + the secret to install after sending.
+
+        Round 18 (rotation re-auth): the body carries the announced
+        epoch PLUS a session-ticket — a MAC under the CURRENT keyring
+        secret of this connection's authenticating entity (the client
+        side's name: that's whose key both handshake directions used).
+        The receiver verifies it against its own keyring, so a key
+        rotation re-proves possession on the live session instead of
+        just relabeling epochs. Appended after the legacy 4-byte
+        epoch, zero-fill discipline: an old peer reads the epoch and
+        ignores the tail. Falls back to the ticketless legacy body
+        when the entity's key is gone (a racing revoke — the fence is
+        already in flight)."""
+        ep = new_epoch.to_bytes(4, "little")
+        entity = self.msgr.name if self.is_client else self.peer_name
+        kr = self.msgr.keyring
+        try:
+            secret = kr.get(entity) if kr is not None else None
+        except Exception:
+            secret = None
+        if secret is None:
+            return ep, None
+        return ep + self.auth.rekey_ticket(secret, new_epoch), secret
+
     async def _maybe_rekey(self) -> None:
         """In-band tx-key rotation (the cephx ticket-renewal analog):
         after ms_rekey_frames frames, announce epoch+1 under the old
@@ -221,8 +247,11 @@ class Connection:
         if not self._secure() or not n or self._tx_frames < n:
             return
         new_epoch = self._tx_epoch + 1
-        await self._send_frame(TAG_REKEY, 0,
-                               new_epoch.to_bytes(4, "little"))
+        body, secret = self._rekey_material(new_epoch)
+        await self._send_frame(TAG_REKEY, 0, body)
+        if secret is not None:
+            self.auth.install_secret(0 if self.is_client else 1,
+                                     secret, new_epoch)
         self._tx_epoch = new_epoch
         self._tx_frames = 0
 
@@ -302,11 +331,14 @@ class Connection:
             return
         async with self._send_lock:
             new_epoch = self._tx_epoch + 1
+            body, secret = self._rekey_material(new_epoch)
             try:
-                await self._send_frame(TAG_REKEY, 0,
-                                       new_epoch.to_bytes(4, "little"))
+                await self._send_frame(TAG_REKEY, 0, body)
             except ConnectionError_:
                 return               # dead conn: nothing left to rekey
+            if secret is not None:
+                self.auth.install_secret(0 if self.is_client else 1,
+                                         secret, new_epoch)
             self._tx_epoch = new_epoch
             self._tx_frames = 0
 
@@ -660,7 +692,35 @@ class Messenger:
             if tag == TAG_KEEPALIVE:
                 continue
             if tag == TAG_REKEY:
-                conn._rx_epoch = int.from_bytes(body[:4], "little")
+                epoch = int.from_bytes(body[:4], "little")
+                if conn._secure() and len(body) >= 36:
+                    # session-ticket re-auth (round 18): the announcer
+                    # must prove it holds the entity's CURRENT secret
+                    # per OUR keyring. Mismatch = rotation skew or a
+                    # revoked key — fence; the reconnect path runs
+                    # full mutual auth against whatever keys then hold
+                    entity = self.name if conn.is_client \
+                        else conn.peer_name
+                    secret = None
+                    try:
+                        secret = self.keyring.get(entity) \
+                            if self.keyring is not None else None
+                    except Exception:
+                        secret = None
+                    ok = secret is not None and hmac.compare_digest(
+                        conn.auth.rekey_ticket(secret, epoch),
+                        bytes(body[4:36]))
+                    if not ok:
+                        log.dout(1, f"rekey ticket from "
+                                    f"{conn.peer_name} failed "
+                                    f"verification: fencing session")
+                        conn._abort()
+                        for d in self.dispatchers:
+                            await d.ms_handle_reset(conn)
+                        return
+                    conn.auth.install_secret(
+                        1 if conn.is_client else 0, secret, epoch)
+                conn._rx_epoch = epoch
                 continue
             if not conn.policy.lossy:
                 # ack even duplicates so a replaying peer can prune

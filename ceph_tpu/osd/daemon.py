@@ -233,6 +233,17 @@ class OSD(Dispatcher):
         # gray-failure slow-score; ref: the osd_perf commit/apply
         # latencies the reference reports per OSD)
         self._peer_rtt: dict[int, float] = {}
+        # oldest UNANSWERED ping send-time per peer (round 18): a
+        # frozen-but-connected peer (SIGSTOP) answers nothing, so its
+        # RTT EWMA goes stale-LOW — the pending age is the live lower
+        # bound on its real round trip and inflates the reported
+        # latency until a reply lands
+        self._hb_ping_pending: dict[int, float] = {}
+        # central-config application state (baselines for `config rm`)
+        self._mon_cfg_state: dict = {}
+        # proc-backend children set this so mon config also mirrors
+        # into the per-process global Config "mon" layer
+        self.mirror_global_config = False
         # used-bytes sweep cache: (stamp, used)
         self._used_cache: tuple[float, int] | None = None
         # graceful shutdown in progress: suppresses the
@@ -357,6 +368,19 @@ class OSD(Dispatcher):
             hb_port=self.hb_msgr.addr.port,
             boot_epoch=self.osdmap.epoch if self.osdmap else 0))
 
+    def _apply_config_map(self, cfgmap: dict) -> None:
+        """Apply a mon-published central config map (round 18): the
+        wire analog of the in-process shared-dict live push, so a
+        separate-process OSD follows `config set` without a restart."""
+        from ceph_tpu.utils.config import apply_mon_config
+        changed = apply_mon_config(
+            f"osd.{self.whoami}", cfgmap, self.config,
+            self._mon_cfg_state,
+            mirror_global=self.mirror_global_config)
+        if changed:
+            log.dout(10, f"osd.{self.whoami} applied mon config "
+                         f"{sorted(changed)}")
+
     async def boot(self, host: str = "127.0.0.1") -> None:
         """ref: OSD::init + _send_boot."""
         await self.msgr.bind(host, 0)
@@ -370,6 +394,12 @@ class OSD(Dispatcher):
         await self.monc.subscribe("mgrmap", 0)
         if self.msgr.keyring is not None:
             await self.monc.subscribe("keyring", 0)
+        # central config db (round 18): live knob flips reach this
+        # daemon over the wire — the only path a separate-process
+        # child has to the shared-dict semantics of the in-proc
+        # backend (`config set osd ...` applies without a restart)
+        self.monc.config_callbacks.append(self._apply_config_map)
+        await self.monc.subscribe("config", 0)
         await self.monc.wait_for_osdmap()
         await self._send_boot()
         # wait until the map shows us up
@@ -1118,11 +1148,16 @@ class OSD(Dispatcher):
                 if now - last_iter > self.hb_grace:
                     for o in list(self._hb_last_rx):
                         self._hb_last_rx[o] = now
+                    for o in list(self._hb_ping_pending):
+                        # our own stall: don't let pending ages accuse
+                        # peers of our silence
+                        self._hb_ping_pending[o] = now
                 last_iter = now
                 for o in range(self.osdmap.max_osd):
                     if o == self.whoami or not self.osd_is_up(o):
                         self._hb_last_rx.pop(o, None)
                         self._peer_rtt.pop(o, None)   # stale evidence
+                        self._hb_ping_pending.pop(o, None)
                         continue
                     addr = self.osd_hb_addr(o)
                     if addr is None:
@@ -1135,6 +1170,9 @@ class OSD(Dispatcher):
                                 epoch=self.osdmap.epoch,
                                 stamp=now), addr, f"osd.{o}"),
                             timeout=1.0)
+                        # only the OLDEST outstanding ping is kept: its
+                        # age is the peer's unanswered-for window
+                        self._hb_ping_pending.setdefault(o, now)
                     except Exception:
                         pass
                     if now - self._hb_last_rx[o] > self.hb_grace and \
@@ -1185,6 +1223,7 @@ class OSD(Dispatcher):
     def _hb_rx(self, m: MOSDPing) -> None:
         now = asyncio.get_event_loop().time()
         self._hb_last_rx[m.from_osd] = now
+        self._hb_ping_pending.pop(m.from_osd, None)
         if m.op == PING_REPLY and m.stamp:
             # gray-failure signal: the PING_REPLY echoes OUR send
             # stamp, so now - stamp is a full round trip through the
@@ -1224,9 +1263,20 @@ class OSD(Dispatcher):
                 spans = self.tracer.drain_ship()
                 # per-peer heartbeat RTTs (µs) piggyback too: the
                 # mon's slow-score sweep needs a FRESH fleet view
-                # every tick, so holding rtts forces the report
-                peer_lat = {str(o): int(r * 1e6)
-                            for o, r in self._peer_rtt.items()}
+                # every tick, so holding rtts forces the report.
+                # Pending-ping inflation (round 18): a peer that has
+                # stopped answering (SIGSTOP gray failure) would
+                # otherwise keep its last — stale-low — EWMA; the
+                # oldest unanswered ping's age is the honest floor.
+                _hb_now = asyncio.get_event_loop().time()
+                peer_lat = {}
+                for o in set(self._peer_rtt) | \
+                        set(self._hb_ping_pending):
+                    r = self._peer_rtt.get(o, 0.0)
+                    pend = self._hb_ping_pending.get(o)
+                    if pend is not None:
+                        r = max(r, _hb_now - pend)
+                    peer_lat[str(o)] = int(r * 1e6)
                 # device-runtime piggyback (round 14): the cumulative
                 # kernel-path/compile/transfer view — reported while
                 # it moves, so the mon's per-report deltas track

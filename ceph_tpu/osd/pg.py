@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import json
 
+from ceph_tpu.msg.messenger import ConnectionError_
 from ceph_tpu.os_.objectstore import StoreError, Transaction
 from ceph_tpu.osd.messages import (
     BACKFILL_OP_FINISH, BACKFILL_OP_PROGRESS, BACKFILL_OP_RESET,
@@ -1849,13 +1850,33 @@ class PG:
             "repop_wait",
             tags={"replicas": sorted(replicas)}) \
             if op_span and replicas else None
+        send_failed = False
         for o in replicas:
             rep = MOSDRepOp(
                 tid=tid, epoch=self.epoch, pgid=self.cid,
                 txn=txn_blob, log_entry=entry.encode(),
                 extra_log=[e.encode() for e in extra_entries])
             rep.set_trace(repop_span)
-            await self.osd.send_osd(o, rep)
+            try:
+                await self.osd.send_osd(o, rep)
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    ConnectionError_) as e:
+                # An unreachable replica (SIGKILLed process, dead
+                # port) must NOT surface as client EIO: it is the same
+                # situation as a replica that never confirms, so it
+                # takes the same -EAGAIN exit below — the objecter
+                # resends once the map moves and the PG re-peers.
+                send_failed = True
+                log.dout(1, f"pg {self.pgid} repop {tid} -> osd.{o} "
+                            f"send failed: {e!r}")
+        if waiter is not None and send_failed:
+            ent = self._repop_waiters.get(tid)
+            if ent is not None:
+                ent[3] = True
+            if repop_span is not None:
+                repop_span.tag("send_failed", True)
+                repop_span.finish()
+            return -11, True, waiter                    # -EAGAIN
         if waiter is not None:
             # asyncio.wait (NOT wait_for): wait_for CANCELS the future
             # on timeout, which would make it impossible for a late

@@ -228,6 +228,141 @@ SCENARIOS: dict[str, list[dict]] = {
 }
 
 
+# -- worker-process sharding (round 18) -----------------------------------
+class _WorkerCluster:
+    """The minimal cluster facade a LoadGen needs (client, keyring,
+    cfg), rebuilt inside a forked worker from the conf document — the
+    same document a proc-backend daemon child reads."""
+
+    def __init__(self, client, keyring, cfg):
+        self.client = client
+        self.keyring = keyring
+        self.cfg = cfg
+
+
+async def run_sharded(cluster, pool: str, sessions: int = 1000,
+                      workers: int = 1, clients: int = 8,
+                      ops_per_session: int = 5, write_bytes: int = 512,
+                      read_fraction: float = 0.25, think_s: float = 0.0,
+                      op_timeout: float = 30.0, concurrency: int = 0,
+                      seed: int = 0) -> dict:
+    """Shard ``sessions`` across ``workers`` FORKED worker processes,
+    each running its own LoadGen fleet over its own real client
+    handles against the same cluster (in-process or proc backend —
+    the wire doesn't care), and merge the reports: summed ops/errors,
+    percentiles over the CONCATENATED latency population (a
+    per-worker p99 average would hide a slow shard), wall = the
+    slowest worker. One worker still exercises the whole path (conf
+    hand-off, fork, merge) at tier-1 cost."""
+    import json as _json
+    import os
+    import sys
+    import tempfile
+
+    from ceph_tpu.cluster.conf import write_conf
+    workers = max(1, int(workers))
+    sessions = int(sessions)
+    conf_path = getattr(cluster, "conf_path", None)
+    tmp = None
+    if conf_path is None or not os.path.exists(conf_path):
+        fd, tmp = tempfile.mkstemp(prefix="lg_conf_", suffix=".json")
+        os.close(fd)
+        write_conf(tmp, cluster.client.monc.monmap, cluster.keyring,
+                   config=cluster.cfg)
+        conf_path = tmp
+    shard = [sessions // workers +
+             (1 if w < sessions % workers else 0)
+             for w in range(workers)]
+
+    async def _one(w: int) -> dict:
+        params = dict(conf=conf_path, pool=pool, sessions=shard[w],
+                      clients=clients, ops_per_session=ops_per_session,
+                      write_bytes=write_bytes,
+                      read_fraction=read_fraction, think_s=think_s,
+                      op_timeout=op_timeout, concurrency=concurrency,
+                      seed=seed * 1000 + w + 1)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "ceph_tpu.sim.loadgen", "--worker",
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE, env=env)
+        out, _ = await proc.communicate(_json.dumps(params).encode())
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"loadgen worker {w} exited {proc.returncode}")
+        # the report is the LAST stdout line; anything above is noise
+        return _json.loads(out.decode().strip().splitlines()[-1])
+
+    t0 = time.perf_counter()
+    try:
+        reports = await asyncio.gather(
+            *[_one(w) for w in range(workers) if shard[w] > 0])
+    finally:
+        if tmp is not None:
+            os.unlink(tmp)
+    wall = time.perf_counter() - t0
+    lats = sorted(x for r in reports for x in r.pop("lats"))
+    ops = len(lats)
+    merged = {
+        "sessions": sessions,
+        "workers": len(reports),
+        "ops": ops,
+        "errors": sum(r["errors"] for r in reports),
+        "error_samples": [s for r in reports
+                          for s in r["error_samples"]][:4],
+        "wall_s": round(wall, 3),
+        "ops_per_s": round(ops / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": round(percentile(lats, 0.50) * 1e3, 2),
+        "p99_ms": round(percentile(lats, 0.99) * 1e3, 2),
+        "max_ms": round(percentile(lats, 1.0) * 1e3, 2),
+        "per_worker": reports,
+    }
+    log.dout(1, f"loadgen sharded: {sessions} sessions / "
+                f"{len(reports)} workers, {ops} ops, "
+                f"{merged['errors']} errors, "
+                f"{merged['ops_per_s']} ops/s, "
+                f"p99 {merged['p99_ms']} ms")
+    return merged
+
+
+async def _worker_main() -> None:
+    """``python -m ceph_tpu.sim.loadgen --worker``: params JSON on
+    stdin, merged-ready report JSON as the last stdout line."""
+    import json as _json
+    import sys
+
+    from ceph_tpu.cluster.conf import (
+        conf_keyring,
+        conf_monmap,
+        read_conf_doc,
+    )
+    from ceph_tpu.rados import Rados
+    params = _json.loads(sys.stdin.read())
+    doc = read_conf_doc(params["conf"])
+    cfg = dict(doc.get("config") or {})
+    client = Rados(conf_monmap(doc), keyring=conf_keyring(doc),
+                   config=cfg)
+    ret, rs, _ = await client.mon_command({"prefix": "status"},
+                                          timeout=30.0)
+    assert ret == 0, rs
+    await client.connect()
+    shim = _WorkerCluster(client, client.monc.msgr.keyring, cfg)
+    lg = LoadGen(shim, params["pool"], sessions=params["sessions"],
+                 clients=params["clients"],
+                 ops_per_session=params["ops_per_session"],
+                 write_bytes=params["write_bytes"],
+                 read_fraction=params["read_fraction"],
+                 think_s=params["think_s"],
+                 op_timeout=params["op_timeout"],
+                 concurrency=params["concurrency"],
+                 seed=params["seed"])
+    report = await lg.run()
+    report["lats"] = [round(x, 6) for x in lg.latencies]
+    await client.shutdown()
+    sys.stdout.write("\n" + _json.dumps(report) + "\n")
+    sys.stdout.flush()
+
+
 async def run_scenario(cluster, name: str,
                        pools: dict[str, str] | None = None,
                        scale: float = 1.0, seed: int = 0,
@@ -265,3 +400,15 @@ async def run_scenario(cluster, name: str,
             f"{r}={reports[r]['ops_per_s']} ops/s "
             f"(p99 {reports[r]['p99_ms']} ms)" for r in reports))
     return {"scenario": name, "phases": phases}
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+    if "--worker" in _sys.argv:
+        asyncio.run(_worker_main())
+    else:
+        raise SystemExit("usage: python -m ceph_tpu.sim.loadgen "
+                         "--worker  (params JSON on stdin)")

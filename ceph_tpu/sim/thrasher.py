@@ -80,7 +80,12 @@ class Thrasher:
         self.max_active_sets = max_active_sets
         self.write_timeout = write_timeout
         self.injector = F.FaultInjector(seed=seed)
-        cluster.install_faults(self.injector)
+        # the proc backend has no in-process daemons to hook: its
+        # fault injection is wire-delivered per child (`ceph daemon
+        # <asok> fault install`), and kill/revive are SIGNALS
+        self.proc = getattr(cluster, "backend", "inproc") == "proc"
+        if not self.proc:
+            cluster.install_faults(self.injector)
         self.downed: list[int] = []
         self.active_sets: list[str] = []
         self.killed_mons = 0
@@ -1110,6 +1115,122 @@ class Thrasher:
                 "faults_injected": _delta("faults_injected"),
                 "ec_degraded_ops": agg_fb,
                 "repromoted_path": path_h}
+
+    # -- proc-backend crash storm (round 18) -------------------------------
+    async def proc_storm(self, io, settle_timeout: float = 180.0,
+                         gray: bool = True) -> dict:
+        """SIGKILL honesty under load (proc backend only): with a
+        continuous unique-oid writer running, crash — in sequence —
+        one OSD, the lead mon (when a majority survives it), and the
+        active mgr, each with a REAL SIGKILL (no goodbye on the wire);
+        let the supervisor restart each; optionally run one
+        SIGSTOP/SIGCONT gray-failure pass (the frozen OSD must trip
+        OSD_SLOW and heal on resume); then settle and verify.
+
+        Invariants enforced: ZERO writer errors (the closed loop plus
+        objecter retry must ride out every crash window), every acked
+        write reads back bit-identical, every victim observed
+        restarting, the mgr telemetry plane re-populates after the
+        active mgr dies. Returns the summary dict."""
+        c = self.c
+        assert self.proc, "proc_storm needs backend='proc'"
+        self._writer_task = asyncio.ensure_future(self._writer(io))
+        restarts: dict[str, int] = {}
+        mgr_failover = None
+        try:
+            await asyncio.sleep(0.5)        # writer gets a head start
+            # 1: crash an OSD; the supervisor must bring it back
+            victim = f"osd.{c.n_osds - 1}"
+            before = c.children[victim].restarts
+            c.kill_osd(c.n_osds - 1)
+            self._log(f"SIGKILL {victim}")
+            await c.wait_for_restart(victim, before, timeout=60.0)
+            # the fresh incarnation must actually BOOT (asok answers,
+            # reports up): wait_for_osds_up alone passes trivially
+            # when the grace outlives the respawn and the dead osd
+            # was never marked down
+            await c.wait_for_daemon_ready(victim, timeout=60.0)
+            await c.wait_for_osds_up(c.n_osds, timeout=90.0)
+            restarts[victim] = c.children[victim].restarts - before
+            # 2: crash the lead mon (only when quorum survives it)
+            before_mons = {n: ch.restarts
+                           for n, ch in c.children.items()
+                           if n.startswith("mon.")}
+            name = await c.kill_mon_leader()
+            if name is not None:
+                self.killed_mons += 1
+                self._log(f"SIGKILL {name} (lead mon)")
+                await c.wait_for_restart(name, before_mons[name],
+                                         timeout=60.0)
+                await c.wait_for_daemon_ready(name, timeout=60.0)
+                restarts[name] = \
+                    c.children[name].restarts - before_mons[name]
+                # the reborn mon must rejoin a WORKING quorum
+                ret, _, _ = await c.client.mon_command(
+                    {"prefix": "status"}, timeout=30.0)
+                assert ret == 0
+            # 3: crash the active mgr; a standby must take over and
+            # the telemetry plane must re-populate from fresh reports
+            old = await c.kill_active_mgr()
+            if old is not None:
+                before_m = c.children[old].restarts
+                self._log(f"SIGKILL {old} (active mgr)")
+                new = await c.wait_for_mgr_active(
+                    not_name=old.split(".", 1)[1], timeout=60.0)
+                mgr_failover = (old, f"mgr.{new}")
+                self._log(f"mgr failover -> mgr.{new}")
+                deadline = asyncio.get_event_loop().time() + 60.0
+                while True:
+                    try:
+                        out = await c.daemon_command(
+                            f"mgr.{new}", "metrics")
+                        # ceph_daemon rows exist only once daemons
+                        # have REPORTED to this (fresh) mgr — the
+                        # re-population proof, not a map-derived row
+                        if "ceph_daemon" in out.get("body", ""):
+                            break
+                    except Exception:
+                        pass
+                    assert asyncio.get_event_loop().time() < \
+                        deadline, "mgr metrics never re-populated"
+                    await asyncio.sleep(0.3)
+                await c.wait_for_restart(old, before_m, timeout=60.0)
+                restarts[old] = c.children[old].restarts - before_m
+            # 4: gray failure — frozen, not dead
+            if gray:
+                gray_id = 0
+                c.pause_osd(gray_id)
+                self._log(f"SIGSTOP osd.{gray_id}")
+                await c.wait_for_health("OSD_SLOW", present=True,
+                                        timeout=60.0)
+                c.resume_osd(gray_id)
+                self._log(f"SIGCONT osd.{gray_id}")
+                await c.wait_for_health("OSD_SLOW", present=False,
+                                        timeout=90.0)
+                await c.wait_for_osds_up(c.n_osds, timeout=90.0)
+        finally:
+            self._writer_task.cancel()
+            await asyncio.gather(self._writer_task,
+                                 return_exceptions=True)
+        await c.wait_for_clean(timeout=settle_timeout)
+        assert self._write_errors == 0, \
+            f"{self._write_errors} writer errors during proc storm"
+        for oid, data in self.acked.items():
+            got = await io.read(oid)
+            assert got == data, \
+                f"acked write {oid} corrupted by proc storm"
+        assert sum(restarts.values()) >= 2, \
+            f"expected supervisor restarts, saw {restarts}"
+        summary = {
+            "seed": self.seed,
+            "acked_writes": len(self.acked),
+            "failed_writes": self._write_errors,
+            "restarts": restarts,
+            "killed_mons": self.killed_mons,
+            "mgr_failover": mgr_failover,
+        }
+        self._log(f"proc storm done: {summary}")
+        return summary
 
     async def settle_and_verify(self, io, timeout: float = 240.0,
                                 fsck_stores=None) -> dict:

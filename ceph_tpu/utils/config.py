@@ -472,6 +472,24 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
            "visible (a single device keeps the plain path); 'off' "
            "never attaches one",
            enum_allowed=("off", "auto")),
+    # multi-process cluster backend (round 18; cluster/proc.py
+    # supervisor + the mon central config db in mon/service.py). The
+    # proc_* knobs govern the parent-side supervisor and are read at
+    # spawn/stop time; mon_config_strict is read LIVE per `config set`.
+    Option("proc_restart_backoff_base", float, 0.3,
+           "seconds before the FIRST respawn after a proc-backend "
+           "daemon crashes (exits without being asked to stop); "
+           "doubles per consecutive crash", min=0.0),
+    Option("proc_restart_backoff_max", float, 5.0,
+           "backoff ceiling for crash respawns", min=0.0),
+    Option("proc_stop_timeout", float, 10.0,
+           "seconds a graceful stop (SIGTERM -> stop(mark_down=True)) "
+           "may take before the supervisor escalates to SIGKILL",
+           min=0.1),
+    Option("mon_config_strict", bool, False,
+           "when true, `ceph config set` rejects names that are not "
+           "registered Options instead of storing them as raw "
+           "strings"),
     # TPU execution knobs (no Ceph analog).
     Option("tpu_ec_backend", str, "auto",
            "GF kernel: bitmatmul (MXU) | lut (VPU) | auto",
@@ -579,3 +597,73 @@ def global_config() -> Config:
         cfg.load_env()  # raises on malformed CEPH_TPU_* before caching
         _global = cfg
     return _global
+
+
+_ABSENT = object()       # live.get sentinel: absent != stored None
+
+
+def apply_mon_config(entity: str, cfgmap: dict, live: dict,
+                     state: dict, mirror_global: bool = False) -> list[str]:
+    """Apply a mon-published config map into a daemon's live config.
+
+    ``cfgmap`` is ``{who: {name: raw-str}}`` with who = global |
+    <type> | <type>.<id>; resolution is most-specific wins, the same
+    mask walk as ConfigMonitor.resolve. ``live`` is the daemon's
+    runtime config dict (shared cluster-wide on the in-process
+    backend, private per child on the proc backend). ``state`` is a
+    per-daemon dict remembering each applied key's pre-map baseline so
+    a key that later leaves the map (`config rm`) restores what the
+    daemon booted with instead of leaving the override stuck.
+
+    Registered Options are validated/coerced to their declared type;
+    unknown names apply as raw strings (same leniency as the mon-side
+    live push). Invalid values are skipped, never raised — a bad
+    central value must not kill a daemon. With ``mirror_global`` the
+    registered names are also mirrored into the per-process
+    :func:`global_config` "mon" layer (the proc-backend children's
+    Config runtime layer). Returns the names whose live value changed.
+    """
+    dtype = entity.split(".", 1)[0]
+    resolved: dict[str, str] = {}
+    for scope in ("global", dtype, entity):
+        for name, raw in (cfgmap.get(scope) or {}).items():
+            resolved[name] = raw
+    baselines: dict[str, tuple[bool, Any]] = state.setdefault(
+        "baseline", {})
+    changed: list[str] = []
+    gcfg = global_config() if mirror_global else None
+    for name in [n for n in baselines if n not in resolved]:
+        had, old = baselines.pop(name)
+        if had:
+            if live.get(name) != old or name not in live:
+                changed.append(name)
+            live[name] = old
+        else:
+            if name in live:
+                changed.append(name)
+            live.pop(name, None)
+        if gcfg is not None and name in gcfg._options:
+            gcfg.rm(name, layer="mon")
+    for name, raw in resolved.items():
+        opt = OPTIONS.get(name)
+        try:
+            value = opt.validate(raw) if opt is not None else raw
+        except (ValueError, TypeError):
+            continue
+        # record the pre-map baseline once — and only when this apply
+        # actually changes the value. On the in-process backend every
+        # daemon shares ONE live dict, so a later applier would
+        # otherwise snapshot the already-mutated value as "previous"
+        # and a config rm would restore the override instead of the
+        # boot value.
+        if name not in baselines and live.get(name, _ABSENT) != value:
+            baselines[name] = (name in live, live.get(name))
+        if name not in live or live.get(name) != value:
+            live[name] = value
+            changed.append(name)
+        if gcfg is not None and name in gcfg._options:
+            try:
+                gcfg.set(name, value, layer="mon")
+            except (ValueError, KeyError):
+                pass
+    return changed

@@ -1,0 +1,61 @@
+"""Durable lossless-replay peer for test_replay_restart.py.
+
+A tiny stand-in for a daemon's apply path, run as a REAL OS process:
+
+    python tests/_replay_child.py PORT NAME PEER KEY_SELF KEY_PEER LOG
+
+Binds a lossless messenger on the FIXED port and appends every MRec it
+dispatches to LOG with flush+fsync before returning — i.e. before the
+transport acks — so the log after a SIGKILL holds exactly the ops whose
+acks the sender may have seen.  The parent kills this process and
+respawns it with identical argv: same entity name, same port, fresh
+memory.  Not a pytest module (underscore prefix keeps it uncollected).
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.msg import (                                    # noqa: E402
+    Dispatcher, Keyring, Message, Messenger, Policy, register,
+)
+
+
+@register
+class MRec(Message):
+    TYPE = 902            # test-only; golden corpus filters non-ceph_tpu
+    FIELDS = [("op", "u64"), ("payload", "blob")]
+
+
+class _Applier(Dispatcher):
+    def __init__(self, path: str):
+        self.path = path
+
+    async def ms_dispatch(self, msg):
+        if not isinstance(msg, MRec):
+            return False
+        with open(self.path, "a") as f:
+            f.write(f"{msg.op}:{msg.payload.hex()}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return True
+
+
+async def _main(port: int, name: str, peer: str,
+                key_self: bytes, key_peer: bytes, path: str) -> None:
+    kr = Keyring({name: key_self, peer: key_peer})
+    msgr = Messenger(name, keyring=kr)
+    msgr.set_policy(peer.split(".", 1)[0], Policy.lossless_peer())
+    msgr.add_dispatcher(_Applier(path))
+    await msgr.bind("127.0.0.1", port)
+    print("READY", flush=True)
+    await asyncio.Event().wait()      # run until SIGKILLed
+
+
+if __name__ == "__main__":
+    _port, _name, _peer, _ks, _kp, _path = sys.argv[1:7]
+    asyncio.run(_main(int(_port), _name, _peer,
+                      bytes.fromhex(_ks), bytes.fromhex(_kp), _path))

@@ -1,0 +1,94 @@
+"""Worker-process loadgen sharding (round 18).
+
+``run_sharded`` forks N worker processes, each running its own LoadGen
+fleet over real client handles built from the cluster conf document,
+and merges the reports with percentiles computed over the CONCATENATED
+latency population (averaging per-worker p99s would hide a slow
+shard). The tier-1 smoke runs the session-scale bar (10k) through ONE
+forked worker — the whole path (conf hand-off, fork, stdin params,
+merge) at one interpreter-startup of cost; the 8-worker 100k run is
+``slow``.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.sim.loadgen import run_sharded
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_loadgen_sharded_10k_one_worker():
+    """10k sessions through one forked worker: zero errors, all ops
+    acked, merged percentiles present and ordered."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config={
+            "osd_client_message_cap": 1024}).start()
+        try:
+            await c.client.pool_create("load", pg_num=16)
+            await c.wait_for_clean(timeout=240)
+            t0 = time.perf_counter()
+            # ops_per_session=1: the bar this smoke holds is SESSION
+            # scale (10k logical sessions multiplexed over real
+            # handles inside a forked worker), not op volume — one op
+            # per session halves the tier-1 wall (the suite runs
+            # against the 870 s cap; ROADMAP "budget new tests")
+            report = await run_sharded(
+                c, "load", sessions=10000, workers=1, clients=16,
+                ops_per_session=1, write_bytes=128,
+                concurrency=512, op_timeout=120.0)
+            assert report["errors"] == 0, report["error_samples"]
+            assert report["sessions"] == 10_000
+            assert report["ops"] == 10_000
+            assert report["workers"] == 1
+            assert len(report["per_worker"]) == 1
+            # merged tail stats come from the concatenated population
+            assert report["p50_ms"] <= report["p99_ms"] <= \
+                report["max_ms"]
+            assert report["ops_per_s"] > 0
+            print(f"sharded 10k/1w: {report['ops_per_s']} ops/s, "
+                  f"p50 {report['p50_ms']} ms, "
+                  f"p99 {report['p99_ms']} ms "
+                  f"({time.perf_counter() - t0:.1f}s wall)")
+        finally:
+            await c.stop()
+    run(go())
+
+
+@pytest.mark.slow
+def test_loadgen_sharded_100k_eight_workers():
+    """The full-scale sharded harness: 100k sessions across 8 forked
+    workers complete with zero errors and a coherent merged tail."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config={
+            "osd_client_message_cap": 2048}).start()
+        try:
+            await c.client.pool_create("load", pg_num=32)
+            await c.wait_for_clean(timeout=240)
+            t0 = time.perf_counter()
+            report = await run_sharded(
+                c, "load", sessions=100_000, workers=8, clients=16,
+                ops_per_session=2, write_bytes=128,
+                concurrency=256, op_timeout=240.0)
+            assert report["errors"] == 0, report["error_samples"]
+            assert report["sessions"] == 100_000
+            assert report["ops"] == 200_000
+            assert report["workers"] == 8
+            assert len(report["per_worker"]) == 8
+            assert report["p50_ms"] <= report["p99_ms"] <= \
+                report["max_ms"]
+            # every shard contributed (the split is near-even)
+            per = [r["ops"] for r in report["per_worker"]]
+            assert min(per) > 0 and max(per) - min(per) <= \
+                2 * 2  # sessions round by at most 1 -> ops by 2
+            print(f"sharded 100k/8w: {report['ops_per_s']} ops/s, "
+                  f"p99 {report['p99_ms']} ms "
+                  f"({time.perf_counter() - t0:.1f}s wall)")
+        finally:
+            await c.stop()
+    run(go())

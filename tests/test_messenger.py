@@ -402,3 +402,77 @@ def test_crc_vs_secure_mode_mismatch_fails_fast():
         await client.shutdown()
         await server.shutdown()
     run(go())
+
+
+def test_key_rotation_reauths_live_secure_session():
+    """AuthMonitor key rotation (round 18): both ends hold the new
+    secret, so the in-band REKEY session-ticket verifies and traffic
+    continues on the live session — no reconnect, no reset."""
+    async def go():
+        master = _keyring("osd.0", "osd.1")
+        kr_srv = master.copy_for("osd.0", "osd.1")
+        kr_cli = master.copy_for("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr_srv, mode=MODE_SECURE)
+        server.set_policy("osd", Policy.lossless_peer())
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr_cli, mode=MODE_SECURE)
+        client.set_policy("osd", Policy.lossless_peer())
+        reply = Collector()
+        client.add_dispatcher(reply)
+        await client.send_message(MPing(x=1, note=""), addr, "osd.1")
+        await _wait(lambda: len(sink.got) == 1)
+        conn = client.conns[addr]
+        epoch0 = conn._tx_epoch
+        # paxos commits the rotation: every keyring copy gets the new
+        # secret, each messenger re-keys the entity's live sessions
+        newkey = master.generate_key()
+        kr_srv.set_key("osd.0", newkey)
+        kr_cli.set_key("osd.0", newkey)
+        await _wait(lambda: conn._tx_epoch > epoch0)
+        for i in range(2, 6):
+            await client.send_message(MPing(x=i, note=""), addr,
+                                      "osd.1")
+        await _wait(lambda: len(sink.got) == 5)
+        assert [m.x for m in sink.got] == [1, 2, 3, 4, 5]
+        assert sink.resets == 0 and reply.resets == 0
+        assert not conn.closed
+        await client.shutdown()
+        await server.shutdown()
+    run(go())
+
+
+def test_key_rotation_skew_fences_session():
+    """Only ONE side saw the rotation: the REKEY ticket no longer
+    proves possession of the peer's notion of the secret, so the peer
+    fences the session instead of silently relabeling epochs. The
+    reconnect then fails full mutual auth (keys genuinely differ)."""
+    async def go():
+        master = _keyring("osd.0", "osd.1")
+        kr_srv = master.copy_for("osd.0", "osd.1")
+        kr_cli = master.copy_for("osd.0", "osd.1")
+        server = Messenger("osd.1", keyring=kr_srv, mode=MODE_SECURE)
+        sink = Collector()
+        server.add_dispatcher(sink)
+        addr = await server.bind()
+        client = Messenger("osd.0", keyring=kr_cli, mode=MODE_SECURE)
+        await client.send_message(MPing(x=1, note=""), addr, "osd.1")
+        await _wait(lambda: len(sink.got) == 1)
+        conn = client.conns[addr]
+        # rotation skew: the client rotates, the server never hears
+        kr_cli.set_key("osd.0", master.generate_key())
+        await _wait(lambda: sink.resets >= 1)
+        await _wait(lambda: conn.closed)
+        with pytest.raises((AuthError, ConnectionError_, OSError,
+                            asyncio.IncompleteReadError)):
+            await client.send_message(MPing(x=2, note=""), addr,
+                                      "osd.1")
+            # at-least-once may mask the dead socket on the first
+            # write; a second send forces the failed re-handshake
+            await client.send_message(MPing(x=3, note=""), addr,
+                                      "osd.1")
+        assert len(sink.got) == 1
+        await client.shutdown()
+        await server.shutdown()
+    run(go())

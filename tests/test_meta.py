@@ -51,6 +51,15 @@ STORM_BUDGETS = {
     # the 10k-session harness: tier-1 smokes stay <= 200 sessions
     # (LoadGen is a constructor call, matched by Name too)
     "LoadGen": {"sessions": 200},
+    # the round-18 worker-process sharded harness: forked workers pay
+    # interpreter+jax startup once each, so the smoke budget is ONE
+    # worker but session-scale (the sessions run inside the fork,
+    # not in the test's own loop)
+    "run_sharded": {"sessions": 10000, "workers": 1},
+    # the round-18 proc-backend crash storm: every phase SIGKILLs a
+    # real process and waits out a supervised respawn (interpreter
+    # start ~2-3 s each) — non-slow callers take the defaults
+    "proc_storm": {"settle_timeout": 180.0},
 }
 BUILTIN_MARKS = {
     "parametrize", "skip", "skipif", "xfail", "usefixtures",
@@ -608,6 +617,17 @@ def test_tuner_knobs_registered_with_defaults():
     so an unregistered knob silently diverges from `config show`
     exactly when an operator is reining the loop in."""
     _assert_knobs_registered(("mgr_tuner_", "mon_tune_"), "tuner")
+
+
+def test_proc_and_config_knobs_registered_with_defaults():
+    """Round 18: every proc-backend supervisor knob (`proc_*` —
+    restart backoff, stop timeout) and central-config knob
+    (`mon_config_*`) read anywhere must be a registered Option with a
+    default. The supervisor reads them LIVE per respawn decision and
+    the ConfigMonitor per `config set`, so an unregistered knob
+    silently diverges from `config show` in both backends."""
+    _assert_knobs_registered(
+        ("proc_", "mon_config_"), "proc backend / central config")
 
 
 def test_fault_kinds_documented():

@@ -1,0 +1,146 @@
+"""Unit pins for the round-18 proc-backend support layers: incarnation
+key derivation (msg/auth.py), the mon-config apply/restore algebra
+(utils/config.py), and the conf document roundtrip (cluster/conf.py).
+All pure/in-memory — the cluster-level behavior rides
+test_proc_cluster.py.
+"""
+
+import pytest
+
+from ceph_tpu.cluster.conf import (
+    conf_keyring,
+    conf_monmap,
+    read_conf_doc,
+    write_conf,
+)
+from ceph_tpu.mon.monitor import MonMap
+from ceph_tpu.msg.auth import AuthError, Keyring
+from ceph_tpu.utils.config import apply_mon_config
+
+
+# -- incarnation key derivation --------------------------------------------
+
+def test_incarnation_key_derives_from_base():
+    """Two keyrings provisioned with the same base secret derive the
+    SAME per-incarnation key — a separate-process daemon and the mon
+    agree without sharing a dict."""
+    master = Keyring()
+    master.add("mds.a")
+    child = master.copy_for("mds.a")
+    assert master.get("mds.a.12345") == child.get("mds.a.12345")
+    # different incarnations get different keys
+    assert master.get("mds.a.12345") != master.get("mds.a.12346")
+    # and none equals the base
+    assert master.get("mds.a.12345") != master.get("mds.a")
+
+
+def test_incarnation_key_requires_base():
+    kr = Keyring()
+    with pytest.raises(AuthError):
+        kr.get("mds.a.12345")
+    # a non-numeric suffix is NOT an incarnation pattern
+    kr.add("mds.a")
+    with pytest.raises(AuthError):
+        kr.get("mds.a.standby")
+
+
+def test_incarnation_key_follows_base_rotation():
+    kr = Keyring()
+    kr.add("mds.a")
+    before = kr.get("mds.a.7")
+    kr.set_key("mds.a", kr.generate_key())
+    assert kr.get("mds.a.7") != before
+
+
+def test_explicit_ident_key_shadows_derivation():
+    """An explicitly added incarnation key wins over derivation (the
+    standalone-harness path where no base entity exists is the same
+    add)."""
+    kr = Keyring()
+    kr.add("mds.a")
+    explicit = kr.add("mds.a.7")
+    assert kr.get("mds.a.7") == explicit
+
+
+# -- apply_mon_config algebra ----------------------------------------------
+
+def test_apply_mon_config_precedence():
+    """Per-entity beats per-type beats global; typed coercion for
+    registered options."""
+    live: dict = {}
+    state: dict = {}
+    cfgmap = {"global": {"osd_max_backfills": "2"},
+              "osd": {"osd_max_backfills": "3"},
+              "osd.0": {"osd_max_backfills": "7"}}
+    changed = apply_mon_config("osd.0", cfgmap, live, state)
+    assert live["osd_max_backfills"] == 7 and changed
+    live2: dict = {}
+    apply_mon_config("osd.1", cfgmap, live2, {})
+    assert live2["osd_max_backfills"] == 3
+    live3: dict = {}
+    apply_mon_config("mon.a", cfgmap, live3, {})
+    assert live3["osd_max_backfills"] == 2
+
+
+def test_apply_mon_config_restores_baseline_on_rm():
+    live = {"osd_max_backfills": 4}
+    state: dict = {}
+    apply_mon_config("osd.0", {"osd": {"osd_max_backfills": "9"}},
+                     live, state)
+    assert live["osd_max_backfills"] == 9
+    apply_mon_config("osd.0", {}, live, state)
+    assert live["osd_max_backfills"] == 4
+    # a key the daemon never had is REMOVED, not left as an override
+    live2: dict = {}
+    state2: dict = {}
+    apply_mon_config("osd.0", {"osd": {"osd_max_backfills": "9"}},
+                     live2, state2)
+    apply_mon_config("osd.0", {}, live2, state2)
+    assert "osd_max_backfills" not in live2
+
+
+def test_apply_mon_config_shared_dict_not_poisoned():
+    """The in-process backend shares ONE live dict across daemons: a
+    later applier must not snapshot the already-applied value as its
+    'baseline' (config rm would then restore the override)."""
+    live = {"osd_max_backfills": 1}
+    s0: dict = {}
+    s1: dict = {}
+    cfgmap = {"osd": {"osd_max_backfills": "9"}}
+    apply_mon_config("osd.0", cfgmap, live, s0)
+    apply_mon_config("osd.1", cfgmap, live, s1)   # sees 9 already
+    apply_mon_config("osd.0", {}, live, s0)
+    apply_mon_config("osd.1", {}, live, s1)
+    assert live["osd_max_backfills"] == 1
+
+
+def test_apply_mon_config_invalid_value_skipped():
+    """A malformed central value must not kill (or change) a daemon."""
+    live = {"osd_max_backfills": 1}
+    changed = apply_mon_config(
+        "osd.0", {"osd": {"osd_max_backfills": "not-an-int"}},
+        live, {})
+    assert live["osd_max_backfills"] == 1 and changed == []
+
+
+# -- conf document roundtrip -----------------------------------------------
+
+def test_conf_document_roundtrip(tmp_path):
+    mm = MonMap(fsid="unit-fsid")
+    mm.add("a", 0, "127.0.0.1", 6789)
+    mm.add("b", 1, "127.0.0.1", 6790)
+    kr = Keyring()
+    kr.add("mon.a")
+    kr.add("client.admin")
+    path = str(tmp_path / "cluster.conf")
+    write_conf(path, mm, kr, config={"osd_heartbeat_grace": 10.0},
+               extra={"data_dir": "/nonexistent/x"})
+    doc = read_conf_doc(path)
+    mm2 = conf_monmap(doc)
+    assert mm2.fsid == "unit-fsid"
+    assert {(n, r[2]) for n, r in mm2.mons.items()} == \
+        {("a", 6789), ("b", 6790)}
+    kr2 = conf_keyring(doc)
+    assert kr2.get("client.admin") == kr.get("client.admin")
+    assert doc["config"]["osd_heartbeat_grace"] == 10.0
+    assert doc["data_dir"] == "/nonexistent/x"

@@ -1,0 +1,116 @@
+"""Lossless-replay dedup across a REAL process restart.
+
+The in-process messenger tests exercise replay across a killed
+*connection*; here the peer dies by SIGKILL mid-session and comes back
+as a fresh OS process with the same entity name on the same port.  The
+client's at-least-once machinery prunes ops once acked, so ops acked
+before the crash must appear in the survivor's durable log exactly
+once — never re-sent to the respawned process — while ops sent after
+the restart flow over the renegotiated session and apply once too.
+
+ref: src/test/msgr/test_msgr.cc (MessengerTest reconnect cases), but
+with an actual process boundary instead of a simulated reset.
+"""
+
+import asyncio
+import importlib.util
+import os
+import socket
+import subprocess
+import sys
+
+from ceph_tpu.msg import Keyring, Messenger, Policy
+from ceph_tpu.msg.messenger import EntityAddr
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_spec = importlib.util.spec_from_file_location(
+    "_replay_child", os.path.join(_HERE, "_replay_child.py"))
+_child_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_child_mod)
+MRec = _child_mod.MRec
+
+
+async def _wait(pred, timeout=30.0):
+    t0 = asyncio.get_event_loop().time()
+    while not pred():
+        if asyncio.get_event_loop().time() - t0 > timeout:
+            raise TimeoutError
+        await asyncio.sleep(0.02)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(port: int, log_path: str, key_srv: str, key_cli: str):
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_HERE, "_replay_child.py"),
+         str(port), "osd.9", "client.r", key_srv, key_cli, log_path],
+        stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    line = proc.stdout.readline()
+    assert "READY" in line, f"child failed to start: {line!r}"
+    return proc
+
+
+def _ops(log_path: str) -> list[int]:
+    if not os.path.exists(log_path):
+        return []
+    with open(log_path) as f:
+        return [int(line.split(":", 1)[0])
+                for line in f.read().splitlines() if line]
+
+
+def test_acked_ops_apply_once_across_process_restart(tmp_path):
+    async def go():
+        kr = Keyring()
+        key_cli = kr.add("client.r")
+        key_srv = kr.add("osd.9")
+        port = _free_port()
+        log_path = str(tmp_path / "applied.log")
+        procs = [_spawn(port, log_path, key_srv.hex(), key_cli.hex())]
+        client = None
+        try:
+            client = Messenger("client.r", keyring=kr)
+            client.set_policy("osd", Policy.lossless_peer())
+            addr = EntityAddr("127.0.0.1", port)
+            for i in range(1, 6):
+                await client.send_message(
+                    MRec(op=i, payload=bytes([i])), addr, "osd.9")
+            conn = client.conns[addr]
+
+            def drained():
+                sess = conn.session
+                pend = sess.unacked if sess is not None else conn.unacked
+                return not pend
+
+            # every op applied (fsync'd) AND acked back to us
+            await _wait(lambda: len(_ops(log_path)) >= 5)
+            await _wait(drained)
+            # crash honesty: SIGKILL — no handler, no graceful goodbye
+            procs[0].kill()
+            procs[0].wait()
+            # same name, same port, fresh memory, same durable log
+            procs.append(
+                _spawn(port, log_path, key_srv.hex(), key_cli.hex()))
+            for i in range(6, 11):
+                await client.send_message(
+                    MRec(op=i, payload=bytes([i])), addr, "osd.9")
+            await _wait(lambda: len(_ops(log_path)) >= 10)
+            ops = _ops(log_path)
+            assert sorted(ops) == list(range(1, 11)), (
+                f"acked ops must apply exactly once across the "
+                f"restart, got {sorted(ops)}")
+        finally:
+            if client is not None:
+                await client.shutdown()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+    asyncio.run(go())
