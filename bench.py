@@ -86,6 +86,20 @@ def ec_streaming_metric(resident_gibs: float | None) -> dict:
     return ec_streaming_section(resident_gibs=resident_gibs)
 
 
+def ec_daemon_path_metric() -> dict:
+    """Round-19 read-side data path: concurrent degraded-read decodes
+    through the ``osd/ec_read_aggregator`` (coalesced padded batched
+    decode launches vs the per-op ``osd_ec_read_agg=off`` baseline),
+    against the resident decode kernel rate. The claim the section
+    pins: the aggregated daemon-path rate lands within 2x of the
+    resident number on TPU (``daemon_within_2x_resident`` in the
+    compact tail; CPU boxes run a smoke size with the same schema and
+    an explicit asyncio-bound caveat)."""
+    from ceph_tpu.bench.ec_daemon_path import ec_daemon_path_section
+
+    return ec_daemon_path_section()
+
+
 def crush_metric() -> dict:
     """North-star #2: batched CRUSH mappings/s on a 10k-OSD straw2 map.
 
@@ -846,6 +860,11 @@ def main() -> None:
             ec_streaming_metric, enc.get("GiB/s"))
     except Exception:
         detail["ec_streaming_error"] = _short_err()
+    try:
+        detail["ec_daemon_path"] = _with_compile_split(
+            ec_daemon_path_metric)
+    except Exception:
+        detail["ec_daemon_path_error"] = _short_err()
     # The remote compile service intermittently drops the mapper's large
     # program on the first attempt; retry once after a cooldown.
     crush = None
@@ -979,6 +998,13 @@ def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
         out["ec_agg_GiBs"] = [ecs.get("per_op_GiBs"),
                               ecs.get("aggregated_GiBs"),
                               ecs.get("pipeline_GiBs")]
+    ecd = detail.get("ec_daemon_path")
+    if isinstance(ecd, dict):    # the round-19 read-side verdict
+        out["daemon_within_2x_resident"] = ecd.get(
+            "daemon_within_2x_resident")
+        out["ec_daemon_GiBs"] = [ecd.get("per_op_GiBs"),
+                                 ecd.get("read_agg_GiBs"),
+                                 ecd.get("resident_GiBs")]
     res = detail.get("device_resilience")
     if isinstance(res, dict):    # the round-16 fault-plane verdict
         out["resilience_within_noise"] = res.get(

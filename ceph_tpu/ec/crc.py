@@ -79,6 +79,63 @@ def row_crc_matrix(chunk_size: int) -> np.ndarray:
     return bits.reshape(8 * C, 32).astype(np.int8)
 
 
+_DEVICE_ROW_CRC_CACHE: dict[int, object] = {}
+
+
+def device_row_crcs(rows: np.ndarray) -> np.ndarray:
+    """ONE batched device CRC job: (R, C) uint8 rows -> (R,) uint32
+    raw row CRCs.
+
+    The standalone twin of the fused encode+crc pass — same 8-bit-plane
+    GF(2) matmul against ``row_crc_matrix(C)`` (plane b multiplies
+    ``G[b::8]``), jitted once per chunk size and accounted through
+    devmon as ``scrub_crc``. Deep scrub uses it to turn a whole
+    chunk-map sweep's per-object ``zlib.crc32`` calls into O(batches)
+    device launches; the per-shard fold back to zlib-equal values is
+    :func:`shard_crc32` (O(rows) host work)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.utils.devmon import devmon as _devmon
+
+    arr = np.ascontiguousarray(rows, dtype=np.uint8)
+    if arr.ndim != 2:
+        raise ValueError("device_row_crcs wants a (rows, C) batch")
+    C = int(arr.shape[1])
+    # pow2-pad the row axis (same discipline as the EC aggregators):
+    # scrub batches arrive at arbitrary per-PG row counts, and an
+    # unpadded launch would compile one program per count — padding
+    # bounds the jit cache at O(log max_rows) shapes per chunk size
+    R = int(arr.shape[0])
+    padded = 1 << (R - 1).bit_length() if R > 1 else 1
+    if padded != R:
+        arr = np.concatenate(
+            [arr, np.zeros((padded - R, C), dtype=np.uint8)])
+    fn = _DEVICE_ROW_CRC_CACHE.get(C)
+    if fn is None:
+        G = jnp.asarray(row_crc_matrix(C))                # (8C, 32) i8
+
+        def _kern(d):
+            # bit-plane at a time keeps the matmul operand at
+            # batch-bytes size (the naive 8C bit expansion is 8x)
+            acc = jnp.zeros((d.shape[0], 32), dtype=jnp.int32)
+            for b in range(8):
+                plane = ((d >> jnp.uint8(b)) &
+                         jnp.uint8(1)).astype(jnp.int8)
+                acc = acc + jnp.matmul(
+                    plane, G[b::8, :],
+                    preferred_element_type=jnp.int32)
+            bit32 = (acc & 1).astype(jnp.uint32)
+            weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+            return jnp.sum(bit32 * weights[None, :], axis=1,
+                           dtype=jnp.uint32)
+
+        fn = _DEVICE_ROW_CRC_CACHE[C] = jax.jit(_kern)
+    out = _devmon().jit_call("scrub_crc", (C, tuple(arr.shape)),
+                             fn, arr)
+    return np.asarray(out)[:R]
+
+
 @functools.lru_cache(maxsize=8)
 def _shift_columns(chunk_size: int) -> np.ndarray:
     """(32,) uint32-valued columns of M_C, the 'append C zero bytes'

@@ -190,6 +190,24 @@ class ErasureCodeInterface(ABC):
             out.append(np.stack([np.asarray(got[w]) for w in want]))
         return np.stack(out)
 
+    def decode_batch_reference(self, want: Sequence[int],
+                               avail: Sequence[int], chunks):
+        """(B, len(avail), C) -> (B, len(want), C) via a HOST-ONLY
+        path — no jit, no device, bit-exact with ``decode_batch`` by
+        construction. The last rung of the OSD read aggregator's
+        degrade ladder (osd/ec_read_aggregator): when the device
+        decode keeps failing, a degraded read is served from here
+        rather than erroring. Base: the per-stripe loop (still
+        host-only when ``decode_chunks`` is — device plugins MUST
+        override with a genuinely device-free implementation)."""
+        chunks = np.asarray(chunks)
+        out = []
+        for b in range(chunks.shape[0]):
+            got = self.decode_chunks(
+                list(want), {a: chunks[b, i] for i, a in enumerate(avail)})
+            out.append(np.stack([np.asarray(got[w]) for w in want]))
+        return np.stack(out)
+
     # -- byte-level API (base implements; harness-compatible) -------------
     def encode_prepare(self, data: bytes) -> np.ndarray:
         """Pad to k*chunk_size and carve into the (k, C) chunk array

@@ -108,6 +108,7 @@ class ErasureCodeJax(ErasureCodeInterface):
         self._bitmatrix = None
         self._encode_kernel = None
         self._decode_cache: dict[tuple, object] = {}
+        self._decode_ref_cache: dict[tuple, np.ndarray] = {}
         self._fused_crc_cache: dict[int, object] = {}
         if profile is not None:
             self.init(ErasureCodeProfile.parse(profile))
@@ -146,6 +147,7 @@ class ErasureCodeJax(ErasureCodeInterface):
             coeffs = rs.coding_matrix(self.technique, self.k, self.m)
             self._encode_kernel = _MatrixKernel(coeffs, self.backend)
         self._decode_cache.clear()
+        self._decode_ref_cache.clear()
         self._fused_crc_cache.clear()
         log.dout(5, "init", k=self.k, m=self.m, technique=self.technique,
                  backend=self.backend)
@@ -300,6 +302,183 @@ class ErasureCodeJax(ErasureCodeInterface):
             "ec_decode", (id(kern), tuple(chunks.shape)),
             kern.apply_batch, chunks)
 
+    def decode_batch_reference(self, want: Sequence[int],
+                               avail: Sequence[int], chunks):
+        """Host-only bit-exact reference decode — the last rung of the
+        OSD read aggregator's degrade ladder. Pure numpy, no jit, no
+        device: the same per-erasure-pattern matrix inversion the
+        device path caches, applied with ``gf_matmul_np`` (GF(2^8)
+        techniques) or the packet-plane XOR mirror (array codes).
+        (B, len(avail), C) uint8 -> (B, len(want), C)."""
+        chunks = np.ascontiguousarray(np.asarray(chunks), dtype=np.uint8)
+        B, _, C = chunks.shape
+        key = (tuple(avail), tuple(want))
+        d = self._decode_ref_cache.get(key)
+        if d is None:
+            if self._bitmatrix is not None:
+                from ceph_tpu.ec import bitmatrix as bmx
+                d = bmx.decode_bitmatrix(self._bitmatrix, self.k, self.m,
+                                         self.w, key[0], key[1])
+            else:
+                d = rs.decode_matrix(self.technique, self.k, self.m,
+                                     key[0], key[1])
+            self._decode_ref_cache[key] = np.asarray(d, dtype=np.uint8)
+            d = self._decode_ref_cache[key]
+        if self._bitmatrix is not None:
+            w = self.w
+            ps = C // w
+            bm = d != 0                        # (len(want)*w, len(avail)*w)
+            planes = chunks.reshape(B, -1, ps)
+            flat = planes.transpose(1, 0, 2).reshape(-1, B * ps)
+            out = np.zeros((bm.shape[0], B * ps), dtype=np.uint8)
+            for r in range(bm.shape[0]):
+                sel = flat[bm[r]]
+                if sel.shape[0]:
+                    out[r] = np.bitwise_xor.reduce(sel, axis=0)
+            ww = out.shape[0]
+            return out.reshape(ww, B, ps).transpose(1, 0, 2).reshape(
+                B, ww // w, C)
+        x = chunks.transpose(1, 0, 2)          # (len(avail), B, C)
+        return tables.gf_matmul_np(d, x).transpose(1, 0, 2)
+
+
+def _resident_perf():
+    """Per-OSD counter family for the hot-shard residency cache
+    (register=False: several in-process OSDs each own one; they reach
+    prometheus through the daemon->mgr report path as
+    ``ceph_osd_ec_resident_*`` rows)."""
+    from ceph_tpu.utils.perf_counters import PerfCountersBuilder
+    return (
+        PerfCountersBuilder("osd_ec_resident")
+        .add_u64_counter("hits",
+                         "gathers served from the device-resident "
+                         "cache (no subreads, no decode, no H2D)")
+        .add_u64_counter("misses", "gathers that went to the shards")
+        .add_u64_counter("inserts", "stripe ranges staged resident")
+        .add_u64_counter("evictions",
+                         "LRU evictions under osd_ec_resident_bytes")
+        .add_u64_counter("invalidations",
+                         "entries dropped by a write to their object")
+        .add_u64_counter("rejected",
+                         "ranges larger than the whole budget, never "
+                         "cached")
+        .add_u64("resident_bytes", "bytes currently resident (gauge)")
+        .add_u64("entries", "entries currently resident (gauge)")
+        .create_perf_counters(register=False))
+
+
+class DeviceShardCache:
+    """Bounded device-side LRU of gathered stripe ranges — hot-shard
+    residency for the OSD data path (round 19).
+
+    A read-modify-write or a repeated degraded read used to re-gather
+    the same stripes (k subread round-trips + a decode + an H2D stage)
+    every time. This cache pins the gathered (count, k, C) batch in
+    device memory under an ``osd_ec_resident_bytes`` budget, keyed by
+    (PG, object, stripe range, object VERSION) — the same write-time
+    ``_v`` discipline the shards carry, so any write bumps the version
+    and makes every cached generation of that object unreachable.
+    Explicit ``invalidate`` on sub-write apply reclaims those dead
+    entries eagerly instead of waiting for LRU pressure.
+
+    Entries are immutable by contract: ``get`` returns the stored
+    device array; callers read through ``np.asarray`` or feed it to a
+    device kernel, never mutate it in place.
+    """
+
+    def __init__(self, config: dict | None = None):
+        self.config = config if config is not None else {}
+        self.perf = _resident_perf()
+        # key -> (device array, nbytes); insertion order = LRU order
+        self._lru: "dict[tuple, tuple[object, int]]" = {}
+        self._bytes = 0
+
+    # knobs (read LIVE: shrinking the budget takes effect on the next
+    # insert's eviction sweep; 0 disables lookups AND inserts)
+    def budget(self) -> int:
+        return int(self.config.get("osd_ec_resident_bytes", 64 << 20))
+
+    def enabled(self) -> bool:
+        return self.budget() > 0
+
+    def get(self, key: tuple):
+        if not self.enabled():
+            return None
+        ent = self._lru.get(key)
+        if ent is None:
+            self.perf.inc("misses")
+            return None
+        # move-to-end = most recently used
+        del self._lru[key]
+        self._lru[key] = ent
+        self.perf.inc("hits")
+        return ent[0]
+
+    def put(self, key: tuple, host_array) -> None:
+        if not self.enabled() or key in self._lru:
+            return
+        # explicit copy: jax.device_put may alias an aligned host
+        # buffer on the CPU backend, and callers keep (and may write
+        # through copies of) the array they handed us
+        arr = np.array(host_array, dtype=np.uint8, order="C")
+        nbytes = int(arr.nbytes)
+        budget = self.budget()
+        if nbytes > budget:
+            self.perf.inc("rejected")
+            return
+        while self._bytes + nbytes > budget and self._lru:
+            old_key = next(iter(self._lru))
+            _, old_n = self._lru.pop(old_key)
+            self._bytes -= old_n
+            self.perf.inc("evictions")
+        try:
+            dev = jax.device_put(arr)
+        except Exception as e:
+            log.dout(1, f"resident cache device_put failed "
+                        f"({type(e).__name__}: {str(e)[:200]})")
+            return
+        self._lru[key] = (dev, nbytes)
+        self._bytes += nbytes
+        self.perf.inc("inserts")
+        self._gauges()
+
+    def invalidate(self, *prefix) -> int:
+        """Drop every entry whose key starts with ``prefix`` (e.g.
+        (pgid, oid) on a sub-write apply). Version-keying already makes
+        stale generations unreachable; this reclaims their bytes."""
+        n = 0
+        for key in [k for k in self._lru if k[:len(prefix)] == prefix]:
+            _, nbytes = self._lru.pop(key)
+            self._bytes -= nbytes
+            n += 1
+        if n:
+            self.perf.inc("invalidations", n)
+            self._gauges()
+        return n
+
+    def clear(self) -> None:
+        self._lru.clear()
+        self._bytes = 0
+        self._gauges()
+
+    def _gauges(self) -> None:
+        self.perf.set("resident_bytes", self._bytes)
+        self.perf.set("entries", len(self._lru))
+
+    def dump(self) -> dict:
+        d = self.perf.dump()
+        return {
+            "enabled": self.enabled(),
+            "budget_bytes": self.budget(),
+            "resident_bytes": self._bytes,
+            "entries": len(self._lru),
+            "hits": d.get("hits", 0),
+            "misses": d.get("misses", 0),
+            "inserts": d.get("inserts", 0),
+            "evictions": d.get("evictions", 0),
+            "invalidations": d.get("invalidations", 0),
+        }
+
 
 class StreamingEncodePipeline:
     """Double-buffered H2D/D2H streaming encode.
@@ -421,3 +600,26 @@ class StreamingEncodePipeline:
 
     def encode_all(self, batches) -> list:
         return list(self.encode_iter(batches))
+
+    def encode_payload_iter(self, payloads, k: int, chunk_size: int):
+        """Messenger-ingest handoff: wire-frame payload buffers in,
+        parity out, with NO intermediate host staging copy.
+
+        Each payload is whatever the messenger delivered for a write —
+        ``bytes`` or, on the zero-copy decode path (denc blob_view), a
+        ``memoryview`` over the received frame — whose length is a
+        multiple of the stripe width k*chunk_size. ``np.frombuffer``
+        wraps the buffer in place and the reshape is a view, so the
+        bytes go wire frame -> H2D stage (encode_iter's device_put)
+        directly; the old path staged a full ``bytes`` copy first."""
+        W = k * chunk_size
+
+        def _carve():
+            for p in payloads:
+                arr = np.frombuffer(p, dtype=np.uint8)
+                if arr.size % W:
+                    raise ValueError(
+                        f"payload of {arr.size} bytes is not a whole "
+                        f"number of {W}-byte stripes")
+                yield arr.reshape(-1, k, chunk_size)
+        return self.encode_iter(_carve())

@@ -199,6 +199,15 @@ class Decoder:
     def blob(self) -> bytes:
         return bytes(self._take(self.u32()))
 
+    def blob_view(self) -> memoryview:
+        """Zero-copy blob: a view over the decoder's buffer instead of
+        a bytes copy. For bulk payloads (EC write data) the view rides
+        the received wire frame all the way into ``np.frombuffer`` —
+        no host staging copy between the messenger and the device
+        transfer. Holding the view keeps the whole frame alive; copy
+        (``bytes(v)``) anything retained past the op."""
+        return self._take(self.u32())
+
     def string(self) -> str:
         return self.blob().decode("utf-8")
 

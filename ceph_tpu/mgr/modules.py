@@ -491,9 +491,12 @@ class PrometheusModule(MgrModule):
             lines.append("# ceph_perf: from daemon report sessions")
             for daemon, loggers in reported.items():
                 for logger, counters in loggers.items():
-                    if logger in ("osd_ec_agg", "devmon",
+                    if logger in ("osd_ec_agg", "osd_ec_read_agg",
+                                  "osd_ec_resident", "devmon",
                                   "device_runtime"):
-                        # dedicated ceph_osd_ec_agg_* / ceph_device_*
+                        # dedicated ceph_osd_ec_agg_* /
+                        # ceph_osd_ec_read_agg_* /
+                        # ceph_osd_ec_resident_* / ceph_device_*
                         # rows below — rendering them here too would
                         # double the family's cardinality every scrape
                         continue
@@ -526,6 +529,33 @@ class PrometheusModule(MgrModule):
                 lines.append("# ceph_osd_ec_agg_*: EC encode "
                              "aggregator (reported)")
                 lines += agg_rows
+            # per-OSD EC read-side rows (round 19): the decode/repair
+            # aggregator and the hot-shard residency cache, same
+            # report-session discipline as ceph_osd_ec_agg_* (both
+            # families are register=False per-daemon)
+            for fam, head in (("osd_ec_read_agg",
+                               "# ceph_osd_ec_read_agg_*: EC "
+                               "decode/repair aggregator (reported)"),
+                              ("osd_ec_resident",
+                               "# ceph_osd_ec_resident_*: hot-shard "
+                               "residency cache (reported)")):
+                fam_rows: list[str] = []
+                for daemon, loggers in sorted(reported.items()):
+                    cs = loggers.get(fam)
+                    if not cs:
+                        continue
+                    for key, val in sorted(cs.items()):
+                        if isinstance(val, dict) and "avgcount" in val:
+                            val = (val["sum"] / val["avgcount"]
+                                   if val["avgcount"] else 0.0)
+                        if isinstance(val, (int, float)):
+                            fam_rows.append(
+                                f'ceph_{fam}_{key}'
+                                f'{{ceph_daemon="{daemon}"}} '
+                                f'{val:.9g}')
+                if fam_rows:
+                    lines.append(head)
+                    lines += fam_rows
             # device-runtime plane (round 14): dedicated ceph_device_*
             # rows from the REPORTED state — per-daemon kernel-path
             # health (the `devmon` family) and the process monitor's

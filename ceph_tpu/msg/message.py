@@ -34,11 +34,13 @@ _ENC: dict[str, Callable] = {
     "f64": lambda e, v: e.f64(v),
     "bool": lambda e, v: e.bool(v), "str": lambda e, v: e.string(v),
     "blob": lambda e, v: e.blob(v),
+    "blob_view": lambda e, v: e.blob(v),
     "list:s32": lambda e, v: e.list(v, lambda e, x: e.s32(x)),
     "list:u32": lambda e, v: e.list(v, lambda e, x: e.u32(x)),
     "list:u64": lambda e, v: e.list(v, lambda e, x: e.u64(x)),
     "list:str": lambda e, v: e.list(v, lambda e, x: e.string(x)),
     "list:blob": lambda e, v: e.list(v, lambda e, x: e.blob(x)),
+    "list:blob_view": lambda e, v: e.list(v, lambda e, x: e.blob(x)),
     "map:str:str": lambda e, v: e.map(v, lambda e, k: e.string(k),
                                       lambda e, x: e.string(x)),
     "map:str:u64": lambda e, v: e.map(v, lambda e, k: e.string(k),
@@ -57,11 +59,17 @@ _DEC: dict[str, Callable] = {
     "f64": lambda d: d.f64(),
     "bool": lambda d: d.bool(), "str": lambda d: d.string(),
     "blob": lambda d: d.blob(),
+    # zero-copy on decode (the encode side is plain blob): bulk
+    # payloads arrive as memoryviews over the wire frame and feed
+    # np.frombuffer / the streaming encode pipeline without a host
+    # staging copy
+    "blob_view": lambda d: d.blob_view(),
     "list:s32": lambda d: d.list(lambda d: d.s32()),
     "list:u32": lambda d: d.list(lambda d: d.u32()),
     "list:u64": lambda d: d.list(lambda d: d.u64()),
     "list:str": lambda d: d.list(lambda d: d.string()),
     "list:blob": lambda d: d.list(lambda d: d.blob()),
+    "list:blob_view": lambda d: d.list(lambda d: d.blob_view()),
     "map:str:str": lambda d: d.map(lambda d: d.string(),
                                    lambda d: d.string()),
     "map:str:u64": lambda d: d.map(lambda d: d.string(),
@@ -88,7 +96,7 @@ def _zero(codec: str):
         return False
     if base == "str":
         return ""
-    if base == "blob":
+    if base in ("blob", "blob_view"):
         return b""
     if base == "list":
         return []

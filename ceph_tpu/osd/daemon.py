@@ -187,8 +187,11 @@ class OSD(Dispatcher):
         from ceph_tpu.mgr.client import MgrReporter
         self._mgr_reporter = MgrReporter(
             name, self.msgr, lambda: self.monc.mgrmap,
-            lambda: [self.perf, self.ec_agg.perf, self.devmon.perf,
-                     self._proc_devmon.perf], cfg)
+            lambda: [self.perf, self.ec_agg.perf,
+                     self.ec_read_agg.perf,
+                     *([self.ec_resident.perf]
+                       if self.ec_resident is not None else []),
+                     self.devmon.perf, self._proc_devmon.perf], cfg)
         self._mgr_report_task: asyncio.Task | None = None
         self._slow_reported = 0     # last slow-op count sent monward
         self._device_reported: dict = {}   # last device_health sent
@@ -211,6 +214,23 @@ class OSD(Dispatcher):
         # kernel launch per flush window (osd_ec_agg knobs, read LIVE)
         from ceph_tpu.osd.ec_aggregator import ECAggregator
         self.ec_agg = ECAggregator(cfg)
+        # EC decode/repair aggregator (round 19): the read-side twin —
+        # degraded reads and recovery rebuilds from every ECPG coalesce
+        # into one padded decode launch per flush window
+        # (osd_ec_read_agg knobs, read LIVE); repair decodes charge the
+        # scheduler's `recovery` class so a degraded-read storm can't
+        # bypass QoS cost tags
+        from ceph_tpu.osd.ec_read_aggregator import ECReadAggregator
+        self.ec_read_agg = ECReadAggregator(cfg,
+                                            scheduler=self.scheduler)
+        # hot-shard residency (round 19): gathered shard batches pin
+        # device-side under osd_ec_resident_bytes, version-keyed so
+        # writes invalidate by construction (None when disabled —
+        # ec_pg probes with getattr)
+        self.ec_resident = None
+        if int(cfg.get("osd_ec_resident_bytes", 0)) > 0:
+            from ceph_tpu.ec.jax_plugin import DeviceShardCache
+            self.ec_resident = DeviceShardCache(cfg)
         # recovery QoS: PR 2's side token bucket folded in as the
         # scheduler's `recovery` class (SchedulerThrottle keeps the
         # acquire/release shape every PG call site uses)
@@ -431,6 +451,10 @@ class OSD(Dispatcher):
                         "backfill_toofull": self.backfill_toofull()},
                     "mapping": self._mapping_status(),
                     "ec_agg": self.ec_agg.dump(),
+                    "ec_read_agg": self.ec_read_agg.dump(),
+                    "ec_resident": (self.ec_resident.dump()
+                                    if self.ec_resident is not None
+                                    else {"enabled": False}),
                     "device": self._device_status(),
                     "mgr_session": self._mgr_reporter.dump()},
                 "osd state summary")
@@ -577,6 +601,9 @@ class OSD(Dispatcher):
                 task.cancel()
         self.scheduler.drain(release=self._release_admission)
         self.ec_agg.drain()
+        self.ec_read_agg.drain()
+        if self.ec_resident is not None:
+            self.ec_resident.clear()
         for pg in self.pgs.values():
             pg._drain_op_queue()
         if self.asok:
@@ -1287,9 +1314,13 @@ class OSD(Dispatcher):
                 # this OSD served from the reference encoder after
                 # device retries exhausted (round 16)
                 agg = self.ec_agg.perf.dump()
-                dh["ec_fallback_ops"] = int(agg.get("fallback_ops", 0))
+                ragg = self.ec_read_agg.perf.dump()
+                dh["ec_fallback_ops"] = int(
+                    agg.get("fallback_ops", 0)) + int(
+                    ragg.get("fallback_ops", 0))
                 dh["ec_flush_failures"] = int(
-                    agg.get("flush_failures", 0))
+                    agg.get("flush_failures", 0)) + int(
+                    ragg.get("flush_failures", 0))
                 # keep reporting until a zero count has been sent: a
                 # daemon whose slow ops drained (or whose capacity
                 # went back to unbounded) while it held no primary

@@ -43,7 +43,7 @@ class ECBackendLite:
     """Striped EC object store over one PG's shard set."""
 
     def __init__(self, ec: ErasureCodeInterface, chunk_size: int = 4096,
-                 name: str = "ec_backend"):
+                 name: str = "ec_backend", config: dict | None = None):
         self.ec = ec
         self.k = ec.get_data_chunk_count()
         self.m = ec.get_coding_chunk_count()
@@ -53,6 +53,16 @@ class ECBackendLite:
         self.shards: dict[int, dict[str, np.ndarray]] = {
             s: {} for s in range(self.n)}
         self.sizes: dict[str, int] = {}     # logical object sizes
+        # hot-shard residency (round 19): gathered stripe ranges pin
+        # device-side under osd_ec_resident_bytes, keyed by a per-oid
+        # generation the mutators bump — RMW and repeated reads skip
+        # the re-gather + H2D leg
+        self.resident = None
+        self._gen: dict[str, int] = {}
+        if config is not None and \
+                int(config.get("osd_ec_resident_bytes", 0)) > 0:
+            from ceph_tpu.ec.jax_plugin import DeviceShardCache
+            self.resident = DeviceShardCache(config)
         self.perf = (PerfCountersBuilder(name)
                      .add_u64_counter("write_bytes", "logical bytes written")
                      .add_u64_counter("rmw_stripes", "stripes read-modified")
@@ -92,6 +102,27 @@ class ECBackendLite:
                 out[:hi - first, c] = store[first:hi]
         return out
 
+    def _resident_read(self, oid: str, first: int,
+                       count: int) -> np.ndarray:
+        """_read_stripes through the residency cache: a hit returns
+        the device-pinned batch (no shard walk); a miss gathers and
+        pins. Generation-keyed, so every mutator's bump makes stale
+        entries unreachable."""
+        if self.resident is None:
+            return self._read_stripes(oid, first, count)
+        key = (oid, int(first), int(count), self._gen.get(oid, 0))
+        hit = self.resident.get(key)
+        if hit is not None:
+            return np.asarray(hit)
+        out = self._read_stripes(oid, first, count)
+        self.resident.put(key, out)
+        return out
+
+    def _bump_gen(self, oid: str) -> None:
+        self._gen[oid] = self._gen.get(oid, 0) + 1
+        if self.resident is not None:
+            self.resident.invalidate(oid)
+
     def _any_shard(self) -> set[str]:
         names: set[str] = set()
         for s in range(self.n):
@@ -110,13 +141,15 @@ class ECBackendLite:
             return
         first, count = self.sinfo.stripe_range(offset, len(data))
         W = self.sinfo.stripe_width
-        stripes = self._read_stripes(oid, first, count)      # old contents
+        stripes = self._resident_read(oid, first, count)     # old contents
         partial_head = offset % W != 0
         partial_tail = (offset + len(data)) % W != 0
         if partial_head or partial_tail:
             self.perf.inc("rmw_stripes", count)
-        # merge new bytes into the logical view
-        flat = stripes.reshape(count, self.k * self.sinfo.chunk_size)
+        # merge new bytes into the logical view (own copy: a resident
+        # hit's array is immutable by the cache contract)
+        flat = np.array(stripes, dtype=np.uint8).reshape(
+            count, self.k * self.sinfo.chunk_size)
         lo = offset - first * W
         flat.reshape(-1)[lo:lo + len(data)] = np.frombuffer(data, np.uint8)
         merged = flat.reshape(count, self.k, self.sinfo.chunk_size)
@@ -132,6 +165,7 @@ class ECBackendLite:
             arr = self._shard_array(self.k + p, oid, n_stripes_total)
             arr[first:first + count] = parity[:, p]
         self.sizes[oid] = max(self.sizes.get(oid, 0), offset + len(data))
+        self._bump_gen(oid)
 
     def read(self, oid: str, offset: int, length: int) -> bytes:
         """ref: ECBackend::objects_read_sync (aligned read + trim)."""
@@ -140,7 +174,7 @@ class ECBackendLite:
         if length <= 0:
             return b""
         first, count = self.sinfo.stripe_range(offset, length)
-        stripes = self._read_stripes(oid, first, count)
+        stripes = self._resident_read(oid, first, count)
         flat = stripes.reshape(-1)
         lo = offset - first * self.sinfo.stripe_width
         return flat[lo:lo + length].tobytes()
@@ -150,9 +184,12 @@ class ECBackendLite:
         """Failure injection: drop one object's shard (or the whole
         shard's contents)."""
         if oid is None:
+            for o in list(self.shards[shard]):
+                self._bump_gen(o)
             self.shards[shard].clear()
         else:
             self.shards[shard].pop(oid, None)
+            self._bump_gen(oid)
 
     def missing_shards(self, oid: str) -> set[int]:
         return {s for s in range(self.n) if oid not in self.shards[s]}
@@ -183,6 +220,7 @@ class ECBackendLite:
         out = np.asarray(self.ec.decode_batch(want, reads, chunks))
         for i, s in enumerate(want):
             self.shards[s][oid] = out[:, i].copy()
+        self._bump_gen(oid)
         self.perf.inc("recover_chunks", len(want) * n_stripes)
         log.dout(5, "recovered", oid=oid, lost=want, read=reads)
         return lost

@@ -213,7 +213,8 @@ class ECPG(PG):
 
     async def _gather(self, oid: str, first: int, count: int,
                       version: eversion,
-                      exclude_osds: frozenset = frozenset()):
+                      exclude_osds: frozenset = frozenset(),
+                      repair: bool = False):
         """Collect this stripe range's chunks from live, fresh shards
         and reconstruct data chunks 0..k-1 -> (count, k, C) uint8.
 
@@ -232,9 +233,29 @@ class ECPG(PG):
 
         ``exclude_osds``: OSDs never used as sources — a holder whose
         shard is being rebuilt (missing, stale, scrub-flagged) must
-        not contribute to its own reconstruction."""
+        not contribute to its own reconstruction.
+
+        ``repair``: this gather feeds a shard REBUILD (recovery /
+        backfill), not a client read — its decode pays a recovery-
+        class QoS grant inside the read aggregator (client reads were
+        already cost-tagged at admission).
+
+        Hot-shard residency (round 19): when the OSD carries a
+        DeviceShardCache, the gathered batch is pinned device-side
+        keyed by (pg, oid, range, VERSION) — a repeat gather of the
+        same generation skips the subreads, the decode and the H2D
+        stage entirely. Never consulted or fed under ``exclude_osds``
+        (a rebuild's source constraints are not the cache's)."""
         C = self.sinfo.chunk_size
         off, ln = first * C, count * C
+        cache = getattr(self.osd, "ec_resident", None)
+        ckey = None
+        if cache is not None and not exclude_osds:
+            ckey = (str(self.cid), oid, int(first), int(count),
+                    _vblob(version))
+            hit = cache.get(ckey)
+            if hit is not None:
+                return np.asarray(hit)
         avail: dict[int, np.ndarray] = {}
         for slot, osd_id in enumerate(self.acting):
             # stop once decodable: all data positions in hand, or any
@@ -270,8 +291,15 @@ class ECPG(PG):
             avail[pos] = chunk.reshape(count, C)
         want = set(range(self.k))
         if want <= set(avail):
-            return np.stack([avail[c] for c in range(self.k)], axis=1)
-        # degraded: decode missing data chunks from what we have
+            out = np.stack([avail[c] for c in range(self.k)], axis=1)
+            if ckey is not None:
+                cache.put(ckey, out)
+            return out
+        # degraded: decode missing data chunks from what we have —
+        # routed through the OSD's cross-op read aggregator, which
+        # coalesces concurrent decodes from every PG on this OSD into
+        # one padded batched launch per flush window (per-op path
+        # behind osd_ec_read_agg=off)
         try:
             need = self.ec.minimum_to_decode(want, list(avail))
         except ValueError:
@@ -283,13 +311,16 @@ class ECPG(PG):
         use = sorted(need)
         stacked = np.stack([avail[c] for c in use], axis=1)
         missing = sorted(want - set(avail))
-        decoded = self.ec.decode_batch(missing, use, stacked)
+        decoded = await self._agg_decode(missing, use, stacked,
+                                         repair=repair)
         out = np.zeros((count, self.k, C), dtype=np.uint8)
         for c in range(self.k):
             if c in avail:
                 out[:, c] = avail[c]
             else:
                 out[:, c] = np.asarray(decoded[:, missing.index(c)])
+        if ckey is not None:
+            cache.put(ckey, out)
         return out
 
     # -- client op execution ----------------------------------------------
@@ -366,9 +397,11 @@ class ECPG(PG):
                                     store.list_objects(self.cid)
                                     if o != PGMETA]
             elif code == OSD_OP_WRITE:
-                edits.append((off, bytes(data)))
+                # keep the frame view: the bytes land in np.frombuffer
+                # at the RMW carve, no host staging copy in between
+                edits.append((off, data))
             elif code == OSD_OP_WRITEFULL:
-                write_full = bytes(data)
+                write_full = data
             elif code == OSD_OP_ZERO:
                 edits.append((off, b"\x00" * length))
             elif code == OSD_OP_TRUNCATE:
@@ -637,6 +670,11 @@ class ECPG(PG):
     # -- sub-op handling (shard side) --------------------------------------
     def _apply_sub_write(self, m: MOSDECSubOpWrite,
                          local: bool = False) -> int:
+        # hot-shard residency: this object's cached generations are
+        # already unreachable (version-keyed), reclaim their bytes now
+        cache = getattr(self.osd, "ec_resident", None)
+        if cache is not None:
+            cache.invalidate(str(self.cid), m.oid)
         t = Transaction()
         C = self.sinfo.chunk_size
         if m.remove:
@@ -780,6 +818,23 @@ class ECPG(PG):
                 (None if crcs is None else np.asarray(crcs))
         return np.asarray(self.ec.encode_batch(data_chunks)), None
 
+    async def _agg_decode(self, want, avail, chunks,
+                          repair: bool = False):
+        """Every ECPG decode routes through the OSD's cross-op read
+        aggregator (osd/ec_read_aggregator.py); the per-op launch
+        survives behind ``osd_ec_read_agg=off`` inside it. Bare
+        harnesses without a daemon aggregator take a direct call.
+        ``repair`` decodes charge a recovery-class size-scaled QoS
+        grant inside the aggregator — client degraded reads pass
+        False (their cost tag was paid at admission). Returns
+        np (B, len(want), C)."""
+        agg = getattr(self.osd, "ec_read_agg", None)
+        if agg is not None:
+            return await agg.decode(
+                self.ec, want, avail, chunks,
+                charge_bytes=int(chunks.nbytes) if repair else 0)
+        return np.asarray(self.ec.decode_batch(want, avail, chunks))
+
     async def _rebuild_shard(self, oid: str, shard: int, ver: eversion,
                              size: int, apply_local: bool = False,
                              exclude_osds: frozenset = frozenset()
@@ -795,7 +850,8 @@ class ECPG(PG):
         # position: after an interval shuffle another holder may
         # legitimately carry this position's bytes.)
         data_chunks = await self._gather(oid, 0, count, ver,
-                                         exclude_osds=exclude_osds)
+                                         exclude_osds=exclude_osds,
+                                         repair=True)
         if shard < self.k:
             shard_bytes = data_chunks[:, shard, :].tobytes()
             hcrc = ec_crc.hcrc_attr(shard_bytes)

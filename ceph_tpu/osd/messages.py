@@ -62,7 +62,10 @@ class MOSDOp(Message):
         ("pool", "s64"), ("seed", "u32"), ("oid", "str"),
         ("op_codes", "list:u32"), ("op_offs", "list:u64"),
         ("op_lens", "list:u64"), ("op_names", "list:str"),
-        ("op_datas", "list:blob"),
+        # zero-copy decode: write payloads arrive as memoryviews over
+        # the wire frame and ride into np.frombuffer / the EC encode
+        # carve without a host staging copy (encode side unchanged)
+        ("op_datas", "list:blob_view"),
         # self-managed snap context (ref: SnapContext in MOSDOp):
         # writes carry (snap_seq, snaps) for clone-on-write; reads
         # carry snap_id (0 = head)

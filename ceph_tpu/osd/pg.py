@@ -1716,11 +1716,12 @@ class PG:
                 deleted = True
             elif code == OSD_OP_SETXATTR:
                 t.touch(cid, oid)
-                t.setattrs(cid, oid, {name: data})
+                # attrs persist past the op: copy out of the frame view
+                t.setattrs(cid, oid, {name: bytes(data)})
                 mutated = True
             elif code == OSD_OP_OMAP_SET:
                 t.touch(cid, oid)
-                t.omap_setkeys(cid, oid, {name: data})
+                t.omap_setkeys(cid, oid, {name: bytes(data)})
                 mutated = True
             elif code == OSD_OP_OMAP_RM:
                 if not store.exists(cid, oid):

@@ -181,6 +181,32 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
            "stripes that force an immediate aggregator flush (the "
            "batch-size ceiling; also bounds the padded launch's "
            "memory)", min=1),
+    # EC read/repair aggregator (round 19; the decode twin of the
+    # round-13 encode aggregator, osd/ec_read_aggregator.py). Read
+    # LIVE per decode, so osd_ec_read_agg=false flips a running OSD
+    # to the measured per-op decode baseline.
+    Option("osd_ec_read_agg", bool, True,
+           "coalesce concurrent EC degraded-read / repair decodes "
+           "from all PGs on this OSD into one padded batched decode "
+           "launch per flush window; false = the per-op-launch "
+           "baseline path"),
+    Option("osd_ec_read_agg_window_us", float, 500.0,
+           "EC read aggregator flush window in microseconds — the "
+           "hard bound on how long a lone degraded read's decode may "
+           "wait for company", min=0.0),
+    Option("osd_ec_read_agg_max_stripes", int, 4096,
+           "stripes that force an immediate read-aggregator flush "
+           "(the decode batch-size ceiling; also bounds the padded "
+           "launch's memory)", min=1),
+    # hot-shard residency (round 19): bounded device-side cache of
+    # gathered stripe batches so RMW and repeated degraded reads skip
+    # the host gather + H2D leg; entries are version-keyed, so any
+    # write to the object range makes the cached generation
+    # unreachable (plus an explicit invalidate on apply).
+    Option("osd_ec_resident_bytes", int, 64 << 20,
+           "per-OSD byte budget for the device-resident hot-shard "
+           "cache (LRU by PG/object range, version-keyed "
+           "invalidation); 0 disables residency", min=0),
     Option("osd_qos_backlog_cap", int, 4096,
            "OSD-wide admission backlog bound across ALL tenants "
            "(per-tenant queues are capped by osd_pg_op_queue_cap; "

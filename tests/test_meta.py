@@ -199,7 +199,10 @@ def _sample(codec: str, i: int):
         return i % 2 == 0
     if base == "str":
         return f"s{i}"
-    if base == "blob":
+    if base in ("blob", "blob_view"):
+        # blob_view is wire-identical to blob (round 19's zero-copy
+        # ingest changes the DECODE side only), so the golden hex for
+        # a field that flips codecs must not move
         return bytes([i % 256, 0x5A])
     if base == "list":
         return [_sample(rest, i), _sample(rest, i + 1)]
@@ -368,6 +371,20 @@ def _render_prometheus(reported: bool = False) -> str:
                    .add_u64_counter("stripes", "guard fixture")
                    .add_time_avg("batch_occupancy", "guard fixture")
                    .create_perf_counters(register=False))
+            # the round-19 read-side families reach /metrics the same
+            # report-session-only way (decode aggregator + hot-shard
+            # residency) — seed both so the dedicated
+            # ceph_osd_ec_read_agg_* / ceph_osd_ec_resident_* render
+            # paths stay inside the exposition-format guards
+            ragg = (PerfCountersBuilder("osd_ec_read_agg")
+                    .add_u64_counter("batches", "guard fixture")
+                    .add_u64_counter("qos_grants", "guard fixture")
+                    .add_time_avg("batch_occupancy", "guard fixture")
+                    .create_perf_counters(register=False))
+            res = (PerfCountersBuilder("osd_ec_resident")
+                   .add_u64_counter("hits", "guard fixture")
+                   .add_u64("resident_bytes", "guard fixture")
+                   .create_perf_counters(register=False))
             # the round-14 device-runtime families reach /metrics the
             # same report-session-only way (per-daemon `devmon`
             # path-health counters + the process `device_runtime`
@@ -384,7 +401,8 @@ def _render_prometheus(reported: bool = False) -> str:
                   .add_time("jit_compile_seconds", "guard fixture")
                   .add_u64_counter("h2d_bytes", "guard fixture")
                   .create_perf_counters(register=False))
-            idx.report(name, 1, schema_entries([pc, agg, dd, dp]),
+            idx.report(name, 1,
+                       schema_entries([pc, agg, ragg, res, dd, dp]),
                        1.0, {
                 name: {
                     "ops": 7,
@@ -397,6 +415,12 @@ def _render_prometheus(reported: bool = False) -> str:
                     "batches": 3, "stripes": 96,
                     "batch_occupancy": {"avgcount": 3,
                                         "sum": 96.0}},
+                "osd_ec_read_agg": {
+                    "batches": 2, "qos_grants": 4,
+                    "batch_occupancy": {"avgcount": 2,
+                                        "sum": 24.0}},
+                "osd_ec_resident": {
+                    "hits": 9, "resident_bytes": 8192},
                 "devmon": {
                     "path_checks": 12, "path_mismatch": 4,
                     "launches_pallas": 8, "launches_xla": 4},
@@ -445,6 +469,22 @@ def _render_prometheus(reported: bool = False) -> str:
             '{ceph_daemon="osd.0"} 5' in text, text
         assert 'counter="devmon.' not in text, text
         assert 'counter="device_runtime.' not in text, text
+        # round 19: the read-side aggregator + residency rows render
+        # from reported state through their dedicated blocks (counters
+        # plain, time-avgs as their long-run mean), never doubled via
+        # the generic ceph_perf render
+        assert 'ceph_osd_ec_read_agg_batches' \
+            '{ceph_daemon="osd.0"} 2' in text, text
+        assert 'ceph_osd_ec_read_agg_qos_grants' \
+            '{ceph_daemon="osd.1"} 4' in text, text
+        assert 'ceph_osd_ec_read_agg_batch_occupancy' \
+            '{ceph_daemon="osd.0"} 12' in text, text
+        assert 'ceph_osd_ec_resident_hits' \
+            '{ceph_daemon="osd.1"} 9' in text, text
+        assert 'ceph_osd_ec_resident_resident_bytes' \
+            '{ceph_daemon="osd.0"} 8192' in text, text
+        assert 'counter="osd_ec_read_agg.' not in text, text
+        assert 'counter="osd_ec_resident.' not in text, text
     return text
 
 
@@ -656,6 +696,19 @@ def test_ec_agg_knobs_registered_with_defaults():
     _assert_knobs_registered(("osd_ec_agg",), "EC aggregator")
 
 
+def test_ec_read_agg_knobs_registered_with_defaults():
+    """Round 19: every read-side data-plane knob — the decode/repair
+    aggregator's (`osd_ec_read_agg*`) and the hot-shard residency
+    budget (`osd_ec_resident*`) — read anywhere must be a registered
+    Option with a default. The aggregator reads them LIVE per decode
+    (the off-flip is a runtime bypass) and the residency cache per
+    budget check, so an unregistered knob silently diverges from
+    `config show`."""
+    _assert_knobs_registered(
+        ("osd_ec_read_agg", "osd_ec_resident"),
+        "EC read aggregator / residency")
+
+
 def test_ec_streaming_bench_schema():
     """The round-13 `ec_streaming` bench section at a smoke size:
     JSON-clean, carries every driver-required key (the three measured
@@ -670,8 +723,25 @@ def test_ec_streaming_bench_schema():
                 "resident_GiBs"):
         assert isinstance(rec[key], float) and rec[key] > 0, key
     assert isinstance(rec["ec_agg_within_2x"], bool)
-    assert rec["agg_batches"] >= 1
+
+
+def test_ec_daemon_path_bench_schema():
+    """The round-19 `ec_daemon_path` bench section at a smoke size:
+    JSON-clean, carries every driver-required key (the per-op
+    baseline, the aggregated daemon path, the resident reference, and
+    the `daemon_within_2x_resident` verdict), the verdict is a real
+    bool, and at least one coalesced batch launched. The within-2x
+    CLAIM is pinned on TPU only (CPU legs are asyncio-dispatch-bound
+    and say so via `cpu_caveat`); this guard pins the shape."""
+    from ceph_tpu.bench.ec_daemon_path import ec_daemon_path_section
+    rec = ec_daemon_path_section(n_ops=4, stripes_per_op=2,
+                                 chunk_size=128, k=2, m=1, reps=1)
+    for key in ("per_op_GiBs", "read_agg_GiBs", "resident_GiBs"):
+        assert isinstance(rec[key], float) and rec[key] > 0, key
+    assert isinstance(rec["daemon_within_2x_resident"], bool)
+    assert rec["read_agg_batches"] >= 1
     assert rec["n_ops"] == 4 and rec["k"] == 2 and rec["m"] == 1
+    import json
     assert json.loads(json.dumps(rec)) == rec   # JSON-clean
 
 
