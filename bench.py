@@ -687,6 +687,106 @@ def device_resilience_metric() -> dict:
     }
 
 
+def snapshot_metric() -> dict:
+    """Round-20 snapshot plane: snap_create and rbd clone wall vs
+    image bytes at 1x/8x/64x (each image is ONE data object, so the
+    64x row is a 64x-bigger object), plus the first-overwrite-after-
+    snap COW cost vs a plain overwrite. Snapshots and clones are
+    O(metadata) — a snap cut is a header mutation plus a selfmanaged
+    snap id, a clone is a child header pointing at the parent snap,
+    and the OSD-side COW is a BlueStore shared-blob ``t.clone`` that
+    bumps refcounts instead of copying extents — so NONE of the three
+    walls may scale with data size. The claim the section pins:
+    ``clone_is_ometa`` — the 64x/1x wall ratio for snap_create, clone
+    AND first-overwrite COW overhead all stay far under the 64x data
+    ratio (threshold: < 8x)."""
+    import asyncio
+    import math
+    import statistics
+
+    base = int(os.environ.get("CEPH_TPU_BENCH_SNAP_BASE",
+                              str(16 << 10)))
+
+    async def one(mult: int) -> dict:
+        from ceph_tpu.cluster.vstart import Cluster
+        from ceph_tpu.rbd import RBD
+        size = base * mult
+        order = max(12, math.ceil(math.log2(size)))
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("snapbench", pg_num=8)
+            await c.wait_for_clean(timeout=120)
+            io = await c.client.open_ioctx("snapbench")
+            rbd = RBD(io)
+            # plain-overwrite control: same size, never snapped
+            await rbd.create("plain", size, order=order)
+            plain = await rbd.open("plain")
+            await plain.write(0, b"p" * size)
+            plain_walls = []
+            for i in range(3):
+                t0 = time.perf_counter()
+                await plain.write(0, bytes([i]) * size)
+                plain_walls.append(time.perf_counter() - t0)
+            await rbd.create("img", size, order=order)
+            img = await rbd.open("img")
+            await img.write(0, b"d" * size)
+            snap_walls, cow_walls, clone_walls = [], [], []
+            for i in range(3):
+                t0 = time.perf_counter()
+                await img.snap_create(f"s{i}")
+                snap_walls.append(time.perf_counter() - t0)
+                # first overwrite under the new snap: the OSD clones
+                # the head object (shared-blob COW) before applying
+                t0 = time.perf_counter()
+                await img.write(0, bytes([65 + i]) * size)
+                cow_walls.append(time.perf_counter() - t0)
+            await img.snap_protect("s0")
+            for i in range(3):
+                t0 = time.perf_counter()
+                await rbd.clone("img", "s0", f"child-{i}")
+                clone_walls.append(time.perf_counter() - t0)
+            med = statistics.median
+            return {"image_bytes": size,
+                    "snap_create_ms": round(med(snap_walls) * 1e3, 3),
+                    "clone_ms": round(med(clone_walls) * 1e3, 3),
+                    "cow_overwrite_ms": round(med(cow_walls) * 1e3, 3),
+                    "plain_overwrite_ms": round(
+                        med(plain_walls) * 1e3, 3)}
+        finally:
+            await c.stop()
+
+    async def run() -> dict:
+        rows = {f"{m}x": await one(m) for m in (1, 8, 64)}
+        r1, r64 = rows["1x"], rows["64x"]
+
+        def ratio(key: str) -> float:
+            return round(r64[key] / max(r1[key], 1e-6), 2)
+        # the COW verdict compares the COW *overhead* (cow minus
+        # plain at the same size): the raw write wall legitimately
+        # scales with the payload, the clone it pays must not
+        cow_over_1 = max(
+            r1["cow_overwrite_ms"] - r1["plain_overwrite_ms"], 1e-3)
+        cow_over_64 = max(
+            r64["cow_overwrite_ms"] - r64["plain_overwrite_ms"], 0.0)
+        cow_ratio = round(cow_over_64 / cow_over_1, 2)
+        return {
+            "object_bytes_1x": base,
+            "rows": rows,
+            "snap_create_wall_ratio_64x": ratio("snap_create_ms"),
+            "clone_wall_ratio_64x": ratio("clone_ms"),
+            "cow_overhead_ratio_64x": cow_ratio,
+            "cow_vs_plain_overwrite_1x": round(
+                r1["cow_overwrite_ms"] /
+                max(r1["plain_overwrite_ms"], 1e-6), 2),
+            # the flag — not a hard error — records the verdict
+            "clone_is_ometa": bool(
+                ratio("snap_create_ms") < 8.0 and
+                ratio("clone_ms") < 8.0 and cow_ratio < 8.0),
+        }
+
+    return asyncio.run(run())
+
+
 def multiproc_metric() -> dict:
     """Round 18: the SAME closed-loop client workload against the two
     cluster backends — every daemon in ONE interpreter vs one OS
@@ -935,6 +1035,10 @@ def main() -> None:
         detail["multiproc"] = _with_compile_split(multiproc_metric)
     except Exception:
         detail["multiproc_error"] = _short_err()
+    try:
+        detail["snapshot"] = _with_compile_split(snapshot_metric)
+    except Exception:
+        detail["snapshot_error"] = _short_err()
     print(json.dumps({
         "metric": "ec_encode_k8m3_4MiB",
         "value": round(enc["GiB/s"], 3),
@@ -1019,6 +1123,13 @@ def compact_summary(enc: dict, dec: dict, detail: dict) -> dict:
         out["proc_within_2x"] = mp.get("proc_within_2x")
         out["proc_spawn_s"] = mp.get("proc", {}).get(
             "spawn_to_healthy_s")
+    snap = detail.get("snapshot")
+    if isinstance(snap, dict):   # the round-20 O(metadata) snap verdict
+        out["clone_is_ometa"] = snap.get("clone_is_ometa")
+        out["snap_wall_ratios_64x"] = [
+            snap.get("snap_create_wall_ratio_64x"),
+            snap.get("clone_wall_ratio_64x"),
+            snap.get("cow_overhead_ratio_64x")]
     # round 14: total observed jit-compile wall for the whole run —
     # BENCH_r06+ can split a compile regression from a runtime one
     try:

@@ -64,13 +64,22 @@ class FileHandle:
     """An open file + the cap that licenses its I/O."""
 
     def __init__(self, client: "CephFSClient", path: str, oid: str,
-                 mode: int, cap_seq: int, size: int):
+                 mode: int, cap_seq: int, size: int,
+                 snap_id: int | None = None,
+                 snapc: tuple[int, list[int]] | None = None):
         self.client = client
         self.path = path
         self.oid = oid
         self.mode = mode
         self.cap_seq = cap_seq
         self.size = size
+        # snap_id: set for a handle opened THROUGH .snap — reads hit
+        # the point-in-time clone, writes are refused. snapc: the snap
+        # context the MDS granted with the open when the file sits
+        # under one or more live snaprealms; stamped on every write so
+        # the OSD COWs before the first post-snapshot mutation.
+        self.snap_id = snap_id
+        self.snapc = snapc
         self.valid = True
 
     async def _ensure(self) -> None:
@@ -93,10 +102,13 @@ class FileHandle:
         if want <= 0:
             return b""
         return await self.client.ioctx.read(self.oid, length=want,
-                                            offset=offset)
+                                            offset=offset,
+                                            snap_id=self.snap_id)
 
     async def write(self, data: bytes, offset: int = 0) -> int:
         await self._ensure()
+        if self.snap_id is not None:
+            raise FSError(-30, "EROFS: snapshots are read-only")
         if self.mode != CAP_FW:
             raise FSError(-9, "handle not open for write")  # -EBADF
         # in-flight accounting: a revoke arriving mid-write must not be
@@ -107,10 +119,12 @@ class FileHandle:
         try:
             if offset:
                 await self.client.ioctx.write(self.oid, data,
-                                              offset=offset)
+                                              offset=offset,
+                                              snapc=self.snapc)
                 self.size = max(self.size, offset + len(data))
             else:
-                await self.client.ioctx.write_full(self.oid, data)
+                await self.client.ioctx.write_full(self.oid, data,
+                                                   snapc=self.snapc)
                 self.size = len(data)
             # dentry size rides a setattr through the MDS (metadata is
             # always MDS-authoritative)
@@ -130,8 +144,9 @@ class FileHandle:
             self.client._handles.pop(self.path, None)
         if self.valid:
             self.valid = False
-            await self.client._send_caps(CAP_OP_RELEASE, self.path,
-                                         self.mode, self.cap_seq)
+            if self.snap_id is None:    # snap handles hold no cap
+                await self.client._send_caps(CAP_OP_RELEASE, self.path,
+                                             self.mode, self.cap_seq)
 
 
 class CephFSClient(Dispatcher):
@@ -706,8 +721,12 @@ class CephFSClient(Dispatcher):
         # the handle keeps the REQUESTED mode, not the granted one: a
         # reader whose client happens to hold FW must neither pass the
         # write check nor reacquire exclusivity after a revoke
+        snapc = info.get("snapc")
         h = FileHandle(self, path, info["oid"], want,
-                       int(r.cap_seq), int(info["size"]))
+                       int(r.cap_seq), int(info["size"]),
+                       snap_id=info.get("snapid"),
+                       snapc=(int(snapc[0]), [int(s) for s in snapc[1]])
+                       if snapc else None)
         self._handles.setdefault(h.path, []).append(h)
         return h
 

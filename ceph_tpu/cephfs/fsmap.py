@@ -98,6 +98,13 @@ class FSMap:
         # "to": rank}]; authority flips only when the commit rewrites
         # ``subtrees`` — until then the "from" rank stays authoritative
         self.migrations: list[dict] = []
+        # -- fs snapshots (v3, ref: SnapServer's snap table made
+        # paxos-durable): snapid -> {"name", "path", "pool"}. The mon is
+        # the snap server of record — realms an MDS journals are derived
+        # from entries here, so a failover can always rebuild. snapids
+        # come from the data pool's selfmanaged-snap allocator and are
+        # never reused (pool snap_seq is monotonic).
+        self.snaps: dict[int, dict] = {}
 
     # -- queries -----------------------------------------------------------
     def by_name(self, name: str) -> MDSInfo | None:
@@ -167,13 +174,24 @@ class FSMap:
             "last_failure_osd_epoch": self.last_failure_osd_epoch,
             "subtrees": dict(sorted(self.subtrees.items())),
             "migrations": [dict(m) for m in self.migrations],
+            "snaps": {sid: dict(s)
+                      for sid, s in sorted(self.snaps.items())},
             "states": {i.name: i.state for i in self.infos.values()},
         }
+
+    def snaps_under(self, path: str) -> dict[int, dict]:
+        """snapid -> entry for every snapshot whose realm root is
+        ``path`` or an ancestor of it — the set whose snap context
+        governs writes at ``path`` (ref: SnapRealm::get_snap_context
+        walking parent realms)."""
+        return {sid: s for sid, s in self.snaps.items()
+                if path == s["path"] or
+                path.startswith(s["path"].rstrip("/") + "/")}
 
     # -- codec -------------------------------------------------------------
     def encode(self) -> bytes:
         e = Encoder()
-        with e.start(2):                 # v2: + max_mds/subtrees/migrations
+        with e.start(3):                 # v3: + snaps
             e.u64(self.epoch)
             e.map(self.infos, lambda e, k: e.u64(k),
                   lambda e, i: (e.u64(i.gid).string(i.name)
@@ -189,6 +207,10 @@ class FSMap:
             e.list(self.migrations,                        # v2
                    lambda e, m: (e.string(m["path"])
                                  .s32(m["from"]).s32(m["to"])))
+            e.map(self.snaps, lambda e, k: e.u64(k),       # v3
+                  lambda e, s: (e.string(s["name"])
+                                .string(s["path"])
+                                .string(s["pool"])))
         return e.tobytes()
 
     @classmethod
@@ -200,7 +222,7 @@ class FSMap:
                            rank=d.s32())
         m = cls()
         d = Decoder(data)
-        with d.start(2) as v:
+        with d.start(3) as v:
             m.epoch = d.u64()
             m.infos = d.map(lambda d: d.u64(), info)
             m.failed = d.list(lambda d: d.s32())
@@ -213,5 +235,10 @@ class FSMap:
                 m.migrations = d.list(
                     lambda d: {"path": d.string(), "from": d.s32(),
                                "to": d.s32()})
+            if v >= 3:
+                m.snaps = d.map(
+                    lambda d: d.u64(),
+                    lambda d: {"name": d.string(), "path": d.string(),
+                               "pool": d.string()})
         m.subtrees.setdefault("/", 0)     # v1 blob / invariant repair
         return m

@@ -102,6 +102,8 @@ RECONNECT_REJECT = 3  # mds -> client: unknown session; re-mount
 
 JOURNAL_OID = ".mds_journal"
 SESSIONS_OID = ".mds_sessions"   # session table (ref: SessionMap)
+REALMS_OID = ".mds_realms"       # snaprealm table (ref: SnapRealm
+                                 # state in the mdlog, persisted flat)
 
 
 def journal_oid(rank: int) -> str:
@@ -112,6 +114,37 @@ def journal_oid(rank: int) -> str:
 
 def sessions_oid(rank: int) -> str:
     return SESSIONS_OID if rank <= 0 else f"{SESSIONS_OID}.{rank}"
+
+
+def realms_oid(rank: int) -> str:
+    return REALMS_OID if rank <= 0 else f"{REALMS_OID}.{rank}"
+
+
+SNAPDIR = ".snap"   # the magic snapshot directory component
+EROFS = -30         # writes through .snap / under a snapshot path
+
+
+def snap_split(path: str) -> tuple[str, str, str] | None:
+    """Decompose a normalized path that traverses the magic ``.snap``
+    directory (ref: the CEPH_SNAPDIR inode): returns
+    ``(realm_root, snap_name, rest)`` or None for ordinary paths.
+
+        /d/.snap           -> ("/d", "",   "")
+        /d/.snap/s1        -> ("/d", "s1", "")
+        /d/.snap/s1/a/f    -> ("/d", "s1", "a/f")
+        /.snap/s1          -> ("/",  "s1", "")
+
+    Only the FIRST .snap component is magic — a second one inside
+    ``rest`` is simply a name that cannot exist (capture never records
+    one)."""
+    parts = path.split("/")
+    if SNAPDIR not in parts:
+        return None
+    i = parts.index(SNAPDIR)
+    root = "/".join(parts[:i]) or "/"
+    name = parts[i + 1] if len(parts) > i + 1 else ""
+    rest = "/".join(parts[i + 2:])
+    return root, name, rest
 
 
 # -ESTALE: the reply code a rank answers with for a path it does not
@@ -217,7 +250,12 @@ class MMDSExportDir(Message):
     TYPE = 225
     FIELDS = [("path", "str"), ("from_rank", "s32"),
               ("to_rank", "s32"), ("cap_seq", "u64"),
-              ("caps", "map:str:blob"), ("completed", "map:str:blob")]
+              ("caps", "map:str:blob"), ("completed", "map:str:blob"),
+              # snaprealms rooted under the subtree (appended,
+              # zero-fills on old corpus): str(snapid) -> realm JSON;
+              # the importer persists them to ITS realm table before
+              # acking, so .snap keeps serving after authority flips
+              ("realms", "map:str:blob")]
 
 
 @register
@@ -321,10 +359,20 @@ class MDSDaemon(Dispatcher):
         self.monc = None                        # set by create()
         self._own_rados = None
         self.fsmap: FSMap | None = None
+        # -- snaprealms (ref: SnapRealm + SnapServer client side) ------
+        # sid -> {"name", "path", "tree"}: the point-in-time namespace
+        # capture under the realm root. Journaled (mksnap/rmsnap
+        # events) AND persisted flat in realms_oid so failover replay
+        # and cold takeover both rebuild it; rides MMDSExportDir on
+        # subtree migration.
+        self.realms: dict[int, dict] = {}
+        self.snap_enabled = cfg.get("mds_snap_enabled", True)
+        self.snap_max = int(cfg.get("mds_snap_max_per_realm", 100))
         # -- multi-active state (round 7) ------------------------------
         self.rank = 0                           # standalone serves rank 0
         self.journal_oid = journal_oid(0)
         self.sessions_oid = sessions_oid(0)
+        self.realms_oid = realms_oid(0)
         # cumulative op counters for the beacon's load report
         self._op_count = 0
         self._subtree_op_counts: dict[str, int] = {}
@@ -418,6 +466,7 @@ class MDSDaemon(Dispatcher):
         # root dirfrag first (idempotent): journal replay on a fresh
         # pool needs it, and every request would ENOENT without it
         await self.fs.mount()
+        await self._load_realms()
         await self._replay_journal()
         await self._load_session_table()
         self.addr = await self.msgr.bind(host, port)
@@ -577,6 +626,7 @@ class MDSDaemon(Dispatcher):
             self.rank = me.rank
             self.journal_oid = journal_oid(self.rank)
             self.sessions_oid = sessions_oid(self.rank)
+            self.realms_oid = realms_oid(self.rank)
             MDS_PERF.inc("state_transitions")
             MDS_PERF.inc("takeovers")
             self._takeover_task = asyncio.ensure_future(
@@ -605,7 +655,8 @@ class MDSDaemon(Dispatcher):
                                 f"{epoch}) not proven: {e}; retrying")
                     await asyncio.sleep(0.2)
             await self.fs.mount()
-            await self._replay_journal()
+            await self._load_realms()     # before replay: a replayed
+            await self._replay_journal()  # mksnap re-persists on top
             await self._load_session_table()
             self._recovering = set(self._session_table)
             self._replay_done.set()
@@ -751,6 +802,13 @@ class MDSDaemon(Dispatcher):
                 c: json.dumps({str(t): r
                                for t, r in tids.items()}).encode()
                 for c, tids in self._completed.items()}
+            # snaprealms rooted in the subtree move with it — the
+            # importer is the one serving .snap lookups afterwards
+            realms = {
+                str(sid): json.dumps(r).encode()
+                for sid, r in self.realms.items()
+                if r["path"] == path or
+                r["path"].startswith(path.rstrip("/") + "/")}
             acked = False
             while not acked and not self._stopping:
                 fm = self.fsmap
@@ -766,7 +824,7 @@ class MDSDaemon(Dispatcher):
                     export_msg = MMDSExportDir(
                         path=path, from_rank=self.rank, to_rank=to,
                         cap_seq=self._cap_seq, caps=caps,
-                        completed=completed)
+                        completed=completed, realms=realms)
                     export_msg.set_trace(span)
                     await self.msgr.send_message(
                         export_msg, dest.addr(), "mds")
@@ -797,6 +855,13 @@ class MDSDaemon(Dispatcher):
             for p in list(self.caps):
                 if p == path or p.startswith(path + "/"):
                     self.caps.pop(p, None)
+            for sid in [int(s) for s in realms]:
+                self.realms.pop(sid, None)
+                try:
+                    await self.ioctx.rm_omap_key(self.realms_oid,
+                                                 f"{sid:016d}")
+                except Exception:
+                    pass     # stale copy is routing-shadowed anyway
             MDS_PERF.inc("subtrees_exported")
             log.dout(1, f"mds.{self.name} (rank {self.rank}) exported "
                         f"subtree {path} -> rank {to}")
@@ -846,6 +911,14 @@ class MDSDaemon(Dispatcher):
             while len(done) > COMPLETED_KEEP:
                 done.pop(next(iter(done)))
             await self._save_session(client)
+        for s, blob in getattr(m, "realms", {}).items():
+            try:
+                realm = json.loads(blob)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            sid = int(s)
+            self.realms[sid] = realm
+            await self._save_realm(sid)     # durable BEFORE the ack
         MDS_PERF.inc("subtrees_imported")
         if span is not None:
             span.finish()
@@ -1063,6 +1136,19 @@ class MDSDaemon(Dispatcher):
             await self.fs.rename(ev["path"], ev["path2"])
         elif op == "setattr":
             await self.fs.set_size(ev["path"], ev["size"])
+        elif op == "mksnap":
+            sid = int(ev["sid"])
+            self.realms[sid] = {"name": ev["name"], "path": ev["path"],
+                                "tree": ev["tree"]}
+            await self._save_realm(sid)
+        elif op == "rmsnap":
+            sid = int(ev["sid"])
+            self.realms.pop(sid, None)
+            try:
+                await self.ioctx.rm_omap_key(self.realms_oid,
+                                             f"{sid:016d}")
+            except Exception:      # already gone: replay-idempotent
+                pass
         elif op in ("export_subtree", "import_subtree"):
             # handoff markers: authority lives in the mon's subtree
             # map, not the journal — replay has nothing to do (the
@@ -1070,6 +1156,207 @@ class MDSDaemon(Dispatcher):
             pass
         else:                                        # pragma: no cover
             raise ValueError(f"unknown journal op {op}")
+
+    # -- snaprealm table (ref: SnapRealm persistence — flat per-rank
+    # omap, the same durability model as the session table) ---------------
+    async def _save_realm(self, sid: int) -> None:
+        await self.ioctx.set_omap(
+            self.realms_oid, f"{sid:016d}",
+            json.dumps(self.realms[sid]).encode())
+
+    async def _load_realms(self) -> None:
+        from ceph_tpu.rados import ObjectOperationError
+        try:
+            omap = await self.ioctx.get_omap_vals(self.realms_oid)
+        except ObjectOperationError:
+            omap = {}
+        self.realms = {int(k): json.loads(v)
+                       for k, v in omap.items() if k.isdigit()}
+
+    def _snaps_governing(self, path: str) -> list[int]:
+        """Ascending snapids whose realm root is ``path`` or an
+        ancestor — the snap context a write at ``path`` must carry
+        (ref: SnapRealm::get_snap_context walking parent realms). The
+        union of this rank's realm table and the FSMap's registry: the
+        FSMap half makes the context correct even for realms whose
+        tree lives on another rank."""
+        out = {sid for sid, r in self.realms.items()
+               if path == r["path"] or
+               path.startswith(r["path"].rstrip("/") + "/")}
+        if self.fsmap is not None:
+            out |= set(self.fsmap.snaps_under(path))
+        return sorted(out)
+
+    def _realm(self, root: str, name: str) -> tuple[int, dict]:
+        entry = next(((sid, r) for sid, r in self.realms.items()
+                      if r["path"] == root and r["name"] == name), None)
+        if entry is None:
+            raise FSError(-2, f"no snapshot {name!r} at {root}")
+        return entry
+
+    async def _capture_tree(self, root: str) -> dict:
+        """Point-in-time namespace capture under ``root``: relative
+        path ("" = the root itself) -> {type, size[, oid]}. Data is NOT
+        copied — a file entry records the head object's name, and
+        point-in-time reads go through the OSD snap machinery
+        (snap_id resolves to the COW clone)."""
+        tree: dict[str, dict] = {"": {"type": "dir"}}
+        stack = [""]
+        base = root.rstrip("/")
+        while stack:
+            rel = stack.pop()
+            absd = (base + "/" + rel) if rel else (root or "/")
+            for nm in await self.fs.ls(absd):
+                chrel = f"{rel}/{nm}" if rel else nm
+                chabs = base + "/" + chrel
+                try:
+                    st = await self.fs.stat(chabs)
+                except FSError:
+                    continue            # raced an unlink: skip
+                ent: dict = {"type": st["type"],
+                             "size": st.get("size", 0)}
+                if st["type"] == "file":
+                    ent["oid"] = _fileobj(chabs)
+                else:
+                    stack.append(chrel)
+                tree[chrel] = ent
+        return tree
+
+    @staticmethod
+    def _tree_children(tree: dict, rest: str) -> list[str]:
+        ent = tree.get(rest)
+        if ent is None:
+            raise FSError(-2, f"no such entry {rest!r} in snapshot")
+        if ent["type"] != "dir":
+            raise FSError(-20, f"{rest!r} is not a directory")
+        pre = rest + "/" if rest else ""
+        return sorted(k[len(pre):] for k in tree
+                      if k and k != rest and k.startswith(pre)
+                      and "/" not in k[len(pre):])
+
+    async def _recall_realm_caps(self, root: str) -> None:
+        """Revoke every cap under the realm root so writers flush and
+        their next open carries the grown snap context (ref: the
+        snaprealm split/update cap recall in Locker) — without this a
+        holder would keep writing with the pre-snapshot context and
+        the OSD would never COW, silently dirtying the snapshot."""
+        base = root.rstrip("/")
+        for path in [p for p in list(self.caps)
+                     if p == root or p.startswith(base + "/")]:
+            # the sentinel requester matches no real client, so EVERY
+            # holder (including the mksnap caller itself) is revoked
+            await self._revoke_conflicting(path, "\0mksnap", CAP_FW)
+
+    async def _mksnap(self, root: str, name: str) -> int:
+        """mkdir <root>/.snap/<name> (ref: Server::handle_client_
+        mksnap): allocate the snapid at the mon, recall write caps,
+        capture the namespace, journal the realm."""
+        if not self.snap_enabled:
+            raise FSError(-1, "EPERM: snapshots disabled "
+                              "(mds_snap_enabled=false)")
+        if not name or name.startswith("_") or name == SNAPDIR:
+            raise FSError(-22, f"invalid snapshot name {name!r}")
+        st = await self.fs.stat(root)
+        if st["type"] != "dir":
+            raise FSError(-20, f"{root} is not a directory")
+        if any(r["path"] == root and r["name"] == name
+               for r in self.realms.values()):
+            raise FSError(-17, f"snapshot {name!r} exists at {root}")
+        if sum(1 for r in self.realms.values()
+               if r["path"] == root) >= self.snap_max:
+            raise FSError(-31, "EMLINK: mds_snap_max_per_realm "
+                               "snapshots already exist here")
+        ret, rs, out = await self.ioctx.rados.mon_command(
+            {"prefix": "fs snap create", "path": root, "name": name,
+             "pool": self.ioctx.pool_name})
+        if ret == -17:
+            # a prior attempt allocated the sid but died before its
+            # journal event landed (mon committed, realm didn't):
+            # adopt the registered sid instead of failing the retry
+            ret2, _, out2 = await self.ioctx.rados.mon_command(
+                {"prefix": "fs snap ls", "path": root})
+            sid = next((int(k) for k, v in
+                        json.loads(out2)["snaps"].items()
+                        if v["name"] == name), None) if ret2 == 0 \
+                else None
+            if sid is None:
+                raise FSError(-17, rs or "snapshot exists")
+        elif ret != 0:
+            raise FSError(ret, rs or "snapid allocation refused")
+        else:
+            sid = int(json.loads(out)["snapid"])
+        # recall BEFORE capture: holders flush their in-flight writes
+        # and reacquire with a context including sid, so everything
+        # captured below is stable and every later write COWs
+        await self._recall_realm_caps(root)
+        tree = await self._capture_tree(root)
+        await self._journaled_apply({"op": "mksnap", "path": root,
+                                     "name": name, "sid": sid,
+                                     "tree": tree})
+        log.dout(1, f"mds.{self.name}: mksnap {root}/.snap/{name} "
+                    f"(snapid {sid}, {len(tree)} entries)")
+        return sid
+
+    async def _rmsnap(self, root: str, name: str) -> None:
+        """rmdir <root>/.snap/<name>: drop the mon registry entry
+        (queues the snapid into removed_snaps — the OSDs trim the
+        clones) and journal the realm removal."""
+        sid, _r = self._realm(root, name)
+        ret, rs, _ = await self.ioctx.rados.mon_command(
+            {"prefix": "fs snap rm", "path": root, "name": name})
+        if ret not in (0, -2):       # -2: mon already forgot it
+            raise FSError(ret, rs or "snap rm refused")
+        await self._journaled_apply({"op": "rmsnap", "sid": sid})
+        log.dout(1, f"mds.{self.name}: rmsnap {root}/.snap/{name} "
+                    f"(snapid {sid})")
+
+    async def _serve_snap(self, m: MClientRequest,
+                          sp: tuple) -> tuple[bytes, int, int]:
+        """Serve one request whose path traverses .snap. Returns
+        (payload, cap_mode, cap_seq); raises FSError for errors.
+        Everything inside a snapshot is immutable: only mkdir/rmdir of
+        the snapshot names themselves mutate, all else is read-only."""
+        root, name, rest = sp
+        if m.op == "mkdir" and name and not rest:
+            await self._mksnap(root, name)
+            return b"", 0, 0
+        if m.op == "rmdir" and name and not rest:
+            await self._rmsnap(root, name)
+            return b"", 0, 0
+        if m.op == "readdir":
+            if not name:      # ls <root>/.snap -> snapshot names
+                return json.dumps(sorted(
+                    r["name"] for r in self.realms.values()
+                    if r["path"] == root)).encode(), 0, 0
+            _sid, r = self._realm(root, name)
+            return json.dumps(
+                self._tree_children(r["tree"], rest)).encode(), 0, 0
+        if m.op == "stat":
+            if not name:      # the .snap dir itself
+                return json.dumps({"path": m.path, "type": "dir",
+                                   "size": 0}).encode(), 0, 0
+            _sid, r = self._realm(root, name)
+            ent = r["tree"].get(rest)
+            if ent is None:
+                raise FSError(-2, f"no such entry in snapshot")
+            return json.dumps(
+                {"path": m.path, "type": ent["type"],
+                 "size": ent.get("size", 0)}).encode(), 0, 0
+        if m.op == "open":
+            if int(m.flags) == CAP_FW:
+                raise FSError(EROFS, "snapshots are read-only")
+            sid, r = self._realm(root, name)
+            ent = r["tree"].get(rest)
+            if ent is None:
+                raise FSError(-2, "no such file in snapshot")
+            if ent["type"] != "file":
+                raise FSError(-21, "EISDIR")
+            # no cap bookkeeping: snapshot content is immutable, so a
+            # shared-read grant can never need revoking
+            return json.dumps(
+                {"size": ent.get("size", 0), "oid": ent["oid"],
+                 "snapid": sid}).encode(), CAP_FR, 0
+        raise FSError(EROFS, "snapshots are read-only")
 
     # -- session table (ref: SessionMap) ----------------------------------
     def _ingest_session_table(self, omap: dict) -> None:
@@ -1499,8 +1786,16 @@ class MDSDaemon(Dispatcher):
                 cap_mode=0, cap_seq=0))
             return
         result, payload, cap_mode, cap_seq = 0, b"", 0, 0
+        sp = snap_split(m.path)
         try:
-            if m.op in ("mkdir", "rmdir", "create", "unlink"):
+            if sp is not None or (m.path2 and snap_split(m.path2)):
+                if sp is None:
+                    # rename INTO .snap (src outside): still a mutation
+                    # of snapshot namespace
+                    raise FSError(EROFS, "snapshots are read-only")
+                payload, cap_mode, cap_seq = await self._serve_snap(
+                    m, sp)
+            elif m.op in ("mkdir", "rmdir", "create", "unlink"):
                 await self._journaled_apply({"op": m.op, "path": m.path})
             elif m.op == "rename":
                 await self._journaled_apply(
@@ -1550,9 +1845,16 @@ class MDSDaemon(Dispatcher):
                         st = await self.fs.stat(m.path)
                     except FSError:
                         st = None
-                payload = json.dumps(
-                    {"size": 0 if st is None else st["size"],
-                     "oid": _fileobj(m.path)}).encode()
+                info = {"size": 0 if st is None else st["size"],
+                        "oid": _fileobj(m.path)}
+                # snap context for writes under a live realm (ref:
+                # the SnapContext a Client stamps on OSD writes): the
+                # OSD COWs the head into a clone before the first
+                # write that carries a snapid it hasn't preserved yet
+                sids = self._snaps_governing(m.path)
+                if sids:
+                    info["snapc"] = [sids[-1], sids[::-1]]
+                payload = json.dumps(info).encode()
             else:
                 result = -22                          # -EINVAL
         except FSError as e:

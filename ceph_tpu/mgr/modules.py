@@ -318,6 +318,14 @@ class PrometheusModule(MgrModule):
                 fsm.get("rank_ops_rate", {}).items()):
             lines.append(
                 f'ceph_mds_rank_ops_rate{{rank="{rk}"}} {rate}')
+        # snapshot plane (round 20): the mon snap service's registry
+        # size and the cumulative deleted snapids riding the osdmap —
+        # registered growing while removed stalls = trimmer wedged
+        lines += [
+            "# TYPE ceph_snap_registered gauge",
+            f"ceph_snap_registered {fsm.get('num_snaps', 0)}",
+            f"ceph_snap_removed {om.get('removed_snaps', 0)}",
+        ]
         # elastic control plane (round 6): quorum depth, committed
         # auth keys, in-flight pg merges — the gauges behind
         # MON_DOWN / AUTH_KEY_REVOKED / PG_MERGE_PENDING
@@ -492,7 +500,8 @@ class PrometheusModule(MgrModule):
             for daemon, loggers in reported.items():
                 for logger, counters in loggers.items():
                     if logger in ("osd_ec_agg", "osd_ec_read_agg",
-                                  "osd_ec_resident", "devmon",
+                                  "osd_ec_resident",
+                                  "bluestore_sharedblob", "devmon",
                                   "device_runtime"):
                         # dedicated ceph_osd_ec_agg_* /
                         # ceph_osd_ec_read_agg_* /
@@ -538,7 +547,14 @@ class PrometheusModule(MgrModule):
                                "decode/repair aggregator (reported)"),
                               ("osd_ec_resident",
                                "# ceph_osd_ec_resident_*: hot-shard "
-                               "residency cache (reported)")):
+                               "residency cache (reported)"),
+                              # round 20: the shared-blob clone plane
+                              # (clones/refcount traffic per
+                              # BlueStore-backed OSD)
+                              ("bluestore_sharedblob",
+                               "# ceph_bluestore_sharedblob_*: "
+                               "shared-blob COW clone plane "
+                               "(reported)")):
                 fam_rows: list[str] = []
                 for daemon, loggers in sorted(reported.items()):
                     cs = loggers.get(fam)

@@ -75,6 +75,10 @@ READONLY_COMMANDS = frozenset((
     # tuner plane (round 17): audit/ownership reads (`tune record`
     # MUTATES the audit ring and stays behind `mon w`)
     "tune status", "tune log",
+    # snap plane (round 20): the registry listing is a read (`fs snap
+    # create`/`fs snap rm` MUTATE the registry + removed_snaps and
+    # stay behind `mon w`)
+    "fs snap ls",
 ))
 AUTH_READS = frozenset(("auth get", "auth ls"))
 

@@ -509,6 +509,9 @@ class MDSMonitor(PaxosService):
             "rank_ops_rate": {r: round(self.rank_rates.get(r, 0.0), 1)
                               for r in sorted(holders)},
             "states": {i.name: i.state for i in fm.infos.values()},
+            # round 20: the snap service's registry size (prometheus
+            # renders ceph_snap_registered from it)
+            "num_snaps": len(fm.snaps),
         }
 
     async def _cmd_set_max_mds(self, cmd):
@@ -556,6 +559,76 @@ class MDSMonitor(PaxosService):
         self.mon.clog("INF", f"fs max_mds set to {n}")
         return 0, f"max_mds set to {n}", b""
 
+    # -- fs snapshots (ref: SnapServer made a mon service: the snap
+    # table is paxos-durable here, not journaled per-MDS, so realms
+    # survive any MDS failover by construction) ------------------------
+    async def _cmd_snap_create(self, cmd):
+        """`fs snap create <path> <name> <pool>`: allocate a snapid
+        from the data pool's self-managed allocator (snap_seq bump —
+        monotonic, never reused) and commit the realm entry into the
+        FSMap in the same breath. The MDS calls this on
+        `mkdir .snap/<name>`; the CLI can drive it directly."""
+        path = str(cmd.get("path", "")).rstrip("/") or "/"
+        name = str(cmd.get("name", ""))
+        pool = str(cmd.get("pool", ""))
+        if not name or not pool or "/" in name:
+            return -22, "usage: fs snap create <path> <name> <pool>", \
+                b""
+        if any(s["path"] == path and s["name"] == name
+               for s in self.fsmap.snaps.values()):
+            return -17, f"snapshot {name!r} exists at {path}", b""
+        ret, rs, outbl = await self.mon.osdmon.handle_command(
+            {"prefix": "osd pool selfmanaged-snap-create",
+             "pool": pool}, b"")
+        if ret != 0:
+            return ret, f"snapid allocation failed: {rs}", b""
+        sid = int(json.loads(outbl)["snapid"])
+
+        def build(fm: FSMap):
+            if sid in fm.snaps or any(
+                    s["path"] == path and s["name"] == name
+                    for s in fm.snaps.values()):
+                return None
+            fm.snaps[sid] = {"name": name, "path": path, "pool": pool}
+            return fm, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            # the allocated sid leaks (snap_seq already advanced) —
+            # harmless: snapids are an infinite namespace and nothing
+            # references an unregistered one
+            return -11, "proposal failed", b""
+        self.mon.clog("INF", f"fs snap {name!r} created at {path} "
+                             f"(snapid {sid})")
+        return 0, "", json.dumps({"snapid": sid}).encode()
+
+    async def _cmd_snap_rm(self, cmd):
+        """`fs snap rm <path> <name>`: drop the realm entry and queue
+        the snapid into the pool's removed_snaps (rides the osdmap;
+        every OSD trims the snap's clones in the background)."""
+        path = str(cmd.get("path", "")).rstrip("/") or "/"
+        name = str(cmd.get("name", ""))
+        entry = next(((sid, s) for sid, s in self.fsmap.snaps.items()
+                      if s["path"] == path and s["name"] == name), None)
+        if entry is None:
+            return -2, f"no snapshot {name!r} at {path}", b""
+        sid, s = entry
+        ret, rs, _ = await self.mon.osdmon.handle_command(
+            {"prefix": "osd pool selfmanaged-snap-remove",
+             "pool": s["pool"], "snapid": sid}, b"")
+        if ret != 0:
+            return ret, f"snap removal failed: {rs}", b""
+
+        def build(fm: FSMap):
+            if fm.snaps.pop(sid, None) is None:
+                return None
+            return fm, None
+        ok, _ = await self._propose_change(build)
+        if not ok:
+            return -11, "proposal failed", b""
+        self.mon.clog("INF", f"fs snap {name!r} at {path} removed "
+                             f"(snapid {sid})")
+        return 0, f"removed snapshot {name!r}", b""
+
     async def handle_command(self, cmd, inbl=b""):
         prefix = cmd.get("prefix", "")
         if prefix in ("fs status", "fs dump", "mds dump"):
@@ -584,6 +657,16 @@ class MDSMonitor(PaxosService):
                 "subtrees": dict(sorted(self.fsmap.subtrees.items())),
                 "migrations": [dict(m) for m in
                                self.fsmap.migrations]}).encode()
+        if prefix == "fs snap create":
+            return await self._cmd_snap_create(cmd)
+        if prefix == "fs snap rm":
+            return await self._cmd_snap_rm(cmd)
+        if prefix == "fs snap ls":
+            path = str(cmd.get("path", "")) or None
+            snaps = {sid: dict(s)
+                     for sid, s in sorted(self.fsmap.snaps.items())
+                     if path is None or s["path"] == path}
+            return 0, "", json.dumps({"snaps": snaps}).encode()
         if prefix == "mds fail":
             who = str(cmd.get("who", ""))
             info = None

@@ -943,7 +943,15 @@ class Monitor(Dispatcher):
                              "full": int(p.is_full())}
                             for p in om.pools.values()
                             if p.quota_bytes or p.quota_objects or
-                            p.is_full()]}
+                            p.is_full()],
+                        # round 20: cumulative deleted snapids across
+                        # pools (prometheus renders
+                        # ceph_snap_removed from it — a count that
+                        # stops growing while deletes continue means
+                        # the trim queue feed is wedged)
+                        "removed_snaps": sum(
+                            len(p.extra.get("removed_snaps") or [])
+                            for p in om.pools.values())}
         if om is not None:
             pending = self.osdmon.pending_merges()
             if pending:

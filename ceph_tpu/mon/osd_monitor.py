@@ -1294,8 +1294,11 @@ class OSDMonitor(PaxosService):
         return 0, "", json.dumps({"snapid": got["snapid"]}).encode()
 
     async def _cmd_snap_remove(self, cmd, inbl):
-        """Record a self-managed snap as deleted (clone trimming is
-        client-driven via OSD_OP_SNAPTRIM)."""
+        """Record a self-managed snap as deleted. removed_snaps rides
+        the osdmap as the deletion queue: every OSD's map consumption
+        kicks a background trim of the snap's clones (clients may also
+        trim eagerly via OSD_OP_SNAPTRIM). snapids are never reused —
+        snap_seq only ever grows."""
         name, sid = cmd["pool"], int(cmd["snapid"])
 
         def build(om):

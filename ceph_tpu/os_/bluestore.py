@@ -26,8 +26,18 @@ not a translation:
   detection) — BlueStore::_fsck's core checks. ``statfs`` reports the
   allocator's view.
 
-Not rebuilt: blob refcounting for clone sharing (clone copies through
-fresh extents), compression, BlueFS/multi-device tiering, cache
+- CLONE is O(metadata) via SHARED BLOBS (ref: BlueStore::SharedBlob +
+  bluestore_shared_blob_t): each extent carries a blob id (``sb_id``,
+  0 = unshared); ``Transaction.clone`` stamps the source's extents
+  with fresh sb_ids, bumps a persisted per-AU refcount table (kv
+  prefix "B") and copies only the extent-map entries — zero data
+  bytes move. Overwrites of a shared extent always take the COW path
+  (never deferred-in-place), the punched AUs merely decrement their
+  refs, and an AU returns to the allocator only at refcount 0
+  (deferred-release discipline). ``fsck`` cross-checks the stored
+  refcounts against the union of extent-map references.
+
+Not rebuilt: compression, BlueFS/multi-device tiering, cache
 trimming. Collections/omap/attrs reuse the kv directly.
 """
 
@@ -38,6 +48,7 @@ import zlib
 
 from ceph_tpu.encoding.denc import Decoder, Encoder
 from ceph_tpu.os_.allocator import AllocatorError, BitmapAllocator
+from ceph_tpu.utils.perf_counters import PerfCountersBuilder
 from ceph_tpu.os_.kv import KVTransaction, WALDB
 from ceph_tpu.os_.objectstore import (
     OP_CLONE, OP_MKCOLL, OP_OMAP_CLEAR, OP_OMAP_RMKEYS, OP_OMAP_SETKEYS,
@@ -52,8 +63,10 @@ class _Onode:
 
     def __init__(self):
         self.size = 0
-        # [(loff, au, n_aus, crc32 of the logical bytes)] sorted by
-        # loff; gaps read as zeros (sparse objects)
+        # [(loff, au, n_aus, crc32 of the logical bytes, sb_id)]
+        # sorted by loff; gaps read as zeros (sparse objects).
+        # sb_id 0 = unshared; nonzero names a shared-blob refcount
+        # record (this AU range may be referenced by other onodes)
         self.extents: list[list[int]] = []
         self.attrs: dict[str, bytes] = {}
         self.omap: dict[str, bytes] = {}
@@ -63,7 +76,7 @@ def _enc_onode(o: _Onode) -> bytes:
     e = Encoder()
     e.u64(o.size)
     e.list(o.extents, lambda e, x:
-           e.u64(x[0]).u64(x[1]).u64(x[2]).u32(x[3]))
+           e.u64(x[0]).u64(x[1]).u64(x[2]).u32(x[3]).u64(x[4]))
     e.map(o.attrs, lambda e, k: e.string(k), lambda e, v: e.blob(v))
     e.map(o.omap, lambda e, k: e.string(k), lambda e, v: e.blob(v))
     return e.tobytes()
@@ -73,10 +86,21 @@ def _dec_onode(data: bytes) -> _Onode:
     d = Decoder(data)
     o = _Onode()
     o.size = d.u64()
-    o.extents = d.list(lambda d: [d.u64(), d.u64(), d.u64(), d.u32()])
+    o.extents = d.list(lambda d: [d.u64(), d.u64(), d.u64(), d.u32(),
+                                  d.u64()])
     o.attrs = d.map(lambda d: d.string(), lambda d: d.blob())
     o.omap = d.map(lambda d: d.string(), lambda d: d.blob())
     return o
+
+
+def _enc_shared(refs: dict[int, int]) -> bytes:
+    e = Encoder()
+    e.map(refs, lambda e, k: e.u64(k), lambda e, v: e.u64(v))
+    return e.tobytes()
+
+
+def _dec_shared(data: bytes) -> dict[int, int]:
+    return Decoder(data).map(lambda d: d.u64(), lambda d: d.u64())
 
 
 class BlueStore(ObjectStore):
@@ -85,8 +109,10 @@ class BlueStore(ObjectStore):
     AU = 4096                     # min_alloc_size
     DEFERRED_MAX = 64 << 10       # small-overwrite deferred threshold
 
-    def __init__(self, path: str, size: int = 64 << 20):
+    def __init__(self, path: str, size: int = 64 << 20,
+                 config: dict | None = None):
         self.path = path
+        self.config = config if config is not None else {}
         os.makedirs(path, exist_ok=True)
         self.db = WALDB(os.path.join(path, "db"))
         self.block_path = os.path.join(path, "block")
@@ -109,6 +135,25 @@ class BlueStore(ObjectStore):
         self.alloc = BitmapAllocator(self.size // self.AU)
         self.colls: dict[str, set[str]] = {}
         self.onodes: dict[tuple[str, str], _Onode] = {}
+        # shared-blob refcount table (ref: bluestore_shared_blob_t):
+        # sb_id -> {au: refcount}; persisted under kv prefix "B"
+        self.shared: dict[int, dict[int, int]] = {}
+        self._next_sb = 1
+        self._shared_dirty: set[int] = set()
+        # round 20: shared-blob plane observability (register=False —
+        # the OSD daemon ships the family through its mgr report
+        # session; prometheus renders ceph_bluestore_sharedblob_*)
+        self.perf = (
+            PerfCountersBuilder("bluestore_sharedblob")
+            .add_u64_counter("clones",
+                             "O(metadata) shared-blob clones executed")
+            .add_u64_counter("cow_released",
+                             "shared-AU claims released (refcount "
+                             "decrements from COW/punch/remove)")
+            .add_u64_counter("aus_freed",
+                             "shared AUs freed at refcount 0")
+            .add_u64("records", "live shared-blob records (gauge)")
+            .create_perf_counters(register=False))
         self._dseq = 0
         # au -> bytes queued for deferred write within the CURRENT
         # transaction (overlay for _read_extent; cleared at commit end)
@@ -123,18 +168,36 @@ class BlueStore(ObjectStore):
         self.alloc = BitmapAllocator(self.size // self.AU)
         self.colls = {}
         self.onodes = {}
+        self.shared = {}
+        self._next_sb = 1
+        self._shared_dirty = set()
         self._load()
 
     # -- mount/load --------------------------------------------------------
     def _load(self) -> None:
         for cid, _ in self.db.get_iterator("L"):
             self.colls[cid] = set()
+        for key, rec in self.db.get_iterator("B"):
+            self.shared[int(key)] = _dec_shared(rec)
+        if self.shared:
+            self._next_sb = max(self.shared) + 1
+        # a shared AU appears in MULTIPLE onodes' extent maps: claim it
+        # once (the allocator's strict double-allocation check still
+        # guards unshared extents and shared-vs-unshared collisions)
+        shared_claimed: set[int] = set()
         for key, rec in self.db.get_iterator("O"):
             cid, _, oid = key.partition("\x00")
             o = _dec_onode(rec)
             self.onodes[(cid, oid)] = o
             self.colls.setdefault(cid, set()).add(oid)
-            self.alloc.mark_used([(x[1], x[2]) for x in o.extents])
+            for x in o.extents:
+                if not x[4]:
+                    self.alloc.mark_used([(x[1], x[2])])
+                    continue
+                for a in range(x[1], x[1] + x[2]):
+                    if a not in shared_claimed:
+                        self.alloc.mark_used([(a, 1)])
+                        shared_claimed.add(a)
         # deferred replay (crash between kv commit and block write):
         # whole-AU rewrites are idempotent, so replay-then-delete is
         # safe regardless of whether the block write had landed
@@ -156,7 +219,7 @@ class BlueStore(ObjectStore):
 
     # -- block I/O helpers -------------------------------------------------
     def _read_extent(self, x) -> bytes:
-        loff, au, n_aus, crc = x
+        loff, au, n_aus, crc, _sb = x
         self._f.seek(au * self.AU)
         raw = self._f.read(n_aus * self.AU)
         if self._pending_au:
@@ -179,7 +242,7 @@ class BlueStore(ObjectStore):
         """Logical bytes [start, end) — gaps as zeros, crc verified."""
         out = bytearray(end - start)
         for x in o.extents:
-            loff, au, n_aus, crc = x
+            loff, au, n_aus, crc, _sb = x
             xlen = n_aus * self.AU
             if loff >= end or loff + xlen <= start:
                 continue
@@ -231,6 +294,17 @@ class BlueStore(ObjectStore):
                 kvt.rmkey("O", okey)
             else:
                 kvt.set("O", okey, _enc_onode(o))
+        for sb in self._shared_dirty:
+            refs = self.shared.get(sb)
+            if refs:
+                kvt.set("B", f"{sb:016d}", _enc_shared(refs))
+            else:
+                # every AU hit refcount 0: the record dies with them
+                self.shared.pop(sb, None)
+                kvt.rmkey("B", f"{sb:016d}")
+        if self._shared_dirty:
+            self.perf.set("records", len(self.shared))
+        self._shared_dirty = set()
         for au, data in deferred:
             e = Encoder()
             e.u64(au).blob(data)
@@ -351,6 +425,32 @@ class BlueStore(ObjectStore):
             self.colls[cid].add(oid)
         return o
 
+    # -- shared-blob refcounts ---------------------------------------------
+    def _release_aus(self, au: int, n_aus: int, sb: int,
+                     to_free: list) -> None:
+        """Drop one extent's claim on [au, au+n_aus). Unshared AUs go
+        straight to ``to_free`` (released after the kv commit); shared
+        AUs only decrement their refcount and free at 0 — an AU still
+        referenced by a sibling clone never reaches the allocator."""
+        if not sb:
+            if n_aus:
+                to_free.append((au, n_aus))
+            return
+        refs = self.shared.setdefault(sb, {})
+        self.perf.inc("cow_released", n_aus)
+        for a in range(au, au + n_aus):
+            r = refs.get(a, 1) - 1
+            if r > 0:
+                refs[a] = r
+            else:
+                refs.pop(a, None)
+                to_free.append((a, 1))
+                self.perf.inc("aus_freed")
+        self._shared_dirty.add(sb)
+
+    def _release_extent(self, x, to_free: list) -> None:
+        self._release_aus(x[1], x[2], x[4], to_free)
+
     def _rewrite_range(self, o: _Onode, off: int, data: bytes,
                        to_free: list) -> None:
         """COW the AU-aligned range covering [off, off+len(data))."""
@@ -371,16 +471,22 @@ class BlueStore(ObjectStore):
             chunk = bytes(buf[pos:pos + n_aus * self.AU])
             self._f.seek(au * self.AU)
             self._f.write(chunk)
-            new_extents.append([a0 + pos, au, n_aus, zlib.crc32(chunk)])
+            new_extents.append([a0 + pos, au, n_aus, zlib.crc32(chunk),
+                                0])
             pos += n_aus * self.AU
         self._replace_extents(o, a0, a1, new_extents, to_free)
 
     def _replace_extents(self, o: _Onode, a0: int, a1: int,
                          new_extents: list, to_free: list) -> None:
-        """Swap the extent-map entries covering AU-aligned [a0, a1)."""
+        """Swap the extent-map entries covering AU-aligned [a0, a1).
+        A shared extent's punched AUs go through the refcount release
+        (this is the COW seam: the new extents are fresh and unshared,
+        the old shared AUs live on under their sibling references);
+        split survivors keep their sb_id — per-AU refcounts make a
+        partial punch naturally correct."""
         kept = []
         for x in o.extents:
-            loff, au, n_aus, crc = x
+            loff, au, n_aus, crc, sb = x
             xlen = n_aus * self.AU
             if loff >= a1 or loff + xlen <= a0:
                 kept.append(x)
@@ -402,7 +508,7 @@ class BlueStore(ObjectStore):
             if loff < a0:
                 pre = (a0 - loff) // self.AU
                 kept.append([loff, au, pre,
-                             zlib.crc32(raw[:pre * self.AU])])
+                             zlib.crc32(raw[:pre * self.AU]), sb])
                 raw = raw[pre * self.AU:]
                 au += pre
                 n_aus -= pre
@@ -411,9 +517,9 @@ class BlueStore(ObjectStore):
                 post = (loff + n_aus * self.AU - a1) // self.AU
                 keep_from = n_aus - post
                 kept.append([a1, au + keep_from, post,
-                             zlib.crc32(raw[keep_from * self.AU:])])
+                             zlib.crc32(raw[keep_from * self.AU:]), sb])
                 n_aus = keep_from
-            to_free.append((au, n_aus))
+            self._release_aus(au, n_aus, sb, to_free)
         kept.extend(new_extents)
         kept.sort(key=lambda x: x[0])
         o.extents = kept
@@ -476,9 +582,9 @@ class BlueStore(ObjectStore):
                 lim = -(-new_size // self.AU) * self.AU
                 kept = []
                 for x in o.extents:
-                    loff, au, n_aus, crc = x
+                    loff, au, n_aus, crc, sb = x
                     if loff >= lim:
-                        to_free.append((au, n_aus))
+                        self._release_aus(au, n_aus, sb, to_free)
                     elif loff + n_aus * self.AU > lim:
                         keep = (lim - loff) // self.AU
                         raw = self._read_extent(x)
@@ -486,8 +592,10 @@ class BlueStore(ObjectStore):
                             raise ChecksumError(
                                 f"extent crc mismatch at {loff}")
                         kept.append([loff, au, keep,
-                                     zlib.crc32(raw[:keep * self.AU])])
-                        to_free.append((au + keep, n_aus - keep))
+                                     zlib.crc32(raw[:keep * self.AU]),
+                                     sb])
+                        self._release_aus(au + keep, n_aus - keep, sb,
+                                          to_free)
                     else:
                         kept.append(x)
                 o.extents = kept
@@ -510,16 +618,39 @@ class BlueStore(ObjectStore):
         elif code == OP_CLONE:
             src = self._onode(cid, oid, create=False)
             dst = self._onode(cid, op[3], create=True)
-            payload = self._object_bytes(src)
             for x in dst.extents:
-                to_free.append((x[1], x[2]))
+                self._release_extent(x, to_free)
             dst.extents = []
             dst.size = 0
             dst.attrs = dict(src.attrs)
             dst.omap = dict(src.omap)
-            if payload:
-                self._rewrite_range(dst, 0, payload, to_free)
-                wrote = True
+            if self.config.get("bluestore_sharedblob_enabled", True):
+                # O(metadata) clone: stamp each source extent with a
+                # shared-blob id (first share promotes it, refs=1 per
+                # AU for the source's own claim), copy the extent
+                # ENTRY to the clone and bump the refs — zero data
+                # bytes move. A later overwrite of either side COWs
+                # fresh space and decrements (see _replace_extents).
+                for x in src.extents:
+                    loff, au, n_aus, crc, sb = x
+                    if not sb:
+                        sb = self._next_sb
+                        self._next_sb += 1
+                        x[4] = sb
+                        self.shared[sb] = {
+                            a: 1 for a in range(au, au + n_aus)}
+                    refs = self.shared.setdefault(sb, {})
+                    for a in range(au, au + n_aus):
+                        refs[a] = refs.get(a, 0) + 1
+                    self._shared_dirty.add(sb)
+                    dst.extents.append([loff, au, n_aus, crc, sb])
+                dirty.add((cid, oid))   # src extents got sb stamps
+                self.perf.inc("clones")
+            else:
+                payload = self._object_bytes(src)
+                if payload:
+                    self._rewrite_range(dst, 0, payload, to_free)
+                    wrote = True
             dst.size = src.size
             dirty.add((cid, op[3]))
         elif code == OP_OMAP_SETKEYS:
@@ -538,7 +669,7 @@ class BlueStore(ObjectStore):
     def _covering_extent(self, o: _Onode, au0: int, au1: int):
         """The single extent covering logical AUs [au0, au1], or None."""
         for x in o.extents:
-            loff, au, n_aus, _ = x
+            loff, au, n_aus = x[0], x[1], x[2]
             first = loff // self.AU
             if first <= au0 and au1 < first + n_aus:
                 return x
@@ -553,10 +684,14 @@ class BlueStore(ObjectStore):
         au0 = off // self.AU
         au1 = (off + len(data) - 1) // self.AU
         covered = self._covering_extent(o, au0, au1)
-        if covered is None or len(data) > self.DEFERRED_MAX:
+        if covered is None or len(data) > self.DEFERRED_MAX or \
+                covered[4]:
+            # a SHARED extent can never be patched in place — its
+            # bytes are visible through sibling clones' extent maps;
+            # the rewrite COWs fresh space and decrements the refs
             self._rewrite_range(o, off, data, to_free)
             return True
-        loff, au, n_aus, crc = covered
+        loff, au, n_aus, crc, _sb = covered
         a0 = au0 * self.AU
         a1 = (au1 + 1) * self.AU
         xlen = n_aus * self.AU
@@ -590,7 +725,8 @@ class BlueStore(ObjectStore):
     def _remove(self, cid: str, oid: str, to_free, dirty) -> None:
         o = self.onodes.pop((cid, oid), None)
         if o is not None:
-            to_free.extend((x[1], x[2]) for x in o.extents)
+            for x in o.extents:
+                self._release_extent(x, to_free)
         self.colls.get(cid, set()).discard(oid)
         dirty.add((cid, oid))
 
@@ -627,28 +763,53 @@ class BlueStore(ObjectStore):
     def statfs(self) -> dict:
         free = self.alloc.free_aus * self.AU
         return {"total": self.size, "free": free,
-                "allocated": self.size - free, "au": self.AU}
+                "allocated": self.size - free, "au": self.AU,
+                "shared_blobs": len(self.shared),
+                "shared_aus": sum(len(r) for r in self.shared.values())}
 
     def fsck(self) -> list[str]:
         """BlueStore::_fsck's core: extent bounds, cross-object
         overlap, per-extent crc vs the block file, allocator/extent
-        bitmap consistency (leaks + double-use)."""
+        bitmap consistency (leaks + double-use) — plus the shared-blob
+        cross-check: an AU referenced by more than one extent is legal
+        ONLY under one matching sb_id, and every stored refcount must
+        equal the actual number of extent-map references (a stored
+        count too high is a space leak; too low is a future
+        double-free)."""
         import numpy as np
         errors = []
         seen = np.zeros(self.size // self.AU, dtype=bool)
+        au_sb: dict[int, int] = {}     # au -> sb_id of first reference
+        census: dict[int, dict[int, int]] = {}   # sb -> {au: refs seen}
         for (cid, oid), o in self.onodes.items():
             for x in o.extents:
-                loff, au, n_aus, crc = x
+                loff, au, n_aus, crc, sb = x
                 if au < 0 or (au + n_aus) * self.AU > self.size:
                     errors.append(f"{cid}/{oid}: extent out of bounds")
                     continue
-                if seen[au:au + n_aus].any():
-                    errors.append(
-                        f"{cid}/{oid}: extent overlap at au {au}")
-                seen[au:au + n_aus] = True
+                for a in range(au, au + n_aus):
+                    if seen[a]:
+                        if not sb or au_sb.get(a) != sb:
+                            errors.append(f"{cid}/{oid}: extent "
+                                          f"overlap at au {a}")
+                    else:
+                        seen[a] = True
+                        au_sb[a] = sb
+                    if sb:
+                        blob = census.setdefault(sb, {})
+                        blob[a] = blob.get(a, 0) + 1
                 if zlib.crc32(self._read_extent(x)) != crc:
                     errors.append(
                         f"{cid}/{oid}: crc mismatch at logical {loff}")
+        for sb in sorted(set(census) | set(self.shared)):
+            want = census.get(sb, {})
+            have = self.shared.get(sb, {})
+            for a in sorted(set(want) | set(have)):
+                if want.get(a, 0) != have.get(a, 0):
+                    errors.append(
+                        f"shared blob {sb} au {a}: stored refcount "
+                        f"{have.get(a, 0)} != {want.get(a, 0)} "
+                        f"extent-map references")
         leaked = int((self.alloc.used & ~seen).sum())
         if leaked:
             errors.append(f"allocator leak: {leaked} AUs marked used "

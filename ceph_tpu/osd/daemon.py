@@ -123,6 +123,8 @@ class OSD(Dispatcher):
         self.osdmap = None
         self.pgs: dict[str, PG] = {}
         self._tid = 0
+        # pool id -> snapids whose removed_snaps trim already ran here
+        self._snaps_trimmed: dict[int, set[int]] = {}
         self._hb_last_rx: dict[int, float] = {}
         self._hb_reported: dict[int, float] = {}
         self._hb_task: asyncio.Task | None = None
@@ -191,6 +193,11 @@ class OSD(Dispatcher):
                      self.ec_read_agg.perf,
                      *([self.ec_resident.perf]
                        if self.ec_resident is not None else []),
+                     # round 20: a BlueStore-backed OSD ships the
+                     # shared-blob family (read LIVE off self.store,
+                     # so a revive-remount swaps the new instance in)
+                     *([self.store.perf]
+                       if hasattr(self.store, "perf") else []),
                      self.devmon.perf, self._proc_devmon.perf], cfg)
         self._mgr_report_task: asyncio.Task | None = None
         self._slow_reported = 0     # last slow-op count sent monward
@@ -698,6 +705,45 @@ class OSD(Dispatcher):
         for pgid_s in [p for p, pg in self.pgs.items()
                        if pg.pool.id not in osdmap.pools]:
             self.pgs.pop(pgid_s)
+        self._kick_snap_trim(osdmap, by_pool)
+
+    def _kick_snap_trim(self, osdmap, by_pool: dict) -> None:
+        """Consume the pool removed_snaps deletion queue riding the
+        osdmap (ref: OSDMap pg_pool_t::removed_snaps + the PG snap
+        trimmer wakeup in PeeringState::activate): every snapid newly
+        observed as removed gets a background trim pass on each local
+        primary PG of the pool. Tracking is in-memory only — a restart
+        replays the whole queue, which is safe because trimming is
+        idempotent (clones covering nothing are already gone)."""
+        for pool in osdmap.pools.values():
+            removed = pool.extra.get("removed_snaps") or []
+            fresh = [s for s in removed
+                     if s not in self._snaps_trimmed.get(pool.id, set())]
+            if not fresh:
+                continue
+            self._snaps_trimmed.setdefault(pool.id, set()).update(fresh)
+            pgs = [pg for pg in by_pool.get(pool.id, [])
+                   if pg.is_primary() and not pool.is_erasure()]
+            if not pgs:
+                continue
+            batch = int(self.config.get("osd_snap_trim_batch", 16))
+            sleep = float(self.config.get("osd_snap_trim_sleep", 0.0))
+
+            async def trim(pgs=pgs, fresh=fresh, batch=batch,
+                           sleep=sleep):
+                for sid in fresh:
+                    for pg in pgs:
+                        try:
+                            n = await pg.snap_trim_removed(
+                                sid, batch, sleep)
+                        except Exception as e:   # trim is best-effort
+                            log.dout(1, f"snap trim pg {pg.pgid} "
+                                        f"snap {sid}: {e!r}")
+                            continue
+                        if n:
+                            log.dout(10, f"snap trim pg {pg.pgid}: "
+                                         f"snap {sid}, {n} objects")
+            asyncio.ensure_future(trim())
 
     def _stale_merge_collections(self, osdmap) -> dict[int, list]:
         """ONE pass over the store: pool id -> [(seed, cid)] of

@@ -765,17 +765,22 @@ class ECPG(PG):
     async def _pull(self, from_osd: int, oid: str) -> None:
         """EC primary reconstructs its OWN shard from live peers
         instead of pulling a byte-identical copy."""
+        entry = self.my_missing.get(oid)
         try:
-            await self._reconstruct_local(oid)
+            await self._reconstruct_local(
+                oid, want=None if entry is None else entry.version)
             self.my_missing.pop(oid, None)
         except (StoreError, ConnectionError, OSError,
                 asyncio.TimeoutError) as e:
             log.dout(1, f"pg {self.pgid} ec self-recover {oid}: {e}")
 
-    async def _reconstruct_local(self, oid: str) -> None:
-        ver, size = await self._authoritative_meta(oid)
+    async def _reconstruct_local(self, oid: str,
+                                 want: eversion | None = None) -> None:
+        ver, size = await self._authoritative_meta(oid, want=want)
         if size is None:
-            # deleted everywhere / never existed: drop local
+            # deleted everywhere / never existed — or the only copy at
+            # a usable version is gone (a reverted divergent create):
+            # drop local
             t = Transaction().remove(self.cid, oid)
             self.osd.store.queue_transaction(t)
             return
@@ -783,8 +788,15 @@ class ECPG(PG):
             oid, self.my_shard(), ver, size, apply_local=True,
             exclude_osds=frozenset({self.osd.whoami}))
 
-    async def _authoritative_meta(self, oid: str):
-        """(version, size) of the newest live shard copy."""
+    async def _authoritative_meta(self, oid: str,
+                                  want: eversion | None = None):
+        """(version, size) of the newest live shard copy. With
+        ``want`` set (a divergent-entry revert: the peering election
+        queued a pull back to the authoritative log's version), copies
+        NEWER than it are ignored — the local shard may carry an
+        uncommitted divergent write whose version outranks every
+        surviving peer's, and trusting it would faithfully restore the
+        very write peering just rolled back."""
         best = (eversion(), None)
         for osd_id in set(o for o in self.acting if o >= 0):
             if not self.osd.osd_is_up(osd_id):
@@ -798,6 +810,8 @@ class ECPG(PG):
                 exists = reply.exists
                 ver = eversion(reply.version_epoch, reply.version_v)
                 size = reply.size
+            if want is not None and ver > want:
+                continue
             if exists and (best[1] is None or ver > best[0]):
                 best = (ver, size)
         return best

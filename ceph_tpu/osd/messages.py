@@ -229,7 +229,20 @@ class MOSDPGInfo(Message):
               # authoritative log head at the sender's last persisted
               # watermark advance: the resume-safety token (see
               # MOSDPGBackfill)
-              ("backfill_at_epoch", "u32"), ("backfill_at_v", "u64")]
+              ("backfill_at_epoch", "u32"), ("backfill_at_v", "u64"),
+              # appended (zero-fill): the sender's persisted
+              # last_epoch_started (ref: pg_info_t.last_epoch_started).
+              # find_best_info orders candidates by (les, head) — a
+              # revived pre-failover primary whose log carries a
+              # divergent entry (logged but never committed on enough
+              # shards) has a HIGHER head but a LOWER les than the
+              # interval that peered without it, so it can never win
+              # authority back and resurrect the uncommitted write.
+              ("les", "u32"),
+              # appended: primary -> acting replicas at activation —
+              # adopt ``les`` so a future election hears the newer
+              # interval from ANY survivor, not just the old primary
+              ("activate", "u8")]
 
 
 @register

@@ -131,6 +131,15 @@ class PG:
         self.backfill_targets: dict[int, str] = {}
         self.peer_last_backfill: dict[int, str] = {}
         self.peer_backfill_at: dict[int, eversion] = {}
+        # last_epoch_started (ref: pg_info_t.last_epoch_started): the
+        # interval_start of the newest interval this OSD saw ACTIVATE
+        # for this PG — recorded by the primary when peering completes
+        # and pushed to acting replicas (MOSDPGInfo activate=1), so
+        # every survivor of an interval can out-elect a revived
+        # pre-failover primary's divergent log (find_best_info orders
+        # by (les, head), not head alone). Persisted with the pg meta.
+        self.last_epoch_started = 0
+        self.peer_les: dict[int, int] = {}
         self._backfill_task: asyncio.Task | None = None
         # the (wm, end] name range a backfill scan is comparing RIGHT
         # NOW: mutations inside it park with -EAGAIN so a write — or a
@@ -242,6 +251,7 @@ class PG:
             self.past_intervals = meta.get("past_intervals", [])
             self.interval_start = meta.get("interval_start", 0)
             self.last_epoch_clean = meta.get("last_epoch_clean", 0)
+            self.last_epoch_started = meta.get("last_epoch_started", 0)
             self.last_backfill = meta.get("last_backfill", MAX_OID)
             self.backfill_at = eversion(
                 *meta.get("backfill_at", (0, 0)))
@@ -253,6 +263,7 @@ class PG:
                 "past_intervals": self.past_intervals,
                 "interval_start": self.interval_start,
                 "last_epoch_clean": self.last_epoch_clean,
+                "last_epoch_started": self.last_epoch_started,
                 "last_backfill": self.last_backfill,
                 "backfill_at": list(self.backfill_at),
             }).encode()})
@@ -372,7 +383,8 @@ class PG:
                         intervals=json.dumps(self.past_intervals),
                         last_backfill=self.last_backfill,
                         backfill_at_epoch=self.backfill_at.epoch,
-                        backfill_at_v=self.backfill_at.v)))
+                        backfill_at_v=self.backfill_at.v,
+                        les=self.last_epoch_started, activate=0)))
 
     # -- client backoffs (ref: PG::add_backoff/release_backoffs) ---------
     async def send_backoff(self, m: MOSDOp) -> None:
@@ -490,6 +502,7 @@ class PG:
         interval_epoch = self.epoch
         peers = [o for o in self.live_acting() if o != self.osd.whoami]
         self.peer_logs = {}
+        self.peer_les = {}
         if len(self.live_acting()) < self.pool.min_size:
             self.state = "peering"        # undersized: wait for map
             return
@@ -599,12 +612,30 @@ class PG:
                 return
         else:
             complete = infos
+        # order candidates by (last_epoch_started, head) — ref:
+        # find_best_info's max-les-then-max-last_update. Head alone is
+        # WRONG here: a revived pre-failover primary can carry a
+        # divergent entry (logged locally, never committed on enough
+        # replicas/shards) whose version outranks everything the
+        # surviving interval wrote — but its les predates the interval
+        # that peered without it, so the survivors' log must win and
+        # the divergent entry rolls back below.
+        def _key(o: int, plog: PGLog) -> tuple:
+            les = self.last_epoch_started if o == self.osd.whoami \
+                else self.peer_les.get(o, 0)
+            if plog.head == eversion():
+                # an empty log testifies to nothing: a fresh primary
+                # that activated empty (pgp_num split migration) must
+                # not out-elect a stray actually holding the data
+                les = 0
+            return (les, plog.head)
         best_osd, best, _ = complete[0]
         for o, plog, _lb in complete[1:]:
-            if plog.head > best.head:
+            if _key(o, plog) > _key(best_osd, best):
                 best, best_osd = plog, o
         if backfill_on and \
-                best.head < max(c[1].head for c in infos):
+                _key(best_osd, best) < max(
+                    _key(c[0], c[1]) for c in infos):
             # the newest log lives ONLY on a mid-backfill candidate:
             # adopting the best complete log would roll back writes
             # acknowledged in a later interval (the incomplete holder
@@ -628,11 +659,31 @@ class PG:
             # watermark < MAX is kept: that is resume progress.)
             self.last_backfill = MIN_OID
         if best_osd != self.osd.whoami:
+            # divergent-entry revert (ref: PGLog::_merge_divergent_
+            # entries rolling back to the authoritative version): any
+            # local entry NEWER than the authoritative log's newest for
+            # that object is an uncommitted write the elected interval
+            # never saw — the store may hold its bytes, so queue a pull
+            # back to the authoritative version before serving anything
+            auth_newest = best.newest_per_object()
+            for oid, e in self.pg_log.newest_per_object().items():
+                ae = auth_newest.get(oid)
+                if ae is not None and e.version > ae.version:
+                    log.dout(1, f"pg {self.pgid} reverting divergent "
+                                f"{oid} {e.version} -> {ae.version}")
+                    self.my_missing[oid] = ae
             # merge may ADD to my_missing; leftovers from an earlier
             # interval whose pulls failed must stay until recovered —
             # our log may now BE the best (merged last round) while the
             # object bytes still aren't here
             self.my_missing.update(self.pg_log.merge(best))
+            # our log now IS the authoritative interval's: adopt its
+            # les so the raced-notify check below (and any election we
+            # testify in before re-activating) ranks us where the
+            # merged log actually stands
+            self.last_epoch_started = max(self.last_epoch_started,
+                                          self.peer_les.get(best_osd,
+                                                            0))
             t = self._meta_txn(Transaction())
             self.osd.store.queue_transaction(t)
         if backfill_on and self.last_backfill != MAX_OID:
@@ -757,7 +808,7 @@ class PG:
         # activating and serving stale data. Terminates: the next round
         # adopts that log, making its head ours. (Backfill targets are
         # exempt: their entries are a subset of ours by construction.)
-        if any(pl.head > self.pg_log.head
+        if any(_key(o, pl) > _key(self.osd.whoami, self.pg_log)
                for o, pl in self.peer_logs.items()
                if o not in self.backfill_targets):
             log.dout(1, f"pg {self.pgid} raced notify knows newer "
@@ -766,6 +817,27 @@ class PG:
             self.osd.request_repeer(self, delay=0.2)
             return
         self.state = "active"
+        # record + broadcast the activation epoch: this interval is
+        # now "started", and every acting survivor must be able to
+        # testify to it in a future election (see MOSDPGInfo.les) —
+        # persist BEFORE serving so a crash can't forget the interval
+        if self.interval_start > self.last_epoch_started:
+            self.last_epoch_started = self.interval_start
+            self.osd.store.queue_transaction(
+                self._meta_txn(Transaction()))
+            for o in self.acting:
+                if o < 0 or o == self.osd.whoami or \
+                        not self.osd.osd_is_up(o):
+                    continue
+                asyncio.ensure_future(self.osd.send_osd(
+                    o, MOSDPGInfo(
+                        pgid=self.cid, epoch=self.epoch,
+                        from_osd=self.osd.whoami,
+                        log=self.pg_log.encode(), notify=0,
+                        intervals="", last_backfill=self.last_backfill,
+                        backfill_at_epoch=self.backfill_at.epoch,
+                        backfill_at_v=self.backfill_at.v,
+                        les=self.last_epoch_started, activate=1)))
         # activation releases the peering backoffs: parked clients
         # resend and the ops now dispatch (ref: on_activate_complete
         # releasing PG backoffs)
@@ -782,11 +854,26 @@ class PG:
             log=self.pg_log.encode(), notify=0, intervals="",
             last_backfill=self.last_backfill,
             backfill_at_epoch=self.backfill_at.epoch,
-            backfill_at_v=self.backfill_at.v)))
+            backfill_at_v=self.backfill_at.v,
+            les=self.last_epoch_started, activate=0)))
 
     def handle_pg_info(self, m: MOSDPGInfo) -> None:
+        if getattr(m, "activate", 0):
+            # primary's activation broadcast: adopt the started epoch
+            # so THIS replica can out-elect a revived older primary
+            # even if the broadcasting primary later dies too
+            if m.les > self.last_epoch_started:
+                self.last_epoch_started = m.les
+                try:
+                    self.osd.store.queue_transaction(
+                        self._meta_txn(Transaction()))
+                except StoreError as e:
+                    log.error(f"pg {self.pgid} les persist failed: "
+                              f"{e}")
+            return
         plog = PGLog.decode(m.log)
         self.peer_logs[m.from_osd] = plog
+        self.peer_les[m.from_osd] = getattr(m, "les", 0)
         self.peer_last_backfill[m.from_osd] = m.last_backfill
         self.peer_backfill_at[m.from_osd] = eversion(
             m.backfill_at_epoch, m.backfill_at_v)
@@ -921,19 +1008,58 @@ class PG:
             # data preserved for OTHER snaps with the current head
             # (silent snapshot corruption, r4 review finding)
             return None
-        data = store.read(self.cid, oid)
-        attrs = dict(store.getattrs(self.cid, oid))
-        omap = store.omap_get(self.cid, oid)
-        t.touch(self.cid, clone)
-        if data:
-            t.write(self.cid, clone, 0, data)
-        attrs["_clsnaps"] = json.dumps(new_snaps).encode()
-        attrs.pop("_pre", None)
-        t.setattrs(self.cid, clone, attrs)
-        if omap:
-            t.omap_setkeys(self.cid, clone, omap)
+        # O(metadata) clone (ref: make_writeable -> _make_clone): the
+        # store's OP_CLONE carries data+attrs+omap to the clone object —
+        # on BlueStore by sharing the head's blobs (refcount bump, zero
+        # data bytes move), so snapshotting never costs O(size) here.
+        size = store.stat(self.cid, oid)
+        t.clone(self.cid, oid, clone)
+        t.setattrs(self.cid, clone,
+                   {"_clsnaps": json.dumps(new_snaps).encode()})
+        t.rmattr(self.cid, clone, "_pre")
+        # clone_overlap (ref: SnapSet::clone_overlap): byte ranges the
+        # clone still shares with the head. Starts as the full clone
+        # extent; head writes in this same op (and later ones) subtract
+        # themselves in do_op. Only the NEWEST clone's overlap is live:
+        # once a younger clone exists, the older clone's overlap-vs-head
+        # at that moment equals its overlap vs the younger clone, and
+        # both sides are immutable from then on — so freezing it is
+        # exact, not an approximation. Recovery/scrub can use it to push
+        # only divergent bytes.
+        t.setattrs(self.cid, clone, {"_clover": json.dumps(
+            [[0, size]] if size else []).encode()})
         self._clone_idx = None          # clone set changes when t lands
         return clone
+
+    def _newest_clone_overlap(self, oid: str) -> tuple[str, list] | None:
+        """(clone_name, overlap_intervals) of the newest existing clone
+        of oid, or None when there is no clone / no recorded overlap."""
+        clones = self._clone_list(oid)
+        if not clones:
+            return None
+        name = clone_name(oid, clones[-1][0])
+        try:
+            blob = self.osd.store.getattrs(self.cid, name).get("_clover")
+        except StoreError:
+            return None
+        if not blob:
+            return None
+        return name, json.loads(blob)
+
+    @staticmethod
+    def _overlap_sub(ivals: list, off: int, end: int | None) -> list:
+        """Subtract [off, end) (end None = to infinity) from sorted
+        disjoint [lo, hi) intervals (ref: interval_set::subtract)."""
+        out = []
+        for lo, hi in ivals:
+            if (end is not None and end <= lo) or off >= hi:
+                out.append([lo, hi])
+                continue
+            if lo < off:
+                out.append([lo, off])
+            if end is not None and end < hi:
+                out.append([end, hi])
+        return out
 
     def _snaptrim(self, t: Transaction, oid: str, snap_id: int) -> list:
         """Drop snap_id from the object's clones; clones covering no
@@ -954,6 +1080,42 @@ class PG:
         if touched:
             self._clone_idx = None
         return touched
+
+    async def snap_trim_removed(self, snap_id: int, batch: int,
+                                sleep: float) -> int:
+        """Primary-driven background trim of one deleted snapid (ref:
+        PrimaryLogPG::do_snap_trim / the SnapTrimmer state machine,
+        driven here from the osdmap's removed_snaps queue): every clone
+        covering snap_id drops it, clones covering nothing are removed.
+        Replicated via the normal repop pipeline (one log entry per
+        touched clone), `batch` objects per burst with `sleep` between
+        bursts so client I/O is not starved. Idempotent — a restart
+        replays the whole removed_snaps queue. Returns objects trimmed."""
+        if not self.is_primary():
+            return 0
+        store = self.osd.store
+        try:
+            names = store.list_objects(self.cid)
+        except StoreError:
+            return 0
+        heads = sorted({h for h in (clone_head(n) for n in names)
+                        if h is not None})
+        done = 0
+        for i, head in enumerate(heads):
+            if not self.is_primary():       # map moved the PG away
+                break
+            t = Transaction()
+            touched = self._snaptrim(t, head, snap_id)
+            if not touched:
+                continue
+            reqid = (f"osd.{self.osd.whoami}.snaptrim", 0,
+                     self.osd.next_tid())
+            await self._submit_write(head, t, False, reqid,
+                                     extra_oids=touched)
+            done += 1
+            if sleep and batch and (i + 1) % batch == 0:
+                await asyncio.sleep(sleep)
+        return done
 
     # -- watch/notify ------------------------------------------------------
     async def _do_notify(self, m, oid: str, timeout_ms: int,
@@ -1617,6 +1779,15 @@ class PG:
         snap_seq = getattr(m, "snap_seq", 0)
         snapc = list(getattr(m, "snaps", []) or [])
         snap_id = getattr(m, "snap_id", 0)
+        # filter the client's snap context against the pool's deletion
+        # queue (ref: PrimaryLogPG::filter_snapc): a laggy client whose
+        # context still names a deleted snap must not make the COW path
+        # mint a clone covering it — the trimmer already ran for that
+        # snapid and would never revisit it
+        removed = self.pool.extra.get("removed_snaps")
+        if removed and snapc:
+            rm = set(removed)
+            snapc = [s for s in snapc if s not in rm]
         # snap reads resolve once to the serving object (clone or head)
         read_oid = oid
         if snap_id:
@@ -1626,6 +1797,15 @@ class PG:
                 return
             read_oid = resolved
         born_after: list[int] = []
+        # clone_overlap upkeep: (clone_name, intervals) of the newest
+        # clone; data-mutating ops below subtract their ranges, and a
+        # single _clover setattrs is appended after the op loop when
+        # anything actually shrank (setattrs auto-creates, so writing
+        # unchanged intervals back could resurrect a trimmed clone)
+        overlap: tuple[str, list] | None = None
+        overlap_dirty = False
+        if any(c in mutating for c in m.op_codes):
+            overlap = self._newest_clone_overlap(oid)
         if snap_seq and any(c in mutating for c in m.op_codes):
             # clone-on-write rides in the SAME transaction as the
             # mutation (atomic on every replica); the clone gets its own
@@ -1633,6 +1813,13 @@ class PG:
             clone = self._maybe_cow(t, oid, snap_seq, snapc)
             if clone:
                 cow_clones.append(clone)
+                # the just-made clone (same txn) is now the newest:
+                # its overlap starts at the full pre-mutation extent
+                try:
+                    sz = store.stat(cid, oid)
+                except StoreError:
+                    sz = 0
+                overlap = (clone, [[0, sz]] if sz else [])
             elif not store.exists(cid, oid):
                 # the object is being born after these snaps existed:
                 # mark it (APPENDED after the mutation ops — a WRITEFULL
@@ -1694,19 +1881,35 @@ class PG:
                 if touched:
                     mutated = True
                     cow_clones.extend(touched)
+                overlap = None      # clone set changed under us
             elif code == OSD_OP_WRITE:
                 t.write(cid, oid, off, data)
                 mutated = True
+                if overlap:
+                    overlap = (overlap[0], self._overlap_sub(
+                        overlap[1], off, off + len(data)))
+                    overlap_dirty = True
             elif code == OSD_OP_WRITEFULL:
                 t.remove(cid, oid)
                 t.write(cid, oid, 0, data)
                 mutated = True
+                if overlap:
+                    overlap = (overlap[0], [])
+                    overlap_dirty = True
             elif code == OSD_OP_TRUNCATE:
                 t.truncate(cid, oid, off)
                 mutated = True
+                if overlap:
+                    overlap = (overlap[0], self._overlap_sub(
+                        overlap[1], off, None))
+                    overlap_dirty = True
             elif code == OSD_OP_ZERO:
                 t.zero(cid, oid, off, length)
                 mutated = True
+                if overlap:
+                    overlap = (overlap[0], self._overlap_sub(
+                        overlap[1], off, off + length))
+                    overlap_dirty = True
             elif code == OSD_OP_DELETE:
                 if not store.exists(cid, oid):
                     await self._reply(m, -2, b"", {})
@@ -1714,6 +1917,9 @@ class PG:
                 t.remove(cid, oid)
                 mutated = True
                 deleted = True
+                if overlap:
+                    overlap = (overlap[0], [])
+                    overlap_dirty = True
             elif code == OSD_OP_SETXATTR:
                 t.touch(cid, oid)
                 # attrs persist past the op: copy out of the frame view
@@ -1738,6 +1944,11 @@ class PG:
         if born_after and not deleted:
             t.setattrs(cid, oid,
                        {"_pre": json.dumps(born_after).encode()})
+        if overlap is not None and overlap_dirty:
+            # last-op-wins: this setattrs lands after _maybe_cow's
+            # initial full-extent _clover in the same transaction
+            t.setattrs(cid, overlap[0],
+                       {"_clover": json.dumps(overlap[1]).encode()})
         result, applied, waiter = await self._submit_write(
             oid, t, deleted, reqid, extra_oids=cow_clones)
         if result == -11 and waiter is not None and waiter.done():

@@ -17,6 +17,20 @@ copy-on-write children referencing a PROTECTED parent snapshot with
 client-side fallthrough reads and copy-up on first write, like the
 reference's layering (ref: src/librbd/io/CopyupRequest).
 
+Header note (round 20): the header omap's ``meta`` blob is the
+image's whole control plane — ``size``/``order`` plus ``snaps``
+(name -> {id, size-at-snap}), ``protected`` (snap names), ``parent``
+({image, snap} for clone children) and ``children``
+([(child, parent-snap)] on the PARENT). Every refusal decision
+(snap_remove/unprotect/clone/remove) re-reads the header first
+(``Image._refresh_meta``) instead of trusting open-time state:
+upstream serializes these through cls_rbd on the header object, and
+the re-read is this client's seat for that atomicity — deciding on a
+stale ``children`` list is exactly the open-clone-child race the
+errno-matrix test pins (-EBUSY on unprotect/rm with children, which
+applies even to an UNprotected snap: a crash between clone and
+protect must not strand the child).
+
 Incremental replication (round 5): ``Image.export_diff`` /
 ``import_diff`` speak the ``rbd diff v1`` tagged stream
 (from-snap/to-snap/size/write/zero records), so snapshots chain
@@ -189,6 +203,21 @@ class Image:
         if self.snap_name is not None:
             raise ObjectOperationError(-30, "snapshot view is read-only")
 
+    async def _refresh_meta(self) -> None:
+        """Re-read the header before a refusal decision. The children
+        list lives in the parent's header omap; another handle's
+        clone()/remove() mutates it AFTER this Image was opened, so
+        deciding unprotect/rm on the open-time snapshot of meta races
+        an open clone child (ref: upstream serializes these through
+        cls_rbd on the header object — the re-read is this client's
+        seat for that atomicity)."""
+        omap = await self.ioctx.get_omap_vals(_header(self.name))
+        if "meta" not in omap:
+            raise ObjectOperationError(-2, f"no image {self.name}")
+        self.meta = json.loads(omap["meta"])
+        self.snaps = self.meta.get("snaps", {})
+        self.parent = self.meta.get("parent")
+
     async def snap_create(self, snap_name: str) -> int:
         """ref: Image::snap_create — allocate a self-managed snap id,
         record it; subsequent writes clone-on-write at the OSD."""
@@ -206,31 +235,54 @@ class Image:
                                    key=lambda kv: kv[1]["id"])]
 
     async def snap_protect(self, snap_name: str) -> None:
+        """ref: Image::snap_protect — -EBUSY when already protected
+        (the reference's errno, pinned by the snap matrix test)."""
+        await self._refresh_meta()
         if snap_name not in self.snaps:
             raise ObjectOperationError(-2, f"no snap {snap_name}")
         prot = self.meta.setdefault("protected", [])
-        if snap_name not in prot:
-            prot.append(snap_name)
-            await self._save_meta()
+        if snap_name in prot:
+            raise ObjectOperationError(
+                -16, f"snap {snap_name} already protected")
+        prot.append(snap_name)
+        await self._save_meta()
 
     async def snap_unprotect(self, snap_name: str) -> None:
+        """ref: Image::snap_unprotect — -ENOENT for a missing snap,
+        -EINVAL when not protected, -EBUSY while clone children
+        reference it. Decides on a FRESH header read: a clone created
+        through another handle after this one opened must still
+        refuse (the open-child race in the children list)."""
+        await self._refresh_meta()
+        if snap_name not in self.snaps:
+            raise ObjectOperationError(-2, f"no snap {snap_name}")
+        if snap_name not in self.meta.get("protected", []):
+            raise ObjectOperationError(
+                -22, f"snap {snap_name} is not protected")
         children = [c for c in self.meta.get("children", [])
                     if c[1] == snap_name]
         if children:
             raise ObjectOperationError(-16, "snap has clone children")
-        prot = self.meta.setdefault("protected", [])
-        if snap_name in prot:
-            prot.remove(snap_name)
-            await self._save_meta()
+        self.meta["protected"].remove(snap_name)
+        await self._save_meta()
 
     async def snap_remove(self, snap_name: str) -> None:
         """ref: Image::snap_remove — trims the snap from every data
-        object's clones, then drops it from the header and pool."""
+        object's clones, then drops it from the header and pool.
+        Children are checked independently of protection: the
+        protect flag and the children list are written in separate
+        header updates, so a crash can strand children on an
+        unprotected snap — their parent data must still refuse to
+        die (-EBUSY, same as the reference's list_children gate)."""
+        await self._refresh_meta()
         snap = self.snaps.get(snap_name)
         if snap is None:
             raise ObjectOperationError(-2, f"no snap {snap_name}")
         if snap_name in self.meta.get("protected", []):
             raise ObjectOperationError(-16, f"snap {snap_name} protected")
+        if any(c[1] == snap_name
+               for c in self.meta.get("children", [])):
+            raise ObjectOperationError(-16, "snap has clone children")
         top = max(self.size_bytes, snap["size"])
         for idx in self._object_range(0, top):
             try:

@@ -346,6 +346,131 @@ class Thrasher:
         return {"victim": victim, "acked_writes": written,
                 "horizon_writes": writes}
 
+    async def snap_storm(self, io, writes: int = 24, snaps: int = 3,
+                         image_kb: int = 32,
+                         settle_timeout: float = 240.0) -> dict:
+        """The point-in-time honesty storm (the snapshot acceptance
+        shape): an RBD image takes a continuous overwrite storm while
+        snapshots are cut mid-stream and a background writer keeps
+        racing the head; after the first snapshot one OSD is killed
+        and the storm keeps writing, then the victim revives. Each
+        snapshot's full readback is captured right after creation —
+        the deterministic main region must already equal the tracked
+        head — and at the end every capture must re-read
+        byte-identical: the OSD's shared-blob COW clones have to
+        freeze the past while the head moves across an acting-set
+        change and recovery replays history onto the revived OSD.
+        Writers are quiesced around each snap cut (the librbd
+        flush-before-snap discipline): an in-flight write stamped
+        with the pre-snap snapc would legitimately land inside the
+        new snapshot. Call ``settle_and_verify`` after for the
+        fsck/shared-blob-refcount cross-check. Returns {victim,
+        snaps_verified, acked_writes, image}."""
+        from ceph_tpu.rbd import RBD
+        rng = random.Random(self.seed ^ 0x54A905)
+        name = f"snapstorm-{self.seed}"
+        size = image_kb * 1024
+        rbd = RBD(io)
+        await rbd.create(name, size, order=12)
+        img = await rbd.open(name)
+        # main region: deterministic, tracked in ``expected``; tail
+        # quarter: the background writer's racetrack (frozen-from-
+        # capture only, never compared against a model)
+        main_len = size * 3 // 4
+        base = bytes(rng.randrange(256) for _ in range(size))
+        await img.write(0, base)
+        expected = bytearray(base)
+        captures: dict[str, bytes] = {}
+        snap_lock = asyncio.Lock()
+        bg_stop = asyncio.Event()
+
+        async def bg_writer():
+            i = 0
+            lanes = max(1, (size - main_len) // 512 - 1)
+            while not bg_stop.is_set():
+                off = main_len + (i % lanes) * 512
+                try:
+                    async with snap_lock:
+                        await img.write(off, bytes([i % 256]) * 512)
+                except Exception as e:
+                    self._write_errors += 1
+                    log.dout(5, f"snap-storm bg write failed: {e!r}")
+                i += 1
+                await asyncio.sleep(0.01)
+
+        bg = asyncio.ensure_future(bg_writer())
+        victim = None
+        written = 0
+        snap_every = max(1, writes // snaps)
+        try:
+            for i in range(writes):
+                off = rng.randrange(0, main_len - 1)
+                n = rng.randint(1, min(2048, main_len - off))
+                data = bytes([rng.randrange(256)]) * n
+                try:
+                    async with snap_lock:
+                        await img.write(off, data)
+                    expected[off:off + n] = data
+                    written += 1
+                except Exception as e:
+                    self._write_errors += 1
+                    log.dout(5, f"snap-storm write failed: {e!r}")
+                if (i + 1) % snap_every == 0 and len(captures) < snaps:
+                    sname = f"storm-{len(captures)}"
+                    async with snap_lock:
+                        await img.snap_create(sname)
+                        view = await rbd.open(name, snapshot=sname)
+                        cap = await view.read(0, size)
+                    assert cap[:main_len] == bytes(expected[:main_len]), \
+                        f"snapshot {sname} differs from the head it froze"
+                    captures[sname] = cap
+                    self._log(f"snap storm: cut+captured {sname}")
+                    if victim is None:
+                        live = self._live_osds()
+                        if len(live) > self.min_live_osds:
+                            victim = live[rng.randrange(len(live))]
+                            await self.c.kill_osd(victim)
+                            st = self.c.osds[victim].store
+                            if self.store_factory is not None and \
+                                    hasattr(st, "umount"):
+                                st.umount()
+                            self.downed.append(victim)
+                            self._log(f"snap storm: kill osd.{victim}")
+                            try:
+                                await self.c.wait_for_osd_down(
+                                    victim, timeout=60)
+                            except TimeoutError:
+                                self._log(f"osd.{victim} not marked "
+                                          f"down in time")
+        finally:
+            bg_stop.set()
+            bg.cancel()
+            try:
+                await bg
+            except asyncio.CancelledError:
+                pass
+        if victim is not None:
+            self.downed.remove(victim)
+            new_store = self.store_factory(victim) \
+                if self.store_factory is not None else None
+            await self.c.revive_osd(victim, store=new_store)
+            self._log(f"snap storm: revive osd.{victim}")
+        await self.c.wait_for_clean(timeout=settle_timeout)
+        verified = 0
+        for sname, cap in captures.items():
+            view = await rbd.open(name, snapshot=sname)
+            got = await view.read(0, size)
+            assert got == cap, \
+                f"snapshot {sname} drifted after the storm"
+            verified += 1
+        head = await (await rbd.open(name)).read(0, size)
+        assert head[:main_len] == bytes(expected[:main_len]), \
+            "head lost acked writes after the storm"
+        self._log(f"snap storm: {verified} snapshots byte-identical, "
+                  f"head intact")
+        return {"victim": victim, "snaps_verified": verified,
+                "acked_writes": written, "image": name}
+
     async def overload_storm(self, io, writers: int = 4,
                              write_bytes: int = 1024,
                              prefill: int = 24,

@@ -262,6 +262,26 @@ OPTIONS: dict[str, Option] = {o.name: o for o in [
     Option("mds_session_timeout", float, 10.0,
            "client cap-lease length advertised at session open",
            min=0.1),
+    # snapshots (ref: osd.yaml.in osd_snap_trim_sleep / osd_pg_max_
+    # concurrent_snap_trims, bluestore shared-blob machinery, mds
+    # snapshot enablement): the snap subsystem's three layers.
+    Option("bluestore_sharedblob_enabled", bool, True,
+           "OP_CLONE shares the source's blobs (refcounted, zero data "
+           "bytes move); false restores the seed's O(size) byte-copy "
+           "clone"),
+    Option("osd_snap_trim_batch", int, 16,
+           "head objects trimmed per burst by the removed_snaps "
+           "background trimmer before sleeping", min=1),
+    Option("osd_snap_trim_sleep", float, 0.0,
+           "seconds the background snap trimmer sleeps between "
+           "bursts (0 = no pacing)", min=0.0),
+    Option("mds_snap_enabled", bool, True,
+           "serve .snap/<name> snapshot verbs (mksnap/rmsnap/readdir "
+           "through a realm); false returns -EPERM like upstream's "
+           "allow_new_snaps=false"),
+    Option("mds_snap_max_per_realm", int, 100,
+           "snapshots one directory may hold before mksnap -EMLINK",
+           min=1),
     # multi-active metadata plane (round 7; ref: mds_bal_* options +
     # the Migrator's export sizing): the mon-side load rebalancer and
     # the two-phase subtree migration.

@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.rados import ObjectOperationError
 
 
 def run(coro):
@@ -526,6 +527,133 @@ def test_ec_read_agg_cluster_acceptance():
                 assert await io.read(oid) == data, oid
             r3 = ragg_totals()
             assert r3["qos_grants"] - r0.get("qos_grants", 0) >= 1
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_ec_killed_primary_overwrites_survive_revive():
+    """Killed-primary acceptance: kill -9 the PG primary, overwrite
+    the object several generations while it is down, revive it. The
+    revived primary's stale log must NOT win peering back — every
+    while-down overwrite stays committed and the log heads of all
+    live holders converge."""
+    async def go():
+        c, io = await _ec_cluster(
+            n_osds=3, config={"mon_osd_down_out_interval": 600.0})
+        try:
+            rng = np.random.default_rng(1919)
+            objs = {f"d-{i}": rng.integers(
+                0, 256, int(rng.integers(2000, 6000)),
+                dtype=np.uint8).tobytes() for i in range(6)}
+            for oid, data in objs.items():
+                await io.write_full(oid, data, timeout=60.0)
+            prim = cid0 = None
+            for o in c.osds:
+                for cid in o.store.list_collections():
+                    if "d-0" in o.store.list_objects(cid):
+                        pg = o.pgs.get(str(cid))
+                        if pg is not None and pg.is_primary():
+                            prim, cid0 = o.whoami, cid
+            assert prim is not None
+            await c.kill_osd(prim)
+            await c.client.mon_command(
+                {"prefix": "osd down", "id": prim})
+            await c.wait_for_osd_down(prim, timeout=60)
+            # several overwrite generations while the primary is down
+            for gen in range(3):
+                objs["d-0"] = bytes([65 + gen]) * (2000 + gen * 500)
+                await io.write_full("d-0", objs["d-0"], timeout=60.0)
+            objs["while-down"] = b"W" * 3000
+            await io.write_full("while-down", objs["while-down"],
+                                timeout=60.0)
+            await c.revive_osd(prim)
+            await c.wait_for_clean(timeout=240)
+            for oid, data in objs.items():
+                assert await io.read(oid, timeout=60.0) == data, oid
+            heads = {o.whoami: tuple(o.pgs[str(cid0)].pg_log.head)
+                     for o in c.osds
+                     if not o._stopped and str(cid0) in o.pgs}
+            assert len(set(heads.values())) == 1, heads
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_ec_revived_primary_divergent_entry_rolls_back():
+    """THE stale-primary-log pin (find_best_info by (les, head)): a
+    write that logs on the primary but commits on fewer than k shards
+    (both replica sub-writes dropped) leaves a DIVERGENT log entry
+    whose version outranks everything the surviving interval has —
+    the survivors take NO writes, so their head stays at the last
+    committed version and a head-only election would hand authority
+    back to the revived primary, resurrecting a write whose client
+    was told it FAILED. The survivors' activation (recorded as
+    last_epoch_started) must out-rank the divergent head, the entry
+    must roll back, and reads must serve the committed bytes."""
+    async def go():
+        c, io = await _ec_cluster(
+            n_osds=3, config={"mon_osd_down_out_interval": 600.0})
+        try:
+            committed = b"committed" * 500
+            await io.write_full("obj", committed, timeout=60.0)
+            prim = cid0 = None
+            for o in c.osds:
+                for cid in o.store.list_collections():
+                    if "obj" in o.store.list_objects(cid):
+                        pg = o.pgs.get(str(cid))
+                        if pg is not None and pg.is_primary():
+                            prim, cid0 = o.whoami, cid
+            assert prim is not None
+            # drop BOTH replicas' sub-writes: the next write appends
+            # to the primary's log but can never reach k durable
+            # shards — the client is told -EIO, yet the entry (and the
+            # primary's own shard bytes) linger in its store
+            patched = []
+            for o in c.osds:
+                if o.whoami == prim or o._stopped:
+                    continue
+                pg = o.pgs.get(str(cid0))
+                if pg is not None:
+                    patched.append((pg, pg.handle_ec_sub_write))
+                    pg.handle_ec_sub_write = lambda m: None
+            with pytest.raises(ObjectOperationError):
+                await io.write_full("obj", b"never-committed" * 400,
+                                    timeout=60.0)
+            for pg, orig in patched:
+                pg.handle_ec_sub_write = orig
+            old_primary_pg = c.osds[prim].pgs[str(cid0)]
+            divergent_head = old_primary_pg.pg_log.head
+            await c.kill_osd(prim)
+            await c.client.mon_command(
+                {"prefix": "osd down", "id": prim})
+            await c.wait_for_osd_down(prim, timeout=60)
+            # survivors peer and ACTIVATE a new interval — crucially
+            # with NO writes: their head stays at the committed
+            # version, strictly BELOW the divergent entry. A head-only
+            # election would elect the revived primary's log here.
+            # Degraded reads prove the survivors activated and serve
+            # the committed bytes.
+            assert await io.read("obj", timeout=60.0) == committed
+            # revive: the old primary re-wins primariness (same crush
+            # position) with the higher-versioned divergent log
+            await c.revive_osd(prim)
+            await c.wait_for_clean(timeout=240)
+            # the never-committed write stays dead
+            got = await io.read("obj", timeout=60.0)
+            assert got == committed, (len(got), got[:20])
+            # the revived holder's log adopted the survivors' head and
+            # dropped the divergent entry
+            heads = {o.whoami: tuple(o.pgs[str(cid0)].pg_log.head)
+                     for o in c.osds
+                     if not o._stopped and str(cid0) in o.pgs}
+            assert len(set(heads.values())) == 1, heads
+            assert heads[prim] != tuple(divergent_head), heads
+            # and a deep scrub over the PG finds nothing to repair
+            revived = c.osds[prim].pgs[str(cid0)]
+            if revived.is_primary():
+                res = await revived.scrubber.scrub(deep=True)
+                assert res["errors"] == [], res
         finally:
             await c.stop()
     run(go())

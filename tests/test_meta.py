@@ -60,6 +60,10 @@ STORM_BUDGETS = {
     # real process and waits out a supervised respawn (interpreter
     # start ~2-3 s each) — non-slow callers take the defaults
     "proc_storm": {"settle_timeout": 180.0},
+    # the round-20 snapshot honesty storm: every write under a snap
+    # context pays an OSD-side COW clone, and each snap cut pays a
+    # full-image capture read — keep the smoke image small
+    "snap_storm": {"writes": 24, "snaps": 3, "image_kb": 32},
 }
 BUILTIN_MARKS = {
     "parametrize", "skip", "skipif", "xfail", "usefixtures",
@@ -288,7 +292,8 @@ _CANNED_STATUS = {
                                 "full": 0}],
                "pending_merges": {"p": {"ready": 1}},
                "slow_osds": {"2": 4.5},
-               "degraded_kernel_paths": {"1": 0.5}},
+               "degraded_kernel_paths": {"1": 0.5},
+               "removed_snaps": 3},
     "pgmap": {"num_pgs": 8, "degraded_pgs": 0, "backfilling_pgs": 0,
               "backfill_progress": {"pushed": 0}, "num_objects": 4,
               "num_bytes": 64, "states": {"active+clean": 8}},
@@ -296,7 +301,7 @@ _CANNED_STATUS = {
               "standby_count": 1, "failed": [], "max_mds": 2,
               "actives": {"0": "a"}, "migrations": [],
               "subtrees": {"/": 0, "/d1": 1},
-              "rank_ops_rate": {"0": 1.5}},
+              "rank_ops_rate": {"0": 1.5}, "num_snaps": 2},
     "mgrmap": {"epoch": 4, "active_name": "x", "active_gid": 1,
                "available": True, "standbys": ["y"]},
     "progress": {"events": [{"id": "backfill", "fraction": 0.25,
@@ -385,6 +390,17 @@ def _render_prometheus(reported: bool = False) -> str:
                    .add_u64_counter("hits", "guard fixture")
                    .add_u64("resident_bytes", "guard fixture")
                    .create_perf_counters(register=False))
+            # the round-20 shared-blob clone plane reaches /metrics
+            # the same report-session-only way (the family lives on
+            # the BlueStore instance, register=False) — seed it so
+            # the dedicated ceph_bluestore_sharedblob_* render path
+            # stays inside the exposition-format guards
+            sbp = (PerfCountersBuilder("bluestore_sharedblob")
+                   .add_u64_counter("clones", "guard fixture")
+                   .add_u64_counter("cow_released", "guard fixture")
+                   .add_u64_counter("aus_freed", "guard fixture")
+                   .add_u64("records", "guard fixture")
+                   .create_perf_counters(register=False))
             # the round-14 device-runtime families reach /metrics the
             # same report-session-only way (per-daemon `devmon`
             # path-health counters + the process `device_runtime`
@@ -402,7 +418,8 @@ def _render_prometheus(reported: bool = False) -> str:
                   .add_u64_counter("h2d_bytes", "guard fixture")
                   .create_perf_counters(register=False))
             idx.report(name, 1,
-                       schema_entries([pc, agg, ragg, res, dd, dp]),
+                       schema_entries([pc, agg, ragg, res, sbp, dd,
+                                       dp]),
                        1.0, {
                 name: {
                     "ops": 7,
@@ -421,6 +438,9 @@ def _render_prometheus(reported: bool = False) -> str:
                                         "sum": 24.0}},
                 "osd_ec_resident": {
                     "hits": 9, "resident_bytes": 8192},
+                "bluestore_sharedblob": {
+                    "clones": 6, "cow_released": 11,
+                    "aus_freed": 5, "records": 2},
                 "devmon": {
                     "path_checks": 12, "path_mismatch": 4,
                     "launches_pallas": 8, "launches_xla": 4},
@@ -445,6 +465,10 @@ def _render_prometheus(reported: bool = False) -> str:
     assert "ceph_tuner_actions_reverted 1" in text, text
     assert "ceph_tuner_proposals_deferred 1" in text, text
     assert "ceph_tuner_active_streaks 1" in text, text
+    # round 20: the snapshot plane's status-driven rows render on
+    # BOTH paths (they only consume the canned status)
+    assert "ceph_snap_registered 2" in text, text
+    assert "ceph_snap_removed 3" in text, text
     if reported:
         # the canned index must actually drive the render: reported
         # rows + the osd perf digest rows, singleton rows absent
@@ -485,6 +509,15 @@ def _render_prometheus(reported: bool = False) -> str:
             '{ceph_daemon="osd.0"} 8192' in text, text
         assert 'counter="osd_ec_read_agg.' not in text, text
         assert 'counter="osd_ec_resident.' not in text, text
+        # round 20: the shared-blob clone plane renders through its
+        # dedicated block only (never doubled via generic ceph_perf)
+        assert 'ceph_bluestore_sharedblob_clones' \
+            '{ceph_daemon="osd.0"} 6' in text, text
+        assert 'ceph_bluestore_sharedblob_aus_freed' \
+            '{ceph_daemon="osd.1"} 5' in text, text
+        assert 'ceph_bluestore_sharedblob_records' \
+            '{ceph_daemon="osd.0"} 2' in text, text
+        assert 'counter="bluestore_sharedblob.' not in text, text
     return text
 
 
@@ -657,6 +690,31 @@ def test_tuner_knobs_registered_with_defaults():
     so an unregistered knob silently diverges from `config show`
     exactly when an operator is reining the loop in."""
     _assert_knobs_registered(("mgr_tuner_", "mon_tune_"), "tuner")
+
+
+def test_snap_knobs_registered_with_defaults():
+    """Round 20: every snapshot-plane knob — the MDS snaprealm gates
+    (`mds_snap_*`), the BlueStore shared-blob switch
+    (`bluestore_sharedblob_*`), and the OSD snap trimmer's pacing
+    (`osd_snap_trim_*`) — read anywhere must be a registered Option
+    with a default. The trimmer reads batch/sleep LIVE per
+    removed-snaps drain and the store reads the sharedblob switch per
+    clone, so an unregistered knob silently diverges from
+    `config show` exactly when an operator is pacing a trim storm."""
+    _assert_knobs_registered(
+        ("mds_snap_", "bluestore_sharedblob_", "osd_snap_trim_"),
+        "snapshot")
+
+
+def test_snap_cli_verbs_cap_classes():
+    """Round 20: `fs snap ls` is pinned in the read-only cap class
+    (an `allow r` mon cap may list snapshots); `fs snap create` and
+    `fs snap rm` mutate the registry + the pool removed_snaps queue
+    and must NOT be — they stay behind `mon w`."""
+    from ceph_tpu.mon.auth_monitor import READONLY_COMMANDS
+    assert "fs snap ls" in READONLY_COMMANDS
+    assert "fs snap create" not in READONLY_COMMANDS
+    assert "fs snap rm" not in READONLY_COMMANDS
 
 
 def test_proc_and_config_knobs_registered_with_defaults():

@@ -274,3 +274,98 @@ def test_import_diff_truncated_stream_raises_cleanly():
         finally:
             await c.stop()
     run(go())
+
+
+def test_rbd_snap_refusal_matrix_and_clone_teardown():
+    """The snap rm/unprotect/protect errno matrix (ref: librbd
+    Operations::snap_* return codes), the open-child race — a clone
+    minted through ANOTHER handle after this one opened must still
+    block unprotect — and the shared-blob teardown: once the last
+    child detaches, unprotect + snap rm drain every OSD-side COW
+    clone object the snapshot pinned."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            await c.client.pool_create("rbd", pg_num=8, size=3)
+            await c.wait_for_clean(timeout=90)
+            io = await c.client.open_ioctx("rbd")
+            rbd = RBD(io)
+            await rbd.create("parent", 128 << 10, order=16)
+            img = await rbd.open("parent")
+            await img.write(0, b"v1" * 8192)
+            # -- protect matrix
+            with pytest.raises(ObjectOperationError) as ei:
+                await img.snap_protect("nosnap")
+            assert ei.value.errno == -2
+            await img.snap_create("s1")
+            await img.snap_protect("s1")
+            with pytest.raises(ObjectOperationError) as ei:
+                await img.snap_protect("s1")          # already
+            assert ei.value.errno == -16
+            # -- unprotect matrix
+            with pytest.raises(ObjectOperationError) as ei:
+                await img.snap_unprotect("nosnap")
+            assert ei.value.errno == -2
+            await img.snap_create("bare")
+            with pytest.raises(ObjectOperationError) as ei:
+                await img.snap_unprotect("bare")      # never protected
+            assert ei.value.errno == -22
+            # -- the open-child race: `img` was opened BEFORE the
+            # clone exists; its in-memory children list is stale, but
+            # unprotect must re-read the header and refuse
+            await rbd.clone("parent", "s1", "child")
+            with pytest.raises(ObjectOperationError) as ei:
+                await img.snap_unprotect("s1")
+            assert ei.value.errno == -16
+            # snap rm of a protected snap refuses too
+            with pytest.raises(ObjectOperationError) as ei:
+                await img.snap_remove("s1")
+            assert ei.value.errno == -16
+            # clone prerequisites: unprotected parent snap refuses,
+            # duplicate child name refuses
+            with pytest.raises(ObjectOperationError) as ei:
+                await rbd.clone("parent", "bare", "child2")
+            assert ei.value.errno == -22
+            with pytest.raises(ObjectOperationError) as ei:
+                await rbd.clone("parent", "s1", "child")
+            assert ei.value.errno == -17
+            # image remove with snapshots refuses
+            with pytest.raises(ObjectOperationError) as ei:
+                await rbd.remove("parent")
+            assert ei.value.errno == -39
+            # the child serves the parent snapshot's bytes through
+            # layering while the head diverges (COW clones at the OSD
+            # keep s1's data: shared-blob references, not copies)
+            await img.write(0, b"v2" * 8192)
+            child = await rbd.open("child")
+            assert await child.read(0, 4) == b"v1v1"
+            assert await img.read(0, 4) == b"v2v2"
+            clones = [n for o in c.osds
+                      for cid in o.store.list_collections()
+                      for n in o.store.list_objects(cid)
+                      if n.startswith("_snapclone.")]
+            assert clones, "overwrite under a snap minted no COW clone"
+            # -- teardown in dependency order: child, unprotect, rm
+            await rbd.remove("child")
+            await img.snap_unprotect("s1")
+            await img.snap_remove("s1")
+            await img.snap_remove("bare")
+            assert await img.snap_list() == []
+            # the snapshot's COW clones drain from every OSD store
+            deadline = asyncio.get_event_loop().time() + 60.0
+            while True:
+                left = [n for o in c.osds
+                        for cid in o.store.list_collections()
+                        for n in o.store.list_objects(cid)
+                        if n.startswith("_snapclone.")]
+                if not left:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"snap trim left clone objects: {left[:4]}"
+                await asyncio.sleep(0.5)
+            # head data untouched by the trims
+            assert await img.read(0, 4) == b"v2v2"
+            await rbd.remove("parent")
+        finally:
+            await c.stop()
+    run(go())

@@ -1,0 +1,226 @@
+"""CephFS snaprealm acceptance: .snap namespace, point-in-time reads
+through the OSD COW-clone machinery, read-only walls, and realm
+survival across MDS failover and subtree migration (ref test model:
+qa/tasks/cephfs/test_snapshots.py)."""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.cephfs import FSError
+from ceph_tpu.cephfs.client import CephFSClient
+from ceph_tpu.cephfs.fsmap import FSMap
+from ceph_tpu.cephfs.mds import MDSDaemon, snap_split
+from ceph_tpu.cluster.vstart import Cluster
+from ceph_tpu.rados import ObjectOperationError
+
+FAST_CFG = {
+    "mds_beacon_interval": 0.2,
+    "mds_beacon_grace": 2.0,
+    "mds_reconnect_timeout": 1.0,
+    "mds_replay_interval": 0.1,
+    "mds_bal_interval": 0.0,
+}
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _pool(c, name="fs"):
+    await c.client.pool_create(name, pg_num=8, size=3)
+    await c.wait_for_clean(timeout=120)
+    io = await c.client.open_ioctx(name)
+    for _ in range(30):
+        try:
+            await io.write_full("_warm", b"x")
+            break
+        except ObjectOperationError:
+            await asyncio.sleep(1)
+    return io
+
+
+def test_snap_split_and_fsmap_v3():
+    """Unit pins: the .snap path parser and the v3 FSMap snap
+    registry (round-trip + realm-coverage query)."""
+    assert snap_split("/d/.snap/s1/a/b") == ("/d", "s1", "a/b")
+    assert snap_split("/d/.snap/s1") == ("/d", "s1", "")
+    assert snap_split("/d/.snap") == ("/d", "", "")
+    assert snap_split("/.snap/s1") == ("/", "s1", "")
+    assert snap_split("/d/sub/f") is None
+    m = FSMap()
+    m.snaps = {1: {"name": "s1", "path": "/d", "pool": "fs"},
+               2: {"name": "s2", "path": "/", "pool": "fs"}}
+    d = FSMap.decode(m.encode())
+    assert d.snaps == m.snaps
+    # coverage: /d/f is governed by both realms, /x only by "/"
+    assert set(d.snaps_under("/d/f")) == {1, 2}
+    assert set(d.snaps_under("/x")) == {2}
+    assert set(d.snaps_under("/d")) == {1, 2}
+    # a default map has no snaps and decodes clean
+    assert FSMap.decode(FSMap().encode()).snaps == {}
+
+
+def test_snaprealm_point_in_time_and_erofs():
+    """THE core pin: mkdir .snap/<name> freezes the subtree —
+    later head writes COW at the OSD, snap reads stay byte-identical,
+    every mutation through .snap is -EROFS, and rmsnap removes the
+    snapshot without disturbing its sibling or the heads."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            io = await _pool(c)
+            mds = MDSDaemon(io)
+            await mds.fs.mount()
+            addr = await mds.start()
+            cl_io = await c.client.open_ioctx("fs")
+            cl = await CephFSClient(cl_io, addr).mount()
+            await cl.mkdir("/d")
+            await cl.mkdir("/d/sub")
+            await cl.write_file("/d/f1", b"one" * 100)
+            await cl.write_file("/d/sub/f2", b"two" * 200)
+            await cl.mkdir("/d/.snap/s1")
+            # namespace through .snap
+            assert await cl.ls("/d/.snap") == ["s1"]
+            assert sorted(await cl.ls("/d/.snap/s1")) == ["f1", "sub"]
+            st = await cl.stat("/d/.snap/s1/f1")
+            assert st["type"] == "file" and st["size"] == 300
+            # overwrite heads; snapshot stays point-in-time
+            await cl.write_file("/d/f1", b"ONE!" * 150)
+            await cl.write_file("/d/sub/f2", b"TWO!" * 10)
+            assert await cl.read_file("/d/.snap/s1/f1") == b"one" * 100
+            assert await cl.read_file("/d/.snap/s1/sub/f2") == \
+                b"two" * 200
+            assert await cl.read_file("/d/f1") == b"ONE!" * 150
+            # read-only walls: write/create/unlink/rename in or across
+            for coro in (cl.write_file("/d/.snap/s1/f1", b"x"),
+                         cl.mkdir("/d/.snap/s1/new"),
+                         cl.unlink("/d/.snap/s1/f1"),
+                         cl.rename("/d/f1", "/d/.snap/s1/f1"),
+                         cl.rename("/d/.snap/s1/f1", "/d/out")):
+                with pytest.raises(FSError) as ei:
+                    await coro
+                assert ei.value.errno == -30          # -EROFS
+            # second snapshot sees the new content, first is unmoved
+            await cl.mkdir("/d/.snap/s2")
+            assert await cl.read_file("/d/.snap/s2/f1") == b"ONE!" * 150
+            assert await cl.read_file("/d/.snap/s1/f1") == b"one" * 100
+            with pytest.raises(FSError) as ei:
+                await cl.mkdir("/d/.snap/s1")         # dup
+            assert ei.value.errno == -17
+            # the mon is the registry of record
+            ret, _, out = await c.client.mon_command(
+                {"prefix": "fs snap ls"})
+            assert ret == 0 and len(json.loads(out)["snaps"]) == 2
+            # rmsnap: s1 gone (reads -ENOENT), s2 + heads intact
+            await cl.rmdir("/d/.snap/s1")
+            assert await cl.ls("/d/.snap") == ["s2"]
+            with pytest.raises(FSError) as ei:
+                await cl.read_file("/d/.snap/s1/f1")
+            assert ei.value.errno == -2
+            assert await cl.read_file("/d/.snap/s2/f1") == b"ONE!" * 150
+            assert await cl.read_file("/d/f1") == b"ONE!" * 150
+            await cl.unmount()
+            await mds.stop()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_snaprealm_knob_and_limit():
+    """mds_snap_enabled=false refuses mksnap -EPERM;
+    mds_snap_max_per_realm caps a realm at -EMLINK."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3).start()
+        try:
+            io = await _pool(c)
+            mds = MDSDaemon(io, config={"mds_snap_max_per_realm": 2})
+            await mds.fs.mount()
+            addr = await mds.start()
+            cl_io = await c.client.open_ioctx("fs")
+            cl = await CephFSClient(cl_io, addr).mount()
+            await cl.mkdir("/d")
+            await cl.mkdir("/d/.snap/a")
+            await cl.mkdir("/d/.snap/b")
+            with pytest.raises(FSError) as ei:
+                await cl.mkdir("/d/.snap/c")
+            assert ei.value.errno == -31              # -EMLINK
+            # knob off: NEW snapshots refuse -EPERM, existing ones
+            # still serve and can still be removed
+            mds.snap_enabled = False
+            with pytest.raises(FSError) as ei:
+                await cl.mkdir("/d/.snap/z")
+            assert ei.value.errno == -1               # -EPERM
+            await cl.mkdir("/other")      # namespace mkdir unaffected
+            assert await cl.ls("/d/.snap") == ["a", "b"]
+            await cl.rmdir("/d/.snap/a")
+            assert await cl.ls("/d/.snap") == ["b"]
+            await cl.unmount()
+            await mds.stop()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_snaprealm_survives_failover():
+    """kill -9 the active MDS after mksnap: the promoted standby
+    reloads the realm (persisted table + journaled mksnap replay) and
+    keeps serving byte-identical point-in-time reads."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config=FAST_CFG).start()
+        try:
+            await c.start_fs(n_mds=2)
+            monmap = c.client.monc.monmap
+            cl = await CephFSClient.create(monmap, None, "cephfs",
+                                           keyring=c.keyring)
+            await cl.mkdir("/d")
+            await cl.write_file("/d/f", b"pre-snap" * 64)
+            await cl.mkdir("/d/.snap/s1")
+            await cl.write_file("/d/f", b"post-snap" * 32)
+            victim = await c.wait_for_mds_active()
+            await c.kill_mds(victim)
+            await c.wait_for_mds_active(not_name=victim, timeout=30)
+            assert await cl.ls("/d/.snap") == ["s1"]
+            assert await cl.read_file("/d/.snap/s1/f") == \
+                b"pre-snap" * 64
+            assert await cl.read_file("/d/f") == b"post-snap" * 32
+            # the realm is live on the successor: new snaps still work
+            await cl.mkdir("/d/.snap/s2")
+            assert await cl.read_file("/d/.snap/s2/f") == \
+                b"post-snap" * 32
+            await cl.unmount()
+        finally:
+            await c.stop()
+    run(go())
+
+
+def test_snaprealm_rides_subtree_migration():
+    """A realm rooted in a migrated subtree moves with it: after the
+    two-phase handoff the IMPORTING rank serves .snap lookups and the
+    snapshot stays point-in-time."""
+    async def go():
+        c = await Cluster(n_mons=1, n_osds=3, config=FAST_CFG).start()
+        try:
+            await c.start_fs(n_mds=2, max_mds=2)
+            monmap = c.client.monc.monmap
+            cl = await CephFSClient.create(monmap, None, "cephfs",
+                                           keyring=c.keyring)
+            await cl.mkdir("/d")
+            await cl.write_file("/d/f", b"before" * 50)
+            await cl.mkdir("/d/.snap/s1")
+            await c.subtree_pin("/d", 1)
+            await cl.write_file("/d/f", b"after" * 99)
+            assert await cl.ls("/d/.snap") == ["s1"]
+            assert await cl.read_file("/d/.snap/s1/f") == b"before" * 50
+            assert await cl.read_file("/d/f") == b"after" * 99
+            # the importer's own realm table serves it (not a stale
+            # copy on the exporter)
+            importer = next(m for m in c.mdss
+                            if m.rank == 1 and not m._stopping)
+            assert any(r["path"] == "/d" and r["name"] == "s1"
+                       for r in importer.realms.values())
+            await cl.unmount()
+        finally:
+            await c.stop()
+    run(go())

@@ -107,3 +107,65 @@ def test_thrasher_storm_deep(tmp_path):
         finally:
             await c.stop()
     run(go())
+
+
+def test_thrasher_snap_storm_smoke(tmp_path):
+    """Snapshot-under-load honesty (MemStore tier-1 smoke): cut
+    snapshots mid-write-storm, kill an OSD after the first one, keep
+    writing, revive — every snapshot's full readback must stay
+    byte-identical to its creation-time capture and the head must
+    hold every acked write."""
+    async def go():
+        c = await Cluster(n_mons=3, n_osds=3,
+                          config=_thrash_cluster_config()).start()
+        try:
+            await c.client.pool_create("rbd", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=240)
+            io = await c.client.open_ioctx("rbd")
+            th = Thrasher(c, seed=4242, min_live_osds=2)
+            report = await th.snap_storm(io, writes=18, snaps=3,
+                                         image_kb=16)
+            assert report["snaps_verified"] == 3
+            assert report["victim"] is not None, \
+                "storm never exercised the OSD-kill path"
+            assert report["acked_writes"] > 0
+            summary = await th.settle_and_verify(io, timeout=240)
+            assert summary["killed_mons"] == 0
+        finally:
+            await c.stop()
+    run(go())
+
+
+@pytest.mark.slow
+def test_thrasher_snap_storm_deep(tmp_path):
+    """The snapshot acceptance storm on BlueStore: bigger image, more
+    snapshots, revive-via-remount (deferred replay + allocator
+    rebuild), then the full fsck — including the shared-blob refcount
+    census that cross-checks every COW clone's extent references
+    against the stored per-blob counts."""
+    async def go():
+        stores = [_mk_store(tmp_path, i) for i in range(4)]
+        c = await Cluster(n_mons=3, n_osds=4, stores=stores,
+                          config=_thrash_cluster_config()).start()
+        try:
+            await c.client.pool_create("rbd", pg_num=8, size=3,
+                                       min_size=2)
+            await c.wait_for_clean(timeout=240)
+            io = await c.client.open_ioctx("rbd")
+
+            def remount(i):
+                return _mk_store(tmp_path, i)
+
+            th = Thrasher(c, seed=777, store_factory=remount,
+                          min_live_osds=3)
+            report = await th.snap_storm(io, writes=48, snaps=5,
+                                         image_kb=64,
+                                         settle_timeout=600.0)
+            assert report["snaps_verified"] == 5
+            assert report["victim"] is not None
+            summary = await th.settle_and_verify(io, timeout=600)
+            assert summary["fscked_stores"] == 4
+        finally:
+            await c.stop()
+    run(go())
